@@ -1,0 +1,55 @@
+"""paddle_tpu: a TPU-native deep-learning framework.
+
+Capability target: PaddlePaddle Fluid 1.5 (see SURVEY.md) — same user-facing
+semantics (Program IR, Executor feed/fetch, layers/optimizers, distributed
+training) rebuilt idiomatically on JAX/XLA/Pallas/pjit:
+
+- a Program lowers to ONE XLA computation per (feed-shapes, fetch) slice;
+- autodiff = vjp over op lowerings, appended as IR grad ops;
+- parallelism = jax.sharding Mesh + GSPMD collectives over ICI, not
+  NCCL op-handles;
+- the eager path (dygraph) runs the same op registry op-by-op under jax.
+
+Top-level namespace mirrors `paddle.fluid` (reference
+python/paddle/fluid/__init__.py) so reference users can port scripts by
+changing the import.
+"""
+
+from . import ops  # noqa: F401  — registers all op lowerings
+from .framework import (Program, program_guard, default_main_program,  # noqa: F401
+                        default_startup_program, name_scope, unique_name,
+                        ParamAttr, Variable, in_dygraph_mode, cpu_places)
+from .core.place import (CPUPlace, XLAPlace, TPUPlace, CUDAPlace,  # noqa: F401
+                         CUDAPinnedPlace)
+from .core.scope import Scope, global_scope, scope_guard  # noqa: F401
+from .core.lod import LoDTensor, LoDTensorArray  # noqa: F401
+from .executor import Executor  # noqa: F401
+from .parallel.api import ParallelExecutor  # noqa: F401
+from .compiler import CompiledProgram, BuildStrategy, ExecutionStrategy  # noqa: F401
+from . import layers  # noqa: F401
+from . import initializer  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import regularizer  # noqa: F401
+from . import clip  # noqa: F401
+from . import nets  # noqa: F401
+from . import metrics  # noqa: F401
+from . import io  # noqa: F401
+from . import dygraph  # noqa: F401
+from . import profiler  # noqa: F401
+from .backward import append_backward, gradients  # noqa: F401
+from .data_feeder import DataFeeder  # noqa: F401
+from .reader import DataLoader, PyReader  # noqa: F401
+from .clip import set_gradient_clip  # noqa: F401
+from .install_check import run_check  # noqa: F401
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """fluid.data: batch dim NOT auto-prepended (reference data.py)."""
+    return layers.data(name, shape, append_batch_size=False, dtype=dtype,
+                       lod_level=lod_level)
+
+
+embedding = layers.embedding
+one_hot = layers.one_hot
+
+__version__ = "0.1.0"

@@ -1,0 +1,116 @@
+"""CompiledProgram: parallel/optimized execution configuration.
+
+Reference: compiler.py:138 CompiledProgram.with_data_parallel constructs a
+ParallelExecutor — per-device graph clones + NCCL AllReduce op-handles
+(parallel_executor.cc:393, multi_devices_graph_pass.cc:454). On TPU none of
+that machinery exists as code you schedule: the SAME step function is jitted
+with batch-sharded feed shardings over a jax Mesh, and XLA GSPMD inserts the
+gradient all-reduces over ICI. BuildStrategy knobs that configured the graph
+passes (fuse_all_reduce, etc.) become no-ops — XLA owns fusion — but remain
+accepted for source compatibility.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["CompiledProgram", "BuildStrategy", "ExecutionStrategy"]
+
+
+class BuildStrategy:
+    """Knob-compatible with fluid.BuildStrategy (build_strategy.h).
+
+    reduce_strategy/gradient_scale_strategy etc. are accepted; on TPU the
+    equivalents are handled by GSPMD sharding propagation.
+    """
+
+    class ReduceStrategy:
+        AllReduce = 0
+        Reduce = 1
+
+    class GradientScaleStrategy:
+        CoeffNumDevice = 0
+        One = 1
+        Customized = 2
+
+    def __init__(self):
+        self.reduce_strategy = BuildStrategy.ReduceStrategy.AllReduce
+        self.gradient_scale_strategy = \
+            BuildStrategy.GradientScaleStrategy.CoeffNumDevice
+        self.fuse_all_reduce_ops = True
+        self.fuse_elewise_add_act_ops = True
+        self.fuse_all_optimizer_ops = True
+        self.sync_batch_norm = False
+        self.enable_inplace = True
+        self.memory_optimize = True
+        self.nccl_comm_num = 1
+        self.use_hierarchical_allreduce = False
+        self.num_trainers = 1
+        self.trainer_id = 0
+
+
+class ExecutionStrategy:
+    """fluid.ExecutionStrategy (pybind.cc:1655) — scheduling knobs.
+    XLA owns scheduling; fields kept for compatibility."""
+
+    def __init__(self):
+        self.num_threads = 0
+        self.num_iteration_per_drop_scope = 1
+        self.num_iteration_per_run = 1
+        self.use_experimental_executor = False
+
+
+class CompiledProgram:
+    def __init__(self, program_or_graph, build_strategy: Optional[
+            BuildStrategy] = None):
+        self.program = program_or_graph
+        self.build_strategy = build_strategy or BuildStrategy()
+        self.exec_strategy = None
+        self._is_data_parallel = False
+        self._loss_name = None
+        self._places = None
+        self._mesh = None
+
+    def with_data_parallel(self, loss_name=None, build_strategy=None,
+                           exec_strategy=None, share_vars_from=None,
+                           places=None):
+        self._is_data_parallel = True
+        self._loss_name = loss_name
+        if build_strategy is not None:
+            self.build_strategy = build_strategy
+        self.exec_strategy = exec_strategy
+        self._places = places
+        return self
+
+    # -- executor hook ---------------------------------------------------
+    def mesh(self) -> Mesh:
+        if self._mesh is None:
+            devs = np.array(jax.devices())
+            self._mesh = Mesh(devs, axis_names=("dp",))
+        return self._mesh
+
+    def build_jit(self, step_fn, state_in_names, feed_arrays):
+        """jit `step_fn(state, feeds, step_idx)` with DP shardings:
+        feeds sharded on batch axis over the mesh, state replicated.
+        GSPMD then emits the gradient AllReduces over ICI — the entire
+        reference multi-device scheduler (SURVEY.md §2.1 details/) reduces
+        to these in_shardings."""
+        if not self._is_data_parallel or len(jax.devices()) == 1:
+            return jax.jit(step_fn, donate_argnums=(0,))
+        mesh = self.mesh()
+        repl = NamedSharding(mesh, P())
+        batch = NamedSharding(mesh, P("dp"))
+        state_shard = {n: repl for n in state_in_names}
+        feed_shard = {}
+        ndev = len(mesh.devices.reshape(-1))
+        for n, a in feed_arrays.items():
+            if a.ndim >= 1 and a.shape[0] % ndev == 0:
+                feed_shard[n] = batch
+            else:
+                feed_shard[n] = repl
+        return jax.jit(step_fn, donate_argnums=(0,),
+                       in_shardings=(state_shard, feed_shard, repl),
+                       out_shardings=None)

@@ -1,0 +1,58 @@
+"""Dtype registry for the Program IR.
+
+The reference keeps dtypes in the VarType proto
+(/root/reference/paddle/fluid/framework/framework.proto:105). Here dtypes are
+plain strings canonicalised to numpy/jax dtypes; bf16 is first-class because
+it is the native TPU matmul type.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+try:  # jax.numpy provides bfloat16 via ml_dtypes
+    import ml_dtypes
+
+    bfloat16 = np.dtype(ml_dtypes.bfloat16)
+except ImportError:  # pragma: no cover
+    import jax.numpy as jnp
+
+    bfloat16 = np.dtype(jnp.bfloat16)
+
+_CANON = {
+    "float32": np.dtype("float32"),
+    "fp32": np.dtype("float32"),
+    "float64": np.dtype("float64"),
+    "fp64": np.dtype("float64"),
+    "float16": np.dtype("float16"),
+    "fp16": np.dtype("float16"),
+    "bfloat16": bfloat16,
+    "bf16": bfloat16,
+    "int8": np.dtype("int8"),
+    "uint8": np.dtype("uint8"),
+    "int16": np.dtype("int16"),
+    "int32": np.dtype("int32"),
+    "int64": np.dtype("int64"),
+    "bool": np.dtype("bool"),
+}
+
+
+def convert_dtype(dtype) -> str:
+    """Canonicalise any dtype spec (str, np.dtype, jnp dtype) to a string name."""
+    if dtype is None:
+        return "float32"
+    if isinstance(dtype, str):
+        if dtype not in _CANON:
+            raise ValueError(f"unsupported dtype {dtype!r}")
+        return str(np.dtype(_CANON[dtype]))
+    d = np.dtype(dtype)
+    return "bfloat16" if d == bfloat16 else d.name
+
+
+def as_np_dtype(dtype) -> np.dtype:
+    name = convert_dtype(dtype)
+    return _CANON[name] if name in _CANON else np.dtype(name)
+
+
+def is_floating(dtype) -> bool:
+    name = convert_dtype(dtype)
+    return name in ("float16", "float32", "float64", "bfloat16")

@@ -1,0 +1,84 @@
+"""LoDTensor: host-side tensor wrapper with level-of-detail metadata.
+
+Reference: framework::LoDTensor (lod_tensor.h:104) — a dense buffer plus
+`LoD = vector<vector<size_t>>` ragged-sequence offsets. On TPU the device
+representation is always dense (XLA static shapes); LoD lives host-side and
+sequence ops take (padded, lengths) pairs (ops/sequence_ops.py). This class
+preserves the user-facing API: set_lod/lod/recursive_sequence_lengths.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+class LoDTensor:
+    def __init__(self, data=None, lod=None):
+        self._data = np.asarray(data) if data is not None else None
+        self._lod = [list(level) for level in (lod or [])]
+
+    # -- fluid API -------------------------------------------------------
+    def set(self, data, place=None):
+        self._data = np.asarray(data)
+
+    def set_lod(self, lod):
+        self._lod = [list(level) for level in lod]
+
+    def lod(self):
+        return self._lod
+
+    def set_recursive_sequence_lengths(self, lengths):
+        self._lod = []
+        for level in lengths:
+            offsets = [0]
+            for n in level:
+                offsets.append(offsets[-1] + n)
+            self._lod.append(offsets)
+
+    def recursive_sequence_lengths(self):
+        out = []
+        for level in self._lod:
+            out.append([level[i + 1] - level[i]
+                        for i in range(len(level) - 1)])
+        return out
+
+    def has_valid_recursive_sequence_lengths(self):
+        for level in self._lod:
+            if any(level[i] > level[i + 1] for i in range(len(level) - 1)):
+                return False
+        return True
+
+    def shape(self):
+        return list(self._data.shape)
+
+    def numpy_value(self):
+        return self._data
+
+    def __array__(self, dtype=None):
+        return self._data if dtype is None else self._data.astype(dtype)
+
+    # Pack ragged rows into (padded, lengths) for sequence ops.
+    def to_padded(self, pad_value=0.0):
+        if not self._lod:
+            return self._data, None
+        level = self._lod[-1]
+        lengths = np.asarray([level[i + 1] - level[i]
+                              for i in range(len(level) - 1)])
+        maxlen = int(lengths.max()) if len(lengths) else 0
+        feat = self._data.shape[1:]
+        out = np.full((len(lengths), maxlen) + feat, pad_value,
+                      self._data.dtype)
+        for i in range(len(lengths)):
+            out[i, :lengths[i]] = self._data[level[i]:level[i + 1]]
+        return out, lengths
+
+    @staticmethod
+    def from_ragged(rows, dtype="float32"):
+        data = np.concatenate([np.asarray(r, dtype) for r in rows], axis=0)
+        t = LoDTensor(data)
+        t.set_recursive_sequence_lengths([[len(r) for r in rows]])
+        return t
+
+
+class LoDTensorArray(list):
+    """reference: LoDTensorArray = vector<LoDTensor>."""
+    pass

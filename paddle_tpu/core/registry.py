@@ -1,0 +1,104 @@
+"""Op registry: op type -> JAX lowering + metadata.
+
+Reference analogue: OpInfoMap + REGISTER_OPERATOR / REGISTER_OP_*_KERNEL
+(/root/reference/paddle/fluid/framework/op_registry.h:199-270). On TPU there
+is no per-device kernel table: every op registers ONE lowering — a pure JAX
+function — and XLA owns fusion/placement. Pallas kernels are just lowerings
+that call pallas_call.
+
+Gradients: the reference requires a hand-written GradOpMaker per op
+(grad_op_desc_maker.h:36). Here the default grad maker is *generic*: backward
+rewrites insert a `grad:<type>` op whose lowering runs `jax.vjp` over the
+forward lowering. XLA CSE merges the recomputed forward with the original, so
+this costs nothing at runtime and removes ~500 hand-written grad kernels.
+Ops can still register a manual_grad lowering when vjp is wrong (e.g.
+straight-through estimators) or a custom grad maker for program-level rewrites.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, Optional, Sequence
+
+
+@dataclasses.dataclass
+class OpDef:
+    type: str
+    # lower(ctx, ins, attrs) -> outs.
+    #   ins:  {slot_name: [jax arrays]}   outs: {slot_name: [jax arrays]}
+    lower: Callable
+    # Input slots that are not differentiable (indices, labels, masks...).
+    nondiff_inputs: Sequence[str] = ()
+    # Output slots that are not differentiable (argmax indices...).
+    nondiff_outputs: Sequence[str] = ()
+    # Uses ctx.rng (dropout, uniform_random...). Such ops get a deterministic
+    # per-op PRNG key so the generic vjp grad sees the identical randomness.
+    stateful: bool = False
+    # Optional manual grad lowering: (ctx, ins, attrs) -> {input_slot: grads}
+    # where ins additionally contains "<slot>@GRAD" entries for outputs.
+    manual_grad: Optional[Callable] = None
+    # If set, backward uses this to emit grad ops instead of the generic one:
+    # f(op, grad_name_of: dict out_var->grad_var) -> (list[op_spec], dict in_var->grad_var)
+    custom_grad_maker: Optional[Callable] = None
+    # Marks ops that mutate persistable state (optimizer updates): their
+    # outputs may alias inputs by var name (ParamOut == Param).
+    inplace: bool = False
+
+
+class OpRegistry:
+    def __init__(self):
+        self._ops: Dict[str, OpDef] = {}
+
+    def register(self, opdef: OpDef):
+        if opdef.type in self._ops:
+            raise ValueError(f"op {opdef.type!r} already registered")
+        self._ops[opdef.type] = opdef
+        return opdef
+
+    def get(self, op_type: str) -> OpDef:
+        try:
+            return self._ops[op_type]
+        except KeyError:
+            raise NotImplementedError(
+                f"op {op_type!r} has no registered TPU lowering "
+                f"({len(self._ops)} ops registered)"
+            ) from None
+
+    def has(self, op_type: str) -> bool:
+        return op_type in self._ops
+
+    def types(self):
+        return sorted(self._ops)
+
+
+REGISTRY = OpRegistry()
+
+
+def register_op(op_type, *, nondiff_inputs=(), nondiff_outputs=(), stateful=False,
+                manual_grad=None, custom_grad_maker=None, inplace=False):
+    """Decorator: @register_op("mul") def _mul(ctx, ins, attrs): ..."""
+
+    def deco(fn):
+        REGISTRY.register(OpDef(
+            type=op_type, lower=fn,
+            nondiff_inputs=tuple(nondiff_inputs),
+            nondiff_outputs=tuple(nondiff_outputs),
+            stateful=stateful, manual_grad=manual_grad,
+            custom_grad_maker=custom_grad_maker, inplace=inplace))
+        return fn
+
+    return deco
+
+
+def simple_op(op_type, in_slots, out_slots, fn, **kw):
+    """Register an op whose lowering is elementwise-style positional:
+    fn(*arrays, **attrs) -> array or tuple of arrays."""
+
+    def lower(ctx, ins, attrs):
+        args = [ins[s][0] for s in in_slots]
+        out = fn(*args, **attrs)
+        if not isinstance(out, tuple):
+            out = (out,)
+        return {s: [o] for s, o in zip(out_slots, out)}
+
+    REGISTRY.register(OpDef(type=op_type, lower=lower, **kw))
+    return lower

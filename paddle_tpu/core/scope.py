@@ -1,0 +1,86 @@
+"""Scope: name -> value store for persistable state.
+
+Reference analogue: framework::Scope (scope.h:46) holding type-erased
+Variables. Here a Scope maps var names to device arrays (jax.Array) or host
+numpy arrays; the Executor donates the persistable sub-dict into each jitted
+step so parameter updates are in-place at the XLA buffer level — the
+functional-JAX answer to the reference's mutable-Scope optimizer kernels.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, Optional
+
+import numpy as np
+
+
+class Scope:
+    def __init__(self, parent: Optional["Scope"] = None):
+        self._vars: Dict[str, object] = {}
+        self.parent = parent
+        self._kids = []
+
+    def var(self, name):
+        """Create-if-missing (scope.h:62 Var)."""
+        if name not in self._vars:
+            self._vars[name] = None
+        return name
+
+    def find_var(self, name):
+        s = self
+        while s is not None:
+            if name in s._vars:
+                return s._vars[name]
+            s = s.parent
+        return None
+
+    def has(self, name):
+        s = self
+        while s is not None:
+            if name in s._vars and s._vars[name] is not None:
+                return True
+            s = s.parent
+        return False
+
+    def set(self, name, value):
+        self._vars[name] = value
+
+    def get(self, name):
+        v = self.find_var(name)
+        if v is None:
+            raise KeyError(f"var {name!r} not initialised in scope")
+        return v
+
+    def get_numpy(self, name) -> np.ndarray:
+        return np.asarray(self.get(name))
+
+    def new_scope(self) -> "Scope":
+        kid = Scope(parent=self)
+        self._kids.append(kid)
+        return kid
+
+    def drop_kids(self):
+        self._kids.clear()
+
+    def names(self):
+        return list(self._vars)
+
+    def delete(self, name):
+        self._vars.pop(name, None)
+
+
+_global_scope = Scope()
+_scope_stack = [_global_scope]
+
+
+def global_scope() -> Scope:
+    return _scope_stack[-1]
+
+
+@contextlib.contextmanager
+def scope_guard(scope: Scope):
+    _scope_stack.append(scope)
+    try:
+        yield
+    finally:
+        _scope_stack.pop()

@@ -1,0 +1,40 @@
+"""DataFeeder: minibatch rows -> feed dict (reference data_feeder.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+from .core.dtypes import as_np_dtype
+from .core.lod import LoDTensor
+from .framework import Variable
+
+__all__ = ["DataFeeder"]
+
+
+class DataFeeder:
+    def __init__(self, feed_list, place=None, program=None):
+        self.feed_vars = []
+        for v in feed_list:
+            if isinstance(v, str):
+                from .framework import default_main_program
+                v = (program or default_main_program()).global_block().var(v)
+            self.feed_vars.append(v)
+        self.place = place
+
+    def feed(self, iterable):
+        """iterable: list of tuples, one per example, fields aligned with
+        feed_list. Ragged (lod_level>0) fields become LoDTensors."""
+        columns = list(zip(*iterable))
+        out = {}
+        for var, col in zip(self.feed_vars, columns):
+            dtype = as_np_dtype(var.dtype)
+            if var.lod_level > 0:
+                out[var.name] = LoDTensor.from_ragged(col, dtype)
+                continue
+            arrs = [np.asarray(c, dtype=dtype) for c in col]
+            batch = np.stack(arrs, axis=0)
+            want = [d for d in (var.shape or []) if d != -1]
+            if want and list(batch.shape[1:]) != want and \
+                    int(np.prod(batch.shape[1:])) == int(np.prod(want)):
+                batch = batch.reshape([batch.shape[0]] + want)
+            out[var.name] = batch
+        return out

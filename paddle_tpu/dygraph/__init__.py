@@ -1,0 +1,248 @@
+"""Dygraph: eager execution over the same op registry.
+
+Reference: paddle/fluid/imperative/ — Tracer::TraceOp runs the kernel
+immediately and records OpBase grad nodes (tracer.cc:45,86); BasicEngine
+walks them on backward() (engine.h:69). Here TraceOp runs the op's JAX
+lowering eagerly (jax is itself an eager-dispatch runtime on TPU) and
+records a tape entry; backward() replays the tape in reverse through the
+same generic-vjp machinery the static graph uses (core/lowering.py) — one
+autograd implementation for both modes.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dtypes import as_np_dtype, is_floating
+from ..core.registry import REGISTRY
+
+__all__ = ["guard", "enabled", "to_variable", "VarBase", "trace_op",
+           "Layer", "no_grad", "save_dygraph", "load_dygraph"]
+
+_state = {"enabled": False, "tape": None, "op_counter": 0, "seed": 0,
+          "is_test": False}
+
+
+def enabled():
+    return _state["enabled"]
+
+
+@contextlib.contextmanager
+def guard(place=None):
+    old = dict(_state)
+    _state.update(enabled=True, tape=[], op_counter=0)
+    try:
+        yield
+    finally:
+        _state.update(old)
+
+
+@contextlib.contextmanager
+def no_grad():
+    old_tape = _state["tape"]
+    _state["tape"] = None
+    try:
+        yield
+    finally:
+        _state["tape"] = old_tape
+
+
+class VarBase:
+    """Eager tensor + autograd leaf (imperative/layer.h:55)."""
+
+    _counter = [0]
+
+    def __init__(self, value, name=None, stop_gradient=False,
+                 persistable=False, trainable=True):
+        self.value = value if isinstance(value, jax.Array) else \
+            jnp.asarray(value)
+        VarBase._counter[0] += 1
+        self.name = name or f"eager_{VarBase._counter[0]}"
+        self.stop_gradient = stop_gradient
+        self.persistable = persistable
+        self.trainable = trainable
+        self.grad: Optional[jax.Array] = None
+
+    @property
+    def shape(self):
+        return tuple(self.value.shape)
+
+    @property
+    def dtype(self):
+        return jnp.dtype(self.value.dtype).name
+
+    def numpy(self):
+        return np.asarray(self.value)
+
+    def set_value(self, v):
+        self.value = jnp.asarray(v)
+
+    def clear_gradient(self):
+        self.grad = None
+
+    def gradient(self):
+        return None if self.grad is None else np.asarray(self.grad)
+
+    def detach(self):
+        return VarBase(self.value, stop_gradient=True)
+
+    def astype(self, dtype):
+        return trace_op("cast", {"X": [self]},
+                        {"out_dtype": str(dtype)})["Out"][0]
+
+    def backward(self):
+        _run_backward(self)
+
+    # operator sugar
+    def _bin(self, other, op):
+        if not isinstance(other, VarBase):
+            other = VarBase(jnp.asarray(other, self.value.dtype),
+                            stop_gradient=True)
+        return trace_op(op, {"X": [self], "Y": [other]}, {})["Out"][0]
+
+    def __add__(self, o):
+        return self._bin(o, "elementwise_add")
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._bin(o, "elementwise_sub")
+
+    def __mul__(self, o):
+        return self._bin(o, "elementwise_mul")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._bin(o, "elementwise_div")
+
+    def __repr__(self):
+        return f"VarBase({self.name}, shape={self.shape})\n{self.numpy()}"
+
+
+def to_variable(value, name=None, zero_copy=None):
+    if isinstance(value, VarBase):
+        return value
+    return VarBase(jnp.asarray(value), name=name, stop_gradient=True)
+
+
+class _EagerCtx:
+    def __init__(self, op_id):
+        self.is_test = _state["is_test"]
+        self.mesh = None
+        key = jax.random.PRNGKey(_state["seed"])
+        self._key = jax.random.fold_in(key, np.uint32(op_id))
+
+    @property
+    def rng(self):
+        return self._key
+
+
+class _TapeEntry:
+    __slots__ = ("op_type", "attrs", "ins", "outs", "op_id")
+
+    def __init__(self, op_type, attrs, ins, outs, op_id):
+        self.op_type = op_type
+        self.attrs = attrs
+        self.ins = ins      # {slot: [VarBase]}
+        self.outs = outs    # {slot: [VarBase]}
+        self.op_id = op_id
+
+
+def trace_op(op_type, ins: Dict[str, List[VarBase]], attrs) -> Dict[
+        str, List[VarBase]]:
+    """Run one op eagerly; record on the tape (tracer.cc:45 TraceOp)."""
+    opdef = REGISTRY.get(op_type)
+    _state["op_counter"] += 1
+    op_id = _state["op_counter"]
+    ctx = _EagerCtx(op_id)
+    arr_ins = {s: [v.value for v in vs] for s, vs in ins.items() if vs}
+    arr_outs = opdef.lower(ctx, arr_ins, attrs)
+    outs = {s: [VarBase(a) for a in arrs] for s, arrs in arr_outs.items()}
+    tape = _state["tape"]
+    needs_grad = any(not v.stop_gradient for vs in ins.values() for v in vs)
+    if tape is not None and needs_grad and not opdef.inplace:
+        tape.append(_TapeEntry(op_type, dict(attrs), ins, outs, op_id))
+    else:
+        for vs in outs.values():
+            for v in vs:
+                v.stop_gradient = True
+    return outs
+
+
+def _run_backward(loss: VarBase):
+    """BasicEngine::Execute (engine.h:69): reverse-tape vjp replay with
+    gradient accumulation (gradient_accumulator.cc)."""
+    tape = _state["tape"]
+    if tape is None:
+        raise RuntimeError("backward() outside dygraph guard")
+    grads: Dict[int, jax.Array] = {
+        id(loss): jnp.ones(loss.shape, loss.value.dtype)}
+    var_of: Dict[int, VarBase] = {id(loss): loss}
+
+    for entry in reversed(tape):
+        opdef = REGISTRY.get(entry.op_type)
+        out_cots = {}
+        any_grad = False
+        for slot, vs in entry.outs.items():
+            if slot in opdef.nondiff_outputs:
+                continue
+            cots = []
+            for v in vs:
+                g = grads.get(id(v))
+                any_grad = any_grad or g is not None
+                cots.append(g)
+            out_cots[slot] = cots
+        if not any_grad:
+            continue
+
+        ctx = _EagerCtx(entry.op_id)
+        arr_ins = {s: [v.value for v in vs]
+                   for s, vs in entry.ins.items() if vs}
+        diff_slots = [
+            s for s, vs in entry.ins.items()
+            if s not in opdef.nondiff_inputs
+            and all(is_floating(v.value.dtype) for v in vs)
+            and any(not v.stop_gradient for v in vs)]
+        if not diff_slots:
+            continue
+        nondiff = {s: arr_ins[s] for s in arr_ins if s not in diff_slots}
+
+        def f(diff):
+            full = dict(nondiff)
+            full.update(diff)
+            outs = opdef.lower(ctx, full, entry.attrs)
+            return {s: outs[s] for s in out_cots if s in outs}
+
+        diff_in = {s: arr_ins[s] for s in diff_slots}
+        primal, vjp = jax.vjp(f, diff_in)
+        cots = {}
+        for slot, prim in primal.items():
+            given = out_cots.get(slot, [None] * len(prim))
+            cots[slot] = [g if g is not None else jnp.zeros(p.shape, p.dtype)
+                          for g, p in zip(given, prim)]
+        (gin,) = vjp(cots)
+        for slot, garrs in gin.items():
+            for v, g in zip(entry.ins[slot], garrs):
+                if v.stop_gradient:
+                    continue
+                prev = grads.get(id(v))
+                grads[id(v)] = g if prev is None else prev + g
+                var_of[id(v)] = v
+
+    for vid, g in grads.items():
+        v = var_of[vid]
+        if v.trainable and not v.stop_gradient:
+            v.grad = g if v.grad is None else v.grad + g
+
+
+from .layers import Layer  # noqa: E402,F401
+from .checkpoint import save_dygraph, load_dygraph  # noqa: E402,F401
+from .nn import (Conv2D, Pool2D, FC, Linear, BatchNorm, Embedding,  # noqa: E402,F401
+                 LayerNorm, Dropout)
+from .parallel import DataParallel, prepare_context  # noqa: E402,F401
+from .base import grad  # noqa: E402,F401

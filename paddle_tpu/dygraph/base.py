@@ -1,0 +1,23 @@
+"""Dygraph functional helpers (reference dygraph/base.py)."""
+from __future__ import annotations
+
+from . import VarBase, _run_backward, _state
+
+__all__ = ["grad"]
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None):
+    """paddle.grad-style double-grad entry: re-runs tape backward and
+    collects input grads without mutating .grad on leaves."""
+    outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    saved = {id(p): p.grad for p in inputs}
+    for p in inputs:
+        p.grad = None
+    _run_backward(outputs[0])
+    out = [p.grad for p in inputs]
+    for p in inputs:
+        p.grad = saved[id(p)]
+    return out

@@ -1,0 +1,19 @@
+"""Dygraph save/load (reference dygraph/checkpoint.py)."""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+__all__ = ["save_dygraph", "load_dygraph"]
+
+
+def save_dygraph(state_dict, model_path):
+    os.makedirs(os.path.dirname(model_path) or ".", exist_ok=True)
+    np.savez(model_path + ".pdparams",
+             **{k: np.asarray(v) for k, v in state_dict.items()})
+
+
+def load_dygraph(model_path):
+    blob = np.load(model_path + ".pdparams")
+    return {k: blob[k] for k in blob.files}, None

@@ -1,0 +1,162 @@
+"""dygraph.Layer base class (reference: dygraph/layers.py:33)."""
+from __future__ import annotations
+
+from typing import Dict, Iterator, Tuple
+
+import numpy as np
+
+from . import VarBase
+
+__all__ = ["Layer"]
+
+
+class Layer:
+    def __init__(self, name_scope=None, dtype="float32"):
+        self._parameters: Dict[str, VarBase] = {}
+        self._sub_layers: Dict[str, "Layer"] = {}
+        self._full_name = name_scope or type(self).__name__.lower()
+        self._dtype = dtype
+        self.training = True
+
+    def full_name(self):
+        return self._full_name
+
+    # -- parameter management -------------------------------------------
+    def create_parameter(self, shape, dtype="float32", initializer=None,
+                         is_bias=False, attr=None):
+        import jax
+        import jax.numpy as jnp
+        from ..core.dtypes import as_np_dtype
+        from ..initializer import Constant, Xavier
+        init = initializer or (attr.initializer if attr is not None and
+                               getattr(attr, "initializer", None) else None)
+        shape = [int(s) for s in shape]
+        key = jax.random.PRNGKey(np.random.randint(0, 2 ** 31))
+        npdtype = as_np_dtype(dtype)
+        if init is None:
+            init = Constant(0.0) if is_bias else Xavier()
+        value = _materialise_init(init, shape, npdtype, key)
+        p = VarBase(jnp.asarray(value), persistable=True,
+                    stop_gradient=False)
+        return p
+
+    def add_parameter(self, name, param):
+        self._parameters[name] = param
+        return param
+
+    def add_sublayer(self, name, layer):
+        self._sub_layers[name] = layer
+        return layer
+
+    def parameters(self, include_sublayers=True):
+        out = list(self._parameters.values())
+        if include_sublayers:
+            for sub in self._sub_layers.values():
+                out.extend(sub.parameters())
+        return out
+
+    def named_parameters(self, prefix="") -> Iterator[Tuple[str, VarBase]]:
+        for n, p in self._parameters.items():
+            yield (f"{prefix}.{n}" if prefix else n), p
+        for sn, sub in self._sub_layers.items():
+            yield from sub.named_parameters(
+                f"{prefix}.{sn}" if prefix else sn)
+
+    def sublayers(self, include_sublayers=True):
+        out = list(self._sub_layers.values())
+        if include_sublayers:
+            for s in self._sub_layers.values():
+                out.extend(s.sublayers())
+        return out
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_gradient()
+
+    # -- train/eval ------------------------------------------------------
+    def train(self):
+        from . import _state
+        _state["is_test"] = False
+        self.training = True
+        for s in self.sublayers():
+            s.training = True
+
+    def eval(self):
+        from . import _state
+        _state["is_test"] = True
+        self.training = False
+        for s in self.sublayers():
+            s.training = False
+
+    # -- state dict ------------------------------------------------------
+    def state_dict(self, include_sublayers=True):
+        return {n: p.numpy() for n, p in self.named_parameters()}
+
+    def set_dict(self, state, include_sublayers=True):
+        import jax.numpy as jnp
+        for n, p in self.named_parameters():
+            if n in state:
+                p.value = jnp.asarray(state[n])
+
+    load_dict = set_dict
+
+    # -- call ------------------------------------------------------------
+    def __call__(self, *args, **kwargs):
+        return self.forward(*args, **kwargs)
+
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def __setattr__(self, name, value):
+        if isinstance(value, VarBase) and value.persistable:
+            self.__dict__.setdefault("_parameters", {})[name] = value
+        elif isinstance(value, Layer):
+            self.__dict__.setdefault("_sub_layers", {})[name] = value
+        object.__setattr__(self, name, value)
+
+
+def _materialise_init(init, shape, dtype, key):
+    """Run an initializer spec eagerly (reference initializers emit startup
+    ops; eager mode materialises directly)."""
+    import jax
+    import math
+    import numpy as np
+    from .. import initializer as I
+    if isinstance(init, I.ConstantInitializer):
+        return np.full(shape, init.value, dtype)
+    if isinstance(init, I.UniformInitializer):
+        return np.asarray(jax.random.uniform(
+            key, shape, minval=init.low, maxval=init.high)).astype(dtype)
+    if isinstance(init, I.NormalInitializer):
+        return np.asarray(jax.random.normal(key, shape) * init.scale +
+                          init.loc).astype(dtype)
+    if isinstance(init, I.TruncatedNormalInitializer):
+        return np.asarray(jax.random.truncated_normal(
+            key, -2.0, 2.0, shape) * init.scale + init.loc).astype(dtype)
+    if isinstance(init, I.XavierInitializer):
+        fin, fout = I._fans(_Shaped(shape))
+        fin = init.fan_in if init.fan_in is not None else fin
+        fout = init.fan_out if init.fan_out is not None else fout
+        if init.uniform:
+            lim = math.sqrt(6.0 / (fin + fout))
+            return np.asarray(jax.random.uniform(
+                key, shape, minval=-lim, maxval=lim)).astype(dtype)
+        std = math.sqrt(2.0 / (fin + fout))
+        return np.asarray(jax.random.normal(key, shape) * std).astype(dtype)
+    if isinstance(init, I.MSRAInitializer):
+        fin, _ = I._fans(_Shaped(shape))
+        fin = init.fan_in if init.fan_in is not None else fin
+        if init.uniform:
+            lim = math.sqrt(6.0 / fin)
+            return np.asarray(jax.random.uniform(
+                key, shape, minval=-lim, maxval=lim)).astype(dtype)
+        return np.asarray(jax.random.normal(key, shape) *
+                          math.sqrt(2.0 / fin)).astype(dtype)
+    if isinstance(init, I.NumpyArrayInitializer):
+        return np.asarray(init.value, dtype).reshape(shape)
+    raise TypeError(f"unsupported initializer {init!r} in dygraph")
+
+
+class _Shaped:
+    def __init__(self, shape):
+        self.shape = tuple(shape)

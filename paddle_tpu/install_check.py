@@ -1,0 +1,29 @@
+"""fluid.install_check.run_check equivalent (reference install_check.py):
+train a tiny fc for one step on the default device, then once more under
+the data-parallel compiled path."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["run_check"]
+
+
+def run_check():
+    from . import (CompiledProgram, Executor, Program, layers, optimizer,
+                   program_guard)
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = layers.data("install_check_x", shape=[2], dtype="float32")
+        y = layers.fc(x, size=1)
+        loss = layers.mean(y)
+        optimizer.SGD(learning_rate=0.01).minimize(loss)
+
+    exe = Executor()
+    exe.run(startup)
+    feed = {"install_check_x": np.ones((4, 2), np.float32)}
+    exe.run(main, feed=feed, fetch_list=[loss])
+    compiled = CompiledProgram(main).with_data_parallel(
+        loss_name=loss.name)
+    exe.run(compiled, feed=feed, fetch_list=[loss])
+    print("Your paddle_tpu works well on this machine.")

@@ -1,0 +1,192 @@
+"""Checkpointing + inference-model export.
+
+Reference: python/paddle/fluid/io.py — save_vars/save_persistables emit
+save ops (operators/save_op.cc); save_inference_model prunes to the
+feed→fetch subgraph (io.py:997). Here persistence is host-side (numpy .npz
+per-var files, program JSON) — the wire format is ours, the semantics match:
+save/load_persistables round-trips training state, save/load_inference_model
+exports a pruned program + params that Executor.run can serve directly.
+Sharded (orbax-style) checkpoints for multi-host land with the fleet path.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from .core.scope import global_scope
+from .framework import Program, Variable
+
+__all__ = ["save_vars", "save_params", "save_persistables", "load_vars",
+           "load_params", "load_persistables", "save_inference_model",
+           "load_inference_model", "save", "load", "batch"]
+
+
+def _var_path(dirname, name):
+    return os.path.join(dirname, name.replace("/", "%2F"))
+
+
+def save_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    from .framework import default_main_program
+    program = main_program or default_main_program()
+    if vars is None:
+        vars = [v for v in program.list_vars() if predicate is None or
+                predicate(v)]
+    os.makedirs(dirname, exist_ok=True)
+    scope = global_scope()
+    if filename is not None:
+        blob = {}
+        for v in vars:
+            if scope.has(v.name):
+                blob[v.name] = scope.get_numpy(v.name)
+        np.savez(os.path.join(dirname, filename), **blob)
+        return
+    for v in vars:
+        if scope.has(v.name):
+            np.save(_var_path(dirname, v.name) + ".npy",
+                    scope.get_numpy(v.name))
+
+
+def _is_persistable(v: Variable):
+    return v.persistable and not v.is_data
+
+
+def _is_param(v: Variable):
+    return v.is_parameter
+
+
+def save_params(executor, dirname, main_program=None, filename=None):
+    return save_vars(executor, dirname, main_program, None, _is_param,
+                     filename)
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    return save_vars(executor, dirname, main_program, None, _is_persistable,
+                     filename)
+
+
+def load_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    from .framework import default_main_program
+    program = main_program or default_main_program()
+    if vars is None:
+        vars = [v for v in program.list_vars() if predicate is None or
+                predicate(v)]
+    scope = global_scope()
+    if filename is not None:
+        blob = np.load(os.path.join(dirname, filename))
+        for v in vars:
+            if v.name in blob:
+                scope.set(v.name, blob[v.name])
+        return
+    for v in vars:
+        path = _var_path(dirname, v.name) + ".npy"
+        if os.path.exists(path):
+            scope.set(v.name, np.load(path))
+
+
+def load_params(executor, dirname, main_program=None, filename=None):
+    return load_vars(executor, dirname, main_program, None, _is_param,
+                     filename)
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    return load_vars(executor, dirname, main_program, None, _is_persistable,
+                     filename)
+
+
+def _prune_for_inference(program: Program, feed_names: List[str],
+                         fetch_names: List[str]) -> Program:
+    """Keep only ops needed to compute fetches from feeds
+    (reference: framework/prune.cc + Program._prune)."""
+    pruned = program.clone(for_test=True)
+    block = pruned.global_block()
+    needed = set(fetch_names)
+    keep = []
+    for op in reversed(block.ops):
+        out_names = set(op.output_names())
+        if out_names & needed:
+            keep.append(op)
+            for n in op.input_names():
+                needed.add(n)
+    keep.reverse()
+    block.ops = keep
+    # Drop vars no kept op touches (e.g. optimizer accumulators) so the
+    # export doesn't carry training state (reference prune.cc behavior).
+    referenced = set(feed_names) | set(fetch_names)
+    for op in keep:
+        referenced.update(op.input_names())
+        referenced.update(op.output_names())
+    block.vars = {n: v for n, v in block.vars.items() if n in referenced}
+    pruned._fp_cache = None
+    return pruned
+
+
+def save_inference_model(dirname, feeded_var_names, target_vars, executor,
+                         main_program=None, model_filename=None,
+                         params_filename=None, export_for_deployment=True,
+                         program_only=False):
+    from .framework import default_main_program
+    program = main_program or default_main_program()
+    fetch_names = [v.name if isinstance(v, Variable) else v
+                   for v in target_vars]
+    pruned = _prune_for_inference(program, list(feeded_var_names),
+                                  fetch_names)
+    os.makedirs(dirname, exist_ok=True)
+    meta = {"program": pruned.to_dict(), "feed_names": list(feeded_var_names),
+            "fetch_names": fetch_names}
+    with open(os.path.join(dirname, model_filename or "__model__.json"),
+              "w") as f:
+        json.dump(meta, f)
+    if not program_only:
+        save_persistables(executor, dirname, pruned,
+                          filename=params_filename)
+    return fetch_names
+
+
+def load_inference_model(dirname, executor, model_filename=None,
+                         params_filename=None):
+    with open(os.path.join(dirname, model_filename or "__model__.json")) as f:
+        meta = json.load(f)
+    program = Program.from_dict(meta["program"])
+    load_persistables(executor, dirname, program, filename=params_filename)
+    block = program.global_block()
+    fetch_vars = [block.var(n) for n in meta["fetch_names"]]
+    return program, meta["feed_names"], fetch_vars
+
+
+def save(program, model_path):
+    os.makedirs(os.path.dirname(model_path) or ".", exist_ok=True)
+    scope = global_scope()
+    blob = {v.name: scope.get_numpy(v.name)
+            for v in program.list_vars()
+            if v.persistable and scope.has(v.name)}
+    np.savez(model_path + ".pdparams", **blob)
+    with open(model_path + ".pdmodel", "w") as f:
+        f.write(program.to_json())
+
+
+def load(program, model_path, executor=None):
+    blob = np.load(model_path + ".pdparams")
+    scope = global_scope()
+    for name in blob.files:
+        scope.set(name, blob[name])
+
+
+def batch(reader, batch_size, drop_last=False):
+    """reference fluid.io.batch / paddle.batch decorator."""
+
+    def batched():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batched

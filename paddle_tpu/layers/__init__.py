@@ -1,0 +1,18 @@
+"""fluid.layers-compatible namespace (reference: python/paddle/fluid/layers/).
+
+`from paddle_tpu import layers; layers.fc(...)` mirrors
+`fluid.layers.fc(...)`.
+"""
+from .. import ops as _ops  # noqa: F401  (registers all lowerings)
+
+from .nn import *  # noqa: F401,F403
+from .tensor import *  # noqa: F401,F403
+from .io import *  # noqa: F401,F403
+from .metric_op import *  # noqa: F401,F403
+from .learning_rate_scheduler import *  # noqa: F401,F403
+from .math_ops import *  # noqa: F401,F403
+from . import control_flow  # noqa: F401
+from .control_flow import *  # noqa: F401,F403
+from . import detection  # noqa: F401
+from . import sequence  # noqa: F401
+from .sequence import *  # noqa: F401,F403

@@ -1,0 +1,166 @@
+"""layers.control_flow — comparisons, increments, array ops, While/cond.
+
+Reference: layers/control_flow.py (19 names). Structured control flow on TPU
+lowers to XLA While/Cond (ops/controlflow.py); the Python-side While class
+records the sub-block exactly like the reference's `While.block()` context.
+"""
+from __future__ import annotations
+
+from ..framework import default_main_program
+from ..layer_helper import LayerHelper
+
+__all__ = ["increment", "less_than", "less_equal", "greater_than",
+           "greater_equal", "equal", "not_equal", "array_write",
+           "array_read", "array_length", "create_array", "While", "Switch",
+           "Print", "is_empty"]
+
+
+def _cmp(op_type):
+    def layer(x, y, cond=None):
+        helper = LayerHelper(op_type)
+        if cond is None:
+            cond = helper.create_variable_for_type_inference("bool", True)
+        helper.append_op(type=op_type,
+                         inputs={"X": [x.name], "Y": [y.name]},
+                         outputs={"Out": [cond.name]})
+        return cond
+    return layer
+
+
+less_than = _cmp("less_than")
+less_equal = _cmp("less_equal")
+greater_than = _cmp("greater_than")
+greater_equal = _cmp("greater_equal")
+equal = _cmp("equal")
+not_equal = _cmp("not_equal")
+
+
+def increment(x, value=1.0, in_place=True):
+    helper = LayerHelper("increment")
+    out_name = x.name if in_place else \
+        helper.create_variable_for_type_inference(x.dtype).name
+    helper.append_op(type="increment", inputs={"X": [x.name]},
+                     outputs={"Out": [out_name]},
+                     attrs={"step": float(value)})
+    return x.block.var(out_name)
+
+
+def is_empty(x, cond=None):
+    helper = LayerHelper("is_empty")
+    if cond is None:
+        cond = helper.create_variable_for_type_inference("bool", True)
+    helper.append_op(type="is_empty", inputs={"X": [x.name]},
+                     outputs={"Out": [cond.name]})
+    return cond
+
+
+def Print(input, message=None, first_n=-1, summarize=-1, **kw):
+    helper = LayerHelper("print")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="print", inputs={"In": [input.name]},
+                     outputs={"Out": [out.name]},
+                     attrs={"message": message or ""})
+    return out
+
+
+def create_array(dtype, max_len=64):
+    helper = LayerHelper("array")
+    return helper.block.create_var(
+        name=helper.name, dtype=dtype, stop_gradient=True,
+        lod_level=0)
+
+
+def array_write(x, i, array=None):
+    helper = LayerHelper("array_write")
+    if array is None:
+        array = create_array(x.dtype)
+    inputs = {"X": [x.name], "I": [i.name]}
+    if array.shape is not None:
+        inputs["Array"] = [array.name]
+    helper.append_op(type="write_to_array", inputs=inputs,
+                     outputs={"Out": [array.name]}, attrs={"max_len": 64})
+    return array
+
+
+def array_read(array, i):
+    helper = LayerHelper("array_read")
+    out = helper.create_variable_for_type_inference(array.dtype)
+    helper.append_op(type="read_from_array",
+                     inputs={"X": [array.name], "I": [i.name]},
+                     outputs={"Out": [out.name]})
+    return out
+
+
+def array_length(array):
+    helper = LayerHelper("array_length")
+    out = helper.create_variable_for_type_inference("int64", True)
+    helper.append_op(type="lod_array_length", inputs={"X": [array.name]},
+                     outputs={"Out": [out.name]})
+    return out
+
+
+class While:
+    """while loop over a sub-block (reference control_flow.py While).
+
+    with While(cond).block(): ... — body ops recorded into a sub-block;
+    vars written in the body that exist outside become loop-carried state.
+    Static shapes required across iterations (XLA While invariant).
+    """
+
+    def __init__(self, cond, is_test=False, name=None):
+        self.cond_var = cond
+        self.helper = LayerHelper("while", name=name)
+        self._block_ctx = None
+
+    class _BlockGuard:
+        def __init__(self, w):
+            self.w = w
+
+        def __enter__(self):
+            prog = default_main_program()
+            self.prog = prog
+            self.sub = prog._create_block()
+            return self
+
+        def __exit__(self, exc_type, exc, tb):
+            if exc_type is not None:
+                return False
+            prog = self.prog
+            sub = prog.current_block()
+            prog._rollback()
+            parent = prog.current_block()
+            # carried vars: sub-block writes to names visible in parent
+            written = []
+            read = []
+            for op in sub.ops:
+                for n in op.input_names():
+                    if parent.has_var(n) and n not in read:
+                        read.append(n)
+                for n in op.output_names():
+                    if parent.has_var(n) and n not in written:
+                        written.append(n)
+            w = self.w
+            cond_name = w.cond_var.name
+            if cond_name not in read:
+                read.append(cond_name)
+            carried = sorted(set(written) | {cond_name})
+            parent.append_op(
+                "while",
+                inputs={"X": read},
+                outputs={"Out": list(carried)},
+                attrs={"sub_block": sub.idx, "condition": cond_name,
+                       "carried_vars": list(carried),
+                       "input_vars": list(read),
+                       "output_vars": list(carried)},
+                infer_shape=False)
+            return False
+
+    def block(self):
+        return While._BlockGuard(self)
+
+
+class Switch:
+    def __init__(self, name=None):
+        raise NotImplementedError(
+            "Switch: use branch-free masked selects on TPU "
+            "(see layers/learning_rate_scheduler.piecewise_decay)")

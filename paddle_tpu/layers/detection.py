@@ -1,0 +1,83 @@
+"""Detection layers (reference: layers/detection.py, 26 names;
+operators/detection/, 15.4k LoC).
+
+Round-1 scope: box/anchor math that lowers cleanly to static-shape XLA
+(prior_box, box_coder, iou_similarity, yolo_box, box_clip). NMS-style ops
+with data-dependent shapes need the padded top-k formulation and land in a
+later round.
+"""
+from __future__ import annotations
+
+from ..layer_helper import LayerHelper
+
+__all__ = ["prior_box", "box_coder", "iou_similarity", "box_clip",
+           "yolo_box"]
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=[1.],
+              variance=[0.1, 0.1, 0.2, 0.2], flip=False, clip=False,
+              steps=[0.0, 0.0], offset=0.5, name=None,
+              min_max_aspect_ratios_order=False):
+    helper = LayerHelper("prior_box", name=name)
+    box = helper.create_variable_for_type_inference(input.dtype, True)
+    var = helper.create_variable_for_type_inference(input.dtype, True)
+    helper.append_op(type="prior_box",
+                     inputs={"Input": [input.name], "Image": [image.name]},
+                     outputs={"Boxes": [box.name], "Variances": [var.name]},
+                     attrs={"min_sizes": list(min_sizes),
+                            "max_sizes": list(max_sizes or []),
+                            "aspect_ratios": list(aspect_ratios),
+                            "variances": list(variance), "flip": flip,
+                            "clip": clip, "step_w": steps[0],
+                            "step_h": steps[1], "offset": offset})
+    return box, var
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              name=None, axis=0):
+    helper = LayerHelper("box_coder", name=name)
+    out = helper.create_variable_for_type_inference(target_box.dtype)
+    helper.append_op(type="box_coder",
+                     inputs={"PriorBox": [prior_box.name],
+                             "PriorBoxVar": [prior_box_var.name],
+                             "TargetBox": [target_box.name]},
+                     outputs={"OutputBox": [out.name]},
+                     attrs={"code_type": code_type,
+                            "box_normalized": box_normalized, "axis": axis})
+    return out
+
+
+def iou_similarity(x, y, name=None):
+    helper = LayerHelper("iou_similarity", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="iou_similarity",
+                     inputs={"X": [x.name], "Y": [y.name]},
+                     outputs={"Out": [out.name]})
+    return out
+
+
+def box_clip(input, im_info, name=None):
+    helper = LayerHelper("box_clip", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="box_clip",
+                     inputs={"Input": [input.name],
+                             "ImInfo": [im_info.name]},
+                     outputs={"Output": [out.name]})
+    return out
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio,
+             name=None):
+    helper = LayerHelper("yolo_box", name=name)
+    boxes = helper.create_variable_for_type_inference(x.dtype)
+    scores = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="yolo_box",
+                     inputs={"X": [x.name], "ImgSize": [img_size.name]},
+                     outputs={"Boxes": [boxes.name],
+                              "Scores": [scores.name]},
+                     attrs={"anchors": list(anchors),
+                            "class_num": class_num,
+                            "conf_thresh": conf_thresh,
+                            "downsample_ratio": downsample_ratio})
+    return boxes, scores

@@ -1,0 +1,26 @@
+"""layers.io — data declaration (reference layers/io.py + data.py)."""
+from __future__ import annotations
+
+from ..framework import default_main_program, default_startup_program
+
+__all__ = ["data"]
+
+
+def data(name, shape, append_batch_size=True, dtype="float32", lod_level=0,
+         type=None, stop_gradient=True):
+    """Declare a feed variable (reference fluid.layers.data / fluid.data).
+
+    append_batch_size=True prepends a dynamic batch dim (-1), matching the
+    reference's default. The Executor specialises the compiled program on
+    the concrete feed shapes (dynamic dims handled by per-shape executable
+    cache, SURVEY.md §7 hard part (c))."""
+    shape = list(shape)
+    if append_batch_size:
+        shape = [-1] + shape
+    for prog in (default_main_program(),):
+        blk = prog.global_block()
+        if blk.has_var(name):
+            return blk.var(name)
+    return default_main_program().global_block().create_var(
+        name=name, shape=shape, dtype=dtype, lod_level=lod_level,
+        stop_gradient=stop_gradient, is_data=True)

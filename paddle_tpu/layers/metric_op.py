@@ -1,0 +1,46 @@
+"""layers.metric_op — accuracy / auc (reference layers/metric_op.py)."""
+from __future__ import annotations
+
+from ..layer_helper import LayerHelper
+from .tensor import create_global_var
+
+__all__ = ["accuracy", "auc"]
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    helper = LayerHelper("accuracy")
+    from .nn import topk
+    topk_out, topk_indices = topk(input, k=k)
+    acc_out = helper.create_variable_for_type_inference("float32", True)
+    if correct is None:
+        correct = helper.create_variable_for_type_inference("int32", True)
+    if total is None:
+        total = helper.create_variable_for_type_inference("int32", True)
+    helper.append_op(type="accuracy",
+                     inputs={"Out": [topk_out.name],
+                             "Indices": [topk_indices.name],
+                             "Label": [label.name]},
+                     outputs={"Accuracy": [acc_out.name],
+                              "Correct": [correct.name],
+                              "Total": [total.name]})
+    return acc_out
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1):
+    helper = LayerHelper("auc")
+    stat_pos = create_global_var([num_thresholds + 1], 0, "int64",
+                                 persistable=True)
+    stat_neg = create_global_var([num_thresholds + 1], 0, "int64",
+                                 persistable=True)
+    auc_out = helper.create_variable_for_type_inference("float64", True)
+    helper.append_op(type="auc",
+                     inputs={"Predict": [input.name],
+                             "Label": [label.name],
+                             "StatPos": [stat_pos.name],
+                             "StatNeg": [stat_neg.name]},
+                     outputs={"AUC": [auc_out.name],
+                              "StatPosOut": [stat_pos.name],
+                              "StatNegOut": [stat_neg.name]},
+                     attrs={"num_thresholds": num_thresholds})
+    return auc_out, [auc_out], [stat_pos, stat_neg]

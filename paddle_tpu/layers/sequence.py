@@ -1,0 +1,69 @@
+"""Sequence layers over padded-dense + mask representation.
+
+Reference: operators/sequence_ops/ + LoD ragged tensors. XLA needs static
+shapes, so the LoD representation maps to (padded data, length mask) pairs
+(SURVEY.md §7 hard part (a)): sequence_pad/unpad become the boundary
+converters, pooling/softmax/reverse take an optional length tensor.
+Round 1 scope: the mask-based core; LoD-faithful APIs widen later.
+"""
+from __future__ import annotations
+
+from ..layer_helper import LayerHelper
+
+__all__ = ["sequence_mask", "sequence_pool", "sequence_softmax",
+           "sequence_reverse", "sequence_expand", "sequence_concat"]
+
+
+def sequence_mask(x, maxlen=None, dtype="int64"):
+    helper = LayerHelper("sequence_mask")
+    out = helper.create_variable_for_type_inference(dtype, True)
+    helper.append_op(type="sequence_mask", inputs={"X": [x.name]},
+                     outputs={"Y": [out.name]},
+                     attrs={"maxlen": maxlen or -1, "out_dtype": dtype})
+    return out
+
+
+def sequence_pool(input, pool_type, lengths=None):
+    """Padded-dense pooling: input [B, T, ...] (+ optional lengths [B])."""
+    helper = LayerHelper("sequence_pool")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    idx = helper.create_variable_for_type_inference("int32", True)
+    inputs = {"X": [input.name]}
+    if lengths is not None:
+        inputs["Lengths"] = [lengths.name]
+    helper.append_op(type="sequence_pool", inputs=inputs,
+                     outputs={"Out": [out.name], "MaxIndex": [idx.name]},
+                     attrs={"pooltype": pool_type.upper()})
+    return out
+
+
+def sequence_softmax(input, lengths=None, name=None):
+    helper = LayerHelper("sequence_softmax", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    inputs = {"X": [input.name]}
+    if lengths is not None:
+        inputs["Lengths"] = [lengths.name]
+    helper.append_op(type="sequence_softmax", inputs=inputs,
+                     outputs={"Out": [out.name]})
+    return out
+
+
+def sequence_reverse(x, lengths=None, name=None):
+    helper = LayerHelper("sequence_reverse", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    inputs = {"X": [x.name]}
+    if lengths is not None:
+        inputs["Lengths"] = [lengths.name]
+    helper.append_op(type="sequence_reverse", inputs=inputs,
+                     outputs={"Y": [out.name]})
+    return out
+
+
+def sequence_expand(x, y, ref_level=-1, name=None):
+    raise NotImplementedError(
+        "sequence_expand needs LoD; use expand/tile on padded-dense")
+
+
+def sequence_concat(input, name=None):
+    from .tensor import concat
+    return concat(input, axis=1, name=name)

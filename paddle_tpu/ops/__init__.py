@@ -1,0 +1,23 @@
+"""Op library: importing this package registers all lowerings.
+
+Parity target: SURVEY.md Appendix A (the reference's 486 registered ops).
+Registered count is reported by `paddle_tpu.ops.registered_types()`.
+"""
+from ..core.registry import REGISTRY
+
+from . import activations  # noqa: F401
+from . import elementwise  # noqa: F401
+from . import math  # noqa: F401
+from . import reduce  # noqa: F401
+from . import tensor_ops  # noqa: F401
+from . import nn_ops  # noqa: F401
+from . import loss_ops  # noqa: F401
+from . import optimizer_ops  # noqa: F401
+from . import metrics_ops  # noqa: F401
+from . import controlflow  # noqa: F401
+from . import sequence_ops  # noqa: F401
+from . import detection_ops  # noqa: F401
+
+
+def registered_types():
+    return REGISTRY.types()

@@ -1,0 +1,84 @@
+"""Activation ops.
+
+Reference: activation_op.cc:637+ / activation_op.h:1682 macro list — 35
+activations, each with hand-written CPU/CUDA functors and grad functors.
+Here each is a one-line jnp expression; gradients come from the generic vjp
+grad op (core/lowering.py), and XLA fuses them into neighbouring ops.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+def _unary(name, fn):
+    @register_op(name)
+    def _low(ctx, ins, attrs, _fn=fn):
+        x = ins["X"][0]
+        return {"Out": [_fn(x, attrs)]}
+    return _low
+
+
+_unary("sigmoid", lambda x, a: jax.nn.sigmoid(x))
+_unary("logsigmoid", lambda x, a: jax.nn.log_sigmoid(x))
+_unary("exp", lambda x, a: jnp.exp(x))
+_unary("gelu", lambda x, a: jax.nn.gelu(
+    x, approximate=bool(a.get("approximate", False))))
+_unary("tanh", lambda x, a: jnp.tanh(x))
+_unary("atan", lambda x, a: jnp.arctan(x))
+_unary("rsqrt", lambda x, a: jax.lax.rsqrt(x))
+_unary("abs", lambda x, a: jnp.abs(x))
+_unary("ceil", lambda x, a: jnp.ceil(x))
+_unary("floor", lambda x, a: jnp.floor(x))
+_unary("cos", lambda x, a: jnp.cos(x))
+_unary("acos", lambda x, a: jnp.arccos(x))
+_unary("sin", lambda x, a: jnp.sin(x))
+_unary("asin", lambda x, a: jnp.arcsin(x))
+_unary("round", lambda x, a: jnp.round(x))
+_unary("reciprocal", lambda x, a: 1.0 / x)
+_unary("log", lambda x, a: jnp.log(x))
+_unary("square", lambda x, a: jnp.square(x))
+_unary("sqrt", lambda x, a: jnp.sqrt(x))
+_unary("relu", lambda x, a: jax.nn.relu(x))
+_unary("relu6", lambda x, a: jnp.clip(x, 0, a.get("threshold", 6.0)))
+_unary("pow", lambda x, a: jnp.power(x, a.get("factor", 1.0)))
+_unary("softplus", lambda x, a: jax.nn.softplus(x))
+_unary("softsign", lambda x, a: jax.nn.soft_sign(x))
+_unary("tanh_shrink", lambda x, a: x - jnp.tanh(x))
+_unary("elu", lambda x, a: jax.nn.elu(x, alpha=a.get("alpha", 1.0)))
+_unary("leaky_relu", lambda x, a: jax.nn.leaky_relu(
+    x, negative_slope=a.get("alpha", 0.02)))
+_unary("brelu", lambda x, a: jnp.clip(
+    x, a.get("t_min", 0.0), a.get("t_max", 24.0)))
+_unary("soft_relu", lambda x, a: jnp.log(
+    1 + jnp.exp(jnp.clip(x, -a.get("threshold", 40.0),
+                         a.get("threshold", 40.0)))))
+_unary("stanh", lambda x, a: a.get("scale_b", 1.7159) *
+       jnp.tanh(a.get("scale_a", 0.67) * x))
+_unary("softshrink", lambda x, a: jnp.where(
+    x > a.get("lambda", 0.5), x - a.get("lambda", 0.5),
+    jnp.where(x < -a.get("lambda", 0.5), x + a.get("lambda", 0.5), 0.0)))
+_unary("hard_shrink", lambda x, a: jnp.where(
+    jnp.abs(x) > a.get("threshold", 0.5), x, 0.0))
+_unary("hard_sigmoid", lambda x, a: jnp.clip(
+    a.get("slope", 0.2) * x + a.get("offset", 0.5), 0.0, 1.0))
+_unary("swish", lambda x, a: x * jax.nn.sigmoid(a.get("beta", 1.0) * x))
+_unary("hard_swish", lambda x, a: x * jnp.clip(
+    x + a.get("offset", 3.0), 0, a.get("threshold", 6.0))
+    / a.get("scale", 6.0))
+_unary("thresholded_relu", lambda x, a: jnp.where(
+    x > a.get("threshold", 1.0), x, 0.0))
+_unary("erf", lambda x, a: jax.scipy.special.erf(x))
+_unary("sign", lambda x, a: jnp.sign(x))
+_unary("logical_not", lambda x, a: jnp.logical_not(x))
+_unary("maxout", lambda x, a: _maxout(x, a.get("groups", 1),
+                                      a.get("axis", 1)))
+
+
+def _maxout(x, groups, axis):
+    shape = list(x.shape)
+    c = shape[axis]
+    new_shape = shape[:axis] + [c // groups, groups] + shape[axis + 1:]
+    return jnp.max(x.reshape(new_shape), axis=axis + 1)

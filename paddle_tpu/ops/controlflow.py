@@ -1,0 +1,142 @@
+"""Control-flow ops.
+
+Reference: operators/controlflow/ — while_op runs its sub-block with a nested
+Executor per iteration (while_op.cc); conditional_block_op likewise. Under
+XLA, data-dependent control flow must lower to structured HLO: while ->
+lax.while_loop over the sub-block's lowered body, cond -> lax.cond. The
+sub-block's carried state is the set of vars it reads from / writes to the
+outer scope — the functional equivalent of the reference's nested-Scope
+mutation.
+
+feed/fetch are no-op markers here: the Executor binds feeds/fetches directly
+(executor.py), matching fluid's semantics where feed_op/fetch_op just move
+values between the feed-var list and the scope.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+@register_op("feed")
+def _feed(ctx, ins, attrs):
+    return {"Out": [ins["X"][attrs.get("col", 0)]]} if "X" in ins else {}
+
+
+@register_op("fetch")
+def _fetch(ctx, ins, attrs):
+    return {"Out": [ins["X"][0]]}
+
+
+@register_op("print")
+def _print(ctx, ins, attrs):
+    x = ins["In"][0]
+    jax.debug.print(attrs.get("message", "") + " {}", x)
+    return {"Out": [x]}
+
+
+@register_op("while")
+def _while(ctx, ins, attrs):
+    """Carried state = sub-block outputs named in attrs['carried_vars'].
+
+    The layers.While frontend (layers/control_flow.py) records which outer
+    vars the body writes; they must keep static shapes across iterations
+    (XLA While invariant — the reference's LoD-growing while loops need the
+    padded/bucketed formulation instead).
+    """
+    block = ctx.sub_block(attrs["sub_block"])
+    cond_name = attrs["condition"]
+    carried = attrs["carried_vars"]
+
+    outer_env = dict(zip(attrs["input_vars"], ins["X"]))
+
+    def cond_fn(state):
+        return state[cond_name].reshape(())
+
+    def body_fn(state):
+        env = dict(outer_env)
+        env.update(state)
+        ctx.lower_sub_block(block, env)
+        return {k: env[k] for k in state}
+
+    init = {k: outer_env[k] for k in carried}
+    if cond_name not in init:
+        init[cond_name] = outer_env[cond_name]
+    out = jax.lax.while_loop(cond_fn, body_fn, init)
+    return {"Out": [out[k] for k in attrs["output_vars"]]}
+
+
+@register_op("conditional_block")
+def _conditional_block(ctx, ins, attrs):
+    block = ctx.sub_block(attrs["sub_block"])
+    pred = ins["Cond"][0].reshape(())
+    input_names = attrs.get("input_vars", [])
+    outer_env = dict(zip(input_names, ins.get("Input", [])))
+    out_names = attrs["output_vars"]
+
+    def true_fn(env):
+        env = dict(env)
+        ctx.lower_sub_block(block, env)
+        return tuple(env[k] for k in out_names)
+
+    def false_fn(env):
+        # Outputs keep their previous values (zeros if undefined) — matches
+        # conditional_block_op's skip semantics for uninitialised outputs.
+        return tuple(
+            env.get(k, jnp.zeros(s.shape, s.dtype)) for k, s in zip(
+                out_names, jax.eval_shape(true_fn, env)))
+
+    out = jax.lax.cond(pred, true_fn, false_fn, outer_env)
+    return {"Out": list(out)}
+
+
+@register_op("select_input")
+def _select_input(ctx, ins, attrs):
+    mask = ins["Mask"][0].reshape(()).astype(jnp.int32)
+    xs = ins["X"]
+    return {"Out": [jax.lax.switch(mask, [lambda i=i: xs[i]
+                                          for i in range(len(xs))])]}
+
+
+# -- tensor array ops: a LoDTensorArray is a stacked tensor with a static
+#    max length on TPU (write_to_array appends -> dynamic_update_slice).
+
+@register_op("write_to_array", nondiff_inputs=("I",))
+def _write_to_array(ctx, ins, attrs):
+    arr = ins["Array"][0] if "Array" in ins else None
+    x = ins["X"][0]
+    i = ins["I"][0].reshape(()).astype(jnp.int32)
+    if arr is None:
+        max_len = attrs.get("max_len", 64)
+        arr = jnp.zeros((max_len,) + x.shape, x.dtype)
+    return {"Out": [jax.lax.dynamic_update_slice(
+        arr, x[None], (i,) + (0,) * x.ndim)]}
+
+
+@register_op("read_from_array", nondiff_inputs=("I",))
+def _read_from_array(ctx, ins, attrs):
+    arr = ins["X"][0]
+    i = ins["I"][0].reshape(()).astype(jnp.int32)
+    out = jax.lax.dynamic_slice(
+        arr, (i,) + (0,) * (arr.ndim - 1), (1,) + arr.shape[1:])
+    return {"Out": [out[0]]}
+
+
+@register_op("lod_array_length", nondiff_outputs=("Out",))
+def _lod_array_length(ctx, ins, attrs):
+    return {"Out": [jnp.asarray([ins["X"][0].shape[0]], jnp.int64)]}
+
+
+@register_op("tensor_array_to_tensor")
+def _tensor_array_to_tensor(ctx, ins, attrs):
+    arr = ins["X"][0]
+    axis = attrs.get("axis", 0)
+    parts = [arr[i] for i in range(arr.shape[0])]
+    if attrs.get("use_stack", False):
+        return {"Out": [jnp.stack(parts, axis=axis)],
+                "OutIndex": [jnp.full((len(parts),), 1, jnp.int32)]}
+    return {"Out": [jnp.concatenate(parts, axis=axis)],
+            "OutIndex": [jnp.asarray([p.shape[axis] for p in parts],
+                                     jnp.int32)]}

@@ -1,0 +1,147 @@
+"""Detection ops with static-shape XLA lowerings.
+
+Reference: operators/detection/ (prior_box_op.cc, box_coder_op.cc,
+iou_similarity_op.cc, box_clip_op.cc, yolo_box_op.cc).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.registry import register_op
+
+
+@register_op("prior_box", nondiff_inputs=("Input", "Image"),
+             nondiff_outputs=("Boxes", "Variances"))
+def _prior_box(ctx, ins, attrs):
+    feat, img = ins["Input"][0], ins["Image"][0]
+    h, w = feat.shape[2], feat.shape[3]
+    img_h, img_w = img.shape[2], img.shape[3]
+    min_sizes = list(attrs["min_sizes"])
+    max_sizes = list(attrs.get("max_sizes", []))
+    ars = [1.0]
+    for ar in attrs.get("aspect_ratios", [1.0]):
+        if not any(abs(ar - a) < 1e-6 for a in ars):
+            ars.append(ar)
+            if attrs.get("flip", False):
+                ars.append(1.0 / ar)
+    step_w = attrs.get("step_w", 0.0) or img_w / w
+    step_h = attrs.get("step_h", 0.0) or img_h / h
+    offset = attrs.get("offset", 0.5)
+
+    whs = []
+    for ms in min_sizes:
+        for ar in ars:
+            whs.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+        if max_sizes:
+            mx = max_sizes[min_sizes.index(ms)]
+            whs.append((np.sqrt(ms * mx), np.sqrt(ms * mx)))
+    whs = jnp.asarray(whs, jnp.float32)  # [P, 2]
+
+    cx = (jnp.arange(w, dtype=jnp.float32) + offset) * step_w
+    cy = (jnp.arange(h, dtype=jnp.float32) + offset) * step_h
+    cxg, cyg = jnp.meshgrid(cx, cy)  # [h, w]
+    centers = jnp.stack([cxg, cyg], -1)[:, :, None, :]  # [h, w, 1, 2]
+    half = whs[None, None] / 2.0  # [1, 1, P, 2]
+    mins = (centers - half) / jnp.asarray([img_w, img_h], jnp.float32)
+    maxs = (centers + half) / jnp.asarray([img_w, img_h], jnp.float32)
+    boxes = jnp.concatenate([mins, maxs], axis=-1)  # [h, w, P, 4]
+    if attrs.get("clip", False):
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    variances = jnp.broadcast_to(
+        jnp.asarray(attrs.get("variances", [0.1, 0.1, 0.2, 0.2]),
+                    jnp.float32), boxes.shape)
+    return {"Boxes": [boxes], "Variances": [variances]}
+
+
+@register_op("box_coder", nondiff_inputs=("PriorBox", "PriorBoxVar"))
+def _box_coder(ctx, ins, attrs):
+    prior = ins["PriorBox"][0]  # [M, 4] xyxy
+    pvar = ins["PriorBoxVar"][0] if "PriorBoxVar" in ins else None
+    tbox = ins["TargetBox"][0]
+    norm = attrs.get("box_normalized", True)
+    one = 0.0 if norm else 1.0
+    pw = prior[:, 2] - prior[:, 0] + one
+    ph = prior[:, 3] - prior[:, 1] + one
+    pcx = prior[:, 0] + pw / 2
+    pcy = prior[:, 1] + ph / 2
+    if attrs.get("code_type", "encode_center_size") == "encode_center_size":
+        tw = tbox[:, 2] - tbox[:, 0] + one
+        th = tbox[:, 3] - tbox[:, 1] + one
+        tcx = tbox[:, 0] + tw / 2
+        tcy = tbox[:, 1] + th / 2
+        dx = (tcx[:, None] - pcx[None]) / pw[None]
+        dy = (tcy[:, None] - pcy[None]) / ph[None]
+        dw = jnp.log(jnp.abs(tw[:, None] / pw[None]))
+        dh = jnp.log(jnp.abs(th[:, None] / ph[None]))
+        out = jnp.stack([dx, dy, dw, dh], -1)
+        if pvar is not None:
+            out = out / pvar[None]
+        return {"OutputBox": [out]}
+    # decode_center_size: tbox [N, M, 4]
+    v = pvar[None] if pvar is not None else 1.0
+    t = tbox * v if pvar is not None else tbox
+    ocx = t[..., 0] * pw + pcx
+    ocy = t[..., 1] * ph + pcy
+    ow = jnp.exp(t[..., 2]) * pw
+    oh = jnp.exp(t[..., 3]) * ph
+    out = jnp.stack([ocx - ow / 2, ocy - oh / 2,
+                     ocx + ow / 2 - one, ocy + oh / 2 - one], -1)
+    return {"OutputBox": [out]}
+
+
+@register_op("iou_similarity")
+def _iou_similarity(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]  # [N,4], [M,4] xyxy
+    area_x = (x[:, 2] - x[:, 0]) * (x[:, 3] - x[:, 1])
+    area_y = (y[:, 2] - y[:, 0]) * (y[:, 3] - y[:, 1])
+    lt = jnp.maximum(x[:, None, :2], y[None, :, :2])
+    rb = jnp.minimum(x[:, None, 2:], y[None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    return {"Out": [inter / (area_x[:, None] + area_y[None] - inter + 1e-10)]}
+
+
+@register_op("box_clip", nondiff_inputs=("ImInfo",))
+def _box_clip(ctx, ins, attrs):
+    boxes, im_info = ins["Input"][0], ins["ImInfo"][0]
+    h = im_info[0, 0] / im_info[0, 2] - 1
+    w = im_info[0, 1] / im_info[0, 2] - 1
+    lim = jnp.stack([w, h, w, h])
+    return {"Output": [jnp.clip(boxes, 0.0, lim)]}
+
+
+@register_op("yolo_box", nondiff_inputs=("ImgSize",),
+             nondiff_outputs=("Boxes", "Scores"))
+def _yolo_box(ctx, ins, attrs):
+    x = ins["X"][0]  # [N, S*(5+C), H, W]
+    img_size = ins["ImgSize"][0]  # [N, 2] (h, w)
+    anchors = attrs["anchors"]
+    cnum = attrs["class_num"]
+    conf_thresh = attrs["conf_thresh"]
+    downsample = attrs["downsample_ratio"]
+    n, _, h, w = x.shape
+    s = len(anchors) // 2
+    an = jnp.asarray(anchors, jnp.float32).reshape(s, 2)
+    x = x.reshape(n, s, 5 + cnum, h, w)
+    gx = jnp.arange(w, dtype=jnp.float32)[None, None, None, :]
+    gy = jnp.arange(h, dtype=jnp.float32)[None, None, :, None]
+    sigmoid = lambda v: jnp.reciprocal(1 + jnp.exp(-v))  # noqa: E731
+    bx = (sigmoid(x[:, :, 0]) + gx) / w
+    by = (sigmoid(x[:, :, 1]) + gy) / h
+    input_h = downsample * h
+    input_w = downsample * w
+    bw = jnp.exp(x[:, :, 2]) * an[None, :, 0, None, None] / input_w
+    bh = jnp.exp(x[:, :, 3]) * an[None, :, 1, None, None] / input_h
+    conf = jnp.reciprocal(1 + jnp.exp(-x[:, :, 4]))
+    probs = jnp.reciprocal(1 + jnp.exp(-x[:, :, 5:])) * conf[:, :, None]
+    probs = jnp.where(probs > conf_thresh, probs, 0.0)
+    img_h = img_size[:, 0].astype(jnp.float32)[:, None]
+    img_w = img_size[:, 1].astype(jnp.float32)[:, None]
+    boxes = jnp.stack([
+        (bx - bw / 2).reshape(n, -1) * img_w,
+        (by - bh / 2).reshape(n, -1) * img_h,
+        (bx + bw / 2).reshape(n, -1) * img_w,
+        (by + bh / 2).reshape(n, -1) * img_h], -1)
+    scores = probs.transpose(0, 1, 3, 4, 2).reshape(n, -1, cnum)
+    return {"Boxes": [boxes], "Scores": [scores]}

@@ -1,0 +1,71 @@
+"""Elementwise binary ops with fluid's axis-broadcast semantics.
+
+Reference: operators/elementwise/ (6k LoC of CPU/CUDA kernels + fused grad
+kernels). fluid broadcast rule: Y's dims align to X starting at `axis`
+(default -1 = numpy-style trailing alignment). XLA fuses these into
+neighbouring computations so there is nothing to hand-fuse.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+def broadcast_y(x, y, axis):
+    if x.ndim == y.ndim or y.ndim == 0:
+        return y
+    axis = x.ndim - y.ndim if axis in (-1, None) else int(axis)
+    new_shape = (1,) * axis + y.shape + (1,) * (x.ndim - axis - y.ndim)
+    return y.reshape(new_shape)
+
+
+def _binary(name, fn):
+    @register_op(name)
+    def _low(ctx, ins, attrs, _fn=fn):
+        x, y = ins["X"][0], ins["Y"][0]
+        y = broadcast_y(x, y, attrs.get("axis", -1))
+        out = _fn(x, y)
+        scale = attrs.get("scale", None)  # fused scale used by transpiler
+        if scale is not None:
+            out = out * scale
+        return {"Out": [out]}
+    return _low
+
+
+_binary("elementwise_add", jnp.add)
+_binary("elementwise_sub", jnp.subtract)
+_binary("elementwise_mul", jnp.multiply)
+_binary("elementwise_div", jnp.divide)
+_binary("elementwise_max", jnp.maximum)
+_binary("elementwise_min", jnp.minimum)
+_binary("elementwise_pow", jnp.power)
+_binary("elementwise_mod", jnp.mod)
+_binary("elementwise_floordiv", jnp.floor_divide)
+
+
+@register_op("minus")
+def _minus(ctx, ins, attrs):
+    return {"Out": [ins["X"][0] - ins["Y"][0]]}
+
+
+# -- comparisons (controlflow/compare_op.cc) -------------------------------
+
+def _compare(name, fn):
+    @register_op(name, nondiff_outputs=("Out",))
+    def _low(ctx, ins, attrs, _fn=fn):
+        x, y = ins["X"][0], ins["Y"][0]
+        y = broadcast_y(x, y, attrs.get("axis", -1))
+        return {"Out": [_fn(x, y)]}
+    return _low
+
+
+_compare("less_than", jnp.less)
+_compare("less_equal", jnp.less_equal)
+_compare("greater_than", jnp.greater)
+_compare("greater_equal", jnp.greater_equal)
+_compare("equal", jnp.equal)
+_compare("not_equal", jnp.not_equal)
+_compare("logical_and", jnp.logical_and)
+_compare("logical_or", jnp.logical_or)
+_compare("logical_xor", jnp.logical_xor)

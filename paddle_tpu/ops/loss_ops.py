@@ -1,0 +1,206 @@
+"""Loss ops (reference: cross_entropy_op.cc, softmax_with_cross_entropy_op.cc,
+sigmoid_cross_entropy_with_logits_op.cc, huber_loss_op.cc, ...)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+def _take_label(x, label):
+    # label: [N, 1] or [N] int -> per-row x[label]
+    lbl = label.reshape(label.shape[0], -1)[:, 0]
+    return jnp.take_along_axis(x, lbl[:, None], axis=-1)
+
+
+@register_op("cross_entropy", nondiff_inputs=("Label",))
+def _cross_entropy(ctx, ins, attrs):
+    x, label = ins["X"][0], ins["Label"][0]
+    eps = 1e-8
+    if attrs.get("soft_label", False):
+        loss = -jnp.sum(label * jnp.log(x + eps), axis=-1, keepdims=True)
+    else:
+        ignore = attrs.get("ignore_index", -100)
+        picked = _take_label(x, label)
+        loss = -jnp.log(picked + eps)
+        lbl = label.reshape(label.shape[0], -1)[:, :1]
+        loss = jnp.where(lbl == ignore, 0.0, loss)
+    return {"Y": [loss]}
+
+
+@register_op("cross_entropy2", nondiff_inputs=("Label",))
+def _cross_entropy2(ctx, ins, attrs):
+    x, label = ins["X"][0], ins["Label"][0]
+    picked = _take_label(x, label)
+    loss = -jnp.log(picked + 1e-8)
+    return {"Y": [loss], "XShape": [jnp.zeros((0,) + x.shape, x.dtype)],
+            "MatchX": [picked]}
+
+
+@register_op("softmax_with_cross_entropy", nondiff_inputs=("Label",))
+def _softmax_with_ce(ctx, ins, attrs):
+    logits, label = ins["Logits"][0], ins["Label"][0]
+    axis = attrs.get("axis", -1) % logits.ndim
+    logp = jax.nn.log_softmax(logits, axis=axis)
+    softmax = jnp.exp(logp)
+    if attrs.get("soft_label", False):
+        loss = -jnp.sum(label * logp, axis=axis, keepdims=True)
+    else:
+        ignore = attrs.get("ignore_index", -100)
+        # hard label: logits shape with size-1 (or absent) class dim at axis
+        lbl = label
+        if lbl.ndim == logits.ndim - 1:
+            lbl = jnp.expand_dims(lbl, axis)
+        picked = jnp.take_along_axis(logp, lbl.astype(jnp.int32), axis=axis)
+        loss = jnp.where(lbl == ignore, 0.0, -picked)
+    return {"Softmax": [softmax], "Loss": [loss]}
+
+
+@register_op("sigmoid_cross_entropy_with_logits", nondiff_inputs=("Label",))
+def _sigmoid_ce(ctx, ins, attrs):
+    x, label = ins["X"][0], ins["Label"][0]
+    ignore = attrs.get("ignore_index", -100)
+    loss = jnp.maximum(x, 0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    loss = jnp.where(label == ignore, 0.0, loss)
+    if attrs.get("normalize", False):
+        n = jnp.maximum(jnp.sum(label != ignore).astype(x.dtype), 1.0)
+        loss = loss / n
+    return {"Out": [loss]}
+
+
+@register_op("square_error_cost")
+def _square_error_cost(ctx, ins, attrs):
+    return {"Out": [jnp.square(ins["X"][0] - ins["Y"][0])]}
+
+
+@register_op("huber_loss", nondiff_inputs=("Y",))
+def _huber_loss(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]  # x=pred, y=label
+    d = attrs.get("delta", 1.0)
+    r = y - x
+    absr = jnp.abs(r)
+    loss = jnp.where(absr <= d, 0.5 * r * r, d * (absr - 0.5 * d))
+    return {"Out": [loss], "Residual": [r]}
+
+
+@register_op("smooth_l1_loss", nondiff_inputs=("Y",))
+def _smooth_l1(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    sigma = attrs.get("sigma", 1.0)
+    s2 = sigma * sigma
+    d = x - y
+    if "InsideWeight" in ins:
+        d = d * ins["InsideWeight"][0]
+    absd = jnp.abs(d)
+    loss = jnp.where(absd < 1.0 / s2, 0.5 * d * d * s2, absd - 0.5 / s2)
+    if "OutsideWeight" in ins:
+        loss = loss * ins["OutsideWeight"][0]
+    loss = jnp.sum(loss.reshape(loss.shape[0], -1), axis=1, keepdims=True)
+    return {"Out": [loss], "Diff": [d]}
+
+
+@register_op("log_loss", nondiff_inputs=("Labels",))
+def _log_loss(ctx, ins, attrs):
+    p, label = ins["Predicted"][0], ins["Labels"][0]
+    eps = attrs.get("epsilon", 1e-4)
+    loss = -label * jnp.log(p + eps) - (1 - label) * jnp.log(1 - p + eps)
+    return {"Loss": [loss]}
+
+
+@register_op("kldiv_loss", nondiff_inputs=("Target",))
+def _kldiv_loss(ctx, ins, attrs):
+    x, tgt = ins["X"][0], ins["Target"][0]
+    red = attrs.get("reduction", "mean")
+    loss = tgt * (jnp.log(jnp.maximum(tgt, 1e-10)) - x)
+    loss = jnp.where(tgt > 0, loss, 0.0)
+    if red == "mean":
+        loss = jnp.mean(loss)
+    elif red == "sum":
+        loss = jnp.sum(loss)
+    elif red == "batchmean":
+        loss = jnp.sum(loss) / x.shape[0]
+    return {"Loss": [loss]}
+
+
+@register_op("hinge_loss", nondiff_inputs=("Labels",))
+def _hinge_loss(ctx, ins, attrs):
+    logits, label = ins["Logits"][0], ins["Labels"][0]
+    return {"Loss": [jnp.maximum(0.0, 1.0 - (2 * label - 1) * logits)]}
+
+
+@register_op("rank_loss", nondiff_inputs=("Label",))
+def _rank_loss(ctx, ins, attrs):
+    label = ins["Label"][0]
+    left, right = ins["Left"][0], ins["Right"][0]
+    d = left - right
+    return {"Out": [jnp.log1p(jnp.exp(d)) - label * d]}
+
+
+@register_op("margin_rank_loss", nondiff_inputs=("Label",))
+def _margin_rank_loss(ctx, ins, attrs):
+    label = ins["Label"][0]
+    x1, x2 = ins["X1"][0], ins["X2"][0]
+    m = attrs.get("margin", 0.0)
+    out = jnp.maximum(0.0, -label * (x1 - x2) + m)
+    return {"Out": [out], "Activated": [(out > 0).astype(x1.dtype)]}
+
+
+@register_op("bpr_loss", nondiff_inputs=("Label",))
+def _bpr_loss(ctx, ins, attrs):
+    x, label = ins["X"][0], ins["Label"][0]
+    lbl = label.reshape(label.shape[0], -1)[:, 0]
+    pos = jnp.take_along_axis(x, lbl[:, None], axis=-1)
+    diff = x - pos
+    n = x.shape[-1]
+    loss = jnp.sum(jnp.log1p(jnp.exp(diff)), axis=-1, keepdims=True) \
+        / (n - 1)
+    return {"Y": [loss]}
+
+
+@register_op("npair_loss", nondiff_inputs=("Labels",))
+def _npair_loss(ctx, ins, attrs):
+    anchor, pos = ins["Anchor"][0], ins["Positive"][0]
+    labels = ins["Labels"][0].reshape(-1)
+    reg = attrs.get("l2_reg", 0.002)
+    sim = jnp.matmul(anchor, pos.T)
+    tgt = (labels[:, None] == labels[None, :]).astype(anchor.dtype)
+    tgt = tgt / jnp.sum(tgt, axis=1, keepdims=True)
+    logp = jax.nn.log_softmax(sim, axis=1)
+    ce = -jnp.mean(jnp.sum(tgt * logp, axis=1))
+    l2 = reg * (jnp.mean(jnp.sum(anchor * anchor, 1)) +
+                jnp.mean(jnp.sum(pos * pos, 1))) / 2
+    return {"Out": [(ce + l2).reshape(())]}
+
+
+@register_op("dice_loss", nondiff_inputs=("Label",))
+def _dice_loss(ctx, ins, attrs):
+    # layers.dice_loss composes from elementwise ops in the reference;
+    # registered as an op here for the fused path.
+    x, label = ins["X"][0], ins["Label"][0]
+    inter = 2 * jnp.sum(x * label)
+    union = jnp.sum(x) + jnp.sum(label)
+    return {"Out": [(1 - inter / (union + 1e-5)).reshape(())]}
+
+
+@register_op("mse_loss")
+def _mse_loss(ctx, ins, attrs):
+    return {"Out": [jnp.mean(jnp.square(ins["X"][0] - ins["Y"][0]))]}
+
+
+@register_op("center_loss", nondiff_inputs=("Label", "Centers",
+                                            "CenterUpdateRate"))
+def _center_loss(ctx, ins, attrs):
+    x, label = ins["X"][0], ins["Label"][0].reshape(-1)
+    centers = ins["Centers"][0]
+    picked = jnp.take(centers, label, axis=0)
+    diff = x - picked
+    loss = 0.5 * jnp.sum(jnp.square(diff), axis=-1, keepdims=True)
+    out = {"Loss": [loss], "SampleCenterDiff": [diff]}
+    if attrs.get("need_update", True) and "CenterUpdateRate" in ins:
+        alpha = ins["CenterUpdateRate"][0].reshape(())
+        cnt = jnp.zeros(centers.shape[0], x.dtype).at[label].add(1.0)
+        upd = jnp.zeros_like(centers).at[label].add(diff)
+        centers_out = centers + alpha * upd / (cnt[:, None] + 1.0)
+        out["CentersOut"] = [centers_out]
+    return out

@@ -1,0 +1,390 @@
+"""Core math / tensor-manipulation ops.
+
+Reference anatomy: each of these is an Op class + InferShape + CPU/CUDA
+kernels + grad kernels (e.g. mul_op.cc:30,114,296-311). Here: one jnp
+lowering each; matmuls hit the MXU via XLA dot lowering.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dtypes import as_np_dtype
+from ..core.registry import register_op
+
+
+def _flatten2(x, num_col_dims):
+    lead = int(np.prod(x.shape[:num_col_dims])) if num_col_dims else 1
+    return x.reshape(lead, -1)
+
+
+@register_op("mul")
+def _mul(ctx, ins, attrs):
+    # mul = 2D matmul after flattening (mul_op.cc:30): MXU-friendly.
+    x, y = ins["X"][0], ins["Y"][0]
+    xnc = attrs.get("x_num_col_dims", 1)
+    ync = attrs.get("y_num_col_dims", 1)
+    x2 = _flatten2(x, xnc)
+    y2 = y.reshape(int(np.prod(y.shape[:ync])), -1)
+    out = jnp.matmul(x2, y2, preferred_element_type=jnp.float32).astype(
+        x.dtype)
+    return {"Out": [out.reshape(x.shape[:xnc] + y.shape[ync:])]}
+
+
+@register_op("matmul")
+def _matmul(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    if attrs.get("transpose_X", False):
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if attrs.get("transpose_Y", False):
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    out = jnp.matmul(x, y, preferred_element_type=jnp.float32).astype(x.dtype)
+    alpha = attrs.get("alpha", 1.0)
+    if alpha != 1.0:
+        out = out * alpha
+    return {"Out": [out]}
+
+
+@register_op("scale")
+def _scale(ctx, ins, attrs):
+    x = ins["X"][0]
+    s, b = attrs.get("scale", 1.0), attrs.get("bias", 0.0)
+    if attrs.get("bias_after_scale", True):
+        return {"Out": [x * s + b]}
+    return {"Out": [(x + b) * s]}
+
+
+@register_op("sum")
+def _sum(ctx, ins, attrs):
+    xs = ins["X"]
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return {"Out": [out]}
+
+
+@register_op("mean")
+def _mean(ctx, ins, attrs):
+    return {"Out": [jnp.mean(ins["X"][0])]}
+
+
+@register_op("shape", nondiff_outputs=("Out",))
+def _shape(ctx, ins, attrs):
+    return {"Out": [jnp.asarray(ins["Input"][0].shape, jnp.int32)]}
+
+
+@register_op("size", nondiff_outputs=("Out",))
+def _size(ctx, ins, attrs):
+    return {"Out": [jnp.asarray(ins["Input"][0].size, jnp.int64)]}
+
+
+@register_op("cast")
+def _cast(ctx, ins, attrs):
+    return {"Out": [ins["X"][0].astype(as_np_dtype(attrs["out_dtype"]))]}
+
+
+@register_op("concat")
+def _concat(ctx, ins, attrs):
+    return {"Out": [jnp.concatenate(ins["X"], axis=attrs.get("axis", 0))]}
+
+
+@register_op("split")
+def _split(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", 0)
+    sections = attrs.get("sections") or []
+    num = attrs.get("num", 0)
+    if sections:
+        idx = np.cumsum(sections)[:-1].tolist()
+        outs = jnp.split(x, idx, axis=axis)
+    else:
+        outs = jnp.split(x, num or len(ins.get("Out", [1])), axis=axis)
+    return {"Out": list(outs)}
+
+
+@register_op("stack")
+def _stack(ctx, ins, attrs):
+    return {"Y": [jnp.stack(ins["X"], axis=attrs.get("axis", 0))]}
+
+
+@register_op("unstack")
+def _unstack(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", 0)
+    n = x.shape[axis]
+    return {"Y": [jnp.squeeze(s, axis=axis)
+                  for s in jnp.split(x, n, axis=axis)]}
+
+
+def _with_xshape(name, fn):
+    """reshape2/squeeze2/... output an XShape var for the reference's grad
+    path; our vjp grads don't need it, but parity tests read its existence.
+    XLA DCEs it when unused."""
+    @register_op(name, nondiff_outputs=("XShape",))
+    def _low(ctx, ins, attrs, _fn=fn):
+        x = ins["X"][0]
+        out = _fn(x, attrs, ins)
+        return {"Out": [out],
+                "XShape": [jnp.zeros((0,) + x.shape, x.dtype)]}
+    return _low
+
+
+def _do_reshape(x, attrs, ins):
+    shape = list(attrs.get("shape", []))
+    if "ShapeTensor" in ins or "Shape" in ins:
+        pass  # static-shape path only: shape attr is authoritative on TPU
+    return jnp.reshape(x, [int(s) for s in shape])
+
+
+_with_xshape("reshape2", _do_reshape)
+_with_xshape("transpose2",
+             lambda x, a, i: jnp.transpose(x, axes=a.get("axis")))
+_with_xshape("squeeze2", lambda x, a, i: (
+    jnp.squeeze(x, axis=tuple(a.get("axes")) if a.get("axes") else None)))
+_with_xshape("unsqueeze2", lambda x, a, i: _unsqueeze(x, a.get("axes", [])))
+_with_xshape("flatten2", lambda x, a, i: x.reshape(
+    (int(np.prod(x.shape[:a.get("axis", 1)])), -1)))
+
+
+@register_op("reshape")
+def _reshape(ctx, ins, attrs):
+    return {"Out": [_do_reshape(ins["X"][0], attrs, ins)]}
+
+
+@register_op("transpose")
+def _transpose(ctx, ins, attrs):
+    return {"Out": [jnp.transpose(ins["X"][0], axes=attrs.get("axis"))]}
+
+
+@register_op("squeeze")
+def _squeeze(ctx, ins, attrs):
+    axes = attrs.get("axes")
+    return {"Out": [jnp.squeeze(ins["X"][0],
+                                axis=tuple(axes) if axes else None)]}
+
+
+def _unsqueeze(x, axes):
+    for ax in sorted(axes):
+        x = jnp.expand_dims(x, ax)
+    return x
+
+
+@register_op("unsqueeze")
+def _unsqueeze_op(ctx, ins, attrs):
+    return {"Out": [_unsqueeze(ins["X"][0], attrs.get("axes", []))]}
+
+
+@register_op("flatten")
+def _flatten(ctx, ins, attrs):
+    x = ins["X"][0]
+    ax = attrs.get("axis", 1)
+    return {"Out": [x.reshape((int(np.prod(x.shape[:ax])), -1))]}
+
+
+@register_op("slice")
+def _slice(ctx, ins, attrs):
+    x = ins["Input"][0]
+    axes = attrs["axes"]
+    starts, ends = attrs["starts"], attrs["ends"]
+    idx = [slice(None)] * x.ndim
+    for ax, s, e in zip(axes, starts, ends):
+        dim = x.shape[ax]
+        s = max(s + dim, 0) if s < 0 else min(s, dim)
+        e = max(e + dim, 0) if e < 0 else min(e, dim)
+        idx[ax] = slice(s, e)
+    out = x[tuple(idx)]
+    if attrs.get("decrease_axis"):
+        out = jnp.squeeze(out, axis=tuple(attrs["decrease_axis"]))
+    return {"Out": [out]}
+
+
+@register_op("strided_slice")
+def _strided_slice(ctx, ins, attrs):
+    x = ins["Input"][0]
+    idx = [slice(None)] * x.ndim
+    for ax, s, e, st in zip(attrs["axes"], attrs["starts"], attrs["ends"],
+                            attrs["strides"]):
+        idx[ax] = slice(s, e, st)
+    return {"Out": [x[tuple(idx)]]}
+
+
+@register_op("expand")
+def _expand(ctx, ins, attrs):
+    x = ins["X"][0]
+    times = attrs["expand_times"]
+    return {"Out": [jnp.tile(x, times)]}
+
+
+@register_op("expand_as")
+def _expand_as(ctx, ins, attrs):
+    x, tgt = ins["X"][0], ins["target_tensor"][0]
+    times = [t // s for t, s in zip(tgt.shape, x.shape)]
+    return {"Out": [jnp.tile(x, times)]}
+
+
+@register_op("gather", nondiff_inputs=("Index",))
+def _gather(ctx, ins, attrs):
+    x, idx = ins["X"][0], ins["Index"][0]
+    return {"Out": [jnp.take(x, idx.reshape(-1), axis=0)]}
+
+
+@register_op("gather_nd", nondiff_inputs=("Index",))
+def _gather_nd(ctx, ins, attrs):
+    x, idx = ins["X"][0], ins["Index"][0]
+    return {"Out": [x[tuple(jnp.moveaxis(idx, -1, 0))]]}
+
+
+@register_op("scatter", nondiff_inputs=("Ids",))
+def _scatter(ctx, ins, attrs):
+    x, ids, upd = ins["X"][0], ins["Ids"][0], ins["Updates"][0]
+    ids = ids.reshape(-1)
+    if attrs.get("overwrite", True):
+        out = x.at[ids].set(upd)
+    else:
+        out = x.at[ids].add(upd)
+    return {"Out": [out]}
+
+
+@register_op("scatter_nd_add", nondiff_inputs=("Index",))
+def _scatter_nd_add(ctx, ins, attrs):
+    x, idx, upd = ins["X"][0], ins["Index"][0], ins["Updates"][0]
+    return {"Out": [x.at[tuple(jnp.moveaxis(idx, -1, 0))].add(upd)]}
+
+
+@register_op("cumsum")
+def _cumsum(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", -1)
+    if attrs.get("flatten", False):
+        x, axis = x.reshape(-1), 0
+    if attrs.get("reverse", False):
+        out = jnp.flip(jnp.cumsum(jnp.flip(x, axis), axis=axis), axis)
+    else:
+        out = jnp.cumsum(x, axis=axis)
+    if attrs.get("exclusive", False):
+        out = out - x
+    return {"Out": [out]}
+
+
+@register_op("top_k", nondiff_outputs=("Indices",))
+def _top_k(ctx, ins, attrs):
+    x = ins["X"][0]
+    k = attrs.get("k", 1)
+    vals, idx = jax.lax.top_k(x, k)
+    return {"Out": [vals], "Indices": [idx.astype(jnp.int64)]}
+
+
+@register_op("argsort", nondiff_outputs=("Indices",))
+def _argsort(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", -1)
+    idx = jnp.argsort(x, axis=axis)
+    if attrs.get("descending", False):
+        idx = jnp.flip(idx, axis=axis)
+    return {"Out": [jnp.take_along_axis(x, idx, axis=axis)],
+            "Indices": [idx.astype(jnp.int64)]}
+
+
+@register_op("arg_max", nondiff_outputs=("Out",))
+def _arg_max(ctx, ins, attrs):
+    return {"Out": [jnp.argmax(ins["X"][0], axis=attrs.get("axis", -1))
+                    .astype(jnp.int64)]}
+
+
+@register_op("arg_min", nondiff_outputs=("Out",))
+def _arg_min(ctx, ins, attrs):
+    return {"Out": [jnp.argmin(ins["X"][0], axis=attrs.get("axis", -1))
+                    .astype(jnp.int64)]}
+
+
+@register_op("clip")
+def _clip(ctx, ins, attrs):
+    return {"Out": [jnp.clip(ins["X"][0], attrs["min"], attrs["max"])]}
+
+
+@register_op("clip_by_norm")
+def _clip_by_norm(ctx, ins, attrs):
+    x = ins["X"][0]
+    max_norm = attrs["max_norm"]
+    norm = jnp.sqrt(jnp.sum(jnp.square(x)))
+    return {"Out": [jnp.where(norm > max_norm, x * (max_norm / norm), x)]}
+
+
+@register_op("squared_l2_norm")
+def _squared_l2_norm(ctx, ins, attrs):
+    return {"Out": [jnp.sum(jnp.square(ins["X"][0])).reshape(1)]}
+
+
+@register_op("l2_normalize")
+def _l2_normalize(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", -1)
+    eps = attrs.get("epsilon", 1e-12)
+    norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True) + eps)
+    return {"Out": [x / norm], "Norm": [norm]}
+
+
+@register_op("norm")
+def _norm(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", -1)
+    eps = attrs.get("epsilon", 1e-10)
+    norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True) + eps)
+    return {"Out": [x / norm], "Norm": [norm]}
+
+
+@register_op("pad")
+def _pad(ctx, ins, attrs):
+    x = ins["X"][0]
+    p = attrs["paddings"]
+    pads = [(p[2 * i], p[2 * i + 1]) for i in range(x.ndim)]
+    return {"Out": [jnp.pad(x, pads,
+                            constant_values=attrs.get("pad_value", 0.0))]}
+
+
+@register_op("pad2d")
+def _pad2d(ctx, ins, attrs):
+    x = ins["X"][0]
+    p = attrs["paddings"]  # [top, bottom, left, right]
+    mode = attrs.get("mode", "constant")
+    fmt = attrs.get("data_format", "NCHW")
+    if fmt == "NCHW":
+        pads = [(0, 0), (0, 0), (p[0], p[1]), (p[2], p[3])]
+    else:
+        pads = [(0, 0), (p[0], p[1]), (p[2], p[3]), (0, 0)]
+    if mode == "constant":
+        out = jnp.pad(x, pads, constant_values=attrs.get("pad_value", 0.0))
+    else:
+        out = jnp.pad(x, pads, mode={"reflect": "reflect",
+                                     "edge": "edge"}[mode])
+    return {"Out": [out]}
+
+
+@register_op("cos_sim")
+def _cos_sim(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    xn = jnp.sqrt(jnp.sum(x * x, -1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(y * y, -1, keepdims=True))
+    out = jnp.sum(x * y, -1, keepdims=True) / (xn * yn)
+    return {"Out": [out], "XNorm": [xn], "YNorm": [yn]}
+
+
+@register_op("bilinear_tensor_product")
+def _bilinear_tp(ctx, ins, attrs):
+    x, y, w = ins["X"][0], ins["Y"][0], ins["Weight"][0]
+    out = jnp.einsum("bi,oij,bj->bo", x, w, y)
+    if "Bias" in ins:
+        out = out + ins["Bias"][0]
+    return {"Out": [out]}
+
+
+@register_op("matmul_v2")
+def _matmul_v2(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    if attrs.get("trans_x", False):
+        x = jnp.swapaxes(x, -1, -2)
+    if attrs.get("trans_y", False):
+        y = jnp.swapaxes(y, -1, -2)
+    return {"Out": [jnp.matmul(x, y, preferred_element_type=jnp.float32)
+                    .astype(x.dtype)]}

@@ -1,0 +1,93 @@
+"""Metric ops (reference: operators/metrics/ — accuracy, auc,
+precision_recall; plus mean_iou from operators/)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+@register_op("accuracy", nondiff_inputs=("Out", "Indices", "Label"),
+             nondiff_outputs=("Accuracy", "Correct", "Total"))
+def _accuracy(ctx, ins, attrs):
+    idx = ins["Indices"][0]  # [N, k] top-k indices
+    label = ins["Label"][0].reshape(-1, 1)
+    correct_rows = jnp.any(idx == label, axis=1)
+    correct = jnp.sum(correct_rows.astype(jnp.float32))
+    total = jnp.asarray(idx.shape[0], jnp.int32)
+    return {"Accuracy": [(correct / idx.shape[0]).reshape(1)],
+            "Correct": [correct.astype(jnp.int32).reshape(1)],
+            "Total": [total.reshape(1)]}
+
+
+@register_op("auc", nondiff_inputs=("Predict", "Label", "StatPos", "StatNeg"),
+             nondiff_outputs=("AUC", "StatPosOut", "StatNegOut"),
+             inplace=True)
+def _auc(ctx, ins, attrs):
+    """Streaming AUC via histogram buckets (auc_op.cc)."""
+    pred = ins["Predict"][0][:, -1]  # prob of positive class
+    label = ins["Label"][0].reshape(-1)
+    stat_pos = ins["StatPos"][0]
+    stat_neg = ins["StatNeg"][0]
+    num_thresholds = attrs.get("num_thresholds", 4095)
+    bucket = jnp.clip((pred * num_thresholds).astype(jnp.int32), 0,
+                      num_thresholds)
+    pos = stat_pos.at[bucket].add((label == 1).astype(stat_pos.dtype))
+    neg = stat_neg.at[bucket].add((label == 0).astype(stat_neg.dtype))
+    # trapezoid over descending thresholds
+    tp = jnp.cumsum(pos[::-1])
+    fp = jnp.cumsum(neg[::-1])
+    tot_pos = tp[-1]
+    tot_neg = fp[-1]
+    tp_prev = jnp.concatenate([jnp.zeros(1, tp.dtype), tp[:-1]])
+    fp_prev = jnp.concatenate([jnp.zeros(1, fp.dtype), fp[:-1]])
+    area = jnp.sum((fp - fp_prev) * (tp + tp_prev) / 2.0)
+    auc = jnp.where(tot_pos * tot_neg > 0, area / (tot_pos * tot_neg), 0.0)
+    return {"AUC": [auc.reshape(())], "StatPosOut": [pos],
+            "StatNegOut": [neg]}
+
+
+@register_op("mean_iou", nondiff_inputs=("Predictions", "Labels"),
+             nondiff_outputs=("OutMeanIou", "OutWrong", "OutCorrect"))
+def _mean_iou(ctx, ins, attrs):
+    pred = ins["Predictions"][0].reshape(-1)
+    label = ins["Labels"][0].reshape(-1)
+    n = attrs["num_classes"]
+    valid = (label >= 0) & (label < n)
+    pred_ = jnp.where(valid, pred, 0)
+    label_ = jnp.where(valid, label, 0)
+    cm = jnp.zeros((n, n), jnp.float32).at[label_, pred_].add(
+        valid.astype(jnp.float32))
+    inter = jnp.diag(cm)
+    union = jnp.sum(cm, 0) + jnp.sum(cm, 1) - inter
+    iou = jnp.where(union > 0, inter / jnp.maximum(union, 1e-12), 0.0)
+    denom = jnp.maximum(jnp.sum(union > 0), 1)
+    return {"OutMeanIou": [jnp.sum(iou) / denom],
+            "OutWrong": [(jnp.sum(cm, 1) - inter).astype(jnp.int32)],
+            "OutCorrect": [inter.astype(jnp.int32)]}
+
+
+@register_op("precision_recall",
+             nondiff_inputs=("MaxProbs", "Indices", "Labels", "Weights",
+                             "StatesInfo"),
+             nondiff_outputs=("BatchMetrics", "AccumMetrics",
+                              "AccumStatesInfo"))
+def _precision_recall(ctx, ins, attrs):
+    idx = ins["Indices"][0].reshape(-1)
+    label = ins["Labels"][0].reshape(-1)
+    cls = attrs["class_number"]
+    tp = jnp.zeros(cls, jnp.float32).at[label].add(
+        (idx == label).astype(jnp.float32))
+    fp = jnp.zeros(cls, jnp.float32).at[idx].add(
+        (idx != label).astype(jnp.float32))
+    fn = jnp.zeros(cls, jnp.float32).at[label].add(
+        (idx != label).astype(jnp.float32))
+    prec = jnp.sum(tp) / jnp.maximum(jnp.sum(tp) + jnp.sum(fp), 1e-12)
+    rec = jnp.sum(tp) / jnp.maximum(jnp.sum(tp) + jnp.sum(fn), 1e-12)
+    f1 = 2 * prec * rec / jnp.maximum(prec + rec, 1e-12)
+    batch = jnp.stack([prec, rec, f1, prec, rec, f1])
+    states = jnp.stack([tp, fp, fn, tp], axis=1)
+    if "StatesInfo" in ins:
+        states = states + ins["StatesInfo"][0]
+    return {"BatchMetrics": [batch], "AccumMetrics": [batch],
+            "AccumStatesInfo": [states]}

@@ -1,0 +1,424 @@
+"""NN ops: conv / pool / norm / softmax / dropout / interpolate.
+
+Reference: conv_op.cc + conv_cudnn_op.cu, pool_op.cc, batch_norm_op.cc,
+layer_norm_op.cc, dropout_op.cc, interpolate_op.cc ... Each lowers to the XLA
+HLO that maps onto the MXU (conv_general_dilated) or VPU; there are no
+separate "cudnn kernels" — XLA's conv emitter plays that role on TPU.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.registry import register_op
+
+
+@register_op("softmax")
+def _softmax(ctx, ins, attrs):
+    return {"Out": [jax.nn.softmax(ins["X"][0], axis=attrs.get("axis", -1))]}
+
+
+@register_op("log_softmax")
+def _log_softmax(ctx, ins, attrs):
+    return {"Out": [jax.nn.log_softmax(ins["X"][0],
+                                       axis=attrs.get("axis", -1))]}
+
+
+def _conv_dn(fmt):
+    return (fmt, "OIHW", fmt) if fmt == "NCHW" else (fmt, "HWIO", fmt)
+
+
+def _conv2d_impl(x, w, attrs):
+    strides = tuple(attrs.get("strides", [1, 1]))
+    pads = attrs.get("paddings", [0, 0])
+    if len(pads) == 2:
+        padding = [(pads[0], pads[0]), (pads[1], pads[1])]
+    else:
+        padding = [(pads[0], pads[1]), (pads[2], pads[3])]
+    dil = tuple(attrs.get("dilations", [1, 1]))
+    groups = attrs.get("groups", 1)
+    fmt = attrs.get("data_format", "NCHW")
+    if fmt in ("AnyLayout", "ANYLAYOUT"):
+        fmt = "NCHW"
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=strides, padding=padding, rhs_dilation=dil,
+        feature_group_count=groups,
+        dimension_numbers=jax.lax.conv_dimension_numbers(
+            x.shape, w.shape, _conv_dn(fmt)),
+        preferred_element_type=jnp.float32).astype(x.dtype)
+
+
+@register_op("conv2d")
+def _conv2d(ctx, ins, attrs):
+    return {"Output": [_conv2d_impl(ins["Input"][0], ins["Filter"][0], attrs)]}
+
+
+@register_op("depthwise_conv2d")
+def _depthwise_conv2d(ctx, ins, attrs):
+    x, w = ins["Input"][0], ins["Filter"][0]
+    attrs = dict(attrs)
+    attrs["groups"] = x.shape[1]  # NCHW channels
+    return {"Output": [_conv2d_impl(x, w, attrs)]}
+
+
+@register_op("conv3d")
+def _conv3d(ctx, ins, attrs):
+    x, w = ins["Input"][0], ins["Filter"][0]
+    strides = tuple(attrs.get("strides", [1, 1, 1]))
+    pads = attrs.get("paddings", [0, 0, 0])
+    padding = [(p, p) for p in pads]
+    dil = tuple(attrs.get("dilations", [1, 1, 1]))
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=strides, padding=padding, rhs_dilation=dil,
+        feature_group_count=attrs.get("groups", 1),
+        dimension_numbers=jax.lax.conv_dimension_numbers(
+            x.shape, w.shape, ("NCDHW", "OIDHW", "NCDHW")),
+        preferred_element_type=jnp.float32).astype(x.dtype)
+    return {"Output": [out]}
+
+
+@register_op("conv2d_transpose")
+def _conv2d_transpose(ctx, ins, attrs):
+    x, w = ins["Input"][0], ins["Filter"][0]  # w: [C_in, C_out/g, kh, kw]
+    strides = tuple(attrs.get("strides", [1, 1]))
+    pads = attrs.get("paddings", [0, 0])
+    dil = tuple(attrs.get("dilations", [1, 1]))
+    groups = attrs.get("groups", 1)
+    if groups != 1:
+        raise NotImplementedError("grouped conv2d_transpose")
+    # Gradient-of-conv formulation: transpose conv == lhs-dilated conv with
+    # flipped kernel (what conv2d_transpose_op.cc computes via col2im).
+    kh, kw = w.shape[2], w.shape[3]
+    padding = [(dil[0] * (kh - 1) - pads[0], dil[0] * (kh - 1) - pads[0]),
+               (dil[1] * (kw - 1) - pads[1], dil[1] * (kw - 1) - pads[1])]
+    w_t = jnp.flip(w, axis=(2, 3)).swapaxes(0, 1)  # -> [C_out, C_in, kh, kw]
+    out = jax.lax.conv_general_dilated(
+        x, w_t, window_strides=(1, 1), padding=padding,
+        lhs_dilation=strides, rhs_dilation=dil,
+        dimension_numbers=jax.lax.conv_dimension_numbers(
+            x.shape, w_t.shape, ("NCHW", "OIHW", "NCHW")),
+        preferred_element_type=jnp.float32).astype(x.dtype)
+    return {"Output": [out]}
+
+
+def _pool2d_impl(x, attrs):
+    ptype = attrs.get("pooling_type", "max")
+    ksize = list(attrs.get("ksize", [2, 2]))
+    strides = list(attrs.get("strides", ksize))
+    pads = list(attrs.get("paddings", [0, 0]))
+    exclusive = attrs.get("exclusive", True)
+    if attrs.get("global_pooling", False) or attrs.get("adaptive", False) \
+            and list(attrs.get("ksize")) == [1, 1]:
+        red = jnp.max if ptype == "max" else jnp.mean
+        return red(x, axis=(2, 3), keepdims=True)
+    if attrs.get("adaptive", False):
+        oh, ow = ksize
+        h, w = x.shape[2], x.shape[3]
+        if h % oh or w % ow:
+            raise NotImplementedError(
+                "adaptive pool needs divisible sizes under static XLA shapes")
+        xr = x.reshape(x.shape[0], x.shape[1], oh, h // oh, ow, w // ow)
+        red = jnp.max if ptype == "max" else jnp.mean
+        return red(xr, axis=(3, 5))
+    window = (1, 1, ksize[0], ksize[1])
+    strides4 = (1, 1, strides[0], strides[1])
+    padding = ((0, 0), (0, 0), (pads[0], pads[0]), (pads[1], pads[1]))
+    if ptype == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) \
+            else jnp.iinfo(x.dtype).min
+        return jax.lax.reduce_window(x, init, jax.lax.max, window, strides4,
+                                     padding)
+    s = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides4, padding)
+    if exclusive and (pads[0] or pads[1]):
+        ones = jnp.ones_like(x)
+        cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides4,
+                                    padding)
+        return s / cnt
+    return s / (ksize[0] * ksize[1])
+
+
+@register_op("pool2d")
+def _pool2d(ctx, ins, attrs):
+    return {"Out": [_pool2d_impl(ins["X"][0], attrs)]}
+
+
+@register_op("max_pool2d_with_index", nondiff_outputs=("Mask",))
+def _max_pool2d_with_index(ctx, ins, attrs):
+    out = _pool2d_impl(ins["X"][0], {**attrs, "pooling_type": "max"})
+    return {"Out": [out], "Mask": [jnp.zeros(out.shape, jnp.int32)]}
+
+
+@register_op("batch_norm", nondiff_inputs=("Mean", "Variance"),
+             nondiff_outputs=("MeanOut", "VarianceOut", "SavedMean",
+                              "SavedVariance"))
+def _batch_norm(ctx, ins, attrs):
+    x = ins["X"][0]
+    scale, bias = ins["Scale"][0], ins["Bias"][0]
+    mean, var = ins["Mean"][0], ins["Variance"][0]
+    eps = attrs.get("epsilon", 1e-5)
+    momentum = attrs.get("momentum", 0.9)
+    layout = attrs.get("data_layout", "NCHW")
+    axis = 1 if layout == "NCHW" else x.ndim - 1
+    red = tuple(i for i in range(x.ndim) if i != axis)
+    bshape = [1] * x.ndim
+    bshape[axis] = x.shape[axis]
+
+    use_global = attrs.get("is_test", False) or \
+        attrs.get("use_global_stats", False) or ctx.is_test
+    if use_global:
+        m, v = mean, var
+        mean_out, var_out = mean, var
+        saved_m, saved_v = mean, var
+    else:
+        m = jnp.mean(x, axis=red)
+        v = jnp.var(x, axis=red)
+        mean_out = mean * momentum + m * (1 - momentum)
+        var_out = var * momentum + v * (1 - momentum)
+        saved_m, saved_v = m, jax.lax.rsqrt(v + eps)
+    inv = jax.lax.rsqrt(v.reshape(bshape) + eps)
+    y = (x - m.reshape(bshape)) * inv * scale.reshape(bshape) \
+        + bias.reshape(bshape)
+    return {"Y": [y], "MeanOut": [mean_out], "VarianceOut": [var_out],
+            "SavedMean": [saved_m], "SavedVariance": [saved_v]}
+
+
+@register_op("layer_norm")
+def _layer_norm(ctx, ins, attrs):
+    x = ins["X"][0]
+    eps = attrs.get("epsilon", 1e-5)
+    bna = attrs.get("begin_norm_axis", 1)
+    red = tuple(range(bna, x.ndim))
+    m = jnp.mean(x, axis=red, keepdims=True)
+    v = jnp.var(x, axis=red, keepdims=True)
+    y = (x - m) * jax.lax.rsqrt(v + eps)
+    norm_shape = x.shape[bna:]
+    if "Scale" in ins:
+        y = y * ins["Scale"][0].reshape(norm_shape)
+    if "Bias" in ins:
+        y = y + ins["Bias"][0].reshape(norm_shape)
+    return {"Y": [y], "Mean": [m.reshape(x.shape[:bna])],
+            "Variance": [v.reshape(x.shape[:bna])]}
+
+
+@register_op("instance_norm")
+def _instance_norm(ctx, ins, attrs):
+    x = ins["X"][0]  # NCHW
+    eps = attrs.get("epsilon", 1e-5)
+    red = tuple(range(2, x.ndim))
+    m = jnp.mean(x, axis=red, keepdims=True)
+    v = jnp.var(x, axis=red, keepdims=True)
+    y = (x - m) * jax.lax.rsqrt(v + eps)
+    bshape = (1, x.shape[1]) + (1,) * (x.ndim - 2)
+    if "Scale" in ins:
+        y = y * ins["Scale"][0].reshape(bshape)
+    if "Bias" in ins:
+        y = y + ins["Bias"][0].reshape(bshape)
+    return {"Y": [y], "SavedMean": [m.reshape(x.shape[:2])],
+            "SavedVariance": [v.reshape(x.shape[:2])]}
+
+
+@register_op("group_norm")
+def _group_norm(ctx, ins, attrs):
+    x = ins["X"][0]  # NCHW
+    g = attrs.get("groups", 1)
+    eps = attrs.get("epsilon", 1e-5)
+    n, c = x.shape[0], x.shape[1]
+    xg = x.reshape((n, g, c // g) + x.shape[2:])
+    red = tuple(range(2, xg.ndim))
+    m = jnp.mean(xg, axis=red, keepdims=True)
+    v = jnp.var(xg, axis=red, keepdims=True)
+    y = ((xg - m) * jax.lax.rsqrt(v + eps)).reshape(x.shape)
+    bshape = (1, c) + (1,) * (x.ndim - 2)
+    if "Scale" in ins:
+        y = y * ins["Scale"][0].reshape(bshape)
+    if "Bias" in ins:
+        y = y + ins["Bias"][0].reshape(bshape)
+    return {"Y": [y], "Mean": [m.reshape(n, g)],
+            "Variance": [v.reshape(n, g)]}
+
+
+@register_op("data_norm")
+def _data_norm(ctx, ins, attrs):
+    x = ins["X"][0]
+    size = ins["BatchSize"][0]
+    s = ins["BatchSum"][0]
+    sq = ins["BatchSquareSum"][0]
+    mean = s / size
+    scale = jax.lax.rsqrt(sq / size - mean * mean + 1e-4)
+    return {"Y": [(x - mean) * scale], "Means": [mean], "Scales": [scale]}
+
+
+@register_op("dropout", stateful=True, nondiff_outputs=("Mask",))
+def _dropout(ctx, ins, attrs):
+    x = ins["X"][0]
+    p = attrs.get("dropout_prob", 0.5)
+    impl = attrs.get("dropout_implementation", "downgrade_in_infer")
+    if ctx.is_test or attrs.get("is_test", False):
+        out = x if impl == "upscale_in_train" else x * (1.0 - p)
+        return {"Out": [out], "Mask": [jnp.ones(x.shape, jnp.uint8)]}
+    keep = jax.random.bernoulli(ctx.rng, 1.0 - p, x.shape)
+    if impl == "upscale_in_train":
+        out = jnp.where(keep, x / (1.0 - p), 0.0).astype(x.dtype)
+    else:
+        out = jnp.where(keep, x, 0.0).astype(x.dtype)
+    return {"Out": [out], "Mask": [keep.astype(jnp.uint8)]}
+
+
+@register_op("prelu")
+def _prelu(ctx, ins, attrs):
+    x, alpha = ins["X"][0], ins["Alpha"][0]
+    mode = attrs.get("mode", "all")
+    if mode == "channel":
+        alpha = alpha.reshape((1, -1) + (1,) * (x.ndim - 2))
+    elif mode == "element":
+        alpha = alpha.reshape((1,) + x.shape[1:])
+    return {"Out": [jnp.where(x > 0, x, alpha * x)]}
+
+
+@register_op("selu")
+def _selu(ctx, ins, attrs):
+    x = ins["X"][0]
+    scale = attrs.get("scale", 1.0507009873554805)
+    alpha = attrs.get("alpha", 1.6732632423543772)
+    return {"Out": [scale * jnp.where(x > 0, x, alpha * (jnp.exp(x) - 1))]}
+
+
+@register_op("lrn")
+def _lrn(ctx, ins, attrs):
+    x = ins["X"][0]  # NCHW
+    n = attrs.get("n", 5)
+    k = attrs.get("k", 2.0)
+    alpha = attrs.get("alpha", 1e-4)
+    beta = attrs.get("beta", 0.75)
+    sq = jnp.square(x)
+    half = n // 2
+    pads = [(0, 0), (half, half), (0, 0), (0, 0)]
+    sq_pad = jnp.pad(sq, pads)
+    acc = sum(sq_pad[:, i:i + x.shape[1]] for i in range(n))
+    mid = k + alpha * acc
+    return {"Out": [x / jnp.power(mid, beta)], "MidOut": [mid]}
+
+
+def _interp(x, attrs, method):
+    oh = attrs.get("out_h", -1)
+    ow = attrs.get("out_w", -1)
+    scale = attrs.get("scale", 0.0)
+    if (oh is None or oh <= 0) and scale:
+        oh = int(x.shape[2] * scale)
+        ow = int(x.shape[3] * scale)
+    align = attrs.get("align_corners", True)
+    if align and method != "nearest":
+        return _bilinear_align_corners(x, oh, ow)
+    m = {"bilinear": "linear", "nearest": "nearest",
+         "trilinear": "linear"}[method]
+    return jax.image.resize(x, x.shape[:2] + (oh, ow), method=m)
+
+
+def _bilinear_align_corners(x, oh, ow):
+    h, w = x.shape[2], x.shape[3]
+    ys = jnp.linspace(0, h - 1, oh)
+    xs = jnp.linspace(0, w - 1, ow)
+    y0 = jnp.floor(ys).astype(jnp.int32)
+    x0 = jnp.floor(xs).astype(jnp.int32)
+    y1 = jnp.minimum(y0 + 1, h - 1)
+    x1 = jnp.minimum(x0 + 1, w - 1)
+    wy = (ys - y0)[None, None, :, None]
+    wx = (xs - x0)[None, None, None, :]
+    g = lambda yy, xx: x[:, :, yy][:, :, :, xx]  # noqa: E731
+    out = (g(y0, x0) * (1 - wy) * (1 - wx) + g(y0, x1) * (1 - wy) * wx +
+           g(y1, x0) * wy * (1 - wx) + g(y1, x1) * wy * wx)
+    return out
+
+
+@register_op("bilinear_interp")
+def _bilinear_interp(ctx, ins, attrs):
+    return {"Out": [_interp(ins["X"][0], attrs, "bilinear")]}
+
+
+@register_op("nearest_interp")
+def _nearest_interp(ctx, ins, attrs):
+    return {"Out": [_interp(ins["X"][0], attrs, "nearest")]}
+
+
+@register_op("pixel_shuffle")
+def _pixel_shuffle(ctx, ins, attrs):
+    x = ins["X"][0]
+    r = attrs.get("upscale_factor", 1)
+    n, c, h, w = x.shape
+    out = x.reshape(n, c // (r * r), r, r, h, w)
+    out = out.transpose(0, 1, 4, 2, 5, 3).reshape(n, c // (r * r), h * r,
+                                                  w * r)
+    return {"Out": [out]}
+
+
+@register_op("space_to_depth")
+def _space_to_depth(ctx, ins, attrs):
+    x = ins["X"][0]
+    b = attrs.get("blocksize", 1)
+    n, c, h, w = x.shape
+    out = x.reshape(n, c, h // b, b, w // b, b)
+    out = out.transpose(0, 3, 5, 1, 2, 4).reshape(n, c * b * b, h // b, w // b)
+    return {"Out": [out]}
+
+
+@register_op("temporal_shift")
+def _temporal_shift(ctx, ins, attrs):
+    x = ins["X"][0]
+    t = attrs["seg_num"]
+    ratio = attrs.get("shift_ratio", 0.25)
+    nt, c, h, w = x.shape
+    n = nt // t
+    xr = x.reshape(n, t, c, h, w)
+    c1 = int(c * ratio)
+    fwd = jnp.pad(xr[:, 1:, :c1], ((0, 0), (0, 1), (0, 0), (0, 0), (0, 0)))
+    bwd = jnp.pad(xr[:, :-1, c1:2 * c1],
+                  ((0, 0), (1, 0), (0, 0), (0, 0), (0, 0)))
+    out = jnp.concatenate([fwd, bwd, xr[:, :, 2 * c1:]], axis=2)
+    return {"Out": [out.reshape(nt, c, h, w)]}
+
+
+@register_op("shuffle_channel")
+def _shuffle_channel(ctx, ins, attrs):
+    x = ins["X"][0]
+    g = attrs.get("group", 1)
+    n, c, h, w = x.shape
+    return {"Out": [x.reshape(n, g, c // g, h, w).swapaxes(1, 2)
+                    .reshape(n, c, h, w)]}
+
+
+@register_op("affine_channel")
+def _affine_channel(ctx, ins, attrs):
+    x = ins["X"][0]
+    scale, bias = ins["Scale"][0], ins["Bias"][0]
+    bshape = (1, -1) + (1,) * (x.ndim - 2)
+    return {"Out": [x * scale.reshape(bshape) + bias.reshape(bshape)]}
+
+
+@register_op("unfold")
+def _unfold(ctx, ins, attrs):
+    x = ins["X"][0]  # NCHW
+    k = attrs["kernel_sizes"]
+    s = attrs.get("strides", [1, 1])
+    p = attrs.get("paddings", [0, 0, 0, 0])
+    d = attrs.get("dilations", [1, 1])
+    patches = jax.lax.conv_general_dilated_patches(
+        x, filter_shape=k, window_strides=s,
+        padding=[(p[0], p[2] if len(p) > 2 else p[0]),
+                 (p[1], p[3] if len(p) > 3 else p[1])],
+        rhs_dilation=d, dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    n, ckk = patches.shape[0], patches.shape[1]
+    return {"Y": [patches.reshape(n, ckk, -1)]}
+
+
+@register_op("add_position_encoding")
+def _add_position_encoding(ctx, ins, attrs):
+    x = ins["X"][0]  # [B, T, D]
+    alpha = attrs.get("alpha", 1.0)
+    beta = attrs.get("beta", 1.0)
+    b, t, d = x.shape
+    pos = jnp.arange(t, dtype=x.dtype)[:, None]
+    i = jnp.arange(d // 2, dtype=x.dtype)[None, :]
+    angle = pos / jnp.power(10000.0, 2 * i / d)
+    pe = jnp.concatenate([jnp.sin(angle), jnp.cos(angle)], axis=-1)
+    return {"Out": [alpha * x + beta * pe[None]]}

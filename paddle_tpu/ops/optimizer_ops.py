@@ -1,0 +1,255 @@
+"""Optimizer update ops (reference: operators/optimizers/, 4.9k LoC).
+
+Each op consumes Param + accumulators and emits *Out slots that alias the
+same var names, so the Executor's donated state dict updates in place at the
+XLA buffer level. All run fused inside the single step computation — the
+reference's per-param optimizer-op fusion passes
+(ir/fuse_optimizer_ops_pass/) are unnecessary here because XLA fuses them.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+def _lr(ins):
+    return ins["LearningRate"][0].reshape(())
+
+
+@register_op("sgd", inplace=True)
+def _sgd(ctx, ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    return {"ParamOut": [p - _lr(ins) * g]}
+
+
+@register_op("momentum", inplace=True)
+def _momentum(ctx, ins, attrs):
+    p, g, v = ins["Param"][0], ins["Grad"][0], ins["Velocity"][0]
+    mu = attrs.get("mu", 0.9)
+    lr = _lr(ins)
+    v_out = mu * v + g
+    if attrs.get("use_nesterov", False):
+        p_out = p - (g + mu * v_out) * lr
+    else:
+        p_out = p - lr * v_out
+    return {"ParamOut": [p_out], "VelocityOut": [v_out]}
+
+
+@register_op("lars_momentum", inplace=True)
+def _lars_momentum(ctx, ins, attrs):
+    p, g, v = ins["Param"][0], ins["Grad"][0], ins["Velocity"][0]
+    mu = attrs.get("mu", 0.9)
+    coeff = attrs.get("lars_coeff", 0.001)
+    decay = attrs.get("lars_weight_decay", 0.0005)
+    lr = _lr(ins)
+    pn = jnp.sqrt(jnp.sum(jnp.square(p)))
+    gn = jnp.sqrt(jnp.sum(jnp.square(g)))
+    local_lr = lr * coeff * pn / (gn + decay * pn + 1e-12)
+    v_out = mu * v + local_lr * (g + decay * p)
+    return {"ParamOut": [p - v_out], "VelocityOut": [v_out]}
+
+
+@register_op("adam", inplace=True)
+def _adam(ctx, ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    m1, m2 = ins["Moment1"][0], ins["Moment2"][0]
+    b1p = ins["Beta1Pow"][0].reshape(())
+    b2p = ins["Beta2Pow"][0].reshape(())
+    b1 = attrs.get("beta1", 0.9)
+    b2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    lr = _lr(ins) * jnp.sqrt(1 - b2p) / (1 - b1p)
+    m1o = b1 * m1 + (1 - b1) * g
+    m2o = b2 * m2 + (1 - b2) * g * g
+    p_out = p - lr * m1o / (jnp.sqrt(m2o) + eps)
+    return {"ParamOut": [p_out], "Moment1Out": [m1o], "Moment2Out": [m2o],
+            "Beta1PowOut": [(b1p * b1).reshape(ins["Beta1Pow"][0].shape)],
+            "Beta2PowOut": [(b2p * b2).reshape(ins["Beta2Pow"][0].shape)]}
+
+
+@register_op("adamw", inplace=True)
+def _adamw(ctx, ins, attrs):
+    # Decoupled weight decay (beyond-reference; standard for BERT training).
+    p, g = ins["Param"][0], ins["Grad"][0]
+    m1, m2 = ins["Moment1"][0], ins["Moment2"][0]
+    b1p = ins["Beta1Pow"][0].reshape(())
+    b2p = ins["Beta2Pow"][0].reshape(())
+    b1 = attrs.get("beta1", 0.9)
+    b2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    wd = attrs.get("coeff", 0.01)
+    base_lr = _lr(ins)
+    lr = base_lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+    m1o = b1 * m1 + (1 - b1) * g
+    m2o = b2 * m2 + (1 - b2) * g * g
+    p_out = p - lr * m1o / (jnp.sqrt(m2o) + eps) - base_lr * wd * p
+    return {"ParamOut": [p_out], "Moment1Out": [m1o], "Moment2Out": [m2o],
+            "Beta1PowOut": [(b1p * b1).reshape(ins["Beta1Pow"][0].shape)],
+            "Beta2PowOut": [(b2p * b2).reshape(ins["Beta2Pow"][0].shape)]}
+
+
+@register_op("adamax", inplace=True)
+def _adamax(ctx, ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    m, inf = ins["Moment"][0], ins["InfNorm"][0]
+    b1p = ins["Beta1Pow"][0].reshape(())
+    b1 = attrs.get("beta1", 0.9)
+    b2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    m_out = b1 * m + (1 - b1) * g
+    inf_out = jnp.maximum(b2 * inf, jnp.abs(g))
+    lr = _lr(ins) / (1 - b1p)
+    return {"ParamOut": [p - lr * m_out / (inf_out + eps)],
+            "MomentOut": [m_out], "InfNormOut": [inf_out]}
+
+
+@register_op("adagrad", inplace=True)
+def _adagrad(ctx, ins, attrs):
+    p, g, m = ins["Param"][0], ins["Grad"][0], ins["Moment"][0]
+    eps = attrs.get("epsilon", 1e-6)
+    m_out = m + g * g
+    return {"ParamOut": [p - _lr(ins) * g / (jnp.sqrt(m_out) + eps)],
+            "MomentOut": [m_out]}
+
+
+@register_op("decayed_adagrad", inplace=True)
+def _decayed_adagrad(ctx, ins, attrs):
+    p, g, m = ins["Param"][0], ins["Grad"][0], ins["Moment"][0]
+    decay = attrs.get("decay", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    m_out = decay * m + (1 - decay) * g * g
+    return {"ParamOut": [p - _lr(ins) * g / (jnp.sqrt(m_out) + eps)],
+            "MomentOut": [m_out]}
+
+
+@register_op("adadelta", inplace=True)
+def _adadelta(ctx, ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    sg, su = ins["AvgSquaredGrad"][0], ins["AvgSquaredUpdate"][0]
+    rho = attrs.get("rho", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    sg_out = rho * sg + (1 - rho) * g * g
+    upd = -jnp.sqrt((su + eps) / (sg_out + eps)) * g
+    su_out = rho * su + (1 - rho) * upd * upd
+    return {"ParamOut": [p + upd], "AvgSquaredGradOut": [sg_out],
+            "AvgSquaredUpdateOut": [su_out]}
+
+
+@register_op("rmsprop", inplace=True)
+def _rmsprop(ctx, ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    ms, mom = ins["MeanSquare"][0], ins["Moment"][0]
+    eps = attrs.get("epsilon", 1e-10)
+    decay = attrs.get("decay", 0.9)
+    mu = attrs.get("momentum", 0.0)
+    lr = _lr(ins)
+    ms_out = decay * ms + (1 - decay) * g * g
+    outs = {"MeanSquareOut": [ms_out]}
+    if attrs.get("centered", False):
+        mg = ins["MeanGrad"][0]
+        mg_out = decay * mg + (1 - decay) * g
+        denom = ms_out - mg_out * mg_out + eps
+        outs["MeanGradOut"] = [mg_out]
+    else:
+        denom = ms_out + eps
+    mom_out = mu * mom + lr * g * jax.lax.rsqrt(denom)
+    outs["MomentOut"] = [mom_out]
+    outs["ParamOut"] = [p - mom_out]
+    return outs
+
+
+@register_op("ftrl", inplace=True)
+def _ftrl(ctx, ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    sq, lin = ins["SquaredAccumulator"][0], ins["LinearAccumulator"][0]
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    lr_power = attrs.get("lr_power", -0.5)
+    lr = _lr(ins)
+    new_sq = sq + g * g
+    if lr_power == -0.5:
+        sigma = (jnp.sqrt(new_sq) - jnp.sqrt(sq)) / lr
+    else:
+        sigma = (jnp.power(new_sq, -lr_power) - jnp.power(sq, -lr_power)) / lr
+    lin_out = lin + g - sigma * p
+    if lr_power == -0.5:
+        x = l2 + jnp.sqrt(new_sq) / lr
+    else:
+        x = l2 + jnp.power(new_sq, -lr_power) / lr
+    pre = jnp.clip(lin_out, -l1, l1) - lin_out
+    p_out = pre / x
+    return {"ParamOut": [p_out], "SquaredAccumOut": [new_sq],
+            "LinearAccumOut": [lin_out]}
+
+
+@register_op("lamb", inplace=True)
+def _lamb(ctx, ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    m1, m2 = ins["Moment1"][0], ins["Moment2"][0]
+    b1p = ins["Beta1Pow"][0].reshape(())
+    b2p = ins["Beta2Pow"][0].reshape(())
+    b1 = attrs.get("beta1", 0.9)
+    b2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-6)
+    wd = attrs.get("weight_decay", 0.01)
+    m1o = b1 * m1 + (1 - b1) * g
+    m2o = b2 * m2 + (1 - b2) * g * g
+    mhat = m1o / (1 - b1p)
+    vhat = m2o / (1 - b2p)
+    r = mhat / (jnp.sqrt(vhat) + eps) + wd * p
+    pn = jnp.sqrt(jnp.sum(jnp.square(p)))
+    rn = jnp.sqrt(jnp.sum(jnp.square(r)))
+    trust = jnp.where((pn > 0) & (rn > 0), pn / rn, 1.0)
+    return {"ParamOut": [p - _lr(ins) * trust * r],
+            "Moment1Out": [m1o], "Moment2Out": [m2o],
+            "Beta1PowOut": [(b1p * b1).reshape(ins["Beta1Pow"][0].shape)],
+            "Beta2PowOut": [(b2p * b2).reshape(ins["Beta2Pow"][0].shape)]}
+
+
+@register_op("proximal_gd", inplace=True)
+def _proximal_gd(ctx, ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    lr = _lr(ins)
+    prox = p - lr * g
+    if l1 > 0:
+        prox = (jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0))
+    return {"ParamOut": [prox / (1.0 + lr * l2)]}
+
+
+@register_op("proximal_adagrad", inplace=True)
+def _proximal_adagrad(ctx, ins, attrs):
+    p, g, m = ins["Param"][0], ins["Grad"][0], ins["Moment"][0]
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    m_out = m + g * g
+    lr = _lr(ins) * jax.lax.rsqrt(m_out + 1e-12)
+    prox = p - lr * g
+    if l1 > 0:
+        prox = jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0)
+    return {"ParamOut": [prox / (1.0 + lr * l2)], "MomentOut": [m_out]}
+
+
+@register_op("dpsgd", inplace=True, stateful=True)
+def _dpsgd(ctx, ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    clip = attrs.get("clip", 10.0)
+    sigma = attrs.get("sigma", 1.0)
+    gn = jnp.sqrt(jnp.sum(jnp.square(g)))
+    g = g * jnp.minimum(1.0, clip / (gn + 1e-12))
+    noise = sigma * clip * jax.random.normal(ctx.rng, g.shape, g.dtype)
+    return {"ParamOut": [p - _lr(ins) * (g + noise)]}
+
+
+@register_op("average_accumulates", inplace=True)
+def _average_accumulates(ctx, ins, attrs):
+    # ModelAverage support (average_accumulates_op.cc): accumulate param sums.
+    p = ins["Param"][0]
+    s1 = ins["InSum1"][0]
+    n = ins["InNumAccumulates"][0].reshape(())
+    return {"OutSum1": [s1 + p],
+            "OutNumAccumulates": [(n + 1).reshape(
+                ins["InNumAccumulates"][0].shape)]}

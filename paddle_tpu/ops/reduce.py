@@ -1,0 +1,31 @@
+"""Reduce ops (reference: operators/reduce_ops/, 1.8k LoC)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+def _reduce(name, fn, nondiff=False):
+    kw = {"nondiff_outputs": ("Out",)} if nondiff else {}
+
+    @register_op(name, **kw)
+    def _low(ctx, ins, attrs, _fn=fn):
+        x = ins["X"][0]
+        dims = attrs.get("dim", [0])
+        keep = attrs.get("keep_dim", False)
+        if attrs.get("reduce_all", False) or not dims:
+            axis = None
+        else:
+            axis = tuple(d % x.ndim for d in dims)
+        return {"Out": [_fn(x, axis=axis, keepdims=keep)]}
+    return _low
+
+
+_reduce("reduce_sum", jnp.sum)
+_reduce("reduce_mean", jnp.mean)
+_reduce("reduce_max", jnp.max)
+_reduce("reduce_min", jnp.min)
+_reduce("reduce_prod", jnp.prod)
+_reduce("reduce_any", jnp.any, nondiff=True)
+_reduce("reduce_all", jnp.all, nondiff=True)

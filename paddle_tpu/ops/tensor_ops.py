@@ -1,0 +1,266 @@
+"""Tensor creation / init / random ops.
+
+Reference: fill_constant_op.cc, uniform_random_op.cc, gaussian_random_op.cc,
+truncated_gaussian_random_op.cc, one_hot_op.cc, assign_op.cc, range_op.cc...
+Random ops draw from the ctx PRNG key, which is deterministically derived per
+(step, op-id) — see core/lowering._OpCtx.rng — so runs are reproducible and
+vjp-grads see the same randomness as forward.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dtypes import as_np_dtype
+from ..core.registry import register_op
+
+
+def _shape_attr(attrs, key="shape"):
+    return tuple(int(s) for s in attrs[key])
+
+
+@register_op("fill_constant", nondiff_outputs=("Out",))
+def _fill_constant(ctx, ins, attrs):
+    dtype = as_np_dtype(attrs.get("dtype", "float32"))
+    return {"Out": [jnp.full(_shape_attr(attrs), attrs.get("value", 0.0),
+                             dtype=dtype)]}
+
+
+@register_op("fill_constant_batch_size_like", nondiff_inputs=("Input",),
+             nondiff_outputs=("Out",))
+def _fill_constant_bsl(ctx, ins, attrs):
+    ref = ins["Input"][0]
+    shape = list(_shape_attr(attrs))
+    in_idx = attrs.get("input_dim_idx", 0)
+    out_idx = attrs.get("output_dim_idx", 0)
+    shape[out_idx] = ref.shape[in_idx]
+    dtype = as_np_dtype(attrs.get("dtype", "float32"))
+    return {"Out": [jnp.full(shape, attrs.get("value", 0.0), dtype=dtype)]}
+
+
+@register_op("fill_zeros_like", nondiff_inputs=("X",),
+             nondiff_outputs=("Out",))
+def _fill_zeros_like(ctx, ins, attrs):
+    x = ins["X"][0]
+    return {"Out": [jnp.zeros(x.shape, x.dtype)]}
+
+
+@register_op("fill_any_like", nondiff_inputs=("X",), nondiff_outputs=("Out",))
+def _fill_any_like(ctx, ins, attrs):
+    x = ins["X"][0]
+    dtype = attrs.get("dtype")
+    dtype = x.dtype if dtype in (None, -1) else as_np_dtype(dtype)
+    return {"Out": [jnp.full(x.shape, attrs.get("value", 0.0), dtype=dtype)]}
+
+
+@register_op("uniform_random", stateful=True, nondiff_outputs=("Out",))
+def _uniform_random(ctx, ins, attrs):
+    dtype = as_np_dtype(attrs.get("dtype", "float32"))
+    out = jax.random.uniform(
+        ctx.rng, _shape_attr(attrs), dtype=jnp.float32,
+        minval=attrs.get("min", -1.0), maxval=attrs.get("max", 1.0))
+    return {"Out": [out.astype(dtype)]}
+
+
+@register_op("uniform_random_batch_size_like", stateful=True,
+             nondiff_inputs=("Input",), nondiff_outputs=("Out",))
+def _uniform_random_bsl(ctx, ins, attrs):
+    ref = ins["Input"][0]
+    shape = list(_shape_attr(attrs))
+    shape[attrs.get("output_dim_idx", 0)] = \
+        ref.shape[attrs.get("input_dim_idx", 0)]
+    out = jax.random.uniform(ctx.rng, shape, dtype=jnp.float32,
+                             minval=attrs.get("min", -1.0),
+                             maxval=attrs.get("max", 1.0))
+    return {"Out": [out.astype(as_np_dtype(attrs.get("dtype", "float32")))]}
+
+
+@register_op("gaussian_random", stateful=True, nondiff_outputs=("Out",))
+def _gaussian_random(ctx, ins, attrs):
+    dtype = as_np_dtype(attrs.get("dtype", "float32"))
+    out = (jax.random.normal(ctx.rng, _shape_attr(attrs), dtype=jnp.float32)
+           * attrs.get("std", 1.0) + attrs.get("mean", 0.0))
+    return {"Out": [out.astype(dtype)]}
+
+
+@register_op("truncated_gaussian_random", stateful=True,
+             nondiff_outputs=("Out",))
+def _truncated_gaussian(ctx, ins, attrs):
+    dtype = as_np_dtype(attrs.get("dtype", "float32"))
+    out = jax.random.truncated_normal(
+        ctx.rng, -2.0, 2.0, _shape_attr(attrs), dtype=jnp.float32)
+    out = out * attrs.get("std", 1.0) + attrs.get("mean", 0.0)
+    return {"Out": [out.astype(dtype)]}
+
+
+@register_op("randint", stateful=True, nondiff_outputs=("Out",))
+def _randint(ctx, ins, attrs):
+    return {"Out": [jax.random.randint(
+        ctx.rng, _shape_attr(attrs), attrs.get("low", 0),
+        attrs.get("high", 100), dtype=as_np_dtype(
+            attrs.get("dtype", "int64")))]}
+
+
+@register_op("sampling_id", stateful=True, nondiff_outputs=("Out",))
+def _sampling_id(ctx, ins, attrs):
+    x = ins["X"][0]  # [batch, n] probabilities
+    return {"Out": [jax.random.categorical(
+        ctx.rng, jnp.log(x + 1e-20), axis=-1).astype(jnp.int64)]}
+
+
+@register_op("assign")
+def _assign(ctx, ins, attrs):
+    return {"Out": [ins["X"][0]]}
+
+
+@register_op("assign_value", nondiff_outputs=("Out",))
+def _assign_value(ctx, ins, attrs):
+    dtype = as_np_dtype(attrs.get("dtype", "float32"))
+    vals = attrs.get("values")
+    if isinstance(vals, np.ndarray):
+        arr = jnp.asarray(vals, dtype=dtype)
+    else:
+        arr = jnp.asarray(np.asarray(vals, dtype=dtype))
+    return {"Out": [arr.reshape(_shape_attr(attrs))]}
+
+
+@register_op("increment")
+def _increment(ctx, ins, attrs):
+    x = ins["X"][0]
+    return {"Out": [x + jnp.asarray(attrs.get("step", 1.0), x.dtype)]}
+
+
+@register_op("range", nondiff_outputs=("Out",))
+def _range(ctx, ins, attrs):
+    s = ins["Start"][0].reshape(())
+    e = ins["End"][0].reshape(())
+    st = ins["Step"][0].reshape(())
+    n = attrs.get("static_len")
+    if n is None:
+        raise NotImplementedError(
+            "range requires static_len attr under XLA (static shapes)")
+    return {"Out": [s + jnp.arange(n, dtype=s.dtype) * st]}
+
+
+@register_op("linspace", nondiff_outputs=("Out",))
+def _linspace(ctx, ins, attrs):
+    s = ins["Start"][0].reshape(())
+    e = ins["Stop"][0].reshape(())
+    n = int(attrs["num"]) if "num" in attrs else int(ins["Num"][0])
+    return {"Out": [jnp.linspace(s, e, n)]}
+
+
+@register_op("eye", nondiff_outputs=("Out",))
+def _eye(ctx, ins, attrs):
+    n = int(attrs["num_rows"])
+    m = int(attrs.get("num_columns", -1))
+    m = n if m < 0 else m
+    return {"Out": [jnp.eye(n, m,
+                            dtype=as_np_dtype(attrs.get("dtype", "float32")))]}
+
+
+@register_op("diag", nondiff_outputs=())
+def _diag(ctx, ins, attrs):
+    return {"Out": [jnp.diag(ins["Diagonal"][0])]}
+
+
+@register_op("one_hot", nondiff_inputs=("X",), nondiff_outputs=("Out",))
+def _one_hot(ctx, ins, attrs):
+    x = ins["X"][0]
+    depth = int(attrs["depth"])
+    squeezed = x.reshape(x.shape[:-1]) if x.shape and x.shape[-1] == 1 else x
+    return {"Out": [jax.nn.one_hot(squeezed, depth, dtype=jnp.float32)]}
+
+
+@register_op("one_hot_v2", nondiff_inputs=("X",), nondiff_outputs=("Out",))
+def _one_hot_v2(ctx, ins, attrs):
+    return {"Out": [jax.nn.one_hot(ins["X"][0], int(attrs["depth"]),
+                                   dtype=jnp.float32)]}
+
+
+@register_op("reverse")
+def _reverse(ctx, ins, attrs):
+    return {"Out": [jnp.flip(ins["X"][0], axis=tuple(attrs["axis"]))]}
+
+
+@register_op("is_empty", nondiff_outputs=("Out",))
+def _is_empty(ctx, ins, attrs):
+    return {"Out": [jnp.asarray(ins["X"][0].size == 0)]}
+
+
+@register_op("isfinite", nondiff_outputs=("Out",))
+def _isfinite(ctx, ins, attrs):
+    return {"Out": [jnp.all(jnp.isfinite(ins["X"][0]))]}
+
+
+@register_op("has_inf", nondiff_outputs=("Out",))
+def _has_inf(ctx, ins, attrs):
+    return {"Out": [jnp.any(jnp.isinf(ins["X"][0]))]}
+
+
+@register_op("has_nan", nondiff_outputs=("Out",))
+def _has_nan(ctx, ins, attrs):
+    return {"Out": [jnp.any(jnp.isnan(ins["X"][0]))]}
+
+
+@register_op("where_index", nondiff_outputs=("Out",))
+def _where_index(ctx, ins, attrs):
+    raise NotImplementedError(
+        "`where` (nonzero-indices) has a data-dependent output shape and "
+        "cannot lower to XLA; use masked ops instead")
+
+
+@register_op("label_smooth")
+def _label_smooth(ctx, ins, attrs):
+    x = ins["X"][0]
+    eps = attrs.get("epsilon", 0.0)
+    if "PriorDist" in ins:
+        prior = ins["PriorDist"][0]
+        return {"Out": [(1 - eps) * x + eps * prior]}
+    return {"Out": [(1 - eps) * x + eps / x.shape[-1]]}
+
+
+@register_op("multiplex", nondiff_inputs=("Ids",))
+def _multiplex(ctx, ins, attrs):
+    ids = ins["Ids"][0].reshape(-1)
+    stacked = jnp.stack(ins["X"], axis=0)  # [n, batch, ...]
+    rows = jnp.arange(stacked.shape[1])
+    return {"Out": [stacked[ids, rows]]}
+
+
+@register_op("lookup_table", nondiff_inputs=("Ids",))
+def _lookup_table(ctx, ins, attrs):
+    w, ids = ins["W"][0], ins["Ids"][0]
+    flat = ids.reshape(-1)
+    out = jnp.take(w, flat, axis=0)
+    padding_idx = attrs.get("padding_idx", -1)
+    if padding_idx is not None and padding_idx != -1:
+        pad = padding_idx % w.shape[0]
+        out = jnp.where((flat == pad)[:, None], 0.0, out)
+    return {"Out": [out.reshape(ids.shape[:-1] + (w.shape[-1],))
+                    if ids.shape and ids.shape[-1] == 1
+                    else out.reshape(ids.shape + (w.shape[-1],))]}
+
+
+@register_op("lookup_table_v2", nondiff_inputs=("Ids",))
+def _lookup_table_v2(ctx, ins, attrs):
+    w, ids = ins["W"][0], ins["Ids"][0]
+    out = jnp.take(w, ids.reshape(-1), axis=0)
+    padding_idx = attrs.get("padding_idx", -1)
+    if padding_idx is not None and padding_idx != -1:
+        pad = padding_idx % w.shape[0]
+        out = jnp.where((ids.reshape(-1) == pad)[:, None], 0.0, out)
+    return {"Out": [out.reshape(ids.shape + (w.shape[-1],))]}
+
+
+@register_op("shard_index", nondiff_inputs=("X",), nondiff_outputs=("Out",))
+def _shard_index(ctx, ins, attrs):
+    x = ins["X"][0]
+    index_num = attrs["index_num"]
+    nshards = attrs["nshards"]
+    shard_id = attrs["shard_id"]
+    ignore_value = attrs.get("ignore_value", -1)
+    shard_size = (index_num + nshards - 1) // nshards
+    in_shard = (x // shard_size) == shard_id
+    return {"Out": [jnp.where(in_shard, x % shard_size, ignore_value)]}

@@ -1,0 +1,8 @@
+"""Parallelism: mesh/sharding utilities, collectives, SPMD training.
+
+Reference scope: SURVEY.md §2.7 — ParallelExecutor DP, collective
+transpiler, hierarchical allreduce, pipeline, recompute... re-expressed as
+jax.sharding meshes + GSPMD + shard_map collectives over ICI/DCN.
+"""
+from .api import ParallelExecutor  # noqa: F401
+from .mesh import get_mesh, set_mesh, mesh_context  # noqa: F401

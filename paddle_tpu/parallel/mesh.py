@@ -1,0 +1,55 @@
+"""Global device-mesh registry.
+
+The reference keys NCCL communicators by ring_id (collective_helper.h
+NCCLCommContext). Here the analogue is a named-axis Mesh; collective ops
+carry a ring_id attr that maps to a mesh axis name via this registry.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+_current_mesh: Optional[Mesh] = None
+
+
+def make_mesh(shape=None, axis_names=None, devices=None) -> Mesh:
+    devices = np.asarray(devices if devices is not None else jax.devices())
+    if shape is None:
+        shape = (devices.size,)
+        axis_names = axis_names or ("dp",)
+    return Mesh(devices.reshape(shape), axis_names=tuple(axis_names))
+
+
+def set_mesh(mesh: Mesh):
+    global _current_mesh
+    _current_mesh = mesh
+
+
+def get_mesh() -> Mesh:
+    global _current_mesh
+    if _current_mesh is None:
+        _current_mesh = make_mesh()
+    return _current_mesh
+
+
+@contextlib.contextmanager
+def mesh_context(mesh: Mesh):
+    global _current_mesh
+    old = _current_mesh
+    _current_mesh = mesh
+    try:
+        yield mesh
+    finally:
+        _current_mesh = old
+
+
+def axis_for_ring(ring_id: int) -> str:
+    """Map a reference-style ring_id to a mesh axis name: ring 0 = first
+    axis (the data-parallel ring in the collective transpiler)."""
+    mesh = get_mesh()
+    names = list(mesh.axis_names)
+    return names[min(ring_id, len(names) - 1)]

@@ -1,0 +1,55 @@
+"""Profiler: phase annotations + device timeline.
+
+Reference: platform/profiler.h RecordEvent/RecordBlock + CUPTI DeviceTracer
+merged into a chrome-trace (tools/timeline.py). TPU equivalent: jax.profiler
+traces (XPlane -> TensorBoard/Perfetto) with the same "annotate framework
+phases, merge with device timeline" design via TraceAnnotation.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+__all__ = ["profiler", "start_profiler", "stop_profiler", "reset_profiler",
+           "record_event", "cuda_profiler"]
+
+_trace_dir = None
+
+
+def start_profiler(state="All", tracer_option=None,
+                   output_dir="/tmp/paddle_tpu_profile"):
+    global _trace_dir
+    _trace_dir = output_dir
+    jax.profiler.start_trace(output_dir)
+
+
+def stop_profiler(sorted_key=None, profile_path=None):
+    jax.profiler.stop_trace()
+
+
+def reset_profiler():
+    pass
+
+
+@contextlib.contextmanager
+def profiler(state="All", sorted_key=None,
+             profile_path="/tmp/paddle_tpu_profile", tracer_option=None):
+    start_profiler(state, tracer_option, profile_path)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)
+
+
+@contextlib.contextmanager
+def record_event(name):
+    """RecordEvent RAII (profiler.h:81) -> XPlane trace annotation."""
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+@contextlib.contextmanager
+def cuda_profiler(*a, **kw):  # name kept for source compat
+    with profiler():
+        yield

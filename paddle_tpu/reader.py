@@ -1,0 +1,118 @@
+"""DataLoader / PyReader: host input pipeline with prefetch.
+
+Reference: python/paddle/fluid/reader.py (DataLoader.from_generator :73,
+PyReader :569) over C++ LoDTensorBlockingQueue + double-buffered reader ops
+(operators/reader/buffered_reader.cc). On TPU the analogue is a host-side
+prefetch thread that stages numpy batches while the device computes —
+device transfer happens inside the jitted step, overlapped by XLA's async
+dispatch. A native C++ feeder (utils/native) accelerates decode when built.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+
+__all__ = ["DataLoader", "PyReader"]
+
+
+class _GeneratorLoader:
+    def __init__(self, feed_list, capacity, iterable, return_list,
+                 use_double_buffer=True):
+        self.feed_list = feed_list
+        self.capacity = capacity
+        self.iterable = iterable
+        self.return_list = return_list
+        self._gen = None
+        self._places = None
+
+    # -- configuration ---------------------------------------------------
+    def set_sample_generator(self, reader, batch_size, drop_last=True,
+                             places=None):
+        from .io import batch as batch_decorator
+        return self.set_sample_list_generator(
+            batch_decorator(reader, batch_size, drop_last), places)
+
+    def set_sample_list_generator(self, reader, places=None):
+        from .data_feeder import DataFeeder
+        feeder = DataFeeder(self.feed_list)
+
+        def gen():
+            for sample_list in reader():
+                yield feeder.feed(sample_list)
+
+        self._gen = gen
+        self._places = places
+        return self
+
+    def set_batch_generator(self, reader, places=None):
+        def gen():
+            for batch in reader():
+                if isinstance(batch, dict):
+                    yield batch
+                else:
+                    yield {v.name: b for v, b in zip(self.feed_list, batch)}
+
+        self._gen = gen
+        self._places = places
+        return self
+
+    # -- iteration with prefetch ----------------------------------------
+    def __iter__(self):
+        q: "queue.Queue" = queue.Queue(maxsize=self.capacity or 2)
+        sentinel = object()
+
+        def worker():
+            try:
+                for item in self._gen():
+                    q.put(item)
+            finally:
+                q.put(sentinel)
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is sentinel:
+                break
+            yield item
+
+    def __call__(self):
+        return iter(self)
+
+    # PyReader-style start/reset are no-ops for the iterable loader.
+    def start(self):
+        pass
+
+    def reset(self):
+        pass
+
+
+class DataLoader:
+    @staticmethod
+    def from_generator(feed_list=None, capacity=2, use_double_buffer=True,
+                       iterable=True, return_list=False):
+        return _GeneratorLoader(feed_list or [], capacity, iterable,
+                                return_list, use_double_buffer)
+
+    @staticmethod
+    def from_dataset(dataset, places, drop_last=True):
+        raise NotImplementedError(
+            "Dataset loader lands with the fleet/data path")
+
+
+class PyReader(_GeneratorLoader):
+    def __init__(self, feed_list=None, capacity=2, use_double_buffer=True,
+                 iterable=True, return_list=False):
+        super().__init__(feed_list or [], capacity, iterable, return_list,
+                         use_double_buffer)
+
+    def decorate_sample_generator(self, sample_generator, batch_size,
+                                  drop_last=True, places=None):
+        return self.set_sample_generator(sample_generator, batch_size,
+                                         drop_last, places)
+
+    def decorate_sample_list_generator(self, reader, places=None):
+        return self.set_sample_list_generator(reader, places)
+
+    def decorate_batch_generator(self, reader, places=None):
+        return self.set_batch_generator(reader, places)

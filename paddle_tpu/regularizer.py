@@ -1,0 +1,33 @@
+"""Weight-decay regularizers (reference: regularizer.py L1/L2Decay) —
+applied by Optimizer.apply_gradients as grad := grad + d(reg)/d(param)."""
+from __future__ import annotations
+
+from .layers import math_ops
+
+__all__ = ["L1Decay", "L2Decay", "L1DecayRegularizer", "L2DecayRegularizer"]
+
+
+class L2DecayRegularizer:
+    def __init__(self, regularization_coeff=0.0):
+        self.coeff = regularization_coeff
+
+    def append_regularization_op(self, param, grad):
+        from .layers.nn import scale
+        from .layers.math_ops import elementwise_add
+        decay = scale(param, scale=self.coeff)
+        return elementwise_add(grad, decay)
+
+
+class L1DecayRegularizer:
+    def __init__(self, regularization_coeff=0.0):
+        self.coeff = regularization_coeff
+
+    def append_regularization_op(self, param, grad):
+        from .layers.nn import scale, sign
+        from .layers.math_ops import elementwise_add
+        decay = scale(sign(param), scale=self.coeff)
+        return elementwise_add(grad, decay)
+
+
+L1Decay = L1DecayRegularizer
+L2Decay = L2DecayRegularizer

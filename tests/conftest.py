@@ -1,0 +1,16 @@
+"""Test config: run on a virtual 8-device CPU mesh.
+
+Mirrors the reference's localhost multi-process trick (test_dist_base.py:877
+NCCL_P2P_DISABLE=1) — here XLA fakes 8 host devices so sharding/collective
+paths compile and run without TPU hardware (SURVEY.md §7 hard part (h)).
+Must run before jax is imported anywhere.
+"""
+import os
+
+# Hard-set: the host environment pins JAX_PLATFORMS to the TPU tunnel.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")

@@ -1,0 +1,95 @@
+"""End-to-end smoke: y=Wx+b lowering, autodiff, optimizer step, save/load.
+
+Mirrors the reference's install_check + book/test_fit_a_line."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def _fresh_programs():
+    main, startup = fluid.Program(), fluid.Program()
+    return main, startup
+
+
+def test_forward_fc():
+    main, startup = _fresh_programs()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[3], dtype="float32")
+        y = layers.fc(x, size=2, bias_attr=True)
+    assert y.shape == (-1, 2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    out, = exe.run(main, feed={"x": np.ones((4, 3), np.float32)},
+                   fetch_list=[y])
+    assert out.shape == (4, 2)
+
+
+def test_fit_a_line_converges():
+    rng = np.random.RandomState(0)
+    w_true = np.array([[2.0], [-3.4]], np.float32)
+    b_true = 4.2
+
+    main, startup = _fresh_programs()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[2], dtype="float32")
+        label = layers.data("y", shape=[1], dtype="float32")
+        pred = layers.fc(x, size=1)
+        loss = layers.mean(layers.square_error_cost(pred, label))
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+
+    exe = fluid.Executor()
+    exe.run(startup)
+    losses = []
+    for _ in range(150):
+        xs = rng.randn(32, 2).astype(np.float32)
+        ys = xs @ w_true + b_true + 0.01 * rng.randn(32, 1).astype(
+            np.float32)
+        lv, = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+        losses.append(float(lv))
+    assert losses[-1] < 0.05, f"did not converge: {losses[::30]}"
+
+
+def test_program_serialization_roundtrip():
+    main, startup = _fresh_programs()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[3], dtype="float32")
+        y = layers.fc(x, size=2)
+    blob = main.to_json()
+    restored = fluid.Program.from_json(blob)
+    assert restored.fingerprint() == main.fingerprint()
+
+
+def test_gradients_numeric_vs_analytic():
+    """OpTest-style check (reference op_test.py get_numeric_gradient)."""
+    main, startup = _fresh_programs()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4], dtype="float32")
+        x.stop_gradient = False
+        y = layers.tanh(x)
+        loss = layers.mean(y)
+        fluid.append_backward(loss)
+
+    exe = fluid.Executor()
+    exe.run(startup)
+    xv = np.random.RandomState(1).randn(2, 4).astype(np.float32)
+    gname = "x@GRAD"
+    g, = exe.run(main, feed={"x": xv}, fetch_list=[gname])
+    # numeric gradient (eps large enough to dominate fp32 eval noise)
+    eps = 1e-2
+    num = np.zeros_like(xv)
+    main2 = main.clone()
+
+    def f(v):
+        out, = exe.run(main2, feed={"x": v}, fetch_list=[loss.name])
+        return float(out)
+
+    for i in range(xv.size):
+        pert = xv.copy().reshape(-1)
+        pert[i] += eps
+        up = f(pert.reshape(xv.shape))
+        pert[i] -= 2 * eps
+        down = f(pert.reshape(xv.shape))
+        num.reshape(-1)[i] = (up - down) / (2 * eps)
+    np.testing.assert_allclose(g, num, rtol=1e-2, atol=1e-3)
