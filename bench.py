@@ -1,0 +1,91 @@
+"""Benchmark: BERT-base pretraining step throughput on one TPU chip.
+
+Matches BASELINE.json config 3 ("BERT-base pretraining — tokens/sec/chip").
+The whole training step (fwd + vjp-backward + AdamW) is one XLA program
+produced by the Executor. vs_baseline = measured MFU / 0.50 (the north-star
+">=50% MFU" target; the reference publishes no numeric baseline —
+BASELINE.md).
+
+Prints ONE JSON line.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np  # noqa: E402
+
+
+def peak_flops_per_chip():
+    """bf16 peak for the local chip; v5e = 197 TFLOP/s."""
+    import jax
+    kind = jax.devices()[0].device_kind.lower()
+    if "v5 lite" in kind or "v5e" in kind:
+        return 197e12
+    if "v4" in kind:
+        return 275e12
+    if "v5p" in kind or "v5" in kind:
+        return 459e12
+    return 197e12
+
+
+def model_flops_per_token(cfg, seq_len):
+    """Matmul flops per token, fwd+bwd (3x fwd): dense 6*N_mat +
+    attention 12*L*T*d (scores+context, fwd+bwd)."""
+    d, L = cfg.d_model, cfg.n_layers
+    n_mat = L * (4 * d * d + 2 * d * cfg.d_ff) + cfg.vocab_size * d
+    dense = 6 * n_mat
+    attn = 12 * L * seq_len * d
+    return dense + attn
+
+
+def main():
+    import paddle_tpu as fluid
+    from paddle_tpu.models import transformer
+
+    batch = int(os.environ.get("BENCH_BATCH", "8"))
+    seq_len = int(os.environ.get("BENCH_SEQ", "512"))
+    steps = int(os.environ.get("BENCH_STEPS", "20"))
+
+    cfg = transformer.bert_base(dropout=0.1)
+    main_prog, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main_prog, startup), fluid.scope_guard(scope):
+        loss, feeds = transformer.build_train(cfg, batch, seq_len, lr=1e-4)
+        exe = fluid.Executor()
+        exe.run(startup)
+
+        rng = np.random.RandomState(0)
+        toks = rng.randint(0, cfg.vocab_size,
+                           (batch, seq_len)).astype(np.int64)
+        feed = {"tokens": toks, "labels": toks}
+
+        # compile + warmup
+        exe.run(main_prog, feed=feed, fetch_list=[loss])
+        exe.run(main_prog, feed=feed, fetch_list=[loss])
+
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            lv, = exe.run(main_prog, feed=feed, fetch_list=[loss])
+        dt = (time.perf_counter() - t0) / steps
+
+    tokens_per_sec = batch * seq_len / dt
+    flops = model_flops_per_token(cfg, seq_len) * batch * seq_len
+    mfu = flops / dt / peak_flops_per_chip()
+    print(json.dumps({
+        "metric": "bert_base_pretrain_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.50, 4),
+        "extra": {"step_ms": round(dt * 1000, 2), "mfu": round(mfu, 4),
+                  "batch": batch, "seq_len": seq_len,
+                  "loss": float(np.asarray(lv))},
+    }))
+
+
+if __name__ == "__main__":
+    main()

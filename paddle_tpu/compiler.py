@@ -73,6 +73,8 @@ class CompiledProgram:
         self._loss_name = None
         self._places = None
         self._mesh = None
+        self._state_spec_fn = None
+        self._batch_axes = ("dp",)
 
     def with_data_parallel(self, loss_name=None, build_strategy=None,
                            exec_strategy=None, share_vars_from=None,
@@ -85,6 +87,19 @@ class CompiledProgram:
         self._places = places
         return self
 
+    def with_distributed(self, mesh: Mesh, state_spec_fn=None,
+                         batch_axes=("dp",)):
+        """Full SPMD: custom mesh (any dp/tp/sp/pp factorisation) +
+        per-parameter PartitionSpecs. state_spec_fn(var_name) ->
+        PartitionSpec or None (replicated). Feeds shard over batch_axes.
+        This is what the reference needed BuildStrategy + transpilers +
+        NCCL ring setup for; here it is three arguments to GSPMD."""
+        self._is_data_parallel = True
+        self._mesh = mesh
+        self._state_spec_fn = state_spec_fn
+        self._batch_axes = tuple(batch_axes)
+        return self
+
     # -- executor hook ---------------------------------------------------
     def mesh(self) -> Mesh:
         if self._mesh is None:
@@ -93,21 +108,35 @@ class CompiledProgram:
         return self._mesh
 
     def build_jit(self, step_fn, state_in_names, feed_arrays):
-        """jit `step_fn(state, feeds, step_idx)` with DP shardings:
-        feeds sharded on batch axis over the mesh, state replicated.
-        GSPMD then emits the gradient AllReduces over ICI — the entire
-        reference multi-device scheduler (SURVEY.md §2.1 details/) reduces
-        to these in_shardings."""
+        """jit `step_fn(state, feeds, step_idx)` with SPMD shardings:
+        feeds sharded on the batch axes, params per state_spec_fn
+        (replicated by default). GSPMD then emits gradient AllReduces /
+        TP collectives over ICI — the entire reference multi-device
+        scheduler (SURVEY.md §2.1 details/) reduces to these
+        in_shardings."""
         if not self._is_data_parallel or len(jax.devices()) == 1:
             return jax.jit(step_fn, donate_argnums=(0,))
         mesh = self.mesh()
         repl = NamedSharding(mesh, P())
-        batch = NamedSharding(mesh, P("dp"))
-        state_shard = {n: repl for n in state_in_names}
+        spec_fn = self._state_spec_fn
+        state_shard = {}
+        for n in state_in_names:
+            spec = spec_fn(n) if spec_fn is not None else None
+            state_shard[n] = NamedSharding(mesh, spec) if spec is not None \
+                else repl
+        unknown = [a for a in self._batch_axes if a not in mesh.axis_names]
+        if unknown:
+            raise ValueError(
+                f"batch_axes {unknown} not in mesh axes {mesh.axis_names}")
+        batch_axes = tuple(self._batch_axes)
+        nbatch = int(np.prod([mesh.shape[a] for a in batch_axes])) \
+            if batch_axes else 1
+        batch = NamedSharding(mesh, P(batch_axes if len(batch_axes) > 1
+                                      else batch_axes[0])) \
+            if batch_axes else repl
         feed_shard = {}
-        ndev = len(mesh.devices.reshape(-1))
         for n, a in feed_arrays.items():
-            if a.ndim >= 1 and a.shape[0] % ndev == 0:
+            if a.ndim >= 1 and nbatch > 1 and a.shape[0] % nbatch == 0:
                 feed_shard[n] = batch
             else:
                 feed_shard[n] = repl

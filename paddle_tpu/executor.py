@@ -43,6 +43,9 @@ class Executor:
         self.place = place or default_place()
         self._cache: Dict[tuple, _CompiledStep] = {}
         self._step_counters: Dict[str, int] = {}
+        # Strong refs to CompiledPrograms in the cache: keys use
+        # id(compiled), which is only stable while the object is alive.
+        self._compiled_refs: Dict[int, object] = {}
 
     # ------------------------------------------------------------------
     def run(self, program: Optional[Program] = None, feed=None,
@@ -74,6 +77,8 @@ class Executor:
             step_fn = self._compile(program, block, feed_arrays, fetch_names,
                                     scope, compiled)
             self._cache[key] = step_fn
+            if compiled is not None:
+                self._compiled_refs[id(compiled)] = compiled
 
         state = {}
         for n in step_fn.state_in_names:
@@ -138,12 +143,15 @@ class Executor:
         state_out = sorted(persistables & (produced | set(state_in)))
         seed = program.random_seed
 
+        mesh = compiled.mesh() if compiled is not None and \
+            compiled._is_data_parallel else None
+
         def step(state, feeds, step_idx):
             env = dict(state)
             env.update(feeds)
             base_key = jax.random.fold_in(
                 jax.random.PRNGKey(seed), step_idx)
-            ctx = LowerCtx(base_key)
+            ctx = LowerCtx(base_key, mesh=mesh)
             lower_block(block, env, ctx)
             fetches = [env[n] for n in fetch_names]
             new_state = {n: env[n] for n in state_out if n in env}
@@ -157,6 +165,7 @@ class Executor:
 
     def close(self):
         self._cache.clear()
+        self._compiled_refs.clear()
 
     # Reference parity: fluid.Executor.infer_from_dataset /
     # train_from_dataset are provided by the dataset path (see reader.py).

@@ -16,3 +16,4 @@ from .control_flow import *  # noqa: F401,F403
 from . import detection  # noqa: F401
 from . import sequence  # noqa: F401
 from .sequence import *  # noqa: F401,F403
+from .dist import *  # noqa: F401,F403

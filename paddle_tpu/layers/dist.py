@@ -1,0 +1,42 @@
+"""Distributed layer builders: sharding annotations + collectives.
+
+Reference analogue: python/paddle/fluid/layers/collective.py (thin wrappers
+over the c_* ops used by the transpiler). shard_hint is the TPU-native
+addition: a GSPMD sharding constraint on an activation, the tool behind
+tensor/sequence parallelism (SURVEY.md §2.7 'not present in reference').
+"""
+from __future__ import annotations
+
+from ..layer_helper import LayerHelper
+
+__all__ = ["shard_hint", "c_allreduce_sum", "c_broadcast", "c_allgather",
+           "c_reducescatter"]
+
+
+def shard_hint(x, spec, name=None):
+    """Constrain x's sharding: spec = list per dim of mesh-axis name(s) or
+    None, e.g. ["dp", None, "tp"]."""
+    helper = LayerHelper("shard_hint", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="shard_hint", inputs={"X": [x.name]},
+                     outputs={"Out": [out.name]}, attrs={"spec": list(spec)})
+    return out
+
+
+def _collective_layer(op_type):
+    def layer(x, ring_id=0, axis_name=None, name=None):
+        helper = LayerHelper(op_type, name=name)
+        out = helper.create_variable_for_type_inference(x.dtype)
+        helper.append_op(type=op_type, inputs={"X": [x.name]},
+                         outputs={"Out": [out.name]},
+                         attrs={"ring_id": ring_id,
+                                "axis_name": axis_name})
+        return out
+    layer.__name__ = op_type
+    return layer
+
+
+c_allreduce_sum = _collective_layer("c_allreduce_sum")
+c_broadcast = _collective_layer("c_broadcast")
+c_allgather = _collective_layer("c_allgather")
+c_reducescatter = _collective_layer("c_reducescatter")

@@ -1,0 +1,6 @@
+"""Model zoo: static-graph builders matching the reference's flagship
+benchmarks (BASELINE.json configs): MNIST LeNet (book/02), ResNet-50
+(PaddleCV), Transformer (PaddleNLP)."""
+from . import lenet  # noqa: F401
+from . import resnet  # noqa: F401
+from . import transformer  # noqa: F401

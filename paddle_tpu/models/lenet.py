@@ -1,0 +1,29 @@
+"""MNIST models (reference: tests/book/test_recognize_digits.py:65 —
+softmax_regression, multilayer_perceptron, convolutional_neural_network)."""
+from __future__ import annotations
+
+from .. import layers, nets
+
+
+def softmax_regression(img, label):
+    predict = layers.fc(img, size=10, act="softmax")
+    cost = layers.cross_entropy(predict, label)
+    return layers.mean(cost), predict
+
+
+def multilayer_perceptron(img, label):
+    h1 = layers.fc(img, size=200, act="tanh")
+    h2 = layers.fc(h1, size=200, act="tanh")
+    predict = layers.fc(h2, size=10, act="softmax")
+    cost = layers.cross_entropy(predict, label)
+    return layers.mean(cost), predict
+
+
+def convolutional_neural_network(img, label):
+    conv1 = nets.simple_img_conv_pool(img, num_filters=20, filter_size=5,
+                                      pool_size=2, pool_stride=2, act="relu")
+    conv2 = nets.simple_img_conv_pool(conv1, num_filters=50, filter_size=5,
+                                      pool_size=2, pool_stride=2, act="relu")
+    predict = layers.fc(conv2, size=10, act="softmax")
+    cost = layers.cross_entropy(predict, label)
+    return layers.mean(cost), predict

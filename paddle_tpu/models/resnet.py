@@ -1,0 +1,79 @@
+"""ResNet for ImageNet/CIFAR (reference benchmark config: models/PaddleCV
+ResNet-50; BASELINE.json north-star workload).
+
+Built from layers.conv2d/batch_norm/pool2d; on TPU the whole network
+compiles to one XLA computation with conv+BN+relu fusion handled by the
+compiler. bf16 via the AMP decorator (contrib/mixed_precision).
+"""
+from __future__ import annotations
+
+from .. import layers
+
+_DEPTH_CFG = {
+    18: ("basic", [2, 2, 2, 2]),
+    34: ("basic", [3, 4, 6, 3]),
+    50: ("bottleneck", [3, 4, 6, 3]),
+    101: ("bottleneck", [3, 4, 23, 3]),
+    152: ("bottleneck", [3, 8, 36, 3]),
+}
+
+
+def _conv_bn(x, num_filters, filter_size, stride=1, act=None, groups=1):
+    conv = layers.conv2d(x, num_filters, filter_size, stride=stride,
+                         padding=(filter_size - 1) // 2, groups=groups,
+                         bias_attr=False)
+    return layers.batch_norm(conv, act=act)
+
+
+def _shortcut(x, num_filters, stride):
+    if x.shape[1] != num_filters or stride != 1:
+        return _conv_bn(x, num_filters, 1, stride)
+    return x
+
+
+def _bottleneck(x, num_filters, stride):
+    conv0 = _conv_bn(x, num_filters, 1, act="relu")
+    conv1 = _conv_bn(conv0, num_filters, 3, stride, act="relu")
+    conv2 = _conv_bn(conv1, num_filters * 4, 1)
+    short = _shortcut(x, num_filters * 4, stride)
+    return layers.relu(layers.elementwise_add(short, conv2))
+
+
+def _basic(x, num_filters, stride):
+    conv0 = _conv_bn(x, num_filters, 3, stride, act="relu")
+    conv1 = _conv_bn(conv0, num_filters, 3)
+    short = _shortcut(x, num_filters, stride)
+    return layers.relu(layers.elementwise_add(short, conv1))
+
+
+def resnet(img, class_dim=1000, depth=50):
+    block_fn_name, counts = _DEPTH_CFG[depth]
+    block_fn = _bottleneck if block_fn_name == "bottleneck" else _basic
+    x = _conv_bn(img, 64, 7, stride=2, act="relu")
+    x = layers.pool2d(x, pool_size=3, pool_stride=2, pool_padding=1,
+                      pool_type="max")
+    for stage, n in enumerate(counts):
+        filters = 64 * (2 ** stage)
+        for i in range(n):
+            stride = 2 if i == 0 and stage > 0 else 1
+            x = block_fn(x, filters, stride)
+    x = layers.pool2d(x, pool_type="avg", global_pooling=True)
+    return layers.fc(x, size=class_dim)
+
+
+def resnet50(img, class_dim=1000):
+    return resnet(img, class_dim, depth=50)
+
+
+def build_train(img_shape=(3, 224, 224), class_dim=1000, depth=50,
+                lr=0.1, momentum=0.9):
+    """Full training graph: returns (loss, acc, feeds)."""
+    from .. import optimizer as opt
+    img = layers.data("image", shape=list(img_shape), dtype="float32")
+    label = layers.data("label", shape=[1], dtype="int64")
+    logits = resnet(img, class_dim, depth)
+    loss = layers.mean(
+        layers.softmax_with_cross_entropy(logits, label))
+    acc = layers.accuracy(layers.softmax(logits), label)
+    opt.Momentum(lr, momentum).minimize(loss)
+    return loss, acc, [img, label]

@@ -1,0 +1,173 @@
+"""Transformer encoder LM — the flagship NLP workload.
+
+Reference configs: Transformer-big NMT / BERT-base pretraining
+(BASELINE.json configs 2-3; reference attention assembled from
+matmul/softmax/layer_norm in models/PaddleNLP). Here the model is built
+from the layers API so the whole step is one XLA computation; optional
+Megatron-style tensor parallelism + sequence parallelism arrive via
+shard_hint annotations (GSPMD inserts the collectives over ICI):
+
+- QKV/FFN-in weights: column-sharded over 'tp'; proj/FFN-out: row-sharded
+- activations between blocks: sharded [dp, sp, None] for sequence
+  parallelism (the 2019 reference has no SP at all — SURVEY.md §2.7)
+"""
+from __future__ import annotations
+
+import math
+
+from .. import layers
+from ..framework import ParamAttr
+from ..initializer import Normal
+
+
+class TransformerConfig:
+    def __init__(self, vocab_size=30522, d_model=768, n_heads=12,
+                 n_layers=12, d_ff=3072, max_seq_len=512, dropout=0.1,
+                 tp=False, sp=False, dp_axis="dp", tp_axis="tp",
+                 sp_axis="sp"):
+        self.vocab_size = vocab_size
+        self.d_model = d_model
+        self.n_heads = n_heads
+        self.n_layers = n_layers
+        self.d_ff = d_ff
+        self.max_seq_len = max_seq_len
+        self.dropout = dropout
+        self.tp = tp  # annotate weights for tensor parallelism
+        self.sp = sp  # annotate activations for sequence parallelism
+        # Mesh axis names the hints refer to; Megatron-style SP shards the
+        # sequence over the TP group (set sp_axis=tp_axis).
+        self.dp_axis = dp_axis
+        self.tp_axis = tp_axis
+        self.sp_axis = sp_axis
+
+
+def bert_base(**kw):
+    return TransformerConfig(**kw)
+
+
+def bert_large(**kw):
+    kw.setdefault("d_model", 1024)
+    kw.setdefault("n_heads", 16)
+    kw.setdefault("n_layers", 24)
+    kw.setdefault("d_ff", 4096)
+    return TransformerConfig(**kw)
+
+
+def transformer_big(**kw):
+    """Transformer-big NMT scale (reference config 2)."""
+    kw.setdefault("vocab_size", 32000)
+    kw.setdefault("d_model", 1024)
+    kw.setdefault("n_heads", 16)
+    kw.setdefault("n_layers", 6)
+    kw.setdefault("d_ff", 4096)
+    return TransformerConfig(**kw)
+
+
+def _dense(x, size, name, cfg, act=None, tp_axis=None):
+    """fc with optional tp annotation on the weight via shard_hint on the
+    output (GSPMD propagates to the weight)."""
+    init = Normal(0.0, 0.02)
+    out = layers.fc(x, size=size, num_flatten_dims=2, act=act,
+                    param_attr=ParamAttr(name=f"{name}.w", initializer=init),
+                    bias_attr=ParamAttr(name=f"{name}.b"))
+    if cfg.tp and tp_axis == "col":
+        out = layers.shard_hint(out, [cfg.dp_axis, None, cfg.tp_axis])
+    return out
+
+
+def _attention(x, cfg, prefix):
+    b, t, d = x.shape[0], x.shape[1], cfg.d_model
+    h = cfg.n_heads
+    hd = d // h
+    q = _dense(x, d, f"{prefix}.q", cfg, tp_axis="col")
+    k = _dense(x, d, f"{prefix}.k", cfg, tp_axis="col")
+    v = _dense(x, d, f"{prefix}.v", cfg, tp_axis="col")
+
+    def split_heads(z):
+        z = layers.reshape(z, [b, t, h, hd])
+        return layers.transpose(z, [0, 2, 1, 3])  # [b, h, t, hd]
+
+    q, k, v = split_heads(q), split_heads(k), split_heads(v)
+    if cfg.tp:
+        q = layers.shard_hint(q, [cfg.dp_axis, cfg.tp_axis, None, None])
+        k = layers.shard_hint(k, [cfg.dp_axis, cfg.tp_axis, None, None])
+        v = layers.shard_hint(v, [cfg.dp_axis, cfg.tp_axis, None, None])
+    scores = layers.matmul(q, k, transpose_y=True,
+                           alpha=1.0 / math.sqrt(hd))
+    weights = layers.softmax(scores)
+    if cfg.dropout:
+        weights = layers.dropout(
+            weights, cfg.dropout,
+            dropout_implementation="upscale_in_train")
+    ctxv = layers.matmul(weights, v)  # [b, h, t, hd]
+    ctxv = layers.transpose(ctxv, [0, 2, 1, 3])
+    ctxv = layers.reshape(ctxv, [b, t, d])
+    return _dense(ctxv, d, f"{prefix}.proj", cfg, tp_axis="row")
+
+
+def _ffn(x, cfg, prefix):
+    h = _dense(x, cfg.d_ff, f"{prefix}.fc1", cfg, act="gelu",
+               tp_axis="col")
+    return _dense(h, cfg.d_model, f"{prefix}.fc2", cfg, tp_axis="row")
+
+
+def _block(x, cfg, i):
+    att = _attention(x, cfg, f"layer_{i}.att")
+    if cfg.dropout:
+        att = layers.dropout(att, cfg.dropout,
+                             dropout_implementation="upscale_in_train")
+    x = layers.layer_norm(layers.elementwise_add(x, att),
+                          begin_norm_axis=2)
+    ff = _ffn(x, cfg, f"layer_{i}.ffn")
+    if cfg.dropout:
+        ff = layers.dropout(ff, cfg.dropout,
+                            dropout_implementation="upscale_in_train")
+    x = layers.layer_norm(layers.elementwise_add(x, ff), begin_norm_axis=2)
+    if cfg.sp:
+        x = layers.shard_hint(x, [cfg.dp_axis, cfg.sp_axis, None])
+    return x
+
+
+def encoder(tokens, cfg: TransformerConfig):
+    """tokens: int64 [batch, seq]. Returns hidden states [b, t, d]."""
+    emb = layers.embedding(
+        tokens, size=[cfg.vocab_size, cfg.d_model],
+        param_attr=ParamAttr(name="word_emb",
+                             initializer=Normal(0.0, 0.02)))
+    x = layers.add_position_encoding(emb, alpha=1.0, beta=1.0)
+    if cfg.dropout:
+        x = layers.dropout(x, cfg.dropout,
+                           dropout_implementation="upscale_in_train")
+    if cfg.sp:
+        x = layers.shard_hint(x, [cfg.dp_axis, cfg.sp_axis, None])
+    for i in range(cfg.n_layers):
+        x = _block(x, cfg, i)
+    return x
+
+
+def lm_loss(hidden, labels, cfg: TransformerConfig):
+    """LM head tied projection + per-token softmax CE."""
+    logits = layers.fc(hidden, size=cfg.vocab_size, num_flatten_dims=2,
+                       param_attr=ParamAttr(name="lm_head.w",
+                                            initializer=Normal(0.0, 0.02)),
+                       bias_attr=False)
+    b, t = hidden.shape[0], hidden.shape[1]
+    logits2 = layers.reshape(logits, [b * t, cfg.vocab_size])
+    labels2 = layers.reshape(labels, [b * t, 1])
+    loss = layers.softmax_with_cross_entropy(logits2, labels2)
+    return layers.mean(loss)
+
+
+def build_train(cfg: TransformerConfig, batch, seq_len, lr=1e-4,
+                optimizer_cls=None):
+    """Full training graph; returns (loss, feed vars)."""
+    from .. import optimizer as opt
+    tokens = layers.data("tokens", shape=[batch, seq_len], dtype="int64",
+                         append_batch_size=False)
+    labels = layers.data("labels", shape=[batch, seq_len], dtype="int64",
+                         append_batch_size=False)
+    hidden = encoder(tokens, cfg)
+    loss = lm_loss(hidden, labels, cfg)
+    optimizer_cls = optimizer_cls or opt.AdamW
+    optimizer_cls(learning_rate=lr).minimize(loss)
+    return loss, [tokens, labels]

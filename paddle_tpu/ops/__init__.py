@@ -17,6 +17,7 @@ from . import metrics_ops  # noqa: F401
 from . import controlflow  # noqa: F401
 from . import sequence_ops  # noqa: F401
 from . import detection_ops  # noqa: F401
+from . import collective  # noqa: F401
 
 
 def registered_types():

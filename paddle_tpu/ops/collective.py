@@ -1,0 +1,138 @@
+"""Collective + sharding ops.
+
+Reference: operators/collective/ — c_allreduce_{sum,max,min,prod},
+c_allgather, c_reducescatter, c_broadcast, each over a ring_id-keyed NCCL
+communicator (c_allreduce_op.h), bootstrapped by c_gen_nccl_id (TCP
+broadcast of ncclUniqueId, c_gen_nccl_id_op.cc:68).
+
+TPU mapping (SURVEY.md §2.8): a ring_id selects a mesh axis
+(parallel/mesh.axis_for_ring); inside a shard_map-lowered program the ops
+emit jax.lax collectives compiled to XLA AllReduce/AllGather/ReduceScatter
+over ICI. Under plain GSPMD jit the partitioner inserts collectives from
+sharding constraints instead, so there c_allreduce is an identity with a
+sharding annotation ("shard_hint" is the primitive tool). No NCCL-id
+bootstrap exists: device topology comes from the platform
+(jax.distributed.initialize for multi-host).
+
+c_sync_calc_stream / c_sync_comm_stream are no-ops: XLA's async scheduler
+owns stream ordering.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..core.registry import register_op
+
+
+def _axis_name(attrs):
+    from ..parallel.mesh import axis_for_ring
+    return attrs.get("axis_name") or axis_for_ring(attrs.get("ring_id", 0))
+
+
+def _in_shard_map(axis):
+    """True when `axis` is a bound named axis (inside shard_map/pmap)."""
+    try:
+        jax.lax.axis_index(axis)
+        return True
+    except NameError:
+        return False
+
+
+def _collective(name, fn):
+    # NOT inplace: backward must differentiate through collectives (vjp of
+    # psum is psum; in GSPMD identity mode the vjp is the identity).
+    @register_op(name)
+    def _low(ctx, ins, attrs, _fn=fn):
+        x = ins["X"][0]
+        axis = _axis_name(attrs)
+        if _in_shard_map(axis):
+            out = _fn(x, axis)
+        else:
+            out = x  # GSPMD mode: partitioner inserts the collective
+        return {"Out": [out]}
+    return _low
+
+
+_collective("c_allreduce_sum", lambda x, a: jax.lax.psum(x, a))
+_collective("c_allreduce_max", lambda x, a: jax.lax.pmax(x, a))
+_collective("c_allreduce_min", lambda x, a: jax.lax.pmin(x, a))
+# product has no direct XLA collective; gather then reduce (sign-safe)
+_collective("c_allreduce_prod",
+            lambda x, a: jnp.prod(jax.lax.all_gather(x, a), axis=0))
+_collective("allreduce", lambda x, a: jax.lax.psum(x, a))
+
+
+@register_op("c_allgather")
+def _c_allgather(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = _axis_name(attrs)
+    if _in_shard_map(axis):
+        out = jax.lax.all_gather(x, axis, tiled=True)
+    else:
+        out = x
+    return {"Out": [out]}
+
+
+@register_op("c_reducescatter")
+def _c_reducescatter(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = _axis_name(attrs)
+    if _in_shard_map(axis):
+        out = jax.lax.psum_scatter(x, axis, tiled=True)
+    else:
+        out = x
+    return {"Out": [out]}
+
+
+@register_op("c_broadcast")
+def _c_broadcast(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = _axis_name(attrs)
+    if _in_shard_map(axis):
+        src = attrs.get("root", 0)
+        idx = jax.lax.axis_index(axis)
+        out = jax.lax.psum(jnp.where(idx == src, x, jnp.zeros_like(x)), axis)
+    else:
+        out = x
+    return {"Out": [out]}
+
+
+@register_op("c_sync_calc_stream")
+def _c_sync_calc(ctx, ins, attrs):
+    return {"Out": [ins["X"][0]]}
+
+
+@register_op("c_sync_comm_stream")
+def _c_sync_comm(ctx, ins, attrs):
+    return {"Out": [ins["X"][0]]}
+
+
+@register_op("c_comm_init")
+def _c_comm_init(ctx, ins, attrs):
+    return {}
+
+
+@register_op("c_comm_init_all")
+def _c_comm_init_all(ctx, ins, attrs):
+    return {}
+
+
+@register_op("c_gen_nccl_id")
+def _c_gen_nccl_id(ctx, ins, attrs):
+    # Topology comes from the platform; nothing to hand-shake.
+    return {}
+
+
+@register_op("shard_hint")
+def _shard_hint(ctx, ins, attrs):
+    """with_sharding_constraint: the GSPMD annotation primitive. spec is a
+    list of axis names (or None) per dim; requires an active mesh."""
+    x = ins["X"][0]
+    if ctx.mesh is None:
+        return {"Out": [x]}
+    spec = PartitionSpec(*[tuple(s) if isinstance(s, list) else s
+                           for s in attrs.get("spec", [])])
+    return {"Out": [jax.lax.with_sharding_constraint(
+        x, NamedSharding(ctx.mesh, spec))]}

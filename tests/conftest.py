@@ -1,16 +1,26 @@
 """Test config: run on a virtual 8-device CPU mesh.
 
 Mirrors the reference's localhost multi-process trick (test_dist_base.py:877
-NCCL_P2P_DISABLE=1) — here XLA fakes 8 host devices so sharding/collective
-paths compile and run without TPU hardware (SURVEY.md §7 hard part (h)).
-Must run before jax is imported anywhere.
+NCCL_P2P_DISABLE=1) — XLA fakes 8 host devices so sharding/collective paths
+compile and run without TPU hardware (SURVEY.md §7 hard part (h)).
+
+Hermeticity: the host image registers a TPU-tunnel PJRT backend from a
+sitecustomize at interpreter start and pins JAX_PLATFORMS to it; its init
+can block on TPU-tunnel state. Setting os.environ["JAX_PLATFORMS"] here is
+too late (jax is already imported), but jax.config.update still works — and
+no XLA client exists yet, so XLA_FLAGS set now is honoured by the CPU
+client. This keeps tests fully independent of the TPU tunnel.
 """
 import os
 
-# Hard-set: the host environment pins JAX_PLATFORMS to the TPU tunnel.
-os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+assert len(jax.devices()) == 8 and jax.devices()[0].platform == "cpu", \
+    "tests require the 8-device virtual CPU mesh"

@@ -1,0 +1,107 @@
+"""Model-level tests (reference pattern: tests/book/ end-to-end tutorials)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.models import lenet, resnet, transformer
+
+
+def test_mnist_cnn_trains():
+    """book/02.recognize_digits (test_recognize_digits.py:65) on synthetic
+    digits: loss must drop and fitting a fixed batch must approach zero."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = layers.data("img", shape=[1, 28, 28], dtype="float32")
+        label = layers.data("label", shape=[1], dtype="int64")
+        loss, predict = lenet.convolutional_neural_network(img, label)
+        # lr 1e-3: the prob-space cross_entropy (softmax act + CE, the
+        # reference book formulation) diverges at 1e-2
+        fluid.optimizer.Adam(learning_rate=0.001).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    imgs = rng.randn(16, 1, 28, 28).astype(np.float32)
+    lbls = rng.randint(0, 10, (16, 1)).astype(np.int64)
+    first = None
+    for i in range(50):
+        lv, = exe.run(main, feed={"img": imgs, "label": lbls},
+                      fetch_list=[loss])
+        if first is None:
+            first = float(np.asarray(lv))
+    last = float(np.asarray(lv))
+    assert last < first * 0.2, (first, last)
+
+
+def test_transformer_trains():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        cfg = transformer.TransformerConfig(
+            vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+            dropout=0.0)
+        loss, feeds = transformer.build_train(cfg, batch=4, seq_len=8,
+                                              lr=1e-2)
+    exe = fluid.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    toks = rng.randint(0, 64, (4, 8)).astype(np.int64)
+    for i in range(40):
+        lv, = exe.run(main, feed={"tokens": toks, "labels": toks},
+                      fetch_list=[loss])
+    assert float(np.asarray(lv)) < 0.5
+
+
+def test_resnet18_step():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        loss, acc, feeds = resnet.build_train(
+            img_shape=(3, 32, 32), class_dim=10, depth=18, lr=0.01)
+    exe = fluid.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    img = rng.randn(4, 3, 32, 32).astype(np.float32)
+    lbl = rng.randint(0, 10, (4, 1)).astype(np.int64)
+    l0 = None
+    for _ in range(5):
+        lv, = exe.run(main, feed={"image": img, "label": lbl},
+                      fetch_list=[loss])
+        if l0 is None:
+            l0 = float(np.asarray(lv))
+    assert np.isfinite(np.asarray(lv)).all()
+    assert float(np.asarray(lv)) < l0
+
+
+def test_clone_for_test_disables_dropout():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[8], dtype="float32")
+        h = layers.dropout(x, 0.5,
+                           dropout_implementation="upscale_in_train")
+        out = layers.mean(h)
+    test_prog = main.clone(for_test=True)
+    exe = fluid.Executor()
+    exe.run(startup)
+    xv = np.ones((4, 8), np.float32)
+    o_test, = exe.run(test_prog, feed={"x": xv}, fetch_list=[out])
+    # upscale_in_train at test time = identity
+    np.testing.assert_allclose(float(np.asarray(o_test)), 1.0, rtol=1e-6)
+
+
+def test_save_load_inference_model(tmp_path):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4], dtype="float32")
+        y = layers.fc(x, size=2)
+    exe = fluid.Executor()
+    exe.run(startup)
+    xv = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+    ref, = exe.run(main, feed={"x": xv}, fetch_list=[y])
+    fluid.io.save_inference_model(str(tmp_path), ["x"], [y], exe,
+                                  main_program=main)
+    prog2, feed_names, fetches = fluid.io.load_inference_model(
+        str(tmp_path), exe)
+    out, = exe.run(prog2, feed={"x": xv}, fetch_list=fetches)
+    np.testing.assert_allclose(ref, out, rtol=1e-6)
+    # training state must not leak into the export
+    import os
+    files = os.listdir(tmp_path)
+    assert not any("beta" in f or "moment" in f for f in files), files
