@@ -47,15 +47,19 @@ def main():
     import paddle_tpu as fluid
     from paddle_tpu.models import transformer
 
-    batch = int(os.environ.get("BENCH_BATCH", "8"))
+    batch = int(os.environ.get("BENCH_BATCH", "16"))
     seq_len = int(os.environ.get("BENCH_SEQ", "512"))
     steps = int(os.environ.get("BENCH_STEPS", "20"))
+    amp = os.environ.get("BENCH_AMP", "1") == "1"
+    use_flash = os.environ.get("BENCH_FLASH", "1") == "1"
 
-    cfg = transformer.bert_base(dropout=0.1)
+    cfg = transformer.bert_base(dropout=0.1, attn_dropout=0.0,
+                                use_flash=use_flash)
     main_prog, startup = fluid.Program(), fluid.Program()
     scope = fluid.Scope()
     with fluid.program_guard(main_prog, startup), fluid.scope_guard(scope):
-        loss, feeds = transformer.build_train(cfg, batch, seq_len, lr=1e-4)
+        loss, feeds = transformer.build_train(cfg, batch, seq_len, lr=1e-4,
+                                              amp=amp)
         exe = fluid.Executor()
         exe.run(startup)
 

@@ -1,0 +1,4 @@
+"""Model compression (reference: contrib/slim — quantization/prune/NAS/
+distillation). Round-1 scope: quantization-aware training (fake-quant
+rewrite) + magnitude pruning utilities."""
+from . import quantization  # noqa: F401

@@ -1,0 +1,118 @@
+"""Quantization-aware training: program rewrite inserting fake-quant ops.
+
+Reference: contrib/slim/quantization/quantization_pass.py
+(QuantizationTransformPass) — for each quantizable op (conv2d, mul,
+depthwise_conv2d), quantize its activation input (moving-average abs-max)
+and weight (channel-wise abs-max); gradients pass straight through (STE).
+The same rewrite here operates on the Program IR directly; the fake-quant
+ops lower to round/clip which XLA fuses into the surrounding computation.
+"""
+from __future__ import annotations
+
+from ...framework import Operator, unique_name
+
+__all__ = ["QuantizationTransformPass", "quant_aware"]
+
+QUANTIZABLE = {"conv2d": ("Input", "Filter"), "depthwise_conv2d":
+               ("Input", "Filter"), "mul": ("X", "Y"),
+               "matmul": ("X", "Y")}
+
+
+class QuantizationTransformPass:
+    def __init__(self, weight_bits=8, activation_bits=8,
+                 activation_quantize_type="moving_average_abs_max",
+                 weight_quantize_type="channel_wise_abs_max",
+                 moving_rate=0.9, skip_pattern="skip_quant"):
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.act_type = activation_quantize_type
+        self.weight_type = weight_quantize_type
+        self.moving_rate = moving_rate
+
+    def apply(self, program, startup_program=None):
+        block = program.global_block()
+        new_ops = []
+        quant_cache = {}
+        for op in block.ops:
+            if op.type in QUANTIZABLE:
+                act_slot, w_slot = QUANTIZABLE[op.type]
+                for slot, is_weight in ((act_slot, False), (w_slot, True)):
+                    names = op.inputs.get(slot, [])
+                    for i, n in enumerate(names):
+                        if not n:
+                            continue
+                        v = block.var(n)
+                        if v.dtype not in ("float32", "bfloat16"):
+                            continue
+                        qn = self._insert_quant(block, new_ops, n,
+                                                is_weight, quant_cache,
+                                                startup_program)
+                        names[i] = qn
+            new_ops.append(op)
+        block.ops = new_ops
+        program._fp_cache = None
+        return program
+
+    def _insert_quant(self, block, new_ops, name, is_weight, cache,
+                      startup_program):
+        if name in cache:
+            return cache[name]
+        v = block.var(name)
+        out = unique_name.generate(f"{name}.quantized")
+        block.create_var(name=out, shape=v.shape, dtype=v.dtype,
+                         stop_gradient=v.stop_gradient)
+        scale = unique_name.generate(f"{name}.scale")
+        if is_weight and self.weight_type == "channel_wise_abs_max":
+            block.create_var(name=scale, shape=(v.shape[0],), dtype="float32",
+                             stop_gradient=True)
+            qop = Operator(block, "fake_channel_wise_quantize_abs_max",
+                           {"X": [name]},
+                           {"Out": [out], "OutScale": [scale]},
+                           {"bit_length": self.weight_bits})
+        elif is_weight or self.act_type == "abs_max":
+            block.create_var(name=scale, shape=(1,), dtype="float32",
+                            stop_gradient=True)
+            qop = Operator(block, "fake_quantize_abs_max", {"X": [name]},
+                           {"Out": [out], "OutScale": [scale]},
+                           {"bit_length": self.weight_bits if is_weight
+                            else self.activation_bits})
+        else:
+            # moving-average activation quant: persistent scale + ema state;
+            # at eval (is_test flipped by clone(for_test=True)) the op reads
+            # the calibrated InScale and freezes the moving averages.
+            scale = self._state_var(block, f"{name}.scale", startup_program,
+                                    init=1.0)
+            state = self._state_var(block, f"{name}.qstate",
+                                    startup_program)
+            accum = self._state_var(block, f"{name}.qaccum",
+                                    startup_program)
+            qop = Operator(
+                block, "fake_quantize_dequantize_moving_average_abs_max",
+                {"X": [name], "InScale": [scale], "InState": [state],
+                 "InAccum": [accum]},
+                {"Out": [out], "OutScale": [scale], "OutState": [state],
+                 "OutAccum": [accum]},
+                {"bit_length": self.activation_bits,
+                 "moving_rate": self.moving_rate, "is_test": False})
+        new_ops.append(qop)
+        cache[name] = out
+        return out
+
+    def _state_var(self, block, hint, startup_program, init=0.0):
+        from ...initializer import Constant
+        name = unique_name.generate(hint)
+        block.create_var(name=name, shape=(1,), dtype="float32",
+                         persistable=True, stop_gradient=True)
+        if startup_program is not None:
+            sb = startup_program.global_block()
+            sv = sb.create_var(name=name, shape=(1,), dtype="float32",
+                               persistable=True, stop_gradient=True)
+            Constant(init)(sv, sb)
+        return name
+
+
+def quant_aware(program, startup_program=None, weight_bits=8,
+                activation_bits=8):
+    """One-call QAT rewrite (paddleslim-style convenience)."""
+    return QuantizationTransformPass(
+        weight_bits, activation_bits).apply(program, startup_program)

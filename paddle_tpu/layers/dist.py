@@ -10,7 +10,23 @@ from __future__ import annotations
 from ..layer_helper import LayerHelper
 
 __all__ = ["shard_hint", "c_allreduce_sum", "c_broadcast", "c_allgather",
-           "c_reducescatter"]
+           "c_reducescatter", "ring_attention"]
+
+
+def ring_attention(q, k, v, causal=False, sm_scale=None, seq_axis="sp",
+                   batch_axis="dp", name=None):
+    """Sequence-parallel attention over [b, h, T, d]: K/V blocks rotate
+    around the mesh's seq axis (parallel/ring_attention.py)."""
+    helper = LayerHelper("ring_attention", name=name)
+    out = helper.create_variable_for_type_inference(q.dtype)
+    attrs = {"causal": causal, "seq_axis": seq_axis,
+             "batch_axis": batch_axis}
+    if sm_scale is not None:
+        attrs["sm_scale"] = float(sm_scale)
+    helper.append_op(type="ring_attention",
+                     inputs={"Q": [q.name], "K": [k.name], "V": [v.name]},
+                     outputs={"Out": [out.name]}, attrs=attrs)
+    return out
 
 
 def shard_hint(x, spec, name=None):

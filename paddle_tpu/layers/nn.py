@@ -36,7 +36,7 @@ __all__ = [
     "uniform_random", "gaussian_random", "sampling_id", "dropout",
     "logical_and", "logical_or", "logical_xor", "logical_not", "sign",
     "where", "unique", "shard_index", "hash", "grid_sampler", "erf",
-    "sums", "elementwise_add", "elementwise_sub", "elementwise_mul",
+    "flash_attention", "sums", "elementwise_add", "elementwise_sub", "elementwise_mul",
     "elementwise_div", "elementwise_max", "elementwise_min",
     "elementwise_pow", "elementwise_mod", "elementwise_floordiv",
 ]
@@ -634,6 +634,21 @@ def mul(x, y, x_num_col_dims=1, y_num_col_dims=1, name=None):
                      outputs={"Out": [out.name]},
                      attrs={"x_num_col_dims": x_num_col_dims,
                             "y_num_col_dims": y_num_col_dims})
+    return out
+
+
+def flash_attention(q, k, v, causal=False, sm_scale=None, block_q=128,
+                    block_k=128, name=None):
+    """Fused attention over [b, h, t, d] q/k/v (Pallas kernel,
+    ops/pallas/flash_attention.py)."""
+    helper = LayerHelper("flash_attention", name=name)
+    out = helper.create_variable_for_type_inference(q.dtype)
+    attrs = {"causal": causal, "block_q": block_q, "block_k": block_k}
+    if sm_scale is not None:
+        attrs["sm_scale"] = float(sm_scale)
+    helper.append_op(type="flash_attention",
+                     inputs={"Q": [q.name], "K": [k.name], "V": [v.name]},
+                     outputs={"Out": [out.name]}, attrs=attrs)
     return out
 
 

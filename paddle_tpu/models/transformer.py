@@ -24,7 +24,8 @@ class TransformerConfig:
     def __init__(self, vocab_size=30522, d_model=768, n_heads=12,
                  n_layers=12, d_ff=3072, max_seq_len=512, dropout=0.1,
                  tp=False, sp=False, dp_axis="dp", tp_axis="tp",
-                 sp_axis="sp"):
+                 sp_axis="sp", use_flash=True, causal=False,
+                 attn_dropout=None):
         self.vocab_size = vocab_size
         self.d_model = d_model
         self.n_heads = n_heads
@@ -34,6 +35,15 @@ class TransformerConfig:
         self.dropout = dropout
         self.tp = tp  # annotate weights for tensor parallelism
         self.sp = sp  # annotate activations for sequence parallelism
+        # fused Pallas attention kernel (ops/pallas/flash_attention.py);
+        # falls back to composed matmul+softmax when False. Dropout on
+        # attention WEIGHTS is a separate knob: the flash kernel does not
+        # implement it, so attn_dropout > 0 forces the composed path
+        # (keeping the trained model identical across kernel choices).
+        self.use_flash = use_flash
+        self.causal = causal
+        self.attn_dropout = dropout if attn_dropout is None else \
+            attn_dropout
         # Mesh axis names the hints refer to; Megatron-style SP shards the
         # sequence over the TP group (set sp_axis=tp_axis).
         self.dp_axis = dp_axis
@@ -92,14 +102,20 @@ def _attention(x, cfg, prefix):
         q = layers.shard_hint(q, [cfg.dp_axis, cfg.tp_axis, None, None])
         k = layers.shard_hint(k, [cfg.dp_axis, cfg.tp_axis, None, None])
         v = layers.shard_hint(v, [cfg.dp_axis, cfg.tp_axis, None, None])
-    scores = layers.matmul(q, k, transpose_y=True,
-                           alpha=1.0 / math.sqrt(hd))
-    weights = layers.softmax(scores)
-    if cfg.dropout:
-        weights = layers.dropout(
-            weights, cfg.dropout,
-            dropout_implementation="upscale_in_train")
-    ctxv = layers.matmul(weights, v)  # [b, h, t, hd]
+    bq = min(128, t)
+    if cfg.use_flash and cfg.attn_dropout == 0 and t % bq == 0:
+        ctxv = layers.flash_attention(q, k, v, causal=cfg.causal,
+                                      sm_scale=1.0 / math.sqrt(hd),
+                                      block_q=bq, block_k=bq)
+    else:
+        scores = layers.matmul(q, k, transpose_y=True,
+                               alpha=1.0 / math.sqrt(hd))
+        weights = layers.softmax(scores)
+        if cfg.attn_dropout:
+            weights = layers.dropout(
+                weights, cfg.attn_dropout,
+                dropout_implementation="upscale_in_train")
+        ctxv = layers.matmul(weights, v)  # [b, h, t, hd]
     ctxv = layers.transpose(ctxv, [0, 2, 1, 3])
     ctxv = layers.reshape(ctxv, [b, t, d])
     return _dense(ctxv, d, f"{prefix}.proj", cfg, tp_axis="row")
@@ -159,8 +175,9 @@ def lm_loss(hidden, labels, cfg: TransformerConfig):
 
 
 def build_train(cfg: TransformerConfig, batch, seq_len, lr=1e-4,
-                optimizer_cls=None):
-    """Full training graph; returns (loss, feed vars)."""
+                optimizer_cls=None, amp=False):
+    """Full training graph; returns (loss, feed vars). amp=True runs the
+    MXU work in bf16 via the mixed-precision rewrite (contrib/)."""
     from .. import optimizer as opt
     tokens = layers.data("tokens", shape=[batch, seq_len], dtype="int64",
                          append_batch_size=False)
@@ -169,5 +186,9 @@ def build_train(cfg: TransformerConfig, batch, seq_len, lr=1e-4,
     hidden = encoder(tokens, cfg)
     loss = lm_loss(hidden, labels, cfg)
     optimizer_cls = optimizer_cls or opt.AdamW
-    optimizer_cls(learning_rate=lr).minimize(loss)
+    opt_inst = optimizer_cls(learning_rate=lr)
+    if amp:
+        from ..contrib import mixed_precision as mp
+        opt_inst = mp.decorate(opt_inst)
+    opt_inst.minimize(loss)
     return loss, [tokens, labels]

@@ -18,6 +18,8 @@ from . import controlflow  # noqa: F401
 from . import sequence_ops  # noqa: F401
 from . import detection_ops  # noqa: F401
 from . import collective  # noqa: F401
+from . import quant_ops  # noqa: F401
+from . import attention  # noqa: F401
 
 
 def registered_types():

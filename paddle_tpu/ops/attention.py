@@ -1,0 +1,22 @@
+"""Fused attention op over the Pallas kernel.
+
+Reference analogue: operators/fused/multihead_matmul (the fused attention
+target of the multihead fusion pass). Here fusion is explicit: one op, one
+Pallas kernel, with custom-vjp backward.
+"""
+from __future__ import annotations
+
+from ..core.registry import register_op
+from .pallas.flash_attention import flash_attention
+
+
+@register_op("flash_attention")
+def _flash_attention_op(ctx, ins, attrs):
+    q, k, v = ins["Q"][0], ins["K"][0], ins["V"][0]
+    out = flash_attention(
+        q, k, v,
+        causal=attrs.get("causal", False),
+        sm_scale=attrs.get("sm_scale", None),
+        block_q=attrs.get("block_q", 128),
+        block_k=attrs.get("block_k", 128))
+    return {"Out": [out]}

@@ -1,0 +1,9 @@
+"""Pallas TPU kernels — the hot-op layer.
+
+Reference analogue: operators/jit/ (runtime-codegen x86 kernels via xbyak,
+registry.h) and the fused ops in operators/fused/. On TPU the codegen
+target is Mosaic via Pallas; kernels register into the same op registry as
+ordinary lowerings (SURVEY.md §2.2 native-component checklist: 'JIT kernel
+layer -> Pallas').
+"""
+from .flash_attention import flash_attention  # noqa: F401

@@ -1,0 +1,285 @@
+"""Flash attention (forward + backward) as Pallas TPU kernels.
+
+Replaces the composed matmul->softmax->matmul attention (reference
+multihead path, operators/fused/multihead_matmul + the PaddleNLP attention
+assembly) with an online-softmax tiled kernel: Q stays resident in VMEM per
+block, K/V stream through in blocks, the softmax normaliser is carried as
+running (max, sum) — O(T) memory instead of O(T^2), MXU-sized tiles.
+
+Backward uses the FlashAttention-2 recomputation scheme: per (q-block,
+k-block) tile recompute p = exp(qk - lse), accumulate dq, dk, dv. Wired to
+jax.custom_vjp so both the IR-level generic grad (core/lowering.py) and
+dygraph tape differentiate through it for free.
+
+Falls back to interpret mode off-TPU (CPU tests), same numerics.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # TPU-specific memory spaces; absent on some CPU-only builds
+    from jax.experimental.pallas import tpu as pltpu
+    _VMEM = pltpu.VMEM
+except ImportError:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+
+def _interpret():
+    return jax.default_backend() != "tpu"
+
+
+NEG_INF = -1e30
+
+
+# ---------------------------------------------------------------------------
+# forward kernel: grid = (batch*heads, num_q_blocks)
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
+                block_k):
+    # block shapes carry a leading singleton (bh) dim: q_ref[0] = [bq, d],
+    # k_ref[0]/v_ref[0] = [T, d] (full K/V for this head)
+    q = q_ref[0].astype(jnp.float32) * sm_scale
+    block_q, d = q.shape
+    t = k_ref.shape[1]
+    qi = pl.program_id(1)
+
+    m = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l = jnp.zeros((block_q, 1), jnp.float32)
+    acc = jnp.zeros((block_q, d), jnp.float32)
+
+    num_kb = t // block_k
+
+    def body(kb, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            qpos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + jnp.sum(p, axis=1, keepdims=True)
+        acc_new = alpha * acc + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    if causal:
+        # skip k blocks entirely past the diagonal:
+        # need ceil(((qi+1)*block_q) / block_k) blocks
+        need = ((qi + 1) * block_q + block_k - 1) // block_k
+        num_iters = jnp.minimum(num_kb, need)
+        m, l, acc = jax.lax.fori_loop(0, num_iters, body, (m, l, acc))
+    else:
+        m, l, acc = jax.lax.fori_loop(0, num_kb, body, (m, l, acc))
+
+    l_safe = jnp.maximum(l, 1e-20)
+    o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
+    lse_ref[0] = (m + jnp.log(l_safe)).reshape(block_q)
+
+
+def _fwd(q, k, v, sm_scale, causal, block_q, block_k):
+    bh, t, d = q.shape
+    grid = (bh, t // block_q)
+    kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale,
+                               causal=causal, block_k=block_k)
+    kw = {}
+    if _VMEM is not None:
+        kw = {"memory_space": _VMEM}
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0), **kw),
+            pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0), **kw),
+            pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0), **kw),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0), **kw),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i), **kw),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, t), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k, v)
+    return o, lse
+
+
+# ---------------------------------------------------------------------------
+# backward: two tiled passes (FlashAttention-2 scheme), both O(T) memory:
+#   dq pass:    grid (bh, q_blocks), stream k-blocks, accumulate dq
+#   dk/dv pass: grid (bh, k_blocks), stream q-blocks, accumulate dk, dv
+# Each tile recomputes p = exp(qk - lse); delta = rowsum(do*o).
+# ---------------------------------------------------------------------------
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref, dq_ref, *,
+                   sm_scale, causal, block_k):
+    q = q_ref[0].astype(jnp.float32)
+    o = o_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0].astype(jnp.float32)
+    block_q, d = q.shape
+    t = k_ref.shape[1]
+    qi = pl.program_id(1)
+    delta = jnp.sum(do * o, axis=1, keepdims=True)
+    num_kb = t // block_k
+
+    def body(kb, dq):
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q * sm_scale, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            qpos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        return dq + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        need = ((qi + 1) * block_q + block_k - 1) // block_k
+        iters = jnp.minimum(num_kb, need)
+    else:
+        iters = num_kb
+    dq = jax.lax.fori_loop(0, iters, body,
+                           jnp.zeros((block_q, d), jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref,
+                    dk_ref, dv_ref, *, sm_scale, causal, block_q):
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    block_k, d = k.shape
+    t = q_ref.shape[1]
+    ki = pl.program_id(1)
+    num_qb = t // block_q
+
+    def body(qb, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        o = o_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(qb * block_q, block_q)].astype(jnp.float32)
+        s = jax.lax.dot_general(q * sm_scale, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            qpos = qb * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        delta = jnp.sum(do * o, axis=1, keepdims=True)
+        ds = p * (dp - delta) * sm_scale
+        dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        return dk, dv
+
+    if causal:
+        # q blocks before the diagonal contribute nothing to this k block
+        start = (ki * block_k) // block_q
+    else:
+        start = 0
+    zeros = jnp.zeros((block_k, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(start, num_qb, body, (zeros, zeros))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _bwd(sm_scale, causal, block_q, block_k, res, do):
+    q, k, v, o, lse = res
+    bh, t, d = q.shape
+    kw = {}
+    if _VMEM is not None:
+        kw = {"memory_space": _VMEM}
+    spec_full = pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0), **kw)
+    spec_lse_full = pl.BlockSpec((1, t), lambda b, i: (b, 0), **kw)
+    spec_qb = pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0), **kw)
+    spec_lse_qb = pl.BlockSpec((1, block_q), lambda b, i: (b, i), **kw)
+    spec_kb = pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0), **kw)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
+                          block_k=block_k),
+        grid=(bh, t // block_q),
+        in_specs=[spec_qb, spec_full, spec_full, spec_qb, spec_lse_qb,
+                  spec_qb],
+        out_specs=spec_qb,
+        out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+        interpret=_interpret(),
+    )(q, k, v, o, lse, do)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale,
+                          causal=causal, block_q=block_q),
+        grid=(bh, t // block_k),
+        in_specs=[spec_full, spec_kb, spec_kb, spec_full, spec_lse_full,
+                  spec_full],
+        out_specs=[spec_kb, spec_kb],
+        out_shape=[jax.ShapeDtypeStruct((bh, t, d), q.dtype)] * 2,
+        interpret=_interpret(),
+    )(q, k, v, o, lse, do)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, sm_scale, causal, block_q, block_k):
+    o, _ = _fwd(q, k, v, sm_scale, causal, block_q, block_k)
+    return o
+
+
+def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k):
+    o, lse = _fwd(q, k, v, sm_scale, causal, block_q, block_k)
+    return o, (q, k, v, o, lse)
+
+
+_flash.defvjp(_flash_fwd, _bwd)
+
+
+def flash_attention(q, k, v, causal=False, sm_scale=None, block_q=128,
+                    block_k=128):
+    """q, k, v: [batch, heads, T, head_dim] (or [bh, T, d]).
+    Returns attention output, same shape/dtype as q."""
+    orig_shape = q.shape
+    if q.ndim == 4:
+        b, h, t, d = q.shape
+        q = q.reshape(b * h, t, d)
+        k = k.reshape(b * h, t, d)
+        v = v.reshape(b * h, t, d)
+    t, d = q.shape[1], q.shape[2]
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+    block_q = min(block_q, t)
+    block_k = min(block_k, t)
+    if t % block_q or t % block_k:
+        raise ValueError(f"seq len {t} must divide block sizes "
+                         f"({block_q}, {block_k})")
+    out = _flash(q, k, v, float(sm_scale), bool(causal), block_q, block_k)
+    return out.reshape(orig_shape)

@@ -1,0 +1,101 @@
+"""Fake-quantization ops for QAT (reference: fake_quantize_op.cc,
+fake_dequantize_op.cc). All use straight-through-estimator gradients via
+manual_grad — the documented escape hatch where vjp (grad of round = 0)
+would be wrong.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+def _ste_grad(ctx, ins, attrs):
+    g = ins.get("Out@GRAD")
+    return {"X@GRAD": [g[0]]} if g else {}
+
+
+def _quant_dequant(x, scale, bits):
+    bnt = (1 << (bits - 1)) - 1
+    s = jnp.maximum(scale, 1e-8)
+    return jnp.round(jnp.clip(x / s, -1.0, 1.0) * bnt) * s / bnt
+
+
+@register_op("fake_quantize_abs_max", manual_grad=_ste_grad,
+             nondiff_outputs=("OutScale",))
+def _fake_quantize_abs_max(ctx, ins, attrs):
+    x = ins["X"][0]
+    bits = attrs.get("bit_length", 8)
+    scale = jnp.max(jnp.abs(x))
+    return {"Out": [_quant_dequant(x, scale, bits)],
+            "OutScale": [scale.reshape(1)]}
+
+
+@register_op("fake_channel_wise_quantize_abs_max", manual_grad=_ste_grad,
+             nondiff_outputs=("OutScale",))
+def _fake_channel_wise_quantize(ctx, ins, attrs):
+    x = ins["X"][0]  # weights [out_c, ...]
+    bits = attrs.get("bit_length", 8)
+    scale = jnp.max(jnp.abs(x.reshape(x.shape[0], -1)), axis=1)
+    s = scale.reshape((-1,) + (1,) * (x.ndim - 1))
+    return {"Out": [_quant_dequant(x, s, bits)], "OutScale": [scale]}
+
+
+@register_op("fake_quantize_moving_average_abs_max", manual_grad=_ste_grad,
+             nondiff_inputs=("InScale", "InAccum", "InState"),
+             nondiff_outputs=("OutScale", "OutAccum", "OutState"),
+             inplace=False)
+def _fake_quantize_moving_avg(ctx, ins, attrs):
+    x = ins["X"][0]
+    bits = attrs.get("bit_length", 8)
+    rate = attrs.get("moving_rate", 0.9)
+    cur = jnp.max(jnp.abs(x))
+    outs = {}
+    if ctx.is_test:
+        scale = ins["InScale"][0].reshape(())
+        outs["OutScale"] = [scale.reshape(1)]
+    else:
+        state = ins["InState"][0].reshape(()) if "InState" in ins else 0.0
+        accum = ins["InAccum"][0].reshape(()) if "InAccum" in ins else 0.0
+        new_state = rate * state + 1.0
+        new_accum = rate * accum + cur
+        scale = new_accum / new_state
+        outs["OutState"] = [new_state.reshape(1)]
+        outs["OutAccum"] = [new_accum.reshape(1)]
+        outs["OutScale"] = [scale.reshape(1)]
+    outs["Out"] = [_quant_dequant(x, scale, bits)]
+    return outs
+
+
+# the reference registers the _dequantize variant separately; semantics of
+# the fused quant+dequant path are identical at training time
+@register_op("fake_quantize_dequantize_moving_average_abs_max",
+             manual_grad=_ste_grad,
+             nondiff_inputs=("InScale", "InAccum", "InState"),
+             nondiff_outputs=("OutScale", "OutAccum", "OutState"))
+def _fake_qdq_moving_avg(ctx, ins, attrs):
+    return _fake_quantize_moving_avg(ctx, ins, attrs)
+
+
+@register_op("fake_dequantize_max_abs", nondiff_inputs=("Scale",))
+def _fake_dequantize_max_abs(ctx, ins, attrs):
+    x, scale = ins["X"][0], ins["Scale"][0]
+    bnt = (1 << (attrs.get("max_range_bits", 8) - 1)) - 1
+    max_range = attrs.get("max_range", float(bnt))
+    return {"Out": [x.astype(jnp.float32) * scale.reshape(()) / max_range]}
+
+
+@register_op("moving_average_abs_max_scale",
+             nondiff_inputs=("InAccum", "InState"),
+             nondiff_outputs=("OutScale", "OutAccum", "OutState"))
+def _moving_avg_scale(ctx, ins, attrs):
+    x = ins["X"][0]
+    rate = attrs.get("moving_rate", 0.9)
+    cur = jnp.max(jnp.abs(x))
+    state = ins["InState"][0].reshape(()) if "InState" in ins else 0.0
+    accum = ins["InAccum"][0].reshape(()) if "InAccum" in ins else 0.0
+    new_state = rate * state + 1.0
+    new_accum = rate * accum + cur
+    return {"Out": [x], "OutScale": [(new_accum / new_state).reshape(1)],
+            "OutState": [new_state.reshape(1)],
+            "OutAccum": [new_accum.reshape(1)]}

@@ -1,0 +1,118 @@
+"""Kernel + precision tests: Pallas flash attention (interpret mode on
+CPU), ring attention over the device ring, AMP rewrite, QAT rewrite."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def _naive_attn(q, k, v, causal):
+    d = q.shape[-1]
+    t = q.shape[2]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / jnp.sqrt(d)
+    if causal:
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        s = jnp.where(mask, s, -1e30)
+    return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), v)
+
+
+def _qkv(t=64, d=16):
+    rng = np.random.RandomState(0)
+    mk = lambda: jnp.asarray(rng.randn(2, 2, t, d), jnp.float32)  # noqa
+    return mk(), mk(), mk()
+
+
+def test_flash_attention_matches_naive():
+    from paddle_tpu.ops.pallas.flash_attention import flash_attention
+    q, k, v = _qkv()
+    for causal in (False, True):
+        out = flash_attention(q, k, v, causal=causal, block_q=32,
+                              block_k=32)
+        ref = _naive_attn(q, k, v, causal)
+        np.testing.assert_allclose(out, ref, atol=2e-5)
+        g1 = jax.grad(lambda q_: flash_attention(
+            q_, k, v, causal=causal, block_q=32, block_k=32).sum())(q)
+        g2 = jax.grad(lambda q_: _naive_attn(q_, k, v, causal).sum())(q)
+        np.testing.assert_allclose(g1, g2, atol=2e-5)
+
+
+def test_ring_attention_matches_naive():
+    from jax.sharding import Mesh
+    from paddle_tpu.parallel.ring_attention import ring_attention_sharded
+    q, k, v = _qkv()
+    mesh = Mesh(np.asarray(jax.devices()).reshape(8), ("sp",))
+    for causal in (False, True):
+        out = ring_attention_sharded(q, k, v, mesh, "sp", causal=causal)
+        ref = _naive_attn(q, k, v, causal)
+        np.testing.assert_allclose(out, ref, atol=2e-5)
+
+
+def test_amp_bf16_rewrite_and_training():
+    from paddle_tpu.models import transformer
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        cfg = transformer.TransformerConfig(
+            vocab_size=64, d_model=32, n_heads=4, n_layers=1, d_ff=64,
+            dropout=0.0, use_flash=False)
+        loss, _ = transformer.build_train(cfg, batch=4, seq_len=8,
+                                          lr=1e-2, amp=True)
+    # rewrite inserted bf16 casts
+    types = [op.type for op in main.global_block().ops]
+    assert "cast" in types
+    exe = fluid.Executor()
+    exe.run(startup)
+    toks = np.random.RandomState(0).randint(0, 64, (4, 8)).astype(np.int64)
+    for _ in range(30):
+        lv, = exe.run(main, feed={"tokens": toks, "labels": toks},
+                      fetch_list=[loss])
+    assert float(np.asarray(lv)) < 1.0
+
+
+def test_qat_rewrite_trains():
+    from paddle_tpu.contrib.slim.quantization import quant_aware
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[8], dtype="float32")
+        label = layers.data("y", shape=[1], dtype="float32")
+        h = layers.fc(x, size=16, act="relu")
+        pred = layers.fc(h, size=1)
+        quant_aware(main, startup)
+        loss = layers.mean(layers.square_error_cost(pred, label))
+        fluid.optimizer.SGD(0.05).minimize(loss)
+    types = [op.type for op in main.global_block().ops]
+    assert any("fake" in t for t in types), types
+    exe = fluid.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    xs = rng.randn(32, 8).astype(np.float32)
+    ys = (xs.sum(1, keepdims=True) > 0).astype(np.float32)
+    first = None
+    for _ in range(40):
+        lv, = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+        if first is None:
+            first = float(np.asarray(lv))
+    assert float(np.asarray(lv)) < first
+
+
+def test_flash_attention_op_in_program():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        q = layers.data("q", shape=[2, 2, 128, 16], dtype="float32",
+                        append_batch_size=False)
+        k = layers.data("k", shape=[2, 2, 128, 16], dtype="float32",
+                        append_batch_size=False)
+        v = layers.data("v", shape=[2, 2, 128, 16], dtype="float32",
+                        append_batch_size=False)
+        out = layers.flash_attention(q, k, v)
+    exe = fluid.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    qv = rng.randn(2, 2, 128, 16).astype(np.float32)
+    kv = rng.randn(2, 2, 128, 16).astype(np.float32)
+    vv = rng.randn(2, 2, 128, 16).astype(np.float32)
+    o, = exe.run(main, feed={"q": qv, "k": kv, "v": vv}, fetch_list=[out])
+    ref = _naive_attn(jnp.asarray(qv), jnp.asarray(kv), jnp.asarray(vv),
+                      False)
+    np.testing.assert_allclose(o, ref, atol=2e-5)
