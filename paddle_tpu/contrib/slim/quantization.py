@@ -28,15 +28,24 @@ class QuantizationTransformPass:
         self.act_type = activation_quantize_type
         self.weight_type = weight_quantize_type
         self.moving_rate = moving_rate
+        self.skip_pattern = skip_pattern
+
+    def _skipped(self, op):
+        if not self.skip_pattern:
+            return False
+        for names in list(op.inputs.values()) + list(op.outputs.values()):
+            if any(self.skip_pattern in n for n in names if n):
+                return True
+        return False
 
     def apply(self, program, startup_program=None):
         block = program.global_block()
         new_ops = []
         quant_cache = {}
         for op in block.ops:
-            if op.type in QUANTIZABLE:
+            if op.type in QUANTIZABLE and not self._skipped(op):
                 act_slot, w_slot = QUANTIZABLE[op.type]
-                for slot, is_weight in ((act_slot, False), (w_slot, True)):
+                for slot in (act_slot, w_slot):
                     names = op.inputs.get(slot, [])
                     for i, n in enumerate(names):
                         if not n:
@@ -44,9 +53,20 @@ class QuantizationTransformPass:
                         v = block.var(n)
                         if v.dtype not in ("float32", "bfloat16"):
                             continue
+                        # weight-quantize only real parameters; a matmul Y
+                        # that is an activation (attention K/V) gets the
+                        # activation scheme (reference only quantizes
+                        # persistable weights channel-wise)
+                        is_weight = slot == w_slot and \
+                            getattr(v, "persistable", False)
+                        # output-channel axis: conv filters [O,I,kh,kw]→0,
+                        # fc/mul/matmul weights [in,out]→last
+                        qaxis = 0 if op.type in ("conv2d",
+                                                 "depthwise_conv2d") \
+                            else len(v.shape) - 1
                         qn = self._insert_quant(block, new_ops, n,
                                                 is_weight, quant_cache,
-                                                startup_program)
+                                                startup_program, qaxis)
                         names[i] = qn
             new_ops.append(op)
         block.ops = new_ops
@@ -54,7 +74,7 @@ class QuantizationTransformPass:
         return program
 
     def _insert_quant(self, block, new_ops, name, is_weight, cache,
-                      startup_program):
+                      startup_program, quant_axis=0):
         if name in cache:
             return cache[name]
         v = block.var(name)
@@ -63,12 +83,13 @@ class QuantizationTransformPass:
                          stop_gradient=v.stop_gradient)
         scale = unique_name.generate(f"{name}.scale")
         if is_weight and self.weight_type == "channel_wise_abs_max":
-            block.create_var(name=scale, shape=(v.shape[0],), dtype="float32",
-                             stop_gradient=True)
+            block.create_var(name=scale, shape=(v.shape[quant_axis],),
+                             dtype="float32", stop_gradient=True)
             qop = Operator(block, "fake_channel_wise_quantize_abs_max",
                            {"X": [name]},
                            {"Out": [out], "OutScale": [scale]},
-                           {"bit_length": self.weight_bits})
+                           {"bit_length": self.weight_bits,
+                            "quant_axis": quant_axis})
         elif is_weight or self.act_type == "abs_max":
             block.create_var(name=scale, shape=(1,), dtype="float32",
                             stop_gradient=True)

@@ -120,6 +120,50 @@ class VarBase:
     def __truediv__(self, o):
         return self._bin(o, "elementwise_div")
 
+    def __rsub__(self, o):
+        return to_variable(jnp.asarray(o, self.value.dtype))._bin(
+            self, "elementwise_sub")
+
+    def __rtruediv__(self, o):
+        return to_variable(jnp.asarray(o, self.value.dtype))._bin(
+            self, "elementwise_div")
+
+    def __pow__(self, o):
+        return trace_op("pow", {"X": [self]}, {"factor": float(o)})["Out"][0]
+
+    def __neg__(self):
+        return trace_op("scale", {"X": [self]},
+                        {"scale": -1.0, "bias": 0.0})["Out"][0]
+
+    def __matmul__(self, o):
+        return self._bin(o, "matmul")
+
+    def _reduce(self, op_type, dim=None, keep_dim=False):
+        attrs = {"dim": list(dim) if dim is not None else None,
+                 "keep_dim": keep_dim,
+                 "reduce_all": dim is None}
+        return trace_op(op_type, {"X": [self]}, attrs)["Out"][0]
+
+    def mean(self, dim=None, keep_dim=False):
+        return self._reduce("reduce_mean", dim, keep_dim)
+
+    def sum(self, dim=None, keep_dim=False):
+        return self._reduce("reduce_sum", dim, keep_dim)
+
+    def max(self, dim=None, keep_dim=False):
+        return self._reduce("reduce_max", dim, keep_dim)
+
+    def min(self, dim=None, keep_dim=False):
+        return self._reduce("reduce_min", dim, keep_dim)
+
+    def reshape(self, shape):
+        return trace_op("reshape2", {"X": [self]},
+                        {"shape": list(shape)})["Out"][0]
+
+    def transpose(self, perm):
+        return trace_op("transpose2", {"X": [self]},
+                        {"axis": list(perm)})["Out"][0]
+
     def __repr__(self):
         return f"VarBase({self.name}, shape={self.shape})\n{self.numpy()}"
 

@@ -638,12 +638,13 @@ def mul(x, y, x_num_col_dims=1, y_num_col_dims=1, name=None):
 
 
 def flash_attention(q, k, v, causal=False, sm_scale=None, block_q=128,
-                    block_k=128, name=None):
+                    block_k=128, attn_dropout=0.0, name=None):
     """Fused attention over [b, h, t, d] q/k/v (Pallas kernel,
-    ops/pallas/flash_attention.py)."""
+    ops/pallas/flash_attention.py; exact fallback when dropout is on)."""
     helper = LayerHelper("flash_attention", name=name)
     out = helper.create_variable_for_type_inference(q.dtype)
-    attrs = {"causal": causal, "block_q": block_q, "block_k": block_k}
+    attrs = {"causal": causal, "block_q": block_q, "block_k": block_k,
+             "attn_dropout": float(attn_dropout)}
     if sm_scale is not None:
         attrs["sm_scale"] = float(sm_scale)
     helper.append_op(type="flash_attention",

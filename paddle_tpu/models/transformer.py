@@ -102,20 +102,13 @@ def _attention(x, cfg, prefix):
         q = layers.shard_hint(q, [cfg.dp_axis, cfg.tp_axis, None, None])
         k = layers.shard_hint(k, [cfg.dp_axis, cfg.tp_axis, None, None])
         v = layers.shard_hint(v, [cfg.dp_axis, cfg.tp_axis, None, None])
-    bq = min(128, t)
-    if cfg.use_flash and cfg.attn_dropout == 0 and t % bq == 0:
-        ctxv = layers.flash_attention(q, k, v, causal=cfg.causal,
-                                      sm_scale=1.0 / math.sqrt(hd),
-                                      block_q=bq, block_k=bq)
-    else:
-        scores = layers.matmul(q, k, transpose_y=True,
-                               alpha=1.0 / math.sqrt(hd))
-        weights = layers.softmax(scores)
-        if cfg.attn_dropout:
-            weights = layers.dropout(
-                weights, cfg.attn_dropout,
-                dropout_implementation="upscale_in_train")
-        ctxv = layers.matmul(weights, v)  # [b, h, t, hd]
+    # Single op either way: the lowering picks the Pallas tiled kernel or
+    # the exact fallback (dropout on / bad tile divisor) — causal mask and
+    # numerics are identical across paths (ops/attention.py).
+    bq = min(128, t) if cfg.use_flash else 0  # 0 = force exact path
+    ctxv = layers.flash_attention(
+        q, k, v, causal=cfg.causal, sm_scale=1.0 / math.sqrt(hd),
+        block_q=bq, block_k=bq, attn_dropout=cfg.attn_dropout)
     ctxv = layers.transpose(ctxv, [0, 2, 1, 3])
     ctxv = layers.reshape(ctxv, [b, t, d])
     return _dense(ctxv, d, f"{prefix}.proj", cfg, tp_axis="row")

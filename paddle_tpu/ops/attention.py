@@ -7,16 +7,27 @@ Pallas kernel, with custom-vjp backward.
 from __future__ import annotations
 
 from ..core.registry import register_op
-from .pallas.flash_attention import flash_attention
+from .pallas.flash_attention import flash_attention, reference_attention
 
 
-@register_op("flash_attention")
+@register_op("flash_attention", stateful=True)
 def _flash_attention_op(ctx, ins, attrs):
     q, k, v = ins["Q"][0], ins["K"][0], ins["V"][0]
-    out = flash_attention(
-        q, k, v,
-        causal=attrs.get("causal", False),
-        sm_scale=attrs.get("sm_scale", None),
-        block_q=attrs.get("block_q", 128),
-        block_k=attrs.get("block_k", 128))
+    causal = attrs.get("causal", False)
+    sm_scale = attrs.get("sm_scale", None)
+    dropout = 0.0 if ctx.is_test else attrs.get("attn_dropout", 0.0)
+    if attrs.get("block_q", 128) == 0:  # explicit exact-path request
+        out = reference_attention(q, k, v, causal=causal, sm_scale=sm_scale,
+                                  dropout=dropout,
+                                  rng=ctx.rng if dropout else None)
+    elif dropout:
+        # the tiled kernel has no dropout path; exact fallback keeps the
+        # trained model identical (incl. the causal mask) across paths
+        out = reference_attention(q, k, v, causal=causal, sm_scale=sm_scale,
+                                  dropout=dropout, rng=ctx.rng)
+    else:
+        out = flash_attention(
+            q, k, v, causal=causal, sm_scale=sm_scale,
+            block_q=attrs.get("block_q", 128),
+            block_k=attrs.get("block_k", 128))
     return {"Out": [out]}

@@ -124,19 +124,19 @@ def _fwd(q, k, v, sm_scale, causal, block_q, block_k):
 # backward: two tiled passes (FlashAttention-2 scheme), both O(T) memory:
 #   dq pass:    grid (bh, q_blocks), stream k-blocks, accumulate dq
 #   dk/dv pass: grid (bh, k_blocks), stream q-blocks, accumulate dk, dv
-# Each tile recomputes p = exp(qk - lse); delta = rowsum(do*o).
+# Each tile recomputes p = exp(qk - lse); delta = rowsum(do*o) is computed
+# once per row up front (FlashAttention-2) and streamed into both kernels.
 # ---------------------------------------------------------------------------
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref, dq_ref, *,
-                   sm_scale, causal, block_k):
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, delta_ref, lse_ref, do_ref, dq_ref,
+                   *, sm_scale, causal, block_k):
     q = q_ref[0].astype(jnp.float32)
-    o = o_ref[0].astype(jnp.float32)
     do = do_ref[0].astype(jnp.float32)
     lse = lse_ref[0].astype(jnp.float32)
     block_q, d = q.shape
     t = k_ref.shape[1]
     qi = pl.program_id(1)
-    delta = jnp.sum(do * o, axis=1, keepdims=True)
+    delta = delta_ref[0].astype(jnp.float32)[:, None]
     num_kb = t // block_k
 
     def body(kb, dq):
@@ -168,7 +168,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref, dq_ref, *,
     dq_ref[0] = dq.astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref,
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, delta_ref, lse_ref, do_ref,
                     dk_ref, dv_ref, *, sm_scale, causal, block_q):
     k = k_ref[0].astype(jnp.float32)
     v = v_ref[0].astype(jnp.float32)
@@ -180,9 +180,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref,
     def body(qb, carry):
         dk, dv = carry
         q = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
-        o = o_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
         do = do_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
         lse = lse_ref[0, pl.ds(qb * block_q, block_q)].astype(jnp.float32)
+        delta = delta_ref[0, pl.ds(qb * block_q, block_q)].astype(
+            jnp.float32)[:, None]
         s = jax.lax.dot_general(q * sm_scale, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         if causal:
@@ -196,7 +197,6 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref,
                                       preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        delta = jnp.sum(do * o, axis=1, keepdims=True)
         ds = p * (dp - delta) * sm_scale
         dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
                                       preferred_element_type=jnp.float32)
@@ -216,6 +216,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref,
 def _bwd(sm_scale, causal, block_q, block_k, res, do):
     q, k, v, o, lse = res
     bh, t, d = q.shape
+    # delta = rowsum(do * o), once per row; XLA fuses this elementwise
+    # reduction, the kernels just stream the [bh, t] result.
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
     kw = {}
     if _VMEM is not None:
         kw = {"memory_space": _VMEM}
@@ -229,23 +232,23 @@ def _bwd(sm_scale, causal, block_q, block_k, res, do):
         functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
                           block_k=block_k),
         grid=(bh, t // block_q),
-        in_specs=[spec_qb, spec_full, spec_full, spec_qb, spec_lse_qb,
+        in_specs=[spec_qb, spec_full, spec_full, spec_lse_qb, spec_lse_qb,
                   spec_qb],
         out_specs=spec_qb,
         out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
         interpret=_interpret(),
-    )(q, k, v, o, lse, do)
+    )(q, k, v, delta, lse, do)
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale,
                           causal=causal, block_q=block_q),
         grid=(bh, t // block_k),
-        in_specs=[spec_full, spec_kb, spec_kb, spec_full, spec_lse_full,
+        in_specs=[spec_full, spec_kb, spec_kb, spec_lse_full, spec_lse_full,
                   spec_full],
         out_specs=[spec_kb, spec_kb],
         out_shape=[jax.ShapeDtypeStruct((bh, t, d), q.dtype)] * 2,
         interpret=_interpret(),
-    )(q, k, v, o, lse, do)
+    )(q, k, v, delta, lse, do)
     return dq, dk, dv
 
 
@@ -263,10 +266,40 @@ def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k):
 _flash.defvjp(_flash_fwd, _bwd)
 
 
+def reference_attention(q, k, v, causal=False, sm_scale=None, dropout=0.0,
+                        rng=None):
+    """Naive exact attention over [..., T, d]; same numerics as the Pallas
+    kernel. Used when block divisibility fails or attention dropout is on
+    (the tiled kernel has no dropout path)."""
+    d = q.shape[-1]
+    t = q.shape[-2]
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+    s = jnp.einsum("...qd,...kd->...qk", q, k).astype(jnp.float32) * sm_scale
+    if causal:
+        qpos = jnp.arange(t)[:, None]
+        kpos = jnp.arange(t)[None, :]
+        s = jnp.where(qpos >= kpos, s, NEG_INF)
+    w = jax.nn.softmax(s, axis=-1)
+    if dropout and rng is not None:
+        keep = jax.random.bernoulli(rng, 1.0 - dropout, w.shape)
+        w = jnp.where(keep, w / (1.0 - dropout), 0.0)
+    return jnp.einsum("...qk,...kd->...qd", w.astype(q.dtype), v)
+
+
+def _pick_block(t, want):
+    """Largest power-of-two divisor of t capped at `want` (>=1)."""
+    b = 1
+    while b * 2 <= min(want, t) and t % (b * 2) == 0:
+        b *= 2
+    return b
+
+
 def flash_attention(q, k, v, causal=False, sm_scale=None, block_q=128,
                     block_k=128):
     """q, k, v: [batch, heads, T, head_dim] (or [bh, T, d]).
-    Returns attention output, same shape/dtype as q."""
+    Returns attention output, same shape/dtype as q. Falls back to the
+    exact naive path when T has no usable tile divisor."""
     orig_shape = q.shape
     if q.ndim == 4:
         b, h, t, d = q.shape
@@ -276,10 +309,11 @@ def flash_attention(q, k, v, causal=False, sm_scale=None, block_q=128,
     t, d = q.shape[1], q.shape[2]
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(d)
-    block_q = min(block_q, t)
-    block_k = min(block_k, t)
-    if t % block_q or t % block_k:
-        raise ValueError(f"seq len {t} must divide block sizes "
-                         f"({block_q}, {block_k})")
+    block_q = _pick_block(t, min(block_q, t))
+    block_k = _pick_block(t, min(block_k, t))
+    if min(block_q, block_k) < 16 and t > 16:
+        # degenerate tiling (e.g. prime T): exact fallback beats 1-wide tiles
+        out = reference_attention(q, k, v, causal=causal, sm_scale=sm_scale)
+        return out.reshape(orig_shape)
     out = _flash(q, k, v, float(sm_scale), bool(causal), block_q, block_k)
     return out.reshape(orig_shape)

@@ -34,10 +34,16 @@ def _fake_quantize_abs_max(ctx, ins, attrs):
 @register_op("fake_channel_wise_quantize_abs_max", manual_grad=_ste_grad,
              nondiff_outputs=("OutScale",))
 def _fake_channel_wise_quantize(ctx, ins, attrs):
-    x = ins["X"][0]  # weights [out_c, ...]
+    x = ins["X"][0]
     bits = attrs.get("bit_length", 8)
-    scale = jnp.max(jnp.abs(x.reshape(x.shape[0], -1)), axis=1)
-    s = scale.reshape((-1,) + (1,) * (x.ndim - 1))
+    # quant_axis: the OUTPUT-channel axis — 0 for conv filters [O,I,kh,kw],
+    # 1 for mul/fc weights [in,out] (reference fake_quantize_op quant_axis)
+    axis = attrs.get("quant_axis", 0)
+    red = tuple(i for i in range(x.ndim) if i != axis)
+    scale = jnp.max(jnp.abs(x), axis=red)
+    shape = [1] * x.ndim
+    shape[axis] = -1
+    s = scale.reshape(shape)
     return {"Out": [_quant_dequant(x, s, bits)], "OutScale": [scale]}
 
 
@@ -55,8 +61,10 @@ def _fake_quantize_moving_avg(ctx, ins, attrs):
         scale = ins["InScale"][0].reshape(())
         outs["OutScale"] = [scale.reshape(1)]
     else:
-        state = ins["InState"][0].reshape(()) if "InState" in ins else 0.0
-        accum = ins["InAccum"][0].reshape(()) if "InAccum" in ins else 0.0
+        state = ins["InState"][0].reshape(()) if "InState" in ins \
+            else jnp.zeros(())
+        accum = ins["InAccum"][0].reshape(()) if "InAccum" in ins \
+            else jnp.zeros(())
         new_state = rate * state + 1.0
         new_accum = rate * accum + cur
         scale = new_accum / new_state
@@ -92,8 +100,10 @@ def _moving_avg_scale(ctx, ins, attrs):
     x = ins["X"][0]
     rate = attrs.get("moving_rate", 0.9)
     cur = jnp.max(jnp.abs(x))
-    state = ins["InState"][0].reshape(()) if "InState" in ins else 0.0
-    accum = ins["InAccum"][0].reshape(()) if "InAccum" in ins else 0.0
+    state = ins["InState"][0].reshape(()) if "InState" in ins \
+        else jnp.zeros(())
+    accum = ins["InAccum"][0].reshape(()) if "InAccum" in ins \
+        else jnp.zeros(())
     new_state = rate * state + 1.0
     new_accum = rate * accum + cur
     return {"Out": [x], "OutScale": [(new_accum / new_state).reshape(1)],
