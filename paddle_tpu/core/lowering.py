@@ -65,7 +65,11 @@ def run_op(op, env, ctx):
         vals = _gather_slot(env, names)
         if vals:
             ins[slot] = vals
-    outs = opdef.lower(_OpCtx(ctx, op), ins, op.attrs)
+    opctx = _OpCtx(ctx, op)
+    # live view of already-materialised vars — lets keep-previous-value
+    # semantics (conditional_block false branch) read carried state
+    opctx.env = env
+    outs = opdef.lower(opctx, ins, op.attrs)
     for slot, names in op.outputs.items():
         if slot not in outs:
             continue

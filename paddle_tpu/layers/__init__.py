@@ -14,6 +14,12 @@ from .math_ops import *  # noqa: F401,F403
 from . import control_flow  # noqa: F401
 from .control_flow import *  # noqa: F401,F403
 from . import detection  # noqa: F401
+from . import rnn  # noqa: F401
+from .rnn import (RNNCell, GRUCell, LSTMCell, birnn,  # noqa: F401
+                  BeamSearchDecoder, Decoder, dynamic_decode,
+                  dynamic_gru, dynamic_lstm, dynamic_lstmp, gru_unit,
+                  lstm_unit, lstm)
+from .rnn import rnn as rnn_fn  # noqa: F401  (module name shadows the fn)
 from . import sequence  # noqa: F401
 from .sequence import *  # noqa: F401,F403
 from .dist import *  # noqa: F401,F403

@@ -12,7 +12,7 @@ from ..layer_helper import LayerHelper
 __all__ = ["increment", "less_than", "less_equal", "greater_than",
            "greater_equal", "equal", "not_equal", "array_write",
            "array_read", "array_length", "create_array", "While", "Switch",
-           "Print", "is_empty"]
+           "Print", "is_empty", "StaticRNN", "DynamicRNN", "IfElse"]
 
 
 def _cmp(op_type):
@@ -159,8 +159,281 @@ class While:
         return While._BlockGuard(self)
 
 
+class _CondBlockGuard:
+    """Record ops into a sub-block, then emit a conditional_block op whose
+    outputs are the outer vars the body writes (first-match semantics rely
+    on the lowering's keep-previous-value false branch,
+    ops/controlflow.py)."""
+
+    def __init__(self, pred):
+        self.pred = pred
+
+    def __enter__(self):
+        prog = default_main_program()
+        self.prog = prog
+        self.sub = prog._create_block()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is not None:
+            return False
+        prog = self.prog
+        sub = prog.current_block()
+        prog._rollback()
+        parent = prog.current_block()
+        read, written = [], []
+        for op in sub.ops:
+            for n in op.input_names():
+                if parent.has_var(n) and n not in read:
+                    read.append(n)
+            for n in op.output_names():
+                if parent.has_var(n) and n not in written:
+                    written.append(n)
+        parent.append_op(
+            "conditional_block",
+            inputs={"Cond": [self.pred.name], "Input": read},
+            outputs={"Out": written},
+            attrs={"sub_block": sub.idx, "input_vars": read,
+                   "output_vars": written},
+            infer_shape=False)
+        return False
+
+
 class Switch:
+    """First-matching-case switch (reference control_flow.py Switch) —
+    used chiefly for LR schedules. Each case body runs under a
+    conditional_block gated on `cond AND no-earlier-match`; on TPU all
+    branches compile into one program, XLA selects at runtime."""
+
     def __init__(self, name=None):
-        raise NotImplementedError(
-            "Switch: use branch-free masked selects on TPU "
-            "(see layers/learning_rate_scheduler.piecewise_decay)")
+        self._matched = None
+
+    def case(self, condition):
+        from .nn import logical_and, logical_not
+        if self._matched is None:
+            pred = condition
+            self._matched = condition
+        else:
+            pred = logical_and(condition, logical_not(self._matched))
+            from .nn import logical_or
+            self._matched = logical_or(self._matched, condition)
+        return _CondBlockGuard(pred)
+
+    def default(self):
+        from .nn import logical_not
+        assert self._matched is not None, "default() before any case()"
+        return _CondBlockGuard(logical_not(self._matched))
+
+
+class IfElse:
+    """Reference IfElse splits the batch by a [N,1] bool condition and runs
+    each branch on its slice (control_flow.py IfElse). TPU formulation:
+    both branches run on the FULL batch (no dynamic shapes) and outputs
+    merge row-wise by mask — identical results, XLA-friendly."""
+
+    def __init__(self, cond, name=None):
+        self.cond = cond
+        self._outs = {True: [], False: []}
+        self._in_branch = None
+
+    class _Branch:
+        def __init__(self, ie, flag):
+            self.ie, self.flag = ie, flag
+
+        def __enter__(self):
+            self.ie._in_branch = self.flag
+            return self
+
+        def __exit__(self, *a):
+            self.ie._in_branch = None
+            return False
+
+    def true_block(self):
+        return IfElse._Branch(self, True)
+
+    def false_block(self):
+        return IfElse._Branch(self, False)
+
+    def input(self, x):
+        # reference returns the branch's row-slice; full batch here
+        return x
+
+    def output(self, *outs):
+        assert self._in_branch is not None, "output() outside a branch"
+        self._outs[self._in_branch].extend(outs)
+
+    def __call__(self):
+        from .math_ops import elementwise_add, elementwise_mul
+        from .tensor import cast
+        t_outs, f_outs = self._outs[True], self._outs[False]
+        assert len(t_outs) == len(f_outs), \
+            "both branches must output the same number of vars"
+        merged = []
+        for tv, fv in zip(t_outs, f_outs):
+            m = cast(self.cond, tv.dtype)
+            one_minus = elementwise_add(
+                elementwise_mul(m, _neg_one(tv.dtype)), _one(tv.dtype))
+            merged.append(elementwise_add(elementwise_mul(tv, m),
+                                          elementwise_mul(fv, one_minus)))
+        return merged
+
+
+def _one(dtype):
+    from .tensor import fill_constant
+    return fill_constant([1], dtype, 1.0)
+
+
+def _neg_one(dtype):
+    from .tensor import fill_constant
+    return fill_constant([1], dtype, -1.0)
+
+
+class StaticRNN:
+    """Imperative-style RNN builder (reference control_flow.py StaticRNN):
+    step_input/memory/update_memory/step_output inside `with rnn.step()`,
+    then `rnn()` returns stacked outputs. Sequence tensors are time-major
+    [T, B, ...] like the reference; lowers to ONE scan-based recurrent op
+    (ops/rnn_ops.py), not per-step sub-block execution."""
+
+    def __init__(self, name=None):
+        self._seq_inputs = []   # (outer var, step var)
+        self._memories = []     # [step var]
+        self._mem_updates = {}  # step var name -> new var
+        self._outputs = []
+        self._sub = None
+        self._parent = None
+
+    class _StepGuard:
+        def __init__(self, rnn):
+            self.rnn = rnn
+
+        def __enter__(self):
+            prog = default_main_program()
+            self.rnn._prog = prog
+            self.rnn._parent = prog.current_block()
+            self.rnn._sub = prog._create_block()
+            return self
+
+        def __exit__(self, exc_type, exc, tb):
+            if exc_type is not None:
+                return False
+            self.rnn._prog._rollback()
+            self.rnn._emit()
+            return False
+
+    def step(self):
+        return StaticRNN._StepGuard(self)
+
+    def step_input(self, x):
+        from ..framework import unique_name
+        shape = list(x.shape)
+        v = self._sub.create_var(name=unique_name.generate("srnn_x"),
+                                 shape=shape[1:], dtype=x.dtype,
+                                 stop_gradient=True)
+        self._seq_inputs.append((x, v))
+        return v
+
+    def memory(self, init=None, shape=None, batch_ref=None, init_value=0.0,
+               init_batch_dim_idx=0, ref_batch_dim_idx=1):
+        from ..framework import unique_name
+        from .tensor import fill_constant
+        if init is None:
+            assert shape is not None
+            blk_cur = default_main_program().current_block()
+            # init built in the PARENT block (it feeds the scan carry)
+            default_main_program()._current_block_idx = self._parent.idx
+            dims = [int(s) if int(s) != -1 else
+                    int(batch_ref.shape[ref_batch_dim_idx])
+                    for s in shape]
+            init = fill_constant(dims, "float32", init_value)
+            default_main_program()._current_block_idx = blk_cur.idx
+        v = self._sub.create_var(name=unique_name.generate("srnn_mem"),
+                                 shape=list(init.shape), dtype=init.dtype,
+                                 stop_gradient=False)
+        self._memories.append((init, v))
+        return v
+
+    def update_memory(self, mem, var):
+        self._mem_updates[mem.name] = var
+
+    def step_output(self, o):
+        self._outputs.append(o)
+
+    def output(self, *outputs):
+        for o in outputs:
+            self.step_output(o)
+
+    _time_major = True  # sequence tensors [T, B, ...] (reference StaticRNN)
+
+    def _emit(self):
+        from ..framework import unique_name
+        parent, sub = self._parent, self._sub
+        local = {v.name for _, v in self._seq_inputs} | \
+            {v.name for _, v in self._memories}
+        written, param_names = set(), []
+        for op in sub.ops:
+            for n in op.input_names():
+                if n not in local and n not in written and \
+                        parent.has_var(n) and n not in param_names:
+                    param_names.append(n)
+            for n in op.output_names():
+                written.add(n)
+        self._result_vars = []
+        seq_shape = list(self._seq_inputs[0][0].shape) if self._seq_inputs \
+            else [None, None]
+        for o in self._outputs:
+            if self._time_major:
+                shape = [seq_shape[0]] + list(o.shape)
+            else:
+                shape = [seq_shape[0], seq_shape[1]] + list(o.shape)[1:]
+            v = parent.create_var(name=unique_name.generate("rnn_out"),
+                                  shape=shape, dtype=o.dtype,
+                                  stop_gradient=False)
+            self._result_vars.append(v)
+        finals = [parent.create_var(name=unique_name.generate("rnn_final"),
+                                    shape=list(v.shape), dtype=v.dtype,
+                                    stop_gradient=False)
+                  for _, v in self._memories]
+        state_out = [self._mem_updates[v.name].name
+                     for _, v in self._memories]
+        parent.append_op(
+            "recurrent",
+            inputs={"X": [x.name for x, _ in self._seq_inputs],
+                    "Init": [i.name for i, _ in self._memories],
+                    "Params": param_names},
+            outputs={"Out": [v.name for v in self._result_vars],
+                     "FinalStates": [f.name for f in finals]},
+            attrs={"sub_block": sub.idx,
+                   "x_names": [v.name for _, v in self._seq_inputs],
+                   "state_names": [v.name for _, v in self._memories],
+                   "state_out_names": state_out,
+                   "out_names": [o.name for o in self._outputs],
+                   "param_names": param_names,
+                   "reverse": False, "time_major": self._time_major},
+            infer_shape=False)
+
+    def __call__(self):
+        if len(self._result_vars) == 1:
+            return self._result_vars[0]
+        return self._result_vars
+
+
+class DynamicRNN(StaticRNN):
+    """Reference DynamicRNN consumes LoD sequences (control_flow.py
+    DynamicRNN). Padded-dense equivalent: batch-major [B, T, ...] inputs;
+    per-row lengths (if any) are handled by the caller with sequence_mask
+    over the outputs. block() aliases step()."""
+
+    _time_major = False
+
+    def block(self):
+        return self.step()
+
+    def step_input(self, x, level=0):
+        from ..framework import unique_name
+        shape = list(x.shape)
+        v = self._sub.create_var(name=unique_name.generate("drnn_x"),
+                                 shape=[shape[0]] + shape[2:], dtype=x.dtype,
+                                 stop_gradient=True)
+        self._seq_inputs.append((x, v))
+        return v

@@ -643,8 +643,9 @@ def flash_attention(q, k, v, causal=False, sm_scale=None, block_q=128,
     ops/pallas/flash_attention.py; exact fallback when dropout is on)."""
     helper = LayerHelper("flash_attention", name=name)
     out = helper.create_variable_for_type_inference(q.dtype)
+    # is_test present so clone(for_test=True) turns attention dropout off
     attrs = {"causal": causal, "block_q": block_q, "block_k": block_k,
-             "attn_dropout": float(attn_dropout)}
+             "attn_dropout": float(attn_dropout), "is_test": False}
     if sm_scale is not None:
         attrs["sm_scale"] = float(sm_scale)
     helper.append_op(type="flash_attention",

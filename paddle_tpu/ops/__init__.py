@@ -20,6 +20,7 @@ from . import detection_ops  # noqa: F401
 from . import collective  # noqa: F401
 from . import quant_ops  # noqa: F401
 from . import attention  # noqa: F401
+from . import rnn_ops  # noqa: F401
 
 
 def registered_types():

@@ -76,17 +76,23 @@ def _conditional_block(ctx, ins, attrs):
     outer_env = dict(zip(input_names, ins.get("Input", [])))
     out_names = attrs["output_vars"]
 
+    # previous values of output vars from the live env, so a skipped
+    # branch preserves what earlier blocks (e.g. earlier Switch cases)
+    # wrote — conditional_block_op's skip semantics
+    prev = {k: ctx.env[k] for k in out_names
+            if getattr(ctx, "env", None) and k in ctx.env}
+
     def true_fn(env):
         env = dict(env)
+        env.update(prev)
         ctx.lower_sub_block(block, env)
         return tuple(env[k] for k in out_names)
 
     def false_fn(env):
-        # Outputs keep their previous values (zeros if undefined) — matches
-        # conditional_block_op's skip semantics for uninitialised outputs.
+        shapes = jax.eval_shape(true_fn, env)
         return tuple(
-            env.get(k, jnp.zeros(s.shape, s.dtype)) for k, s in zip(
-                out_names, jax.eval_shape(true_fn, env)))
+            prev.get(k, env.get(k, jnp.zeros(s.shape, s.dtype)))
+            for k, s in zip(out_names, shapes))
 
     out = jax.lax.cond(pred, true_fn, false_fn, outer_env)
     return {"Out": list(out)}

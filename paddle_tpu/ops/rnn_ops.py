@@ -1,0 +1,323 @@
+"""RNN ops: scan-based recurrence, GRU/LSTM cells + full-sequence kernels,
+beam search.
+
+Reference analogues: recurrent_op.cc:668 (static-graph RNN running a
+sub-block per step with memory vars), gru_unit_op.h (gates [u,r,c],
+h = u*c + (1-u)*h_prev, origin_mode flips), lstm_op.h +
+math/detail/lstm_kernel.h (gate layout [c~,i,f,o] with peephole checkI/F/O,
+state = c~*i + prev*f, h = o*act(state)), math/beam_search.h,
+beam_search_decode_op, gather_tree_op.
+
+TPU design: every sequence loop is ONE lax.scan (= one XLA While with
+stacked outputs) instead of the reference's per-step Executor invocation;
+the batch dim stays leading so each step is a batched matmul on the MXU.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+_ACT = {
+    "sigmoid": jax.nn.sigmoid, "tanh": jnp.tanh, "relu": jax.nn.relu,
+    "identity": lambda x: x, "": lambda x: x,
+}
+
+
+def _act(name):
+    return _ACT[name if isinstance(name, str) else "sigmoid"]
+
+
+# ---------------------------------------------------------------------------
+# recurrent: run a sub-block per time step under lax.scan
+# ---------------------------------------------------------------------------
+
+@register_op("recurrent")
+def _recurrent(ctx, ins, attrs):
+    """Scan a sub-block over time.
+
+    Slots: X = sequence inputs [B, T, ...]; Init = initial states;
+    Params = outer vars the block reads (weights etc.).
+    attrs: sub_block, x_names (step-var name per X), state_names (step-var
+    name per Init), state_out_names (var the block writes per state),
+    out_names (per-step outputs to stack), param_names, reverse.
+    """
+    block = ctx.sub_block(attrs["sub_block"])
+    x_names = attrs.get("x_names", [])
+    state_names = attrs.get("state_names", [])
+    state_out = attrs.get("state_out_names", [])
+    out_names = attrs.get("out_names", [])
+    reverse = attrs.get("reverse", False)
+
+    xs = ins.get("X", [])
+    inits = ins.get("Init", [])
+    params = dict(zip(attrs.get("param_names", []), ins.get("Params", [])))
+    time_major = attrs.get("time_major", False)
+    lens = ins["SeqLen"][0].reshape(-1) if "SeqLen" in ins else None
+
+    # batch-major [B, T, ...] -> time-major for scan
+    xs_t = xs if time_major else [jnp.moveaxis(x, 1, 0) for x in xs]
+    if reverse:
+        xs_t = [x[::-1] for x in xs_t]
+    t_len = xs_t[0].shape[0]
+    steps = jnp.arange(t_len) if not reverse else \
+        jnp.arange(t_len)[::-1]
+
+    def step(states, scanned):
+        xts, i = scanned
+        env = dict(params)
+        env.update(zip(x_names, xts))
+        env.update(zip(state_names, states))
+        ctx.lower_sub_block(block, env)
+        new_states = tuple(env[n] for n in state_out)
+        if lens is not None:
+            # padded steps carry state through (reference rnn() mask,
+            # layers/rnn.py _maybe_copy); state leading dim = batch
+            valid = i < lens
+            new_states = tuple(
+                jnp.where(valid.reshape((-1,) + (1,) * (n.ndim - 1)), n, o)
+                for n, o in zip(new_states, states))
+        outs = tuple(env[n] for n in out_names)
+        return new_states, outs
+
+    final_states, stacked = jax.lax.scan(step, tuple(inits),
+                                         (tuple(xs_t), steps))
+    if reverse:
+        stacked = tuple(o[::-1] for o in stacked)
+    outs = list(stacked) if time_major else \
+        [jnp.moveaxis(o, 0, 1) for o in stacked]
+    return {"Out": outs, "FinalStates": list(final_states)}
+
+
+# ---------------------------------------------------------------------------
+# GRU
+# ---------------------------------------------------------------------------
+
+def _gru_step(x3, h_prev, weight, bias, gate_act, cand_act, origin_mode):
+    """x3: [B, 3D] pre-projected input; weight: [D, 3D] ([:, :2D] gates,
+    [:, 2D:] candidate); returns (gate, reset_h_prev, h)."""
+    d = h_prev.shape[-1]
+    if bias is not None:
+        x3 = x3 + bias.reshape(1, 3 * d)
+    g2 = x3[:, :2 * d] + h_prev @ weight[:, :2 * d]
+    u = gate_act(g2[:, :d])
+    r = gate_act(g2[:, d:])
+    rhp = r * h_prev
+    c = cand_act(x3[:, 2 * d:] + rhp @ weight[:, 2 * d:])
+    if origin_mode:
+        h = c + u * (h_prev - c)      # (1-u)*c + u*h_prev
+    else:
+        h = u * (c - h_prev) + h_prev  # u*c + (1-u)*h_prev
+    gate = jnp.concatenate([u, r, c], axis=1)
+    return gate, rhp, h
+
+
+@register_op("gru_unit")
+def _gru_unit(ctx, ins, attrs):
+    x = ins["Input"][0]
+    h_prev = ins["HiddenPrev"][0]
+    w = ins["Weight"][0]
+    b = ins["Bias"][0] if "Bias" in ins else None
+    gate, rhp, h = _gru_step(
+        x, h_prev, w, b, _act(attrs.get("gate_activation", "sigmoid")),
+        _act(attrs.get("activation", "tanh")),
+        attrs.get("origin_mode", False))
+    return {"Gate": [gate], "ResetHiddenPrev": [rhp], "Hidden": [h]}
+
+
+@register_op("gru", nondiff_inputs=("Lengths",))
+def _gru(ctx, ins, attrs):
+    """dynamic_gru: Input [B, T, 3D] (pre-projected), Weight [D, 3D],
+    optional H0 [B, D], Bias [1, 3D], Lengths [B]."""
+    x = ins["Input"][0]
+    w = ins["Weight"][0]
+    b = ins["Bias"][0] if "Bias" in ins else None
+    d = w.shape[0]
+    bsz, t = x.shape[0], x.shape[1]
+    h0 = ins["H0"][0] if "H0" in ins else jnp.zeros((bsz, d), x.dtype)
+    lens = ins["Lengths"][0].reshape(-1) if "Lengths" in ins else None
+    gate_act = _act(attrs.get("gate_activation", "sigmoid"))
+    cand_act = _act(attrs.get("activation", "tanh"))
+    origin = attrs.get("origin_mode", False)
+    reverse = attrs.get("is_reverse", False)
+
+    xs = jnp.moveaxis(x, 1, 0)
+    if reverse:
+        xs = xs[::-1]
+    steps = jnp.arange(t) if not reverse else jnp.arange(t)[::-1]
+
+    def step(h, inp):
+        xt, i = inp
+        _, _, h_new = _gru_step(xt, h, w, b, gate_act, cand_act, origin)
+        if lens is not None:  # past-the-end steps carry state through
+            valid = (i < lens)[:, None]
+            h_new = jnp.where(valid, h_new, h)
+        return h_new, h_new
+    _, hs = jax.lax.scan(step, h0, (xs, steps))
+    if reverse:
+        hs = hs[::-1]
+    return {"Hidden": [jnp.moveaxis(hs, 0, 1)]}
+
+
+# ---------------------------------------------------------------------------
+# LSTM
+# ---------------------------------------------------------------------------
+
+def _lstm_step(x4, h_prev, c_prev, weight, checks, gate_act, cell_act,
+               cand_act):
+    """x4: [B, 4D] pre-projected (+bias) in gate order [c~, i, f, o];
+    weight: [P, 4D] recurrent (P = proj size or D); checks: (ci, cf, co)
+    peepholes or None."""
+    d = c_prev.shape[-1]
+    g = x4 + h_prev @ weight
+    cand = cand_act(g[:, :d])
+    ci, cf, co = checks if checks is not None else (0.0, 0.0, 0.0)
+    i = gate_act(g[:, d:2 * d] + c_prev * ci)
+    f = gate_act(g[:, 2 * d:3 * d] + c_prev * cf)
+    c = cand * i + c_prev * f
+    o = gate_act(g[:, 3 * d:] + c * co)
+    h = o * cell_act(c)
+    return h, c
+
+
+@register_op("lstm", nondiff_inputs=("Lengths",))
+def _lstm(ctx, ins, attrs):
+    """dynamic_lstm: Input [B, T, 4D] pre-projected, Weight [P, 4D],
+    Bias [1, 4D] (+[1,7D] with peepholes), optional H0/C0, Lengths.
+    With ProjWeight [D, P] this is dynamic_lstmp: the recurrent state is
+    the projection h_proj = (o * act(c)) @ ProjWeight (lstmp_op.h)."""
+    x = ins["Input"][0]
+    w = ins["Weight"][0]
+    proj = ins["ProjWeight"][0] if "ProjWeight" in ins else None
+    d = w.shape[1] // 4
+    bsz, t = x.shape[0], x.shape[1]
+    use_peep = attrs.get("use_peepholes", True)
+    b = ins["Bias"][0].reshape(-1) if "Bias" in ins else None
+    checks = None
+    if b is not None:
+        x = x + b[:4 * d].reshape(1, 1, 4 * d)
+        if use_peep and b.shape[0] >= 7 * d:
+            checks = (b[4 * d:5 * d], b[5 * d:6 * d], b[6 * d:7 * d])
+    hdim = proj.shape[1] if proj is not None else d
+    h0 = ins["H0"][0] if "H0" in ins else jnp.zeros((bsz, hdim), x.dtype)
+    c0 = ins["C0"][0] if "C0" in ins else jnp.zeros((bsz, d), x.dtype)
+    lens = ins["Lengths"][0].reshape(-1) if "Lengths" in ins else None
+    gate_act = _act(attrs.get("gate_activation", "sigmoid"))
+    cell_act = _act(attrs.get("cell_activation", "tanh"))
+    cand_act = _act(attrs.get("candidate_activation", "tanh"))
+    proj_act = _act(attrs.get("proj_activation", "identity"))
+    reverse = attrs.get("is_reverse", False)
+
+    xs = jnp.moveaxis(x, 1, 0)
+    if reverse:
+        xs = xs[::-1]
+    steps = jnp.arange(t) if not reverse else jnp.arange(t)[::-1]
+
+    def step(carry, inp):
+        h, c = carry
+        xt, i = inp
+        h_new, c_new = _lstm_step(xt, h, c, w, checks, gate_act, cell_act,
+                                  cand_act)
+        if proj is not None:
+            h_new = proj_act(h_new @ proj)
+        if lens is not None:
+            valid = (i < lens)[:, None]
+            h_new = jnp.where(valid, h_new, h)
+            c_new = jnp.where(valid, c_new, c)
+        return (h_new, c_new), (h_new, c_new)
+
+    (_, _), (hs, cs) = jax.lax.scan(step, (h0, c0), (xs, steps))
+    if reverse:
+        hs, cs = hs[::-1], cs[::-1]
+    return {"Hidden": [jnp.moveaxis(hs, 0, 1)],
+            "Cell": [jnp.moveaxis(cs, 0, 1)]}
+
+
+@register_op("lstm_unit")
+def _lstm_unit(ctx, ins, attrs):
+    """x [B, 4D] pre-projected, gate order [i, f, c~, o] (lstm_unit_op.h
+    uses the unprojected 4-gate layout); returns C, H."""
+    x = ins["X"][0]
+    c_prev = ins["C_prev"][0]
+    d = c_prev.shape[-1]
+    forget_bias = attrs.get("forget_bias", 0.0)
+    i = jax.nn.sigmoid(x[:, :d])
+    f = jax.nn.sigmoid(x[:, d:2 * d] + forget_bias)
+    cand = jnp.tanh(x[:, 2 * d:3 * d])
+    o = jax.nn.sigmoid(x[:, 3 * d:])
+    c = f * c_prev + i * cand
+    h = o * jnp.tanh(c)
+    return {"C": [c], "H": [h]}
+
+
+# ---------------------------------------------------------------------------
+# beam search (batched dense form: [batch, beam, ...])
+# ---------------------------------------------------------------------------
+
+@register_op("beam_search", nondiff_inputs=("pre_ids", "pre_scores", "ids"),
+             nondiff_outputs=("selected_ids", "parent_idx"))
+def _beam_search(ctx, ins, attrs):
+    """One beam step. pre_ids [B, beam], pre_scores [B, beam],
+    scores [B, beam, V] = accumulated log-probs of every extension.
+    Selects top-beam over beam*V per batch; finished beams (pre_id ==
+    end_id) contribute a single frozen candidate carrying their score."""
+    pre_ids = ins["pre_ids"][0]
+    pre_scores = ins["pre_scores"][0]
+    scores = ins["scores"][0]
+    end_id = attrs.get("end_id", 0)
+    bsz, beam, vocab = scores.shape
+
+    finished = pre_ids == end_id  # [B, beam]
+    neg = jnp.asarray(-1e9, scores.dtype)
+    # finished beams: freeze — only the end_id continuation, at pre_score
+    frozen = jnp.full((bsz, beam, vocab), neg).at[:, :, end_id].set(
+        pre_scores)
+    cand = jnp.where(finished[:, :, None], frozen, scores)
+    flat = cand.reshape(bsz, beam * vocab)
+    top_scores, top_idx = jax.lax.top_k(flat, beam)
+    parent = (top_idx // vocab).astype(jnp.int32)     # [B, beam]
+    token = (top_idx % vocab).astype(pre_ids.dtype)   # [B, beam]
+    return {"selected_ids": [token], "selected_scores": [top_scores],
+            "parent_idx": [parent]}
+
+
+@register_op("beam_reorder", nondiff_inputs=("Index",))
+def _beam_reorder(ctx, ins, attrs):
+    """Reorder the beam dim by parent index: X [B, beam, ...],
+    Index [B, beam] -> X gathered along dim 1."""
+    x, idx = ins["X"][0], ins["Index"][0]
+    idxe = idx.reshape(idx.shape + (1,) * (x.ndim - 2)).astype(jnp.int32)
+    idxe = jnp.broadcast_to(idxe, idx.shape + x.shape[2:])
+    return {"Out": [jnp.take_along_axis(x, idxe, axis=1)]}
+
+
+@register_op("gather_tree", nondiff_inputs=("Ids", "Parents"),
+             nondiff_outputs=("Out",))
+def _gather_tree(ctx, ins, attrs):
+    """Backtrack beam parents: Ids/Parents [T, B, beam] -> full sequences
+    [T, B, beam] (gather_tree_op.cc semantics)."""
+    ids, parents = ins["Ids"][0], ins["Parents"][0]
+    t = ids.shape[0]
+
+    def step(beam_idx, i):
+        # walking backwards from the last step
+        tok = jnp.take_along_axis(ids[i], beam_idx, axis=-1)
+        par = jnp.take_along_axis(parents[i], beam_idx, axis=-1)
+        return par, tok
+
+    init = jnp.broadcast_to(jnp.arange(ids.shape[2], dtype=jnp.int32),
+                            ids.shape[1:]).astype(jnp.int32)
+    _, toks = jax.lax.scan(step, init, jnp.arange(t - 1, -1, -1))
+    return {"Out": [toks[::-1]]}
+
+
+@register_op("beam_search_decode", nondiff_inputs=("Ids", "Scores"),
+             nondiff_outputs=("SentenceIds", "SentenceScores"))
+def _beam_search_decode(ctx, ins, attrs):
+    """Ids [T, B, beam] + parents encoded via attrs? Dense path: the
+    decoder layer stacks (ids, parents, scores) per step; here Ids are
+    already backtracked by gather_tree, so just reshape + pass scores."""
+    ids = ins["Ids"][0]
+    scores = ins["Scores"][0]
+    return {"SentenceIds": [ids], "SentenceScores": [scores]}

@@ -1,0 +1,275 @@
+"""RNN stack tests: cells/rnn() (scan-based recurrent op), dynamic
+gru/lstm full-sequence ops, beam-search decode (reference
+test_rnn_cell_api.py / test_rnn_decode_api.py pattern)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.layers import rnn as rnn_mod
+
+
+def _run(main, startup, feed, fetch):
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        return exe.run(main, feed=feed, fetch_list=fetch)
+
+
+def test_gru_cell_rnn_matches_numpy():
+    b, t, din, d = 3, 5, 4, 6
+    rs = np.random.RandomState(0)
+    x = rs.randn(b, t, din).astype("float32")
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = fluid.data("x", [b, t, din])
+        cell = rnn_mod.GRUCell(d)
+        out, final = rnn_mod.rnn(cell, xv)
+        outs = _run(main, startup, {"x": x}, [out, final])
+    o, f = outs
+    assert o.shape == (b, t, d)
+    assert f.shape == (b, d)
+    # final state equals last output
+    np.testing.assert_allclose(o[:, -1], f, atol=1e-5)
+    # outputs change over time (non-degenerate)
+    assert np.abs(o[:, 0] - o[:, -1]).max() > 1e-6
+
+
+def test_lstm_cell_rnn_shapes_and_grad():
+    b, t, din, d = 2, 4, 3, 5
+    rs = np.random.RandomState(1)
+    x = rs.randn(b, t, din).astype("float32")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = fluid.data("x", [b, t, din])
+        cell = rnn_mod.LSTMCell(d)
+        out, (h, c) = rnn_mod.rnn(cell, xv)
+        loss = layers.mean(out)
+        fluid.optimizer.SGD(0.1).minimize(loss)
+        l0, = _run(main, startup, {"x": x}, [loss])
+    assert np.isfinite(l0)
+
+
+def test_dynamic_gru_op_sequence_mask():
+    """Steps past each row's length must carry state through unchanged."""
+    b, t, d = 2, 6, 4
+    rs = np.random.RandomState(2)
+    x3 = rs.randn(b, t, 3 * d).astype("float32")
+    lens = np.array([6, 3], dtype="int64")
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        blk = main.global_block()
+        xv = fluid.data("x", [b, t, 3 * d])
+        lv = fluid.data("lens", [b], dtype="int64")
+        w = layers.create_parameter([d, 3 * d], "float32", name="gru_w")
+        hid = blk.create_var(name="gru_hid")
+        blk.append_op("gru", inputs={"Input": [xv.name],
+                                     "Weight": [w.name],
+                                     "Lengths": [lv.name]},
+                      outputs={"Hidden": [hid.name]}, infer_shape=False)
+        h, = _run(main, startup, {"x": x3, "lens": lens}, [hid])
+    assert h.shape == (b, t, d)
+    # row 1 frozen after step 3
+    np.testing.assert_allclose(h[1, 3], h[1, 5], atol=1e-6)
+    assert np.abs(h[0, 3] - h[0, 5]).max() > 1e-7
+
+
+def test_dynamic_lstm_op():
+    b, t, d = 2, 5, 3
+    rs = np.random.RandomState(3)
+    x4 = rs.randn(b, t, 4 * d).astype("float32")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        blk = main.global_block()
+        xv = fluid.data("x", [b, t, 4 * d])
+        w = layers.create_parameter([d, 4 * d], "float32", name="lstm_w")
+        bias = layers.create_parameter([1, 7 * d], "float32", name="lstm_b")
+        hid = blk.create_var(name="lstm_hid")
+        cell = blk.create_var(name="lstm_cell")
+        blk.append_op("lstm", inputs={"Input": [xv.name], "Weight": [w.name],
+                                      "Bias": [bias.name]},
+                      outputs={"Hidden": [hid.name], "Cell": [cell.name]},
+                      infer_shape=False)
+        h, c = _run(main, startup, {"x": x4}, [hid, cell])
+    assert h.shape == (b, t, d) and c.shape == (b, t, d)
+    # |h| <= 1 (tanh-bounded), cell unbounded
+    assert np.abs(h).max() <= 1.0 + 1e-6
+
+
+def test_beam_search_decode_greedy_path():
+    """Beam decode over a fixed transition table: beam search with size 1+
+    must reproduce the greedy argmax chain of a deterministic LM."""
+    vocab, d, beam, steps, b = 7, 8, 3, 5, 2
+    rs = np.random.RandomState(4)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        cell = rnn_mod.GRUCell(d)
+        emb_w = layers.create_parameter([vocab, d], "float32", name="emb_w")
+
+        def embed(ids):
+            return layers.gather(emb_w, ids)
+
+        def output_fn(h):
+            return layers.fc(h, size=vocab, name="out_proj",
+                             bias_attr=False)
+
+        dec = rnn_mod.BeamSearchDecoder(
+            cell, start_token=1, end_token=0, beam_size=beam,
+            embedding_fn=embed, output_fn=output_fn)
+        init = layers.fill_constant([b, d], "float32", 0.0)
+        ids, scores = rnn_mod.dynamic_decode(dec, inits=init,
+                                             max_step_num=steps)
+        out_ids, out_scores = _run(main, startup, {}, [ids, scores])
+    assert out_ids.shape == (b, steps, beam)
+    assert out_scores.shape == (b, steps, beam)
+    # top beam scores are non-increasing over beams at the last step
+    last = out_scores[:, -1, :]
+    assert (np.diff(last, axis=1) <= 1e-5).all()
+
+
+def test_gather_tree_backtrack():
+    # T=3, B=1, beam=2: hand-built parents
+    ids = np.array([[[2, 3]], [[4, 5]], [[6, 7]]], dtype="int64")
+    parents = np.array([[[0, 0]], [[1, 0]], [[0, 1]]], dtype="int64")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        blk = main.global_block()
+        iv = fluid.data("ids", [3, 1, 2], dtype="int64")
+        pv = fluid.data("par", [3, 1, 2], dtype="int64")
+        out = blk.create_var(name="gt_out")
+        blk.append_op("gather_tree", inputs={"Ids": [iv.name],
+                                             "Parents": [pv.name]},
+                      outputs={"Out": [out.name]}, infer_shape=False)
+        res, = _run(main, startup, {"ids": ids, "par": parents}, [out])
+    # beam 0 at t=2: id 6, parent chain: parents[2][0]=0 -> ids[1][0]=4,
+    # parents[1][0]=1 -> ids[0][1]=3
+    np.testing.assert_array_equal(res[:, 0, 0], [3, 4, 6])
+    # beam 1 at t=2: id 7, parent 1 -> ids[1][1]=5, parents[1][1]=0 -> ids[0][0]=2
+    np.testing.assert_array_equal(res[:, 0, 1], [2, 5, 7])
+
+
+def test_dynamic_gru_lstm_layers():
+    b, t, d = 2, 4, 3
+    rs = np.random.RandomState(5)
+    x3 = rs.randn(b, t, 3 * d).astype("float32")
+    x4 = rs.randn(b, t, 4 * d).astype("float32")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        g_in = fluid.data("g", [b, t, 3 * d])
+        l_in = fluid.data("l", [b, t, 4 * d])
+        h_gru = layers.dynamic_gru(g_in, d)
+        h_lstm, c_lstm = layers.dynamic_lstm(l_in, 4 * d)
+        hp, cp = layers.dynamic_lstmp(l_in, 4 * d, proj_size=2)
+        res = _run(main, startup, {"g": x3, "l": x4},
+                   [h_gru, h_lstm, c_lstm, hp, cp])
+    assert res[0].shape == (b, t, d)
+    assert res[1].shape == (b, t, d)
+    assert res[3].shape == (b, t, 2)
+
+
+def test_static_rnn():
+    t, b, d = 4, 2, 3
+    rs = np.random.RandomState(6)
+    x = rs.randn(t, b, d).astype("float32")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = fluid.data("x", [t, b, d])
+        srnn = layers.StaticRNN()
+        with srnn.step():
+            x_t = srnn.step_input(xv)
+            h_prev = srnn.memory(shape=[-1, d], batch_ref=xv)
+            h = layers.fc([x_t, h_prev], size=d, act="tanh",
+                          name="srnn_fc")
+            srnn.update_memory(h_prev, h)
+            srnn.step_output(h)
+        out = srnn()
+        res, = _run(main, startup, {"x": x}, [out])
+    assert res.shape == (t, b, d)
+    assert np.abs(res).max() <= 1.0
+
+
+def test_ifelse_and_switch():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [4, 1])
+        zero = layers.fill_constant([4, 1], "float32", 0.0)
+        cond = layers.greater_than(x, zero)
+        ie = layers.IfElse(cond)
+        with ie.true_block():
+            ie.output(layers.scale(ie.input(x), 2.0))
+        with ie.false_block():
+            ie.output(layers.scale(ie.input(x), -1.0))
+        merged, = ie()
+
+        # Switch over a scalar step counter
+        step = layers.fill_constant([1], "float32", 5.0)
+        lr = layers.create_global_var([1], 0.0, "float32",
+                                      persistable=True, name="sw_lr")
+        bound = layers.fill_constant([1], "float32", 10.0)
+        sw = layers.Switch()
+        with sw.case(layers.less_than(step, bound)):
+            layers.assign(layers.fill_constant([1], "float32", 0.1), lr)
+        with sw.default():
+            layers.assign(layers.fill_constant([1], "float32", 0.01), lr)
+
+        xin = np.array([[1.0], [-2.0], [3.0], [-4.0]], dtype="float32")
+        m, lrv = _run(main, startup, {"x": xin}, [merged, lr])
+    np.testing.assert_allclose(m.ravel(), [2.0, 2.0, 6.0, 4.0])
+    np.testing.assert_allclose(lrv, [0.1])
+
+
+def test_rnn_sequence_length_masking():
+    b, t, din, d = 2, 6, 3, 4
+    rs = np.random.RandomState(7)
+    x = rs.randn(b, t, din).astype("float32")
+    lens = np.array([6, 3], dtype="int64")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = fluid.data("x", [b, t, din])
+        lv = fluid.data("lens", [b], dtype="int64")
+        cell = rnn_mod.GRUCell(d)
+        out, final = rnn_mod.rnn(cell, xv, sequence_length=lv)
+        o, f = _run(main, startup, {"x": x, "lens": lens}, [out, final])
+    # final state of short row == state at its last valid step
+    np.testing.assert_allclose(f[1], o[1, 2], atol=1e-6)
+    np.testing.assert_allclose(f[0], o[0, 5], atol=1e-6)
+
+
+def test_lstm_layer_wrapper():
+    b, t, din, d = 2, 5, 4, 6
+    rs = np.random.RandomState(8)
+    x = rs.randn(b, t, din).astype("float32")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = fluid.data("x", [b, t, din])
+        out, lh, lc = layers.lstm(xv, None, None, t, d, num_layers=2,
+                                  is_bidirec=True)
+        o, h, c = _run(main, startup, {"x": x}, [out, lh, lc])
+    assert o.shape == (b, t, 2 * d)
+    assert h.shape == (4, b, d) and c.shape == (4, b, d)
+    # forward-direction final state of last layer: matches out last step
+    np.testing.assert_allclose(h[2], o[:, -1, :d], atol=1e-5)
+    np.testing.assert_allclose(h[3], o[:, 0, d:], atol=1e-5)
+
+
+def test_attention_dropout_off_in_clone_for_test():
+    from paddle_tpu.models import transformer
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        cfg = transformer.TransformerConfig(
+            vocab_size=32, d_model=16, n_heads=2, n_layers=1, d_ff=32,
+            dropout=0.5, use_flash=False)
+        x = fluid.data("tokens", [2, 8], dtype="int64")
+        hid = transformer.encoder(x, cfg)
+    test_prog = main.clone(for_test=True)
+    toks = np.random.RandomState(0).randint(0, 32, (2, 8)).astype("int64")
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        a, = exe.run(test_prog, feed={"tokens": toks}, fetch_list=[hid])
+        bvals, = exe.run(test_prog, feed={"tokens": toks},
+                         fetch_list=[hid])
+    # inference must be deterministic (dropout off)
+    np.testing.assert_allclose(a, bvals, atol=0)
