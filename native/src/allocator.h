@@ -1,0 +1,129 @@
+// Host buffer pool: auto-growth best-fit allocator.
+//
+// Counterpart of the reference's memory facade (memory/malloc.h,
+// allocation/allocator_facade.cc:48 choosing `auto_growth` /
+// `naive_best_fit` strategies, allocation/auto_growth_best_fit_allocator.cc).
+// On TPU the device heap belongs to XLA; what the framework still owns is
+// HOST staging memory for the input pipeline — parse buffers and batch
+// staging areas reused across steps. This allocator keeps a best-fit free
+// list over large malloc'd regions so steady-state batch assembly does no
+// system allocation.
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+#include <cstdlib>
+#include <map>
+#include <mutex>
+#include <unordered_map>
+#include <vector>
+
+namespace ptn {
+
+class BufferPool {
+ public:
+  // chunk_size: granularity of growth mallocs (default 16 MiB).
+  explicit BufferPool(size_t chunk_size = 16u << 20)
+      : chunk_size_(chunk_size) {}
+
+  ~BufferPool() {
+    for (void* r : regions_) std::free(r);
+  }
+
+  void* Alloc(size_t size) {
+    if (size == 0) size = 1;
+    size = Align(size);
+    std::lock_guard<std::mutex> lk(mu_);
+    // Best fit: smallest free block >= size.
+    auto it = free_by_size_.lower_bound(size);
+    if (it == free_by_size_.end()) {
+      Grow(size);
+      it = free_by_size_.lower_bound(size);
+    }
+    char* base = it->second;
+    size_t block = it->first;
+    EraseFree(it);
+    if (block - size >= kMinSplit) {
+      InsertFree(block - size, base + size);
+      block = size;
+    }
+    allocated_[base] = block;
+    bytes_in_use_ += block;
+    peak_in_use_ = bytes_in_use_ > peak_in_use_ ? bytes_in_use_ : peak_in_use_;
+    ++n_allocs_;
+    return base;
+  }
+
+  void Free(void* p) {
+    if (p == nullptr) return;
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = allocated_.find(static_cast<char*>(p));
+    if (it == allocated_.end()) return;
+    size_t block = it->second;
+    bytes_in_use_ -= block;
+    char* base = it->first;
+    allocated_.erase(it);
+    // Coalesce with a free right-neighbour if adjacent.
+    auto nb = free_by_addr_.find(base + block);
+    if (nb != free_by_addr_.end()) {
+      size_t nb_size = nb->second;
+      EraseFreeAddr(nb);
+      block += nb_size;
+    }
+    InsertFree(block, base);
+  }
+
+  struct Stats {
+    uint64_t bytes_in_use, bytes_reserved, peak_in_use, n_allocs;
+  };
+  Stats GetStats() const {
+    std::lock_guard<std::mutex> lk(mu_);
+    return {bytes_in_use_, bytes_reserved_, peak_in_use_, n_allocs_};
+  }
+
+ private:
+  static constexpr size_t kAlign = 64;  // cache line; SIMD-friendly
+  static constexpr size_t kMinSplit = 256;
+
+  static size_t Align(size_t n) { return (n + kAlign - 1) & ~(kAlign - 1); }
+
+  void Grow(size_t at_least) {
+    size_t n = at_least > chunk_size_ ? Align(at_least) : chunk_size_;
+    void* r = nullptr;
+    if (posix_memalign(&r, kAlign, n) != 0 || r == nullptr) return;
+    regions_.push_back(r);
+    bytes_reserved_ += n;
+    InsertFree(n, static_cast<char*>(r));
+  }
+
+  void InsertFree(size_t size, char* base) {
+    auto it = free_by_size_.emplace(size, base);
+    free_by_addr_[base] = size;
+    (void)it;
+  }
+  void EraseFree(std::multimap<size_t, char*>::iterator it) {
+    free_by_addr_.erase(it->second);
+    free_by_size_.erase(it);
+  }
+  void EraseFreeAddr(std::map<char*, size_t>::iterator it) {
+    auto range = free_by_size_.equal_range(it->second);
+    for (auto i = range.first; i != range.second; ++i) {
+      if (i->second == it->first) {
+        free_by_size_.erase(i);
+        break;
+      }
+    }
+    free_by_addr_.erase(it);
+  }
+
+  size_t chunk_size_;
+  mutable std::mutex mu_;
+  std::multimap<size_t, char*> free_by_size_;
+  std::map<char*, size_t> free_by_addr_;
+  std::unordered_map<char*, size_t> allocated_;
+  std::vector<void*> regions_;
+  uint64_t bytes_in_use_ = 0, bytes_reserved_ = 0, peak_in_use_ = 0,
+           n_allocs_ = 0;
+};
+
+}  // namespace ptn

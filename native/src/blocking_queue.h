@@ -1,0 +1,74 @@
+// Bounded MPMC blocking queue.
+//
+// TPU-native counterpart of the reference's concurrency primitives
+// (framework/blocking_queue.h:26 `BlockingQueue`, reader/
+// lod_tensor_blocking_queue.h `LoDTensorBlockingQueue`): a capacity-bounded
+// queue with close semantics used between parse workers and the consumer
+// that stages batches for host→device infeed.
+#pragma once
+
+#include <condition_variable>
+#include <deque>
+#include <mutex>
+#include <utility>
+
+namespace ptn {
+
+template <typename T>
+class BlockingQueue {
+ public:
+  explicit BlockingQueue(size_t capacity) : capacity_(capacity) {}
+
+  // Returns false iff the queue was closed before space became available.
+  bool Push(T item) {
+    std::unique_lock<std::mutex> lk(mu_);
+    not_full_.wait(lk, [&] { return closed_ || q_.size() < capacity_; });
+    if (closed_) return false;
+    q_.push_back(std::move(item));
+    not_empty_.notify_one();
+    return true;
+  }
+
+  // Returns false iff the queue is closed AND drained.
+  bool Pop(T* out) {
+    std::unique_lock<std::mutex> lk(mu_);
+    not_empty_.wait(lk, [&] { return closed_ || !q_.empty(); });
+    if (q_.empty()) return false;
+    *out = std::move(q_.front());
+    q_.pop_front();
+    not_full_.notify_one();
+    return true;
+  }
+
+  // After Close: pushes fail, pops drain then fail.
+  void Close() {
+    std::lock_guard<std::mutex> lk(mu_);
+    closed_ = true;
+    not_empty_.notify_all();
+    not_full_.notify_all();
+  }
+
+  void Reopen() {
+    std::lock_guard<std::mutex> lk(mu_);
+    closed_ = false;
+  }
+
+  size_t Size() const {
+    std::lock_guard<std::mutex> lk(mu_);
+    return q_.size();
+  }
+
+  bool Closed() const {
+    std::lock_guard<std::mutex> lk(mu_);
+    return closed_;
+  }
+
+ private:
+  mutable std::mutex mu_;
+  std::condition_variable not_empty_, not_full_;
+  std::deque<T> q_;
+  size_t capacity_;
+  bool closed_ = false;
+};
+
+}  // namespace ptn

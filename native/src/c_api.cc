@@ -1,0 +1,128 @@
+// C ABI for the native runtime — the boundary Python binds via ctypes.
+//
+// Counterpart of the reference's pybind layer (paddle/fluid/pybind/
+// pybind.cc) and its stable C APIs (framework/c/c_api.cc, inference/capi/):
+// everything the Python frontend needs from the native runtime crosses
+// here as plain C. No Python.h dependency — keeps the .so usable from any
+// host language (the reference's C++ trainer demo is the precedent,
+// train/demo_trainer.cc).
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+#include "allocator.h"
+#include "data_feed.h"
+#include "profiler.h"
+
+using ptn::Batch;
+using ptn::BufferPool;
+using ptn::DataFeed;
+using ptn::SlotDesc;
+using ptn::SlotType;
+
+extern "C" {
+
+// ---------------- buffer pool ----------------
+
+void* ptn_pool_create(uint64_t chunk_bytes) {
+  return new BufferPool(chunk_bytes ? chunk_bytes : (16u << 20));
+}
+void ptn_pool_destroy(void* pool) { delete static_cast<BufferPool*>(pool); }
+void* ptn_pool_alloc(void* pool, uint64_t size) {
+  return static_cast<BufferPool*>(pool)->Alloc(size);
+}
+void ptn_pool_free(void* pool, void* p) {
+  static_cast<BufferPool*>(pool)->Free(p);
+}
+void ptn_pool_stats(void* pool, uint64_t* in_use, uint64_t* reserved,
+                    uint64_t* peak, uint64_t* n_allocs) {
+  auto s = static_cast<BufferPool*>(pool)->GetStats();
+  *in_use = s.bytes_in_use;
+  *reserved = s.bytes_reserved;
+  *peak = s.peak_in_use;
+  *n_allocs = s.n_allocs;
+}
+
+// ---------------- data feed ----------------
+
+// slot_types: 0=float32, 1=int64; slot_dims: values per sample (pad/trunc).
+void* ptn_feed_create(int32_t n_slots, const char** slot_names,
+                      const int32_t* slot_types, const int64_t* slot_dims,
+                      int64_t batch_size, int32_t queue_capacity,
+                      int32_t drop_last) {
+  std::vector<SlotDesc> slots;
+  slots.reserve(static_cast<size_t>(n_slots));
+  for (int32_t i = 0; i < n_slots; ++i) {
+    slots.push_back({slot_names[i],
+                     static_cast<SlotType>(slot_types[i]), slot_dims[i],
+                     /*dense=*/false});
+  }
+  return new DataFeed(std::move(slots), batch_size,
+                      static_cast<size_t>(queue_capacity), drop_last != 0);
+}
+
+void ptn_feed_destroy(void* feed) { delete static_cast<DataFeed*>(feed); }
+
+void ptn_feed_add_file(void* feed, const char* path) {
+  static_cast<DataFeed*>(feed)->AddFile(path);
+}
+
+void ptn_feed_set_shuffle(void* feed, int32_t on, uint64_t seed) {
+  static_cast<DataFeed*>(feed)->SetShuffle(on != 0, seed);
+}
+
+void ptn_feed_start(void* feed, int32_t n_threads) {
+  static_cast<DataFeed*>(feed)->Start(n_threads);
+}
+
+void ptn_feed_stop(void* feed) { static_cast<DataFeed*>(feed)->Stop(); }
+
+// Pops the next batch and copies each slot into caller-provided buffers
+// (shaped [batch_size, dim]; short final batches zero-pad the tail rows and
+// report the true size). lengths_out: concatenated per-slot [batch] arrays.
+// Returns batch_size (>0), or 0 at end of data.
+int64_t ptn_feed_next(void* feed, void** slot_buffers, int64_t* lengths_out) {
+  auto* df = static_cast<DataFeed*>(feed);
+  Batch b;
+  if (!df->Next(&b)) return 0;
+  // Copy out then release pool buffers (caller side keeps a stable ABI:
+  // plain memcpy into numpy arrays it allocated).
+  int64_t bs = b.batch_size;
+  int64_t off = 0;
+  for (size_t si = 0; si < b.buffers.size(); ++si) {
+    const auto& lens = b.lengths[si];
+    size_t row = df->SlotRowBytes(si);
+    std::memcpy(slot_buffers[si], b.buffers[si],
+                static_cast<size_t>(bs) * row);
+    for (int64_t i = 0; i < bs; ++i) {
+      lengths_out[off + i] = lens[static_cast<size_t>(i)];
+    }
+    off += df->MaxBatch();
+  }
+  df->ReleaseBatch(&b);
+  return bs;
+}
+
+uint64_t ptn_feed_samples_parsed(void* feed) {
+  return static_cast<DataFeed*>(feed)->samples_parsed();
+}
+uint64_t ptn_feed_parse_errors(void* feed) {
+  return static_cast<DataFeed*>(feed)->parse_errors();
+}
+
+// ---------------- profiler ----------------
+
+void ptn_profiler_enable() { ptn::ProfilerEnable(); }
+void ptn_profiler_disable() { ptn::ProfilerDisable(); }
+void ptn_profiler_reset() { ptn::ProfilerReset(); }
+void ptn_profiler_push(const char* name) { ptn::ProfilerPush(name); }
+void ptn_profiler_pop(const char* name) { ptn::ProfilerPop(name); }
+int ptn_profiler_dump(const char* path) {
+  return ptn::ProfilerDumpChromeTrace(path);
+}
+
+// ---------------- version ----------------
+
+const char* ptn_version() { return "paddle-tpu-native 0.1"; }
+
+}  // extern "C"

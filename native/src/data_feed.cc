@@ -1,0 +1,211 @@
+#include "data_feed.h"
+
+#include <cstdio>
+#include <cstring>
+#include <algorithm>
+
+namespace ptn {
+
+void DataFeed::Start(int n_threads) {
+  Stop();
+  queue_.Reopen();
+  next_file_ = 0;
+  running_ = true;
+  // sample queue sized to keep parsers ahead of the batcher without
+  // unbounded memory
+  sample_q_.reset(new BlockingQueue<Sample>(
+      static_cast<size_t>(batch_size_) * 4 + 64));
+  if (n_threads < 1) n_threads = 1;
+  live_parsers_ = n_threads;
+  for (int i = 0; i < n_threads; ++i) {
+    parse_threads_.emplace_back([this] { ParseWorker(); });
+  }
+  batch_thread_ = std::thread([this] { BatchWorker(); });
+}
+
+void DataFeed::Stop() {
+  if (!running_) return;
+  running_ = false;
+  if (sample_q_) sample_q_->Close();
+  queue_.Close();
+  for (auto& t : parse_threads_) t.join();
+  parse_threads_.clear();
+  if (batch_thread_.joinable()) batch_thread_.join();
+  // drain unreturned batches
+  Batch b;
+  while (queue_.Pop(&b)) ReleaseBatch(&b);
+}
+
+void DataFeed::ParseWorker() {
+  std::string content;
+  for (;;) {
+    size_t idx = next_file_.fetch_add(1);
+    if (idx >= files_.size()) break;
+    FILE* f = std::fopen(files_[idx].c_str(), "rb");
+    if (f == nullptr) {
+      parse_errors_.fetch_add(1);
+      continue;
+    }
+    std::fseek(f, 0, SEEK_END);
+    long sz = std::ftell(f);
+    std::fseek(f, 0, SEEK_SET);
+    content.resize(static_cast<size_t>(sz));
+    size_t got = sz > 0 ? std::fread(&content[0], 1, sz, f) : 0;
+    std::fclose(f);
+    content.resize(got);
+
+    const char* p = content.data();
+    const char* end = p + content.size();
+    while (p < end) {
+      const char* nl = static_cast<const char*>(
+          memchr(p, '\n', static_cast<size_t>(end - p)));
+      size_t len = nl ? static_cast<size_t>(nl - p)
+                      : static_cast<size_t>(end - p);
+      if (len > 0) {
+        Sample s;
+        if (ParseLine(p, len, &s)) {
+          samples_parsed_.fetch_add(1);
+          if (!sample_q_->Push(std::move(s))) return;  // closed
+        } else {
+          parse_errors_.fetch_add(1);
+        }
+      }
+      p = nl ? nl + 1 : end;
+    }
+  }
+  // Last parser out closes the sample queue so the batcher can flush.
+  if (live_parsers_.fetch_sub(1) == 1) sample_q_->Close();
+}
+
+bool DataFeed::ParseLine(const char* line, size_t len, Sample* s) {
+  const char* p = line;
+  const char* end = line + len;
+  s->fvals.resize(slots_.size());
+  s->ivals.resize(slots_.size());
+
+  auto skip_ws = [&] {
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\r')) ++p;
+  };
+  auto read_i64 = [&](int64_t* out) -> bool {
+    skip_ws();
+    if (p >= end) return false;
+    char* q = nullptr;
+    long long v = strtoll(p, &q, 10);
+    if (q == p) return false;
+    p = q;
+    *out = v;
+    return true;
+  };
+  auto read_f32 = [&](float* out) -> bool {
+    skip_ws();
+    if (p >= end) return false;
+    char* q = nullptr;
+    float v = strtof(p, &q);
+    if (q == p) return false;
+    p = q;
+    *out = v;
+    return true;
+  };
+
+  for (size_t si = 0; si < slots_.size(); ++si) {
+    int64_t n = 0;
+    if (!read_i64(&n) || n < 0) return false;
+    if (slots_[si].type == SlotType::kFloat32) {
+      auto& v = s->fvals[si];
+      v.resize(static_cast<size_t>(n));
+      for (int64_t j = 0; j < n; ++j) {
+        if (!read_f32(&v[static_cast<size_t>(j)])) return false;
+      }
+    } else {
+      auto& v = s->ivals[si];
+      v.resize(static_cast<size_t>(n));
+      for (int64_t j = 0; j < n; ++j) {
+        if (!read_i64(&v[static_cast<size_t>(j)])) return false;
+      }
+    }
+  }
+  return true;
+}
+
+void DataFeed::BatchWorker() {
+  std::vector<Sample> buf;
+  buf.reserve(static_cast<size_t>(batch_size_));
+  std::mt19937_64 rng(seed_);
+  std::vector<Sample> shuffle_buf;
+  const size_t shuffle_window =
+      shuffle_ ? static_cast<size_t>(batch_size_) * 64 : 0;
+
+  Sample s;
+  while (sample_q_->Pop(&s)) {
+    if (shuffle_) {
+      // reservoir-window shuffle (the reference's LocalShuffle analogue:
+      // data_set.h:99) — bounded memory, decorrelates file order
+      shuffle_buf.push_back(std::move(s));
+      if (shuffle_buf.size() < shuffle_window) continue;
+      size_t pick = rng() % shuffle_buf.size();
+      std::swap(shuffle_buf[pick], shuffle_buf.back());
+      s = std::move(shuffle_buf.back());
+      shuffle_buf.pop_back();
+    }
+    buf.push_back(std::move(s));
+    if (static_cast<int64_t>(buf.size()) == batch_size_) {
+      Batch b;
+      PackBatch(buf, &b);
+      buf.clear();
+      if (!queue_.Push(std::move(b))) return;
+    }
+  }
+  // drain the shuffle window
+  while (!shuffle_buf.empty()) {
+    buf.push_back(std::move(shuffle_buf.back()));
+    shuffle_buf.pop_back();
+    if (static_cast<int64_t>(buf.size()) == batch_size_) {
+      Batch b;
+      PackBatch(buf, &b);
+      buf.clear();
+      if (!queue_.Push(std::move(b))) return;
+    }
+  }
+  if (!buf.empty() && !drop_last_) {
+    Batch b;
+    PackBatch(buf, &b);
+    if (!queue_.Push(std::move(b))) return;
+  }
+  queue_.Close();
+}
+
+void DataFeed::PackBatch(std::vector<Sample>& buf, Batch* b) {
+  const int64_t bs = static_cast<int64_t>(buf.size());
+  b->batch_size = bs;
+  b->buffers.resize(slots_.size());
+  b->lengths.resize(slots_.size());
+  for (size_t si = 0; si < slots_.size(); ++si) {
+    const auto& slot = slots_[si];
+    const size_t elem = slot.type == SlotType::kFloat32 ? 4 : 8;
+    const size_t row = static_cast<size_t>(slot.dim) * elem;
+    char* dst = static_cast<char*>(
+        pool_.Alloc(static_cast<size_t>(bs) * row));
+    std::memset(dst, 0, static_cast<size_t>(bs) * row);
+    auto& lens = b->lengths[si];
+    lens.resize(static_cast<size_t>(bs));
+    for (int64_t i = 0; i < bs; ++i) {
+      char* out = dst + static_cast<size_t>(i) * row;
+      if (slot.type == SlotType::kFloat32) {
+        const auto& v = buf[static_cast<size_t>(i)].fvals[si];
+        size_t n = std::min<size_t>(v.size(),
+                                    static_cast<size_t>(slot.dim));
+        std::memcpy(out, v.data(), n * 4);
+        lens[static_cast<size_t>(i)] = static_cast<int64_t>(v.size());
+      } else {
+        const auto& v = buf[static_cast<size_t>(i)].ivals[si];
+        size_t n = std::min<size_t>(v.size(),
+                                    static_cast<size_t>(slot.dim));
+        std::memcpy(out, v.data(), n * 8);
+        lens[static_cast<size_t>(i)] = static_cast<int64_t>(v.size());
+      }
+    }
+    b->buffers[si] = dst;
+  }
+}
+
+}  // namespace ptn

@@ -1,0 +1,122 @@
+// Multi-threaded training-data feed.
+//
+// TPU-native counterpart of the reference's DataFeed/Dataset stack
+// (framework/data_feed.h:61 `DataFeed`, :222 `MultiSlotDataFeed`,
+// framework/data_set.h:92 `Dataset::LoadIntoMemory`, :102 shuffle): parse
+// worker threads read MultiSlot-format text files, assemble samples, and a
+// batcher packs fixed-shape dense batches (TPU needs static shapes — ragged
+// slots are padded/truncated to `dim` and the true lengths are emitted
+// alongside, replacing LoD metadata). Batches flow through a bounded
+// BlockingQueue to the Python host-infeed loop.
+//
+// MultiSlot text format (data_feed.cc parser in the reference): each line is
+// one sample; for each slot in config order: `<n> <v1> ... <vn>`.
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <memory>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "allocator.h"
+#include "blocking_queue.h"
+
+namespace ptn {
+
+enum class SlotType : int32_t { kFloat32 = 0, kInt64 = 1 };
+
+struct SlotDesc {
+  std::string name;
+  SlotType type;
+  int64_t dim;   // values per sample; shorter rows padded, longer truncated
+  bool dense;    // dense: exactly dim values expected (no length output)
+};
+
+// One parsed sample: per-slot raw values.
+struct Sample {
+  // flat storage: per slot, the parsed values (float or int64 view)
+  std::vector<std::vector<float>> fvals;
+  std::vector<std::vector<int64_t>> ivals;
+};
+
+// A packed batch: per slot one contiguous buffer [batch, dim] plus a
+// lengths vector [batch] holding the pre-pad value counts.
+struct Batch {
+  int64_t batch_size = 0;
+  std::vector<void*> buffers;          // slot-ordered, BufferPool-owned
+  std::vector<std::vector<int64_t>> lengths;
+};
+
+class DataFeed {
+ public:
+  DataFeed(std::vector<SlotDesc> slots, int64_t batch_size,
+           size_t queue_capacity, bool drop_last)
+      : slots_(std::move(slots)),
+        batch_size_(batch_size),
+        drop_last_(drop_last),
+        queue_(queue_capacity) {}
+
+  ~DataFeed() { Stop(); }
+
+  void AddFile(const std::string& path) { files_.push_back(path); }
+
+  void SetShuffle(bool on, uint64_t seed) {
+    shuffle_ = on;
+    seed_ = seed;
+  }
+
+  // Launch n parse workers + 1 batcher. Each worker takes whole files off a
+  // shared index; parsed samples flow to the batcher through sample_q_.
+  void Start(int n_threads);
+
+  // Pops the next batch; false at end of epoch. Caller owns the buffers and
+  // must return them via ReleaseBatch.
+  bool Next(Batch* out) { return queue_.Pop(out); }
+
+  void ReleaseBatch(Batch* b) {
+    for (void* p : b->buffers) pool_.Free(p);
+    b->buffers.clear();
+  }
+
+  void Stop();
+
+  BufferPool::Stats PoolStats() const { return pool_.GetStats(); }
+  uint64_t samples_parsed() const { return samples_parsed_.load(); }
+  uint64_t parse_errors() const { return parse_errors_.load(); }
+  int64_t MaxBatch() const { return batch_size_; }
+  size_t SlotRowBytes(size_t si) const {
+    const auto& s = slots_[si];
+    return static_cast<size_t>(s.dim) *
+           (s.type == SlotType::kFloat32 ? 4 : 8);
+  }
+
+ private:
+  void ParseWorker();
+  void BatchWorker();
+  bool ParseLine(const char* line, size_t len, Sample* s);
+  void PackBatch(std::vector<Sample>& buf, Batch* b);
+
+  std::vector<SlotDesc> slots_;
+  int64_t batch_size_;
+  bool drop_last_;
+  bool shuffle_ = false;
+  uint64_t seed_ = 0;
+
+  std::vector<std::string> files_;
+  std::atomic<size_t> next_file_{0};
+  std::atomic<int> live_parsers_{0};
+  std::atomic<uint64_t> samples_parsed_{0};
+  std::atomic<uint64_t> parse_errors_{0};
+
+  BlockingQueue<Batch> queue_;
+  std::unique_ptr<BlockingQueue<Sample>> sample_q_;
+  std::vector<std::thread> parse_threads_;
+  std::thread batch_thread_;
+  BufferPool pool_;
+  bool running_ = false;
+};
+
+}  // namespace ptn

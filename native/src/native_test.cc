@@ -1,0 +1,122 @@
+// Native-layer unit tests (assert-based, mirroring the reference's
+// colocated *_test.cc pattern, e.g. memory/allocation/
+// best_fit_allocator_test.cc and framework/blocking_queue tests).
+#include <cassert>
+#include <cstdio>
+#include <cstring>
+#include <fstream>
+#include <thread>
+#include <vector>
+
+#include "allocator.h"
+#include "blocking_queue.h"
+#include "data_feed.h"
+#include "profiler.h"
+#include "threadpool.h"
+
+using namespace ptn;
+
+static void TestBlockingQueue() {
+  BlockingQueue<int> q(4);
+  std::thread prod([&] {
+    for (int i = 0; i < 100; ++i) assert(q.Push(i));
+    q.Close();
+  });
+  int sum = 0, v;
+  while (q.Pop(&v)) sum += v;
+  prod.join();
+  assert(sum == 4950);
+  std::puts("TestBlockingQueue OK");
+}
+
+static void TestThreadPool() {
+  ThreadPool pool(4);
+  std::atomic<int> n{0};
+  for (int i = 0; i < 64; ++i) pool.Submit([&] { n.fetch_add(1); });
+  pool.Wait();
+  assert(n == 64);
+  std::puts("TestThreadPool OK");
+}
+
+static void TestBufferPool() {
+  BufferPool pool(1 << 20);
+  void* a = pool.Alloc(1000);
+  void* b = pool.Alloc(5000);
+  assert(a && b && a != b);
+  std::memset(a, 1, 1000);
+  std::memset(b, 2, 5000);
+  pool.Free(a);
+  void* c = pool.Alloc(512);  // should reuse a's block
+  assert(c != nullptr);
+  auto s = pool.GetStats();
+  assert(s.bytes_reserved == (1u << 20));
+  assert(s.n_allocs == 3);
+  pool.Free(b);
+  pool.Free(c);
+  assert(pool.GetStats().bytes_in_use == 0);
+  std::puts("TestBufferPool OK");
+}
+
+static void TestDataFeed() {
+  // 2 slots: float dim 3, int64 dim 2; 7 samples across 2 files.
+  const char* f1 = "/tmp/ptn_test_1.txt";
+  const char* f2 = "/tmp/ptn_test_2.txt";
+  {
+    std::ofstream o(f1);
+    for (int i = 0; i < 4; ++i)
+      o << "3 " << i << ".5 1.0 2.0 2 " << i << " " << i + 1 << "\n";
+  }
+  {
+    std::ofstream o(f2);
+    for (int i = 4; i < 7; ++i)
+      o << "1 " << i << ".5 2 " << i << " " << i + 1 << "\n";
+  }
+  std::vector<SlotDesc> slots = {{"x", SlotType::kFloat32, 3, false},
+                                 {"y", SlotType::kInt64, 2, false}};
+  DataFeed feed(slots, /*batch=*/2, /*cap=*/4, /*drop_last=*/false);
+  feed.AddFile(f1);
+  feed.AddFile(f2);
+  feed.Start(2);
+  int64_t total = 0;
+  int n_batches = 0;
+  Batch b;
+  while (feed.Next(&b)) {
+    total += b.batch_size;
+    ++n_batches;
+    // int slot: second value == first + 1 in every row
+    auto* iv = static_cast<int64_t*>(b.buffers[1]);
+    for (int64_t i = 0; i < b.batch_size; ++i) {
+      assert(iv[i * 2 + 1] == iv[i * 2] + 1);
+    }
+    feed.ReleaseBatch(&b);
+  }
+  assert(total == 7);
+  assert(n_batches == 4);  // 2+2+2+1
+  assert(feed.samples_parsed() == 7);
+  assert(feed.parse_errors() == 0);
+  feed.Stop();
+  std::puts("TestDataFeed OK");
+}
+
+static void TestProfiler() {
+  ProfilerReset();
+  ProfilerEnable();
+  ProfilerPush("step");
+  ProfilerPush("lower");
+  ProfilerPop("lower");
+  ProfilerPop("step");
+  ProfilerDisable();
+  int n = ProfilerDumpChromeTrace("/tmp/ptn_trace.json");
+  assert(n == 4);
+  std::puts("TestProfiler OK");
+}
+
+int main() {
+  TestBlockingQueue();
+  TestThreadPool();
+  TestBufferPool();
+  TestDataFeed();
+  TestProfiler();
+  std::puts("ALL NATIVE TESTS OK");
+  return 0;
+}

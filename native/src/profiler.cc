@@ -1,0 +1,102 @@
+// Host-side event profiler with chrome://tracing export.
+//
+// Counterpart of the reference's platform/profiler.{h,cc} (`RecordEvent`
+// RAII :81, Enable/DisableProfiler state machine :166) + tools/timeline.py
+// (proto → chrome trace). Host phases (program build, lowering, infeed,
+// step dispatch) are recorded here; device-side events come from the jax
+// profiler — paddle_tpu/profiler.py merges both, mirroring the reference's
+// host+CUPTI merged timeline (platform/device_tracer.cc:58).
+#include "profiler.h"
+
+#include <atomic>
+#include <chrono>
+#include <cstdio>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+namespace ptn {
+
+namespace {
+
+struct Event {
+  const char* phase;  // "B" or "E" (begin/end)
+  std::string name;
+  uint64_t ts_us;
+  uint64_t tid;
+};
+
+struct State {
+  std::mutex mu;
+  std::vector<Event> events;
+  std::atomic<bool> enabled{false};
+  std::chrono::steady_clock::time_point origin =
+      std::chrono::steady_clock::now();
+};
+
+State* state() {
+  static State s;
+  return &s;
+}
+
+uint64_t NowUs() {
+  return static_cast<uint64_t>(
+      std::chrono::duration_cast<std::chrono::microseconds>(
+          std::chrono::steady_clock::now() - state()->origin)
+          .count());
+}
+
+uint64_t Tid() {
+  return std::hash<std::thread::id>{}(std::this_thread::get_id()) & 0xffff;
+}
+
+}  // namespace
+
+void ProfilerEnable() { state()->enabled = true; }
+
+void ProfilerDisable() { state()->enabled = false; }
+
+void ProfilerReset() {
+  std::lock_guard<std::mutex> lk(state()->mu);
+  state()->events.clear();
+  state()->origin = std::chrono::steady_clock::now();
+}
+
+void ProfilerPush(const char* name) {
+  State* s = state();
+  if (!s->enabled.load(std::memory_order_relaxed)) return;
+  uint64_t ts = NowUs(), tid = Tid();
+  std::lock_guard<std::mutex> lk(s->mu);
+  s->events.push_back({"B", name, ts, tid});
+}
+
+void ProfilerPop(const char* name) {
+  State* s = state();
+  if (!s->enabled.load(std::memory_order_relaxed)) return;
+  uint64_t ts = NowUs(), tid = Tid();
+  std::lock_guard<std::mutex> lk(s->mu);
+  s->events.push_back({"E", name, ts, tid});
+}
+
+int ProfilerDumpChromeTrace(const char* path) {
+  State* s = state();
+  std::lock_guard<std::mutex> lk(s->mu);
+  FILE* f = std::fopen(path, "w");
+  if (f == nullptr) return -1;
+  std::fprintf(f, "{\"traceEvents\":[\n");
+  for (size_t i = 0; i < s->events.size(); ++i) {
+    const Event& e = s->events[i];
+    std::fprintf(f,
+                 "{\"name\":\"%s\",\"ph\":\"%s\",\"pid\":0,\"tid\":%llu,"
+                 "\"ts\":%llu}%s\n",
+                 e.name.c_str(), e.phase,
+                 static_cast<unsigned long long>(e.tid),
+                 static_cast<unsigned long long>(e.ts_us),
+                 i + 1 < s->events.size() ? "," : "");
+  }
+  std::fprintf(f, "]}\n");
+  std::fclose(f);
+  return static_cast<int>(s->events.size());
+}
+
+}  // namespace ptn

@@ -1,0 +1,13 @@
+#pragma once
+
+namespace ptn {
+
+void ProfilerEnable();
+void ProfilerDisable();
+void ProfilerReset();
+void ProfilerPush(const char* name);
+void ProfilerPop(const char* name);
+// Writes chrome://tracing JSON; returns event count or -1.
+int ProfilerDumpChromeTrace(const char* path);
+
+}  // namespace ptn

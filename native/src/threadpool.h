@@ -1,0 +1,77 @@
+// Fixed-size worker pool.
+//
+// Counterpart of the reference's framework/threadpool.{h,cc} (used by its
+// threaded SSA executors and async data feeders). Here it drives parse
+// workers in the data feed and async host-side work.
+#pragma once
+
+#include <condition_variable>
+#include <functional>
+#include <mutex>
+#include <queue>
+#include <thread>
+#include <vector>
+
+namespace ptn {
+
+class ThreadPool {
+ public:
+  explicit ThreadPool(int n_threads) {
+    for (int i = 0; i < n_threads; ++i) {
+      workers_.emplace_back([this] { Loop(); });
+    }
+  }
+
+  ~ThreadPool() {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      stop_ = true;
+    }
+    cv_.notify_all();
+    for (auto& t : workers_) t.join();
+  }
+
+  void Submit(std::function<void()> fn) {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      tasks_.push(std::move(fn));
+    }
+    cv_.notify_one();
+  }
+
+  // Blocks until every submitted task has finished.
+  void Wait() {
+    std::unique_lock<std::mutex> lk(mu_);
+    idle_cv_.wait(lk, [&] { return tasks_.empty() && active_ == 0; });
+  }
+
+ private:
+  void Loop() {
+    for (;;) {
+      std::function<void()> task;
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        cv_.wait(lk, [&] { return stop_ || !tasks_.empty(); });
+        if (stop_ && tasks_.empty()) return;
+        task = std::move(tasks_.front());
+        tasks_.pop();
+        ++active_;
+      }
+      task();
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        --active_;
+        if (tasks_.empty() && active_ == 0) idle_cv_.notify_all();
+      }
+    }
+  }
+
+  std::vector<std::thread> workers_;
+  std::queue<std::function<void()>> tasks_;
+  std::mutex mu_;
+  std::condition_variable cv_, idle_cv_;
+  int active_ = 0;
+  bool stop_ = false;
+};
+
+}  // namespace ptn

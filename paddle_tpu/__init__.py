@@ -36,6 +36,8 @@ from . import metrics  # noqa: F401
 from . import io  # noqa: F401
 from . import dygraph  # noqa: F401
 from . import profiler  # noqa: F401
+from . import dataset  # noqa: F401
+from .dataset import DatasetFactory  # noqa: F401
 from . import contrib  # noqa: F401
 from .backward import append_backward, gradients  # noqa: F401
 from .data_feeder import DataFeeder  # noqa: F401

@@ -1,0 +1,196 @@
+"""Dataset: file-backed training data over the native C++ feed.
+
+Reference analogue: python/paddle/fluid/dataset.py `DatasetFactory` /
+`InMemoryDataset` / `QueueDataset` configuring the C++ DataFeed/Dataset
+stack (framework/data_feed.h:222 MultiSlotDataFeed, data_set.h:92
+LoadIntoMemory, :99 LocalShuffle), consumed by
+`Executor.train_from_dataset` (executor.py:1098). Here the C++ side is
+native/src/data_feed.cc: parse workers + windowed shuffle + batcher
+feeding a bounded queue; the trainer loop stays host-side and drives the
+jitted XLA step (the HogwildWorker thread pool collapses into XLA's own
+parallelism on TPU).
+
+When the native toolchain is unavailable, a pure-Python parser provides the
+same semantics (slower; same file format).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["DatasetFactory", "InMemoryDataset", "QueueDataset"]
+
+
+class DatasetBase:
+    def __init__(self):
+        self._filelist = []
+        self._batch_size = 1
+        self._thread_num = 1
+        self._use_vars = []
+        self._pipe_command = None  # accepted for API parity; not used
+        self._shuffle = False
+        self._seed = 0
+
+    # -- reference API surface ------------------------------------------
+    def set_batch_size(self, batch_size):
+        self._batch_size = int(batch_size)
+
+    def set_thread(self, thread_num):
+        self._thread_num = int(thread_num)
+
+    def set_filelist(self, filelist):
+        self._filelist = list(filelist)
+
+    def set_use_var(self, var_list):
+        self._use_vars = list(var_list)
+
+    def set_pipe_command(self, cmd):
+        self._pipe_command = cmd
+
+    def _slots(self):
+        slots = []
+        for v in self._use_vars:
+            np_dt = np.dtype("int64") if "int" in str(v.dtype) \
+                else np.dtype("float32")
+            dim = 1
+            for d in v.shape:
+                if d is not None and d > 0:
+                    dim *= d
+            slots.append((v.name, np_dt, dim))
+        return slots
+
+    def _make_feed(self, drop_last=True):
+        from .native import AVAILABLE, NativeDataFeed
+        if AVAILABLE:
+            feed = NativeDataFeed(self._slots(), self._batch_size,
+                                  capacity=8, drop_last=drop_last)
+            feed.set_filelist(self._filelist)
+            if self._shuffle:
+                feed.set_shuffle(True, self._seed)
+            feed.start(self._thread_num)
+            return feed
+        return _PyFeed(self._slots(), self._batch_size, self._filelist,
+                       drop_last, self._shuffle, self._seed)
+
+    def batches(self, drop_last=True):
+        """Iterate {var_name: np.ndarray[batch, dim]} batches."""
+        slots = self._slots()
+        shapes = {}
+        for v in self._use_vars:
+            dims = [d for d in v.shape if d is not None and d > 0]
+            shapes[v.name] = dims or [1]
+        for batch in self._make_feed(drop_last):
+            out = {}
+            for name, _, _ in slots:
+                arr = batch[name]
+                out[name] = arr.reshape([arr.shape[0]] + shapes[name])
+            yield out
+
+
+class QueueDataset(DatasetBase):
+    """Streams batches straight off files (data_set.h QueueDataset)."""
+
+
+class InMemoryDataset(DatasetBase):
+    """Load-then-shuffle dataset (data_set.h:92 LoadIntoMemory,
+    :99 LocalShuffle, :102 GlobalShuffle). On TPU the memory copy lives in
+    the native feed's shuffle window; global_shuffle over hosts reduces to
+    seeding per-host windows differently (file-level sharding happens in
+    fleet.util.get_file_shard)."""
+
+    def load_into_memory(self):
+        pass  # streaming + windowed shuffle; kept for API parity
+
+    def local_shuffle(self):
+        self._shuffle = True
+
+    def global_shuffle(self, fleet=None):
+        self._shuffle = True
+        if fleet is not None:
+            self._seed = getattr(fleet, "worker_index", lambda: 0)()
+
+    def release_memory(self):
+        pass
+
+    def set_fleet_send_batch_size(self, _n):
+        pass
+
+
+class DatasetFactory:
+    """Reference: dataset.py DatasetFactory.create_dataset."""
+
+    def create_dataset(self, datafeed_class="QueueDataset"):
+        if datafeed_class == "InMemoryDataset":
+            return InMemoryDataset()
+        return QueueDataset()
+
+
+class _PyFeed:
+    """Pure-Python MultiSlot parser fallback (same format/semantics)."""
+
+    def __init__(self, slots, batch_size, files, drop_last, shuffle, seed):
+        self.slots = slots
+        self.batch_size = batch_size
+        self.files = files
+        self.drop_last = drop_last
+        self.shuffle = shuffle
+        self.seed = seed
+
+    def _samples(self):
+        rng = np.random.RandomState(self.seed)
+        window = []
+        win_cap = self.batch_size * 64 if self.shuffle else 0
+        for path in self.files:
+            with open(path) as f:
+                for line in f:
+                    toks = line.split()
+                    if not toks:
+                        continue
+                    # malformed lines are skipped, matching the native
+                    # parser's return-false-and-count behaviour
+                    try:
+                        vals, i = [], 0
+                        for _, dt, _dim in self.slots:
+                            n = int(toks[i])
+                            i += 1
+                            conv = int if dt == np.int64 else float
+                            vals.append([conv(t) for t in toks[i:i + n]])
+                            if len(vals[-1]) != n:
+                                raise ValueError("short row")
+                            i += n
+                    except (ValueError, IndexError):
+                        self.parse_errors = getattr(
+                            self, "parse_errors", 0) + 1
+                        continue
+                    if self.shuffle:
+                        window.append(vals)
+                        if len(window) >= win_cap:
+                            j = rng.randint(len(window))
+                            window[j], window[-1] = window[-1], window[j]
+                            yield window.pop()
+                    else:
+                        yield vals
+        while window:
+            yield window.pop()
+
+    def __iter__(self):
+        buf = []
+        for s in self._samples():
+            buf.append(s)
+            if len(buf) == self.batch_size:
+                yield self._pack(buf)
+                buf = []
+        if buf and not self.drop_last:
+            yield self._pack(buf)
+
+    def _pack(self, buf):
+        out = {}
+        for si, (name, dt, dim) in enumerate(self.slots):
+            arr = np.zeros((len(buf), dim), dtype=dt)
+            lens = np.zeros(len(buf), dtype=np.int64)
+            for i, sample in enumerate(buf):
+                v = sample[si][:dim]
+                arr[i, :len(v)] = v
+                lens[i] = len(sample[si])
+            out[name] = arr
+            out[name + ".lens"] = lens
+        return out

@@ -11,6 +11,7 @@ autograd implementation for both modes.
 from __future__ import annotations
 
 import contextlib
+import weakref
 from typing import Dict, List, Optional
 
 import jax
@@ -24,7 +25,7 @@ __all__ = ["guard", "enabled", "to_variable", "VarBase", "trace_op",
            "Layer", "no_grad", "save_dygraph", "load_dygraph"]
 
 _state = {"enabled": False, "tape": None, "op_counter": 0, "seed": 0,
-          "is_test": False}
+          "is_test": False, "var_map": None}
 
 
 def enabled():
@@ -34,7 +35,11 @@ def enabled():
 @contextlib.contextmanager
 def guard(place=None):
     old = dict(_state)
-    _state.update(enabled=True, tape=[], op_counter=0)
+    # WeakValueDictionary: name lookup for layers.* dispatch must not pin
+    # temp outputs — vars die with their last real reference, matching the
+    # reference dygraph's refcount-driven frees.
+    _state.update(enabled=True, tape=[], op_counter=0,
+                  var_map=weakref.WeakValueDictionary())
     try:
         yield
     finally:
@@ -58,14 +63,24 @@ class VarBase:
 
     def __init__(self, value, name=None, stop_gradient=False,
                  persistable=False, trainable=True):
-        self.value = value if isinstance(value, jax.Array) else \
-            jnp.asarray(value)
+        # value=None creates an unbound placeholder (filled by the layer
+        # dispatch in LayerHelper.append_op before anyone reads it)
+        if value is None:
+            self.value = None
+        else:
+            self.value = value if isinstance(value, jax.Array) else \
+                jnp.asarray(value)
         VarBase._counter[0] += 1
         self.name = name or f"eager_{VarBase._counter[0]}"
         self.stop_gradient = stop_gradient
         self.persistable = persistable
         self.trainable = trainable
         self.grad: Optional[jax.Array] = None
+        # name→var registry so name-keyed layers.* calls resolve eager vars
+        # (the reference's dygraph scope; imperative/layer.h VarBase names)
+        vm = _state.get("var_map")
+        if _state["enabled"] and vm is not None:
+            vm[self.name] = self
 
     @property
     def shape(self):
@@ -197,16 +212,33 @@ class _TapeEntry:
         self.op_id = op_id
 
 
-def trace_op(op_type, ins: Dict[str, List[VarBase]], attrs) -> Dict[
+def trace_op(op_type, ins: Dict[str, List[VarBase]], attrs,
+             out_vars: Optional[Dict[str, List[VarBase]]] = None) -> Dict[
         str, List[VarBase]]:
-    """Run one op eagerly; record on the tape (tracer.cc:45 TraceOp)."""
+    """Run one op eagerly; record on the tape (tracer.cc:45 TraceOp).
+    out_vars: pre-created placeholders to bind results into (keeps tape
+    identity when layers.* pre-allocates its output vars)."""
     opdef = REGISTRY.get(op_type)
     _state["op_counter"] += 1
     op_id = _state["op_counter"]
     ctx = _EagerCtx(op_id)
     arr_ins = {s: [v.value for v in vs] for s, vs in ins.items() if vs}
     arr_outs = opdef.lower(ctx, arr_ins, attrs)
-    outs = {s: [VarBase(a) for a in arrs] for s, arrs in arr_outs.items()}
+    if out_vars is not None:
+        outs = {}
+        for s, arrs in arr_outs.items():
+            slots = out_vars.get(s, [])
+            bound = []
+            for i, a in enumerate(arrs):
+                if i < len(slots):
+                    slots[i].value = a
+                    bound.append(slots[i])
+                else:
+                    bound.append(VarBase(a))
+            outs[s] = bound
+    else:
+        outs = {s: [VarBase(a) for a in arrs]
+                for s, arrs in arr_outs.items()}
     tape = _state["tape"]
     needs_grad = any(not v.stop_gradient for vs in ins.values() for v in vs)
     if tape is not None and needs_grad and not opdef.inplace:

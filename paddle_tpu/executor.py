@@ -167,5 +167,45 @@ class Executor:
         self._cache.clear()
         self._compiled_refs.clear()
 
-    # Reference parity: fluid.Executor.infer_from_dataset /
-    # train_from_dataset are provided by the dataset path (see reader.py).
+    # ------------------------------------------------------------------
+    # Dataset trainer path. Reference: Executor.train_from_dataset
+    # (executor.py:1098) → TrainerFactory → C++ MultiTrainer with
+    # HogwildWorker threads (trainer.h:64, device_worker.h:151). On TPU the
+    # worker thread pool collapses into the single jitted step (XLA owns
+    # device parallelism); the native C++ feed supplies ready batches.
+    def train_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        return self._run_from_dataset(program, dataset, scope, thread,
+                                      fetch_list, fetch_info, print_period,
+                                      drop_last=True)
+
+    def infer_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        # inference must see every sample — keep the final partial batch
+        return self._run_from_dataset(program, dataset, scope, thread,
+                                      fetch_list, fetch_info, print_period,
+                                      drop_last=False)
+
+    def _run_from_dataset(self, program, dataset, scope, thread,
+                          fetch_list, fetch_info, print_period, drop_last):
+        if dataset is None:
+            raise ValueError("dataset must be provided")
+        if thread:
+            dataset.set_thread(thread)
+        fetch_list = list(fetch_list or [])
+        names = [v.name if isinstance(v, Variable) else str(v)
+                 for v in fetch_list]
+        info = list(fetch_info or names)
+        step = 0
+        last = []
+        for feed in dataset.batches(drop_last=drop_last):
+            last = self.run(program, feed=feed, fetch_list=fetch_list,
+                            scope=scope)
+            step += 1
+            if names and step % print_period == 0:
+                msg = ", ".join(f"{i}={np.asarray(v).mean():.6f}"
+                                for i, v in zip(info, last))
+                print(f"step {step}: {msg}")
+        return last

@@ -66,6 +66,9 @@ class LayerHelper:
 
     def create_variable_for_type_inference(self, dtype="float32",
                                            stop_gradient=False):
+        from . import dygraph
+        if dygraph.enabled():
+            return dygraph.VarBase(None, stop_gradient=stop_gradient)
         return self.block.create_var(
             name=unique_name.generate(f"{self.name}.tmp"),
             dtype=dtype, stop_gradient=stop_gradient)
@@ -73,6 +76,34 @@ class LayerHelper:
     create_tmp_variable = create_variable_for_type_inference
 
     def append_op(self, **kwargs):
+        from . import dygraph
+        if dygraph.enabled():
+            # layers.* in dygraph mode: resolve name-keyed slots to live
+            # eager vars and dispatch to the tracer (the reference routes
+            # LayerHelper through Tracer::TraceOp the same way,
+            # dygraph layer_object_helper).
+            vm = dygraph._state["var_map"]
+
+            def resolve(slot_map):
+                out = {}
+                for slot, items in (slot_map or {}).items():
+                    vs = []
+                    for it in items or []:
+                        if isinstance(it, dygraph.VarBase):
+                            vs.append(it)
+                        elif it in vm:
+                            vs.append(vm[it])
+                        else:
+                            raise KeyError(
+                                f"dygraph var {it!r} not found for "
+                                f"{kwargs['type']}.{slot}")
+                    out[slot] = vs
+                return out
+
+            return dygraph.trace_op(kwargs["type"],
+                                    resolve(kwargs.get("inputs")),
+                                    kwargs.get("attrs") or {},
+                                    out_vars=resolve(kwargs.get("outputs")))
         return self.block.append_op(
             kwargs["type"], inputs=kwargs.get("inputs"),
             outputs=kwargs.get("outputs"), attrs=kwargs.get("attrs"))

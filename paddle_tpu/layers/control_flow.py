@@ -124,6 +124,9 @@ class While:
 
         def __exit__(self, exc_type, exc, tb):
             if exc_type is not None:
+                # leave the program pointing at the parent block even when
+                # the body raised, or later ops land in the orphaned sub
+                self.prog._rollback()
                 return False
             prog = self.prog
             sub = prog.current_block()
@@ -176,6 +179,7 @@ class _CondBlockGuard:
 
     def __exit__(self, exc_type, exc, tb):
         if exc_type is not None:
+            self.prog._rollback()
             return False
         prog = self.prog
         sub = prog.current_block()
@@ -316,6 +320,7 @@ class StaticRNN:
 
         def __exit__(self, exc_type, exc, tb):
             if exc_type is not None:
+                self.rnn._prog._rollback()
                 return False
             self.rnn._prog._rollback()
             self.rnn._emit()

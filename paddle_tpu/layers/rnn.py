@@ -375,9 +375,6 @@ def rnn(cell, inputs, initial_states=None, sequence_length=None,
 
     cell_in = _pack_as(step_ins, inputs)
     cell_states = _pack_as(step_states, initial_states)
-    if isinstance(cell_in, list) and len(cell_in) == 1 and not \
-            isinstance(inputs, (list, tuple)):
-        cell_in = cell_in[0]
     out, new_states = cell.call(cell_in, cell_states, **kwargs) if kwargs \
         else cell.call(cell_in, cell_states)
     out_list = _flatten(out)
@@ -523,7 +520,7 @@ class BeamSearchDecoder(Decoder):
         cell_out, next_cell_states = self.cell(emb, cell_states)
         logits = self.output_fn(cell_out) if self.output_fn else cell_out
         vocab = logits.shape[-1]
-        logp = _log_softmax(logits)
+        logp = _nn.log_softmax(logits)
         logp = _nn.reshape(logp, [batch, beam, vocab])
         # accumulate: candidate score = pre_score + logp
         acc = _nn.elementwise_add(
@@ -566,18 +563,12 @@ class BeamSearchDecoder(Decoder):
         return _nn.reshape(out, [batch * beam] + rest)
 
 
-def _log_softmax(x, name=None):
-    helper = LayerHelper("log_softmax", name=name)
-    out = helper.create_variable_for_type_inference(x.dtype)
-    helper.append_op(type="log_softmax", inputs={"X": [x.name]},
-                     outputs={"Out": [out.name]}, attrs={"axis": -1})
-    return out
-
-
 def dynamic_decode(decoder, inits=None, max_step_num=64, output_time_major
                    =False, return_length=False, **kwargs):
     """Run decoder.step for max_step_num steps via the recurrent op; beam
-    backtrack with gather_tree. Returns (ids [B, T, beam], scores)."""
+    backtrack with gather_tree. Returns (ids [B, T, beam], scores), plus
+    per-beam lengths when return_length=True (reference rnn.py
+    dynamic_decode)."""
     initial_inputs, initial_states = decoder.initialize(inits)
 
     prog = default_main_program()
@@ -596,7 +587,8 @@ def dynamic_decode(decoder, inits=None, max_step_num=64, output_time_major
 
     outputs, next_states, next_inputs, finished = decoder.step(
         None, input_var, cell_states, **kwargs)
-    out_list = [outputs["ids"], outputs["parents"], outputs["scores"]]
+    out_list = [outputs["ids"], outputs["parents"], outputs["scores"],
+                finished]
     new_state_list = _flatten(next_states) + [next_inputs]
     prog._rollback()
 
@@ -612,8 +604,10 @@ def dynamic_decode(decoder, inits=None, max_step_num=64, output_time_major
             written.add(n)
 
     helper = LayerHelper("dynamic_decode")
-    # dummy sequence input to give the scan its length: [B, T] zeros
-    batch = _flatten(initial_states)[0].shape[0]
+    # dummy sequence input to give the scan its length: [B, T] zeros.
+    # batch comes from initial_inputs [B, beam] — cell states are tiled
+    # to [B*beam, D] and would give the wrong leading dim.
+    batch = _flatten(initial_inputs)[0].shape[0]
     dummy = _tensor.fill_constant([batch, max_step_num], "float32", 0.0)
     dummy_step = sub.create_var(name=unique_name.generate("dec_t"),
                                 shape=[batch], dtype="float32",
@@ -647,7 +641,7 @@ def dynamic_decode(decoder, inits=None, max_step_num=64, output_time_major
                "reverse": False},
         infer_shape=False)
 
-    ids_btk, parents_btk, scores_btk = outs
+    ids_btk, parents_btk, scores_btk, fin_btk = outs
     # gather_tree wants [T, B, beam]
     ids_t = _nn.transpose(ids_btk, [1, 0, 2])
     par_t = _nn.transpose(parents_btk, [1, 0, 2])
@@ -658,4 +652,10 @@ def dynamic_decode(decoder, inits=None, max_step_num=64, output_time_major
     out_ids = seq if output_time_major else _nn.transpose(seq, [1, 0, 2])
     out_scores = _nn.transpose(scores_btk, [1, 0, 2]) if output_time_major \
         else scores_btk
+    if return_length:
+        # length per (batch, beam) = #steps not yet finished at step start
+        not_fin = _tensor.cast(
+            _nn.logical_not(_tensor.cast(fin_btk, "bool")), "int64")
+        lengths = _nn.reduce_sum(not_fin, dim=1)
+        return out_ids, out_scores, lengths
     return out_ids, out_scores

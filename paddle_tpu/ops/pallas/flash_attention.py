@@ -42,7 +42,7 @@ NEG_INF = -1e30
 # ---------------------------------------------------------------------------
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
-                block_k):
+                block_k, kv_len):
     # block shapes carry a leading singleton (bh) dim: q_ref[0] = [bq, d],
     # k_ref[0]/v_ref[0] = [T, d] (full K/V for this head)
     q = q_ref[0].astype(jnp.float32) * sm_scale
@@ -62,12 +62,15 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
         v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
-        if causal:
+        if causal or kv_len < t:
             qpos = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             kpos = kb * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(qpos >= kpos, s, NEG_INF)
+            keep = kpos < kv_len
+            if causal:
+                keep = jnp.logical_and(keep, qpos >= kpos)
+            s = jnp.where(keep, s, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
@@ -88,14 +91,18 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
 
     l_safe = jnp.maximum(l, 1e-20)
     o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
-    lse_ref[0] = (m + jnp.log(l_safe)).reshape(block_q)
+    # lse is carried as [bh, 8, T] — replicated across an 8-sublane dim so
+    # its blocks satisfy the TPU (8, 128) tile constraint.
+    lse_ref[0] = jnp.broadcast_to((m + jnp.log(l_safe)).reshape(1, block_q),
+                                  (8, block_q))
 
 
-def _fwd(q, k, v, sm_scale, causal, block_q, block_k):
+def _fwd(q, k, v, sm_scale, causal, block_q, block_k, kv_len):
     bh, t, d = q.shape
     grid = (bh, t // block_q)
     kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale,
-                               causal=causal, block_k=block_k)
+                               causal=causal, block_k=block_k,
+                               kv_len=kv_len)
     kw = {}
     if _VMEM is not None:
         kw = {"memory_space": _VMEM}
@@ -109,11 +116,11 @@ def _fwd(q, k, v, sm_scale, causal, block_q, block_k):
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0), **kw),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i), **kw),
+            pl.BlockSpec((1, 8, block_q), lambda b, i: (b, 0, i), **kw),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, t, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, t), jnp.float32),
+            jax.ShapeDtypeStruct((bh, 8, t), jnp.float32),
         ],
         interpret=_interpret(),
     )(q, k, v)
@@ -129,14 +136,14 @@ def _fwd(q, k, v, sm_scale, causal, block_q, block_k):
 # ---------------------------------------------------------------------------
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, delta_ref, lse_ref, do_ref, dq_ref,
-                   *, sm_scale, causal, block_k):
+                   *, sm_scale, causal, block_k, kv_len):
     q = q_ref[0].astype(jnp.float32)
     do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, 0, :].astype(jnp.float32)
     block_q, d = q.shape
     t = k_ref.shape[1]
     qi = pl.program_id(1)
-    delta = delta_ref[0].astype(jnp.float32)[:, None]
+    delta = delta_ref[0, 0, :].astype(jnp.float32)[:, None]
     num_kb = t // block_k
 
     def body(kb, dq):
@@ -144,12 +151,15 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, delta_ref, lse_ref, do_ref, dq_ref,
         v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
         s = jax.lax.dot_general(q * sm_scale, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
-        if causal:
+        if causal or kv_len < t:
             qpos = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             kpos = kb * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(qpos >= kpos, s, NEG_INF)
+            keep = kpos < kv_len
+            if causal:
+                keep = jnp.logical_and(keep, qpos >= kpos)
+            s = jnp.where(keep, s, NEG_INF)
         p = jnp.exp(s - lse[:, None])
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -169,7 +179,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, delta_ref, lse_ref, do_ref, dq_ref,
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, delta_ref, lse_ref, do_ref,
-                    dk_ref, dv_ref, *, sm_scale, causal, block_q):
+                    dk_ref, dv_ref, *, sm_scale, causal, block_q, kv_len):
     k = k_ref[0].astype(jnp.float32)
     v = v_ref[0].astype(jnp.float32)
     block_k, d = k.shape
@@ -181,17 +191,20 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, delta_ref, lse_ref, do_ref,
         dk, dv = carry
         q = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
         do = do_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(qb * block_q, block_q)].astype(jnp.float32)
-        delta = delta_ref[0, pl.ds(qb * block_q, block_q)].astype(
+        lse = lse_ref[0, 0, pl.ds(qb * block_q, block_q)].astype(jnp.float32)
+        delta = delta_ref[0, 0, pl.ds(qb * block_q, block_q)].astype(
             jnp.float32)[:, None]
         s = jax.lax.dot_general(q * sm_scale, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
-        if causal:
+        if causal or kv_len < t:
             qpos = qb * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             kpos = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(qpos >= kpos, s, NEG_INF)
+            keep = kpos < kv_len
+            if causal:
+                keep = jnp.logical_and(keep, qpos >= kpos)
+            s = jnp.where(keep, s, NEG_INF)
         p = jnp.exp(s - lse[:, None])
         dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
                                       preferred_element_type=jnp.float32)
@@ -213,24 +226,26 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, delta_ref, lse_ref, do_ref,
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
-def _bwd(sm_scale, causal, block_q, block_k, res, do):
+def _bwd(sm_scale, causal, block_q, block_k, kv_len, res, do):
     q, k, v, o, lse = res
     bh, t, d = q.shape
     # delta = rowsum(do * o), once per row; XLA fuses this elementwise
     # reduction, the kernels just stream the [bh, t] result.
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    # replicate across the 8-sublane dim to match the lse carry layout
+    delta = jnp.broadcast_to(delta[:, None, :], (bh, 8, t))
     kw = {}
     if _VMEM is not None:
         kw = {"memory_space": _VMEM}
     spec_full = pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0), **kw)
-    spec_lse_full = pl.BlockSpec((1, t), lambda b, i: (b, 0), **kw)
+    spec_lse_full = pl.BlockSpec((1, 8, t), lambda b, i: (b, 0, 0), **kw)
     spec_qb = pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0), **kw)
-    spec_lse_qb = pl.BlockSpec((1, block_q), lambda b, i: (b, i), **kw)
+    spec_lse_qb = pl.BlockSpec((1, 8, block_q), lambda b, i: (b, 0, i), **kw)
     spec_kb = pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0), **kw)
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
-                          block_k=block_k),
+                          block_k=block_k, kv_len=kv_len),
         grid=(bh, t // block_q),
         in_specs=[spec_qb, spec_full, spec_full, spec_lse_qb, spec_lse_qb,
                   spec_qb],
@@ -241,7 +256,7 @@ def _bwd(sm_scale, causal, block_q, block_k, res, do):
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale,
-                          causal=causal, block_q=block_q),
+                          causal=causal, block_q=block_q, kv_len=kv_len),
         grid=(bh, t // block_k),
         in_specs=[spec_full, spec_kb, spec_kb, spec_lse_full, spec_lse_full,
                   spec_full],
@@ -252,14 +267,14 @@ def _bwd(sm_scale, causal, block_q, block_k, res, do):
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, sm_scale, causal, block_q, block_k):
-    o, _ = _fwd(q, k, v, sm_scale, causal, block_q, block_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, sm_scale, causal, block_q, block_k, kv_len):
+    o, _ = _fwd(q, k, v, sm_scale, causal, block_q, block_k, kv_len)
     return o
 
 
-def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k):
-    o, lse = _fwd(q, k, v, sm_scale, causal, block_q, block_k)
+def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, kv_len):
+    o, lse = _fwd(q, k, v, sm_scale, causal, block_q, block_k, kv_len)
     return o, (q, k, v, o, lse)
 
 
@@ -288,11 +303,15 @@ def reference_attention(q, k, v, causal=False, sm_scale=None, dropout=0.0,
 
 
 def _pick_block(t, want):
-    """Largest power-of-two divisor of t capped at `want` (>=1)."""
-    b = 1
-    while b * 2 <= min(want, t) and t % (b * 2) == 0:
-        b *= 2
-    return b
+    """Largest TPU-legal block size for a 128-aligned t: divides t AND is
+    a multiple of 128 (lane-dim tiling of the lse carry). Requests below
+    128 are clamped up — sub-128 tiles cannot satisfy the lse lane
+    constraint. t is always a 128-multiple here, so b=128 is the floor."""
+    want = min(max(want, 128), t)
+    for b in range(want - want % 128, 0, -128):
+        if t % b == 0:
+            return b
+    return t
 
 
 def flash_attention(q, k, v, causal=False, sm_scale=None, block_q=128,
@@ -309,11 +328,24 @@ def flash_attention(q, k, v, causal=False, sm_scale=None, block_q=128,
     t, d = q.shape[1], q.shape[2]
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(d)
-    block_q = _pick_block(t, min(block_q, t))
-    block_k = _pick_block(t, min(block_k, t))
-    if min(block_q, block_k) < 16 and t > 16:
-        # degenerate tiling (e.g. prime T): exact fallback beats 1-wide tiles
+    if t < 128:
+        # short sequences: exact path is cheaper than kernel padding
         out = reference_attention(q, k, v, causal=causal, sm_scale=sm_scale)
         return out.reshape(orig_shape)
-    out = _flash(q, k, v, float(sm_scale), bool(causal), block_q, block_k)
+    # Pad T to a 128-multiple so every length stays on the flash path; the
+    # kernels mask padded key columns (kv_len), padded query rows are
+    # sliced off below. Zero-padding is grad-safe: masked columns get p=0
+    # and padded rows get zero cotangents.
+    t_pad = (t + 127) & ~127
+    if t_pad != t:
+        pad = [(0, 0), (0, t_pad - t), (0, 0)]
+        q = jnp.pad(q, pad)
+        k = jnp.pad(k, pad)
+        v = jnp.pad(v, pad)
+    block_q = _pick_block(t_pad, block_q)
+    block_k = _pick_block(t_pad, block_k)
+    out = _flash(q, k, v, float(sm_scale), bool(causal), block_q, block_k,
+                 t)
+    if t_pad != t:
+        out = out[:, :t, :]
     return out.reshape(orig_shape)

@@ -306,8 +306,9 @@ def _gather_tree(ctx, ins, attrs):
         par = jnp.take_along_axis(parents[i], beam_idx, axis=-1)
         return par, tok
 
-    init = jnp.broadcast_to(jnp.arange(ids.shape[2], dtype=jnp.int32),
-                            ids.shape[1:]).astype(jnp.int32)
+    # carry dtype must match the per-step parent output (Parents dtype)
+    init = jnp.broadcast_to(
+        jnp.arange(ids.shape[2], dtype=parents.dtype), ids.shape[1:])
     _, toks = jax.lax.scan(step, init, jnp.arange(t - 1, -1, -1))
     return {"Out": [toks[::-1]]}
 
