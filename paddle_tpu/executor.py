@@ -126,6 +126,10 @@ class Executor:
 
     def _compile(self, program, block, feed_arrays, fetch_names, scope,
                  compiled) -> _CompiledStep:
+        # Fetch targets hidden inside recompute sub-blocks must be surfaced
+        # as segment outputs first (parallel/recompute.py).
+        from .parallel.recompute import expose_fetch_vars
+        expose_fetch_vars(program, fetch_names)
         # State-in: persistables already initialised in scope OR consumed
         # by some op before being produced.
         persistables = {v.name for v in program.list_vars() if v.persistable}

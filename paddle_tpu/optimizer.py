@@ -23,6 +23,7 @@ __all__ = [
     "FtrlOptimizer", "Lamb", "LambOptimizer", "Dpsgd", "DpsgdOptimizer",
     "ExponentialMovingAverage", "ModelAverage", "LookaheadOptimizer",
     "RecomputeOptimizer", "PipelineOptimizer", "DGCMomentumOptimizer",
+    "GradientMergeOptimizer",
 ]
 
 
@@ -652,10 +653,11 @@ class RecomputeOptimizer:
     """Activation recomputation wrapper (reference optimizer.py:3313).
 
     The reference re-runs forward sub-segments in the backward pass
-    (backward.py:576). Here gradient ops already recompute their forward
-    lowering under vjp; marking checkpoints tells XLA (via jax.checkpoint
-    in the segment lowering — see parallel/recompute.py) which activations
-    NOT to keep live in HBM.
+    (backward.py:576). Here minimize() first rewrites the forward into
+    `recompute_segment` sub-blocks at the marked checkpoints
+    (parallel/recompute.py); each segment lowers under jax.checkpoint, so
+    the generic vjp backward recomputes it and XLA drops the internal
+    activations from HBM.
     """
 
     def __init__(self, optimizer):
@@ -671,10 +673,85 @@ class RecomputeOptimizer:
     def apply_gradients(self, params_grads):
         return self.inner.apply_gradients(params_grads)
 
+    def load(self, state):
+        raise NotImplementedError(
+            "load() is unsupported (matches reference RecomputeOptimizer)")
+
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):
+        if self._checkpoints:
+            from .parallel.recompute import rewrite_program_for_recompute
+            rewrite_program_for_recompute(
+                loss.block.program, self._checkpoints, keep_names=[loss])
         return self.inner.minimize(loss, startup_program, parameter_list,
                                    no_grad_set)
+
+
+class GradientMergeOptimizer:
+    """Gradient accumulation over k steps (reference multi_batch_merge_pass,
+    ir/multi_batch_merge_pass.cc; fluid 1.6's GradientMergeOptimizer).
+
+    Gradients accumulate into persistable buffers every step; every k-th
+    step the inner optimizer's update ops run inside a conditional_block
+    (lax.cond), so optimizer state (Adam moments etc.) mutates ONLY on
+    apply steps — identical to running the optimizer on a k-times-larger
+    batch.
+    """
+
+    def __init__(self, inner_optimizer, k_steps=1, avg=True):
+        self.inner = inner_optimizer
+        self.k_steps = int(k_steps)
+        self.avg = avg
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        return self.inner.backward(loss, startup_program, parameter_list,
+                                   no_grad_set, callbacks)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        from .layers import math_ops, nn as nn_layers, tensor as tlayers
+        from .layers.control_flow import _CondBlockGuard, equal
+        from .layers.learning_rate_scheduler import autoincreased_step_counter
+
+        params_grads = self.inner.backward(
+            loss, startup_program, parameter_list, no_grad_set)
+        if self.k_steps <= 1:
+            return self.inner.apply_gradients(params_grads), params_grads
+
+        block = default_main_program().current_block()
+        step = autoincreased_step_counter(
+            counter_name=unique_name.generate("@GRADIENT_MERGE_STEP@"),
+            begin=1)
+        k_var = tlayers.fill_constant([1], "int64", self.k_steps)
+        zero = tlayers.fill_constant([1], "int64", 0)
+        cond = equal(math_ops.elementwise_mod(step, k_var), zero)
+
+        merged = []
+        for p, g in params_grads:
+            acc = create_global_var(
+                list(p.shape), 0.0, p.dtype, persistable=True,
+                name=unique_name.generate(f"{p.name}_gradient_merge"))
+            block.append_op(  # in-place: acc += grad
+                "elementwise_add", inputs={"X": [acc.name], "Y": [g.name]},
+                outputs={"Out": [acc.name]}, attrs={"axis": -1},
+                infer_shape=False)
+            merged.append((p, acc))
+
+        with _CondBlockGuard(cond):
+            applied = []
+            for p, acc in merged:
+                eff = nn_layers.scale(acc, scale=1.0 / self.k_steps) \
+                    if self.avg else acc
+                applied.append((p, eff))
+            opt_ops = self.inner.apply_gradients(applied)
+            sub = default_main_program().current_block()
+            for _, acc in merged:
+                sub.append_op(  # reset buffer after apply
+                    "scale", inputs={"X": [acc.name]},
+                    outputs={"Out": [acc.name]},
+                    attrs={"scale": 0.0, "bias": 0.0}, infer_shape=False)
+        return opt_ops, params_grads
 
 
 class PipelineOptimizer:

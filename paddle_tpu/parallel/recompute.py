@@ -1,0 +1,151 @@
+"""Activation recomputation (gradient checkpointing) over the Program IR.
+
+Reference analogue: RecomputeOptimizer (optimizer.py:3313) +
+`_append_backward_ops_with_checkpoints_` (backward.py:576): the forward is
+split at user-marked checkpoint vars into segments; the backward re-runs
+each segment's forward ops instead of keeping its activations live.
+
+TPU-native formulation: each segment's ops move into a sub-block fronted by
+one `recompute_segment` meta-op whose lowering evaluates the sub-block under
+``jax.checkpoint``. The generic vjp grad (core/lowering.py) then recomputes
+the segment in the backward automatically, and XLA's buffer assignment drops
+the internal activations — the memory/FLOPs trade the reference implements
+with hand-scheduled op copies falls out of one remat annotation.
+"""
+from __future__ import annotations
+
+from typing import List
+
+import jax
+
+from ..core.registry import REGISTRY, register_op
+
+__all__ = ["rewrite_program_for_recompute", "expose_fetch_vars"]
+
+
+@register_op("recompute_segment")
+def _recompute_segment(ctx, ins, attrs):
+    names_in: List[str] = attrs["input_vars"]
+    names_out: List[str] = attrs["output_vars"]
+    block = ctx.sub_block(attrs["sub_block"])
+
+    def seg(xs):
+        env = dict(zip(names_in, xs))
+        ctx.lower_sub_block(block, env)
+        return [env[n] for n in names_out]
+
+    outs = jax.checkpoint(seg)(list(ins["X"]))
+    return {"Out": outs}
+
+
+def _op_is_wrappable(op) -> bool:
+    """Segments may only contain plain ops: inplace (optimizer) ops and ops
+    with bespoke grad plumbing keep their own backward path."""
+    if not REGISTRY.has(op.type):
+        return False
+    opdef = REGISTRY.get(op.type)
+    return not opdef.inplace and opdef.custom_grad_maker is None \
+        and op.type not in ("feed", "fetch", "recompute_segment")
+
+
+def rewrite_program_for_recompute(program, checkpoints, keep_names=()):
+    """Partition block-0's forward ops into segments ending at each
+    checkpoint var; wrap every multi-op segment in a recompute_segment op.
+
+    Must run BEFORE append_backward. ``keep_names`` (e.g. the loss) are
+    always exposed as segment outputs.
+    """
+    block = program.global_block()
+    checkpoints = {c.name if hasattr(c, "name") else str(c)
+                   for c in checkpoints}
+    keep = {k.name if hasattr(k, "name") else str(k) for k in keep_names}
+
+    ops = list(block.ops)
+    if not all(_op_is_wrappable(op) for op in ops):
+        return  # control flow / custom-grad ops present: leave as-is
+
+    # Split: a segment closes after the op that produces a checkpoint var.
+    segments, cur = [], []
+    for op in ops:
+        cur.append(op)
+        if any(n in checkpoints for n in op.output_names()):
+            segments.append(cur)
+            cur = []
+    if cur:
+        segments.append(cur)
+    if len(segments) < 2:
+        return
+
+    persistable = {v.name for v in block.vars.values() if v.persistable}
+    # consumers[name] = index of first segment reading it after production
+    read_by_later: dict = {}
+    for si, seg in enumerate(segments):
+        for op in seg:
+            for n in op.input_names():
+                read_by_later.setdefault(n, set()).add(si)
+
+    block.ops = []
+    for si, seg in enumerate(segments):
+        produced_here = set()
+        consumed = []
+        for op in seg:
+            for n in op.input_names():
+                if n and n not in produced_here and n not in consumed:
+                    consumed.append(n)
+            for n in op.output_names():
+                if n:
+                    produced_here.add(n)
+        ext_in = [n for n in consumed if n not in produced_here]
+        ext_out = sorted(
+            n for n in produced_here
+            if n in persistable or n in keep or n in checkpoints
+            or any(sj > si for sj in read_by_later.get(n, ())))
+        if len(seg) == 1:
+            # single-op segment: nothing to recompute, keep it inline
+            block.ops.append(seg[0])
+            continue
+
+        sub = program._create_block(parent_idx=block.idx)
+        for op in seg:
+            op.block = sub
+            sub.ops.append(op)
+        program._current_block_idx = block.idx
+
+        block.append_op(
+            "recompute_segment",
+            inputs={"X": ext_in},
+            outputs={"Out": ext_out},
+            attrs={"sub_block": sub.idx,
+                   "input_vars": ext_in,
+                   "output_vars": ext_out},
+            infer_shape=False)
+
+
+def expose_fetch_vars(program, fetch_names):
+    """Make fetch targets hidden inside recompute sub-blocks fetchable.
+
+    A var produced inside a segment is normally an internal (recomputed)
+    value; if the user fetches it, extend the owning recompute_segment op's
+    outputs so it is materialised in the outer env. Called by
+    Executor._compile; mutates the op attrs (the executable cache key
+    already includes fetch_names, so each fetch set compiles consistently).
+    """
+    block = program.global_block()
+    metas = [op for op in block.ops if op.type == "recompute_segment"]
+    if not metas:
+        return
+    available = set()
+    for op in block.ops:
+        available.update(n for n in op.output_names() if n)
+    for name in fetch_names:
+        if name in available:
+            continue
+        for op in metas:
+            sub = program.blocks[op.attrs["sub_block"]]
+            if any(name in sop.output_names() for sop in sub.ops):
+                new_out = list(op.attrs["output_vars"]) + [name]
+                op.attrs = dict(op.attrs,
+                                output_vars=new_out)
+                op.outputs = dict(op.outputs, Out=new_out)
+                program._fp_cache = None
+                break

@@ -112,3 +112,198 @@ def test_unknown_batch_axis_raises():
         with pytest.raises(ValueError, match="batch_axes"):
             exe.run(compiled, feed={"x": np.ones((8, 4), np.float32)},
                     fetch_list=[loss])
+
+
+# ---------------------------------------------------------------------------
+# Pipeline parallelism (parallel/pipeline.py)
+# ---------------------------------------------------------------------------
+
+def test_gpipe_matches_sequential():
+    """GPipe over pp=4 must equal running the 4 stages sequentially."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.parallel import gpipe, stack_stage_params
+    from paddle_tpu.parallel.mesh import make_mesh
+
+    rng = np.random.RandomState(0)
+    d = 16
+    n_stages = 4
+    params = [{"w": jnp.asarray(rng.randn(d, d).astype(np.float32) / 4),
+               "b": jnp.asarray(rng.randn(d).astype(np.float32) / 10)}
+              for _ in range(n_stages)]
+
+    def stage(p, x):
+        return jnp.tanh(x @ p["w"] + p["b"])
+
+    x = jnp.asarray(rng.randn(8, d).astype(np.float32))
+    want = x
+    for p in params:
+        want = stage(p, want)
+
+    mesh = make_mesh((2, 4), ("dp", "pp"))
+    stacked = stack_stage_params(params)
+    got = gpipe(stage, stacked, x, n_microbatches=4, mesh=mesh, axis="pp")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_gpipe_backward_trains():
+    """jax.grad through the pipeline gives the same grads as sequential."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.parallel import gpipe, stack_stage_params
+    from paddle_tpu.parallel.mesh import make_mesh
+
+    rng = np.random.RandomState(1)
+    d, n_stages = 8, 4
+    params = [{"w": jnp.asarray(rng.randn(d, d).astype(np.float32) / 3)}
+              for _ in range(n_stages)]
+    stacked = stack_stage_params(params)
+    x = jnp.asarray(rng.randn(8, d).astype(np.float32))
+    mesh = make_mesh((4,), ("pp",), devices=jax.devices()[:4])
+
+    def stage(p, h):
+        return jnp.tanh(h @ p["w"])
+
+    def loss_pipe(sp):
+        return jnp.mean(gpipe(stage, sp, x, n_microbatches=4,
+                              mesh=mesh, axis="pp") ** 2)
+
+    def loss_seq(sp):
+        h = x
+        for i in range(n_stages):
+            h = stage(jax.tree.map(lambda a: a[i], sp), h)
+        return jnp.mean(h ** 2)
+
+    g_pipe = jax.grad(loss_pipe)(stacked)
+    g_seq = jax.grad(loss_seq)(stacked)
+    np.testing.assert_allclose(np.asarray(g_pipe["w"]),
+                               np.asarray(g_seq["w"]), rtol=1e-4, atol=1e-5)
+
+
+def test_section_pipeline_grad_accumulation():
+    import jax.numpy as jnp
+    from paddle_tpu.parallel import SectionPipeline
+
+    rng = np.random.RandomState(2)
+    d = 8
+    p1 = {"w": jnp.asarray(rng.randn(d, d).astype(np.float32))}
+    p2 = {"w": jnp.asarray(rng.randn(d, 1).astype(np.float32))}
+    x = jnp.asarray(rng.randn(16, d).astype(np.float32))
+    y = jnp.asarray(rng.randn(16, 1).astype(np.float32))
+
+    def s1(p, h):
+        return jnp.tanh(h @ p["w"])
+
+    def s2(p, h):
+        return h @ p["w"]
+
+    def loss_fn(pred, yb):
+        return jnp.mean((pred - yb) ** 2)
+
+    pipe = SectionPipeline([s1, s2], n_microbatches=4)
+    loss, grads = pipe.grad(loss_fn, [p1, p2], x, y)
+
+    import jax
+
+    def full(ps):
+        return loss_fn(s2(ps[1], s1(ps[0], x)), y)
+
+    want_loss, want_grads = jax.value_and_grad(full)([p1, p2])
+    np.testing.assert_allclose(float(loss), float(want_loss), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(grads[0]["w"]),
+                               np.asarray(want_grads[0]["w"]),
+                               rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# Recompute + gradient merge (IR-level)
+# ---------------------------------------------------------------------------
+
+def _train_mlp_losses(opt_factory, steps=6, seed=3, batch=16):
+    rng = np.random.RandomState(seed)
+    xs = rng.randn(batch, 16).astype(np.float32)
+    ys = rng.randn(batch, 1).astype(np.float32)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = layers.data("x", shape=[16], dtype="float32")
+            label = layers.data("y", shape=[1], dtype="float32")
+            h1 = layers.fc(x, size=32, act="relu")
+            h2 = layers.fc(h1, size=32, act="relu")
+            pred = layers.fc(h2, size=1)
+            loss = layers.mean(layers.square_error_cost(pred, label))
+            opt_factory(loss, [h1, h2])
+        main.random_seed = startup.random_seed = 11
+        exe = fluid.Executor()
+        exe.run(startup)
+        out = []
+        for _ in range(steps):
+            lv, = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+            out.append(float(np.asarray(lv)))
+    return out
+
+
+def test_recompute_matches_plain():
+    def plain(loss, cps):
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+
+    def recompute(loss, cps):
+        opt = fluid.optimizer.RecomputeOptimizer(
+            fluid.optimizer.SGD(learning_rate=0.1))
+        opt._set_checkpoints(cps)
+        opt.minimize(loss)
+
+    np.testing.assert_allclose(_train_mlp_losses(plain),
+                               _train_mlp_losses(recompute),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_gradient_merge_matches_big_batch():
+    """k=2 merge over half-batches == plain SGD on the full batch."""
+    rng = np.random.RandomState(4)
+    xs = rng.randn(16, 16).astype(np.float32)
+    ys = rng.randn(16, 1).astype(np.float32)
+
+    def build(opt_factory):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = layers.data("x", shape=[16], dtype="float32")
+            label = layers.data("y", shape=[1], dtype="float32")
+            h = layers.fc(x, size=8, act="relu")
+            pred = layers.fc(h, size=1)
+            loss = layers.mean(layers.square_error_cost(pred, label))
+            opt_factory(loss)
+        main.random_seed = startup.random_seed = 13
+        return main, startup, loss
+
+    # merged: two half-batch steps per apply, averaging grads
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        main, startup, loss = build(lambda l: fluid.optimizer
+                                    .GradientMergeOptimizer(
+                                        fluid.optimizer.SGD(0.1), k_steps=2)
+                                    .minimize(l))
+        w_name = main.global_block().all_parameters()[0].name
+        exe = fluid.Executor()
+        exe.run(startup)
+        for i in range(4):  # 2 applies
+            half = slice(0, 8) if i % 2 == 0 else slice(8, 16)
+            exe.run(main, feed={"x": xs[half], "y": ys[half]},
+                    fetch_list=[loss])
+        w_merged = np.asarray(scope.get(w_name))
+
+    # plain: one full-batch step per apply
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        main, startup, loss = build(
+            lambda l: fluid.optimizer.SGD(0.1).minimize(l))
+        w_name = main.global_block().all_parameters()[0].name
+        exe = fluid.Executor()
+        exe.run(startup)
+        for _ in range(2):
+            exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+        w_plain = np.asarray(scope.get(w_name))
+
+    np.testing.assert_allclose(w_merged, w_plain, rtol=1e-4, atol=1e-5)
