@@ -39,6 +39,10 @@ from . import profiler  # noqa: F401
 from . import dataset  # noqa: F401
 from .dataset import DatasetFactory  # noqa: F401
 from . import contrib  # noqa: F401
+from . import transpiler  # noqa: F401
+from .transpiler import (DistributeTranspiler,  # noqa: F401
+                         DistributeTranspilerConfig, memory_optimize,
+                         release_memory)
 from .backward import append_backward, gradients  # noqa: F401
 from .data_feeder import DataFeeder  # noqa: F401
 from .reader import DataLoader, PyReader  # noqa: F401

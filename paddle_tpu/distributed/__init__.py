@@ -1,0 +1,10 @@
+"""Host-side distributed runtime: RPC, parameter-server loop, launcher.
+
+Reference scope: operators/distributed/ (10.3k LoC gRPC/BRPC runtime),
+operators/distributed_ops/, python/paddle/distributed/launch.py —
+re-expressed as a small host TCP-RPC layer (DCN path) around XLA-compiled
+update programs; ICI-scale collectives live in paddle_tpu.parallel
+instead (SURVEY.md §2.8).
+"""
+from .ps_server import HeartBeatMonitor, PServerRuntime, run_pserver  # noqa: F401
+from .rpc import RPCClient, RPCServer  # noqa: F401

@@ -1,0 +1,114 @@
+"""Multi-process job launcher.
+
+Reference: python/paddle/distributed/launch.py — spawns one process per
+device/worker on the node, wiring PADDLE_TRAINER_ID /
+PADDLE_TRAINER_ENDPOINTS / PADDLE_CURRENT_ENDPOINT env vars; launch_ps.py
+adds PSERVER roles. Usage:
+
+  python -m paddle_tpu.distributed.launch --worker_num 2 train.py args...
+  python -m paddle_tpu.distributed.launch --server_num 2 --worker_num 2 \
+      train_ps.py
+
+On TPU one process drives all local chips (XLA owns intra-host
+parallelism), so worker_num defaults to the host count (1), not the chip
+count — the key contrast with the reference's process-per-GPU model.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+
+__all__ = ["launch"]
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _parse_args(argv=None):
+    p = argparse.ArgumentParser("paddle_tpu.distributed.launch")
+    p.add_argument("--cluster_node_ips", default="127.0.0.1")
+    p.add_argument("--node_ip", default="127.0.0.1")
+    p.add_argument("--started_port", type=int, default=0,
+                   help="0 = pick free ports")
+    p.add_argument("--worker_num", "--nproc_per_node", type=int, default=1)
+    p.add_argument("--server_num", type=int, default=0,
+                   help=">0 starts parameter-server mode")
+    p.add_argument("--log_dir", default=None)
+    p.add_argument("training_script")
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def _endpoints(ip, n, started_port):
+    ports = ([started_port + i for i in range(n)] if started_port
+             else [_free_port() for _ in range(n)])
+    return [f"{ip}:{p}" for p in ports]
+
+
+def launch(argv=None):
+    args = _parse_args(argv)
+    server_eps = _endpoints(args.node_ip, args.server_num,
+                            args.started_port)
+    worker_eps = _endpoints(
+        args.node_ip, args.worker_num,
+        args.started_port + args.server_num if args.started_port else 0)
+
+    procs = []
+    log_fhs = []
+
+    def _spawn(env_extra, tag):
+        env = dict(os.environ, **{k: str(v) for k, v in env_extra.items()})
+        cmd = [sys.executable, args.training_script,
+               *args.training_script_args]
+        out = None
+        if args.log_dir:
+            os.makedirs(args.log_dir, exist_ok=True)
+            out = open(os.path.join(args.log_dir, f"{tag}.log"), "w")
+            log_fhs.append(out)
+        procs.append(subprocess.Popen(cmd, env=env, stdout=out,
+                                      stderr=subprocess.STDOUT))
+
+    common = {
+        "PADDLE_PSERVERS_IP_PORT_LIST": ",".join(server_eps),
+        "PADDLE_TRAINER_ENDPOINTS": ",".join(worker_eps),
+        "PADDLE_TRAINERS_NUM": args.worker_num,
+    }
+    for i, ep in enumerate(server_eps):
+        _spawn({**common, "TRAINING_ROLE": "PSERVER",
+                "PADDLE_CURRENT_ENDPOINT": ep, "PADDLE_PORT":
+                ep.rsplit(":", 1)[1]}, f"serverlog.{i}")
+    for i, ep in enumerate(worker_eps):
+        _spawn({**common, "TRAINING_ROLE": "TRAINER",
+                "PADDLE_TRAINER_ID": i,
+                "PADDLE_CURRENT_ENDPOINT": ep}, f"workerlog.{i}")
+
+    def _terminate(signum=None, frame=None):
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+
+    signal.signal(signal.SIGINT, _terminate)
+    signal.signal(signal.SIGTERM, _terminate)
+
+    rc = 0
+    try:
+        # workers decide job success; servers are killed at the end
+        for p in procs[len(server_eps):]:
+            p.wait()
+            rc = rc or p.returncode
+    finally:
+        _terminate()
+        for fh in log_fhs:
+            fh.close()
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(launch())

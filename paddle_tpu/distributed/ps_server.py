@@ -1,0 +1,247 @@
+"""Parameter-server runtime: the listen_and_serv loop.
+
+Reference: operators/distributed_ops/listen_and_serv_op.cc — the pserver
+executes an RPC service that (sync mode) waits on a batch barrier for all
+trainers' grads, runs one optimizer sub-block per param, then serves the
+updated params; async mode applies each grad on arrival
+(AsyncCommunicator, communicator.h:288). Worker liveness follows
+HeartBeatMonitor (heart_beat_monitor.h:54,104).
+
+Here the optimizer sub-blocks still lower to XLA (each param's update is
+one tiny jitted program, compiled once); only the RPC+barrier choreography
+is host-side Python, mirroring how the reference keeps the PS control
+plane on the host while kernels run on device.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+from .rpc import RPCServer, pack_array, unpack_array
+
+__all__ = ["PServerRuntime", "HeartBeatMonitor", "run_pserver"]
+
+
+class HeartBeatMonitor:
+    """Chief-pserver worker-liveness tracker (heart_beat_monitor.h:54).
+
+    Workers are marked lost when silent for `timeout` seconds; COMPLETED
+    workers are exempt (LostWorkerMonitor :104).
+    """
+
+    def __init__(self, n_workers: int, timeout: float = 60.0):
+        self.timeout = timeout
+        self._last_seen: Dict[int, float] = {}
+        self._completed = set()
+        self._lock = threading.Lock()
+        self.n_workers = n_workers
+
+    def update(self, worker_id: int, status: str = "PING"):
+        with self._lock:
+            if status == "COMPLETED":
+                self._completed.add(worker_id)
+            self._last_seen[worker_id] = time.monotonic()
+
+    def lost_workers(self):
+        now = time.monotonic()
+        with self._lock:
+            return sorted(
+                w for w, t in self._last_seen.items()
+                if w not in self._completed and now - t > self.timeout)
+
+
+class PServerRuntime:
+    """Executes a pserver program produced by DistributeTranspiler."""
+
+    def __init__(self, pserver_program, startup_program=None, scope=None,
+                 heartbeat_timeout: float = 60.0):
+        from ..core.scope import Scope
+        from ..executor import Executor
+
+        ls = next(op for op in pserver_program.global_block().ops
+                  if op.type == "listen_and_serv")
+        self.program = pserver_program
+        self.params = list(ls.attrs["params"])
+        self.grad_of_param = dict(ls.attrs["grad_of_param"])
+        self.opt_block_of = dict(ls.attrs["opt_block_of"])
+        self.sync_mode = ls.attrs.get("sync_mode", True)
+        self.fanin = int(ls.attrs.get("Fanin", 1))
+        self.endpoint = ls.attrs["endpoint"]
+
+        self.scope = scope if scope is not None else Scope()
+        self.exe = Executor()
+        if startup_program is not None:
+            self.exe.run(startup_program, scope=self.scope)
+
+        # per-param optimizer programs (sub-block -> standalone Program)
+        self._opt_progs = {p: self._opt_program(p) for p in self.params}
+
+        self.monitor = HeartBeatMonitor(self.fanin, heartbeat_timeout)
+        self._lock = threading.Lock()
+        self._batch_cv = threading.Condition(self._lock)
+        self._grad_buf: Dict[str, list] = {p: [] for p in self.params}
+        self._barrier_count = 0
+        self._batch_id = 0
+        self._applied_batch = 0
+        self._completed = set()
+        self._server = RPCServer(self.endpoint, self._handle)
+        self.endpoint = self._server.endpoint  # resolved port (":0" ok)
+
+    # ------------------------------------------------------------------
+    def _opt_program(self, param):
+        from ..framework import Program
+
+        src = self.program
+        sub = src.blocks[self.opt_block_of[param]]
+        prog = Program()
+        blk = prog.global_block()
+        src_g = src.global_block()
+        for op in sub.ops:
+            for n in list(op.input_names()) + list(op.output_names()):
+                if n and not blk.has_var(n) and src_g.has_var(n):
+                    v = src_g.var(n)
+                    blk.create_var(name=n, shape=v.shape, dtype=v.dtype,
+                                   persistable=True, stop_gradient=True)
+            blk.append_op(op.type, inputs=op.inputs, outputs=op.outputs,
+                          attrs=op.attrs, infer_shape=False)
+        return prog
+
+    # ------------------------------------------------------------------
+    def start(self):
+        self._server.start()
+
+    def stop(self):
+        self._server.stop()
+
+    def wait_all_completed(self, timeout: Optional[float] = None):
+        """Block until every trainer sent 'complete'. timeout=None blocks
+        indefinitely (reference listen_and_serv semantics)."""
+        deadline = (time.monotonic() + timeout) if timeout else None
+        with self._batch_cv:
+            while len(self._completed) < self.fanin:
+                if deadline is not None and time.monotonic() >= deadline:
+                    raise TimeoutError(
+                        f"pserver {self.endpoint}: only "
+                        f"{len(self._completed)}/{self.fanin} trainers "
+                        f"completed")
+                wait_t = 1.0 if deadline is None else \
+                    min(1.0, max(0.0, deadline - time.monotonic()))
+                self._batch_cv.wait(timeout=wait_t)
+
+    # ------------------------------------------------------------------
+    def _live_count(self) -> int:
+        """Trainers still expected at the barrier: fanin minus completed
+        minus heartbeat-lost."""
+        lost = set(self.monitor.lost_workers())
+        return self.fanin - len(self._completed | lost)
+
+    def _apply_param(self, param, grads):
+        g_name = self.grad_of_param[param]
+        merged = np.mean(grads, axis=0) if len(grads) > 1 else grads[0]
+        self.scope.set(g_name, merged)
+        self.exe.run(self._opt_progs[param], scope=self.scope)
+
+    def _apply_batch_locked(self):
+        for p in self.params:
+            buf = self._grad_buf[p]
+            if buf:
+                self._apply_param(p, buf)
+                self._grad_buf[p] = []
+        self._applied_batch = self._batch_id
+        self._batch_id += 1
+        self._barrier_count = 0
+        self._batch_cv.notify_all()
+
+    # ------------------------------------------------------------------
+    def _handle(self, header, payload):
+        method = header.get("method")
+        tid = int(header.get("trainer_id", 0))
+        self.monitor.update(tid, "PING")
+
+        if method == "send_var":
+            name = header["name"]
+            arr = unpack_array(header, payload)
+            param = next((p for p, g in self.grad_of_param.items()
+                          if g == name), None)
+            if param is None:
+                return {"status": f"unknown grad {name!r}"}, b""
+            with self._batch_cv:
+                if self.sync_mode:
+                    self._grad_buf[param].append(arr)
+                else:
+                    self._apply_param(param, [arr])
+            return {"status": "ok"}, b""
+
+        if method == "send_barrier":
+            with self._batch_cv:
+                if self.sync_mode:
+                    self._barrier_count += 1
+                    if self._barrier_count >= max(1, self._live_count()):
+                        self._apply_batch_locked()
+                    else:
+                        batch = self._batch_id
+                        # wake periodically to re-check liveness: if a
+                        # trainer died (HeartBeatMonitor), the survivors'
+                        # barrier must not deadlock (heart_beat_monitor.h
+                        # LostWorkerMonitor:104 motivates exactly this)
+                        while not (self._batch_id > batch
+                                   or len(self._completed) >= self.fanin):
+                            self._batch_cv.wait(timeout=1.0)
+                            if self._batch_id > batch:
+                                break
+                            if self._barrier_count >= max(
+                                    1, self._live_count()):
+                                self._apply_batch_locked()
+                                break
+            return {"status": "ok"}, b""
+
+        if method == "get_var":
+            name = header["name"]
+            if not self.scope.has(name):
+                return {"status": f"unknown var {name!r}"}, b""
+            meta, data = pack_array(np.asarray(self.scope.get(name)))
+            return {"status": "ok", **meta}, data
+
+        if method == "fetch_barrier":
+            return {"status": "ok"}, b""
+
+        if method == "geo_push_pull":
+            name = header["name"]
+            delta = unpack_array(header, payload)
+            with self._batch_cv:
+                if not self.scope.has(name):
+                    return {"status": f"unknown var {name!r}"}, b""
+                cur = np.asarray(self.scope.get(name))
+                self.scope.set(name, cur + delta)
+            meta, data = pack_array(np.asarray(self.scope.get(name)))
+            return {"status": "ok", **meta}, data
+
+        if method == "complete":
+            with self._batch_cv:
+                self._completed.add(tid)
+                self.monitor.update(tid, "COMPLETED")
+                if self.sync_mode and self._barrier_count >= max(
+                        1, self._live_count()):
+                    self._apply_batch_locked()
+                self._batch_cv.notify_all()
+            return {"status": "ok"}, b""
+
+        if method == "ping":
+            return {"status": "ok"}, b""
+
+        return {"status": f"unknown method {method!r}"}, b""
+
+
+def run_pserver(pserver_program, startup_program=None, scope=None,
+                block: bool = True) -> PServerRuntime:
+    """Executor entry for a program whose main block is listen_and_serv
+    (reference: exe.run(pserver_program) blocks in the server loop)."""
+    rt = PServerRuntime(pserver_program, startup_program, scope)
+    rt.start()
+    if block:
+        rt.wait_all_completed()
+        rt.stop()
+    return rt

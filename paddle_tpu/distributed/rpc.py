@@ -1,0 +1,200 @@
+"""Host-side RPC for parameter-server training.
+
+Reference: operators/distributed/ — `RPCClient`/`RPCServer` (rpc_client.h:34,
+rpc_server.h:48) over gRPC/BRPC with protobuf-framed tensors
+(sendrecvop_utils.cc, send_recv.proto.in). The TPU rebuild keeps the PS
+topology host-side (SURVEY.md §2.8: the RPC stack maps to DCN/host gRPC);
+this module is a dependency-free equivalent: length-prefixed JSON header +
+raw ndarray payload over TCP, persistent connection per trainer, threaded
+server.
+"""
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+from typing import Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["RPCClient", "RPCServer", "send_msg", "recv_msg"]
+
+
+def _recvn(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return buf
+
+
+def send_msg(sock: socket.socket, header: dict, payload: bytes = b""):
+    h = json.dumps(header).encode()
+    sock.sendall(struct.pack("<II", len(h), len(payload)) + h + payload)
+
+
+def recv_msg(sock: socket.socket) -> Tuple[dict, bytes]:
+    hlen, plen = struct.unpack("<II", _recvn(sock, 8))
+    header = json.loads(_recvn(sock, hlen).decode())
+    payload = _recvn(sock, plen) if plen else b""
+    return header, payload
+
+
+def pack_array(arr: np.ndarray) -> Tuple[dict, bytes]:
+    arr = np.ascontiguousarray(arr)
+    return ({"dtype": str(arr.dtype), "shape": list(arr.shape)},
+            arr.tobytes())
+
+
+def unpack_array(meta: dict, payload: bytes) -> np.ndarray:
+    return np.frombuffer(payload, dtype=np.dtype(meta["dtype"])).reshape(
+        meta["shape"]).copy()
+
+
+class RPCServer:
+    """Threaded request server: handler(header, payload) -> (header, payload).
+
+    The handler may block (sync-mode barrier semantics live in the
+    handler, mirroring listen_and_serv's batch barriers, rpc_server.h:48).
+    """
+
+    def __init__(self, endpoint: str,
+                 handler: Callable[[dict, bytes], Tuple[dict, bytes]]):
+        host, port = endpoint.rsplit(":", 1)
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, int(port)))
+        self._sock.listen(64)
+        self.endpoint = f"{host}:{self._sock.getsockname()[1]}"
+        self._handler = handler
+        self._running = False
+
+    def start(self):
+        self._running = True
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+
+    def _accept_loop(self):
+        while self._running:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True).start()
+
+    def _serve_conn(self, conn: socket.socket):
+        try:
+            while True:
+                header, payload = recv_msg(conn)
+                out_h, out_p = self._handler(header, payload)
+                send_msg(conn, out_h, out_p)
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def stop(self):
+        self._running = False
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class RPCClient:
+    """Persistent-connection client (reference grpc_client.h:190
+    AsyncSendVar/AsyncGetVar — here calls are synchronous; the executor's
+    ordered host callbacks serialize them anyway)."""
+
+    _lock = threading.Lock()
+    _instances: Dict[int, "RPCClient"] = {}
+
+    def __init__(self, trainer_id: int = 0):
+        self.trainer_id = trainer_id
+        self._conns: Dict[str, socket.socket] = {}
+        self._conn_lock = threading.Lock()
+        self._ep_locks: Dict[str, threading.Lock] = {}
+
+    @classmethod
+    def instance(cls, trainer_id: int = 0) -> "RPCClient":
+        with cls._lock:
+            if trainer_id not in cls._instances:
+                cls._instances[trainer_id] = cls(trainer_id)
+            return cls._instances[trainer_id]
+
+    def _conn(self, endpoint: str) -> socket.socket:
+        with self._conn_lock:
+            if endpoint not in self._conns:
+                host, port = endpoint.rsplit(":", 1)
+                s = socket.create_connection((host, int(port)), timeout=120)
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                self._conns[endpoint] = s
+                self._ep_locks[endpoint] = threading.Lock()
+            return self._conns[endpoint]
+
+    def _call(self, endpoint: str, header: dict,
+              payload: bytes = b"") -> Tuple[dict, bytes]:
+        header = dict(header, trainer_id=self.trainer_id)
+        conn = self._conn(endpoint)
+        # one in-flight request per connection: a request/response pair must
+        # not interleave with another thread's on the same socket
+        with self._ep_locks[endpoint]:
+            send_msg(conn, header, payload)
+            return recv_msg(conn)
+
+    # -- verbs (reference rpc_client.h) --------------------------------
+    def send_var(self, endpoint: str, name: str, arr: np.ndarray):
+        meta, payload = pack_array(np.asarray(arr))
+        h, _ = self._call(endpoint, {"method": "send_var", "name": name,
+                                     **meta}, payload)
+        if h.get("status") != "ok":
+            raise RuntimeError(f"send_var({name}) -> {h}")
+
+    def get_var(self, endpoint: str, name: str) -> np.ndarray:
+        h, p = self._call(endpoint, {"method": "get_var", "name": name})
+        if h.get("status") != "ok":
+            raise RuntimeError(f"get_var({name}) -> {h}")
+        return unpack_array(h, p)
+
+    def send_barrier(self, endpoint: str):
+        self._call(endpoint, {"method": "send_barrier"})
+
+    def fetch_barrier(self, endpoint: str):
+        self._call(endpoint, {"method": "fetch_barrier"})
+
+    def send_complete(self, endpoint: str):
+        try:
+            self._call(endpoint, {"method": "complete"})
+        except (ConnectionError, OSError):
+            pass
+
+    def ping(self, endpoint: str):
+        self._call(endpoint, {"method": "ping"})
+
+    def geo_push_pull(self, endpoint: str, name: str,
+                      delta: np.ndarray) -> np.ndarray:
+        meta, payload = pack_array(np.asarray(delta))
+        h, p = self._call(endpoint, {"method": "geo_push_pull",
+                                     "name": name, **meta}, payload)
+        if h.get("status") != "ok":
+            raise RuntimeError(f"geo_push_pull({name}) -> {h}")
+        return unpack_array(h, p)
+
+    def close(self):
+        with self._conn_lock:
+            for s in self._conns.values():
+                try:
+                    s.close()
+                except OSError:
+                    pass
+            self._conns.clear()
+
+    @classmethod
+    def reset_all(cls):
+        with cls._lock:
+            for c in cls._instances.values():
+                c.close()
+            cls._instances.clear()

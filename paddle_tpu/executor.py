@@ -63,6 +63,16 @@ class Executor:
             program = compiled.program
 
         scope = scope or global_scope()
+
+        # A listen_and_serv program IS the parameter-server loop: block in
+        # the host-side runtime instead of lowering (the reference's
+        # exe.run(pserver_prog) does the same, listen_and_serv_op.cc).
+        if any(op.type == "listen_and_serv"
+               for op in program.global_block().ops):
+            from .distributed.ps_server import run_pserver
+            run_pserver(program, scope=scope)
+            return []
+
         feed = dict(feed or {})
         fetch_list = list(fetch_list or [])
         fetch_names = [v.name if isinstance(v, Variable) else str(v)
@@ -70,6 +80,12 @@ class Executor:
 
         block = program.global_block()
         feed_arrays = self._prepare_feed(block, feed, compiled)
+
+        # Surface fetch targets hidden inside recompute sub-blocks BEFORE
+        # keying the cache: the rewrite mutates the program fingerprint
+        # (parallel/recompute.py).
+        from .parallel.recompute import expose_fetch_vars
+        expose_fetch_vars(program, fetch_names)
 
         key = self._cache_key(program, feed_arrays, fetch_names, compiled)
         step_fn = self._cache.get(key) if use_program_cache else None
@@ -126,10 +142,6 @@ class Executor:
 
     def _compile(self, program, block, feed_arrays, fetch_names, scope,
                  compiled) -> _CompiledStep:
-        # Fetch targets hidden inside recompute sub-blocks must be surfaced
-        # as segment outputs first (parallel/recompute.py).
-        from .parallel.recompute import expose_fetch_vars
-        expose_fetch_vars(program, fetch_names)
         # State-in: persistables already initialised in scope OR consumed
         # by some op before being produced.
         persistables = {v.name for v in program.list_vars() if v.persistable}

@@ -1,0 +1,4 @@
+from . import base  # noqa: F401
+from . import collective  # noqa: F401
+from . import parameter_server  # noqa: F401
+from .base import role_maker  # noqa: F401

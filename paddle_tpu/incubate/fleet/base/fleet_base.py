@@ -1,0 +1,98 @@
+"""Fleet: unified distributed-training front end.
+
+Reference: python/paddle/fluid/incubate/fleet/base/fleet_base.py:38 —
+`Fleet.init(role_maker)`, worker/server predicates, `init_worker`/
+`init_server`/`run_server`/`stop_worker`, and `distributed_optimizer`
+returning a DistributedOptimizer that transpiles during minimize.
+"""
+from __future__ import annotations
+
+import abc
+
+from .role_maker import PaddleCloudRoleMaker, RoleMakerBase
+
+__all__ = ["Fleet", "DistributedOptimizer"]
+
+
+class Fleet(metaclass=abc.ABCMeta):
+    def __init__(self):
+        self._role_maker: RoleMakerBase = None
+        self._optimizer = None
+        self._is_initialized = False
+
+    # -- predicates / topology (fleet_base.py:60-180) -------------------
+    def is_worker(self):
+        return self._role_maker.is_worker()
+
+    def is_server(self):
+        return self._role_maker.is_server()
+
+    def is_first_worker(self):
+        return self._role_maker.is_first_worker()
+
+    def worker_index(self):
+        return self._role_maker.worker_index()
+
+    def worker_num(self):
+        return self._role_maker.worker_num()
+
+    def server_num(self):
+        return self._role_maker.server_num()
+
+    def server_index(self):
+        return self._role_maker.server_index()
+
+    def worker_endpoints(self, to_string=False):
+        eps = self._role_maker.get_trainer_endpoints()
+        return ",".join(eps) if to_string else eps
+
+    def server_endpoints(self, to_string=False):
+        eps = self._role_maker.get_pserver_endpoints()
+        return ",".join(eps) if to_string else eps
+
+    # -- lifecycle ------------------------------------------------------
+    def init(self, role_maker=None):
+        self._role_maker = role_maker or PaddleCloudRoleMaker()
+        self._role_maker.generate_role()
+        self._is_initialized = True
+        return self
+
+    @abc.abstractmethod
+    def init_worker(self):
+        ...
+
+    @abc.abstractmethod
+    def init_server(self, model_dir=None):
+        ...
+
+    @abc.abstractmethod
+    def run_server(self):
+        ...
+
+    @abc.abstractmethod
+    def stop_worker(self):
+        ...
+
+    @abc.abstractmethod
+    def distributed_optimizer(self, optimizer, strategy=None):
+        ...
+
+
+class DistributedOptimizer(metaclass=abc.ABCMeta):
+    def __init__(self, optimizer, strategy=None):
+        self._optimizer = optimizer
+        self._strategy = strategy
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        return self._optimizer.backward(loss, startup_program,
+                                        parameter_list, no_grad_set,
+                                        callbacks)
+
+    def apply_gradients(self, params_grads):
+        return self._optimizer.apply_gradients(params_grads)
+
+    @abc.abstractmethod
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        ...

@@ -1,0 +1,108 @@
+"""Cluster role discovery.
+
+Reference: python/paddle/fluid/incubate/fleet/base/role_maker.py —
+RoleMakerBase subclasses discover whether this process is a WORKER or
+SERVER and the cluster endpoints, either from user args
+(UserDefinedRoleMaker) or from env vars set by the launcher
+(PaddleCloudRoleMaker; env names match the reference's launch.py).
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["Role", "RoleMakerBase", "UserDefinedRoleMaker",
+           "PaddleCloudRoleMaker"]
+
+
+class Role:
+    WORKER = 1
+    SERVER = 2
+
+
+class RoleMakerBase:
+    def __init__(self):
+        self._role = Role.WORKER
+        self._current_id = 0
+        self._worker_endpoints = []
+        self._server_endpoints = []
+        self._generated = False
+
+    def generate_role(self):
+        self._generated = True
+
+    def is_worker(self):
+        return self._role == Role.WORKER
+
+    def is_server(self):
+        return self._role == Role.SERVER
+
+    def is_first_worker(self):
+        return self.is_worker() and self._current_id == 0
+
+    def worker_index(self):
+        return self._current_id
+
+    def server_index(self):
+        return self._current_id
+
+    def worker_num(self):
+        return len(self._worker_endpoints) or 1
+
+    def server_num(self):
+        return len(self._server_endpoints)
+
+    def get_trainer_endpoints(self):
+        return list(self._worker_endpoints)
+
+    def get_pserver_endpoints(self):
+        return list(self._server_endpoints)
+
+
+class UserDefinedRoleMaker(RoleMakerBase):
+    def __init__(self, current_id=0, role=Role.WORKER, worker_num=1,
+                 server_endpoints=None, worker_endpoints=None):
+        super().__init__()
+        self._current_id = current_id
+        self._role = role
+        self._server_endpoints = list(server_endpoints or [])
+        self._worker_endpoints = list(
+            worker_endpoints or [""] * worker_num)
+
+
+class PaddleCloudRoleMaker(RoleMakerBase):
+    """Env-var discovery (the reference's launcher contract):
+    TRAINING_ROLE=TRAINER|PSERVER, PADDLE_TRAINER_ID,
+    PADDLE_TRAINERS_NUM, PADDLE_PSERVERS_IP_PORT_LIST,
+    PADDLE_CURRENT_ENDPOINT, PADDLE_TRAINER_ENDPOINTS."""
+
+    def __init__(self, is_collective=False):
+        super().__init__()
+        self._is_collective = is_collective
+
+    def generate_role(self):
+        role = os.environ.get("TRAINING_ROLE", "TRAINER").upper()
+        self._role = Role.SERVER if role == "PSERVER" else Role.WORKER
+        self._server_endpoints = [
+            e for e in os.environ.get(
+                "PADDLE_PSERVERS_IP_PORT_LIST", "").split(",") if e]
+        self._worker_endpoints = [
+            e for e in os.environ.get(
+                "PADDLE_TRAINER_ENDPOINTS", "").split(",") if e]
+        if self._role == Role.SERVER:
+            cur = os.environ.get("PADDLE_CURRENT_ENDPOINT", "")
+            self._current_id = (self._server_endpoints.index(cur)
+                                if cur in self._server_endpoints else 0)
+            self._current_endpoint = cur
+        else:
+            self._current_id = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+            self._current_endpoint = os.environ.get(
+                "PADDLE_CURRENT_ENDPOINT", "")
+        n = os.environ.get("PADDLE_TRAINERS_NUM")
+        if n and not self._worker_endpoints:
+            self._worker_endpoints = [""] * int(n)
+        self._generated = True
+
+    def current_endpoint(self):
+        if not self._generated:
+            self.generate_role()
+        return self._current_endpoint

@@ -1,0 +1,113 @@
+"""Collective fleet: multi-process data-parallel training.
+
+Reference: python/paddle/fluid/incubate/fleet/collective/__init__.py —
+`Collective` fleet (:41), `DistributedStrategy(fluid.BuildStrategy)`
+(:94-108, adds local_sgd/recompute/nccl_comm_num/hierarchical_allreduce
+knobs) and `CollectiveOptimizer` (:142) whose minimize applies the
+collective transpiler. On TPU the transpiled c_allreduce ops ride XLA
+collectives over ICI; cross-host bootstrap is jax.distributed.initialize
+(the c_gen_nccl_id analogue) driven by the role maker's env contract.
+"""
+from __future__ import annotations
+
+from ....compiler import BuildStrategy
+from ....transpiler.collective import GradAllReduce, LocalSGD
+from ..base.fleet_base import DistributedOptimizer, Fleet
+
+__all__ = ["fleet", "Collective", "CollectiveOptimizer",
+           "DistributedStrategy"]
+
+
+class DistributedStrategy(BuildStrategy):
+    def __init__(self):
+        super().__init__()
+        self.use_local_sgd = False
+        self.local_sgd_steps = 1
+        self.use_dgc = False
+        self.forward_recompute = False
+        self.recompute_checkpoints = []
+        self.use_amp = False
+        self.amp_loss_scaling = 2 ** 15
+        self.nccl_comm_num = 1
+        self.use_hierarchical_allreduce = False
+        self.hierarchical_allreduce_inter_nranks = 8
+        self.exec_strategy = None
+
+
+class Collective(Fleet):
+    def __init__(self):
+        super().__init__()
+        self.main_program = None
+        self.startup_program = None
+
+    def init_worker(self):
+        # multi-host: one jax process per host joins the platform topology
+        # (the c_gen_nccl_id + c_comm_init analogue, SURVEY.md §2.8)
+        import jax
+
+        eps = self.worker_endpoints()
+        if len([e for e in eps if e]) > 1:
+            try:
+                jax.distributed.initialize(
+                    coordinator_address=eps[0],
+                    num_processes=len(eps),
+                    process_id=self.worker_index())
+            except (RuntimeError, ValueError):
+                pass  # already initialized (or single-process test run)
+
+    def init_server(self, model_dir=None):
+        raise NotImplementedError("collective mode has no servers")
+
+    def run_server(self):
+        raise NotImplementedError("collective mode has no servers")
+
+    def stop_worker(self):
+        pass
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        self._optimizer = CollectiveOptimizer(optimizer, strategy, self)
+        return self._optimizer
+
+
+class CollectiveOptimizer(DistributedOptimizer):
+    def __init__(self, optimizer, strategy=None, fleet_ref=None):
+        super().__init__(optimizer, strategy or DistributedStrategy())
+        self._fleet = fleet_ref
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        from ....framework import (default_main_program,
+                                   default_startup_program)
+
+        opt = self._optimizer
+        s = self._strategy
+        if getattr(s, "forward_recompute", False):
+            from ....optimizer import RecomputeOptimizer
+            opt = RecomputeOptimizer(opt)
+            opt._set_checkpoints(list(s.recompute_checkpoints))
+        if getattr(s, "use_amp", False):
+            from ....contrib.mixed_precision import decorate
+            opt = decorate(opt, init_loss_scaling=s.amp_loss_scaling)
+
+        ret = opt.minimize(loss, startup_program, parameter_list,
+                           no_grad_set)
+
+        f = self._fleet
+        main = loss.block.program
+        startup = startup_program or default_startup_program()
+        rank = f.worker_index() if f else 0
+        eps = (f.worker_endpoints() if f else [""]) or [""]
+        cur = eps[rank] if rank < len(eps) else ""
+        nrings = getattr(s, "nccl_comm_num", 1) or 1
+        if getattr(s, "use_local_sgd", False):
+            t = LocalSGD(nrings=nrings,
+                         k_steps=getattr(s, "local_sgd_steps", 1))
+        else:
+            t = GradAllReduce(nrings=nrings)
+        t.transpile(startup, main, rank, eps, cur)
+        if f is not None:
+            f.main_program, f.startup_program = main, startup
+        return ret
+
+
+fleet = Collective()

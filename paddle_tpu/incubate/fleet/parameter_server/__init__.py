@@ -1,0 +1,92 @@
+"""Parameter-server fleet (transpiler-backed).
+
+Reference: python/paddle/fluid/incubate/fleet/parameter_server/
+distribute_transpiler/__init__.py — fleet wraps DistributeTranspiler:
+`distributed_optimizer(...).minimize(loss)` transpiles; workers run the
+rewritten trainer program, servers run listen_and_serv
+(ps_server.PServerRuntime here).
+"""
+from __future__ import annotations
+
+from ....transpiler import DistributeTranspiler, DistributeTranspilerConfig
+from ..base.fleet_base import DistributedOptimizer, Fleet
+
+__all__ = ["fleet", "ParameterServerFleet", "TranspilerOptimizer"]
+
+
+class ParameterServerFleet(Fleet):
+    def __init__(self):
+        super().__init__()
+        self._transpiler: DistributeTranspiler = None
+        self.main_program = None
+        self.startup_program = None
+        self._server_runtime = None
+
+    # -- worker side ----------------------------------------------------
+    def init_worker(self):
+        pass  # connections open lazily on first send
+
+    def stop_worker(self):
+        from ....distributed.rpc import RPCClient
+
+        c = RPCClient.instance(self.worker_index())
+        for ep in self.server_endpoints():
+            c.send_complete(ep)
+        c.close()
+
+    # -- server side ----------------------------------------------------
+    def init_server(self, model_dir=None):
+        from ....core.scope import global_scope
+        from ....executor import Executor
+
+        ep = self._role_maker.current_endpoint()
+        self.pserver_program = self._transpiler.get_pserver_program(ep)
+        pserver_startup = self._transpiler.get_startup_program(
+            ep, self.pserver_program)
+        Executor().run(pserver_startup, scope=global_scope())
+        if model_dir:
+            from .... import io as fio
+            fio.load_persistables(Executor(), model_dir,
+                                  main_program=self.pserver_program)
+
+    def run_server(self):
+        from ....core.scope import global_scope
+        from ....distributed.ps_server import run_pserver
+
+        self._server_runtime = run_pserver(
+            self.pserver_program, scope=global_scope(), block=True)
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        self._optimizer = TranspilerOptimizer(optimizer, strategy, self)
+        return self._optimizer
+
+
+class TranspilerOptimizer(DistributedOptimizer):
+    def __init__(self, optimizer, strategy=None, fleet_ref=None):
+        super().__init__(optimizer, strategy or
+                         DistributeTranspilerConfig())
+        self._fleet = fleet_ref
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        from ....framework import default_startup_program
+
+        ret = self._optimizer.minimize(loss, startup_program,
+                                       parameter_list, no_grad_set)
+        f = self._fleet
+        t = DistributeTranspiler(self._strategy)
+        t.transpile(
+            trainer_id=f.worker_index(),
+            program=loss.block.program,
+            pservers=",".join(f.server_endpoints()),
+            trainers=f.worker_num(),
+            startup_program=startup_program or default_startup_program())
+        f._transpiler = t
+        if f.is_worker():
+            f.main_program = t.get_trainer_program()
+            f.startup_program = (startup_program or
+                                 default_startup_program())
+        return ret
+
+
+fleet = ParameterServerFleet()

@@ -14,7 +14,8 @@ from .tensor import cast, create_global_var, fill_constant
 
 __all__ = ["exponential_decay", "natural_exp_decay", "inverse_time_decay",
            "polynomial_decay", "piecewise_decay", "noam_decay",
-           "cosine_decay", "linear_lr_warmup", "autoincreased_step_counter"]
+           "cosine_decay", "linear_lr_warmup", "autoincreased_step_counter",
+           "every_n_steps"]
 
 
 def autoincreased_step_counter(counter_name=None, begin=1, step=1):
@@ -32,6 +33,22 @@ def autoincreased_step_counter(counter_name=None, begin=1, step=1):
                   infer_shape=False)
     counter.stop_gradient = True
     return counter
+
+
+def every_n_steps(n, counter_name=None):
+    """Bool var true once every n executed steps (counter starts at 1, so
+    fires at steps n, 2n, ...). Shared trigger for gradient merge /
+    LocalSGD-style periodic ops."""
+    from ..framework import unique_name
+    from .control_flow import equal
+    from .math_ops import elementwise_mod
+    from .tensor import fill_constant
+
+    step = autoincreased_step_counter(
+        counter_name=counter_name or unique_name.generate("@EVERY_N_STEP@"))
+    n_var = fill_constant([1], "int64", n)
+    zero = fill_constant([1], "int64", 0)
+    return equal(elementwise_mod(step, n_var), zero)
 
 
 def _fstep():

@@ -1,0 +1,131 @@
+"""Parameter-server communication ops: send/recv/barriers/geo-SGD.
+
+Reference: operators/distributed_ops/ (send_op, recv_op, send_barrier,
+fetch_barrier) calling into the gRPC RPCClient (grpc_client.h:190). Here
+each op lowers to an ORDERED jax host callback invoking
+paddle_tpu.distributed.rpc.RPCClient — the host↔device boundary the
+reference crosses per-op with gRPC happens via XLA's host-callback
+mechanism, and ordered=True preserves the reference's program-order
+send→barrier→recv choreography inside the single jitted step.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import io_callback
+
+from ..core.dtypes import as_np_dtype
+from ..core.registry import register_op
+
+
+def _client(attrs):
+    from ..distributed.rpc import RPCClient
+    return RPCClient.instance(int(attrs.get("trainer_id", 0)))
+
+
+@register_op("send", nondiff_inputs=("X",))
+def _send(ctx, ins, attrs):
+    x = ins["X"][0]
+    endpoint, name = attrs["endpoint"], attrs["var_name"]
+
+    def cb(arr):
+        _client(attrs).send_var(endpoint, name, np.asarray(arr))
+        return np.uint32(0)
+
+    token = io_callback(cb, jax.ShapeDtypeStruct((), jnp.uint32), x,
+                        ordered=True)
+    return {"Out": [token]}
+
+
+@register_op("send_barrier")
+def _send_barrier(ctx, ins, attrs):
+    eps = list(attrs["endpoints"])
+
+    def cb():
+        c = _client(attrs)
+        for ep in eps:
+            c.send_barrier(ep)
+        return np.uint32(0)
+
+    token = io_callback(cb, jax.ShapeDtypeStruct((), jnp.uint32),
+                        ordered=True)
+    return {"Out": [token]}
+
+
+@register_op("fetch_barrier")
+def _fetch_barrier(ctx, ins, attrs):
+    eps = list(attrs["endpoints"])
+
+    def cb():
+        c = _client(attrs)
+        for ep in eps:
+            c.fetch_barrier(ep)
+        return np.uint32(0)
+
+    token = io_callback(cb, jax.ShapeDtypeStruct((), jnp.uint32),
+                        ordered=True)
+    return {"Out": [token]}
+
+
+@register_op("recv")
+def _recv(ctx, ins, attrs):
+    endpoint, name = attrs["endpoint"], attrs["var_name"]
+    v = ctx.block.var(name)
+    sds = jax.ShapeDtypeStruct(tuple(v.shape), as_np_dtype(v.dtype))
+
+    def cb():
+        return _client(attrs).get_var(endpoint, name).astype(sds.dtype)
+
+    return {"Out": [io_callback(cb, sds, ordered=True)]}
+
+
+# ---------------------------------------------------------------------------
+# Geo-SGD: local steps + periodic delta push/pull (GeoSgdCommunicator,
+# operators/distributed/communicator.h:326)
+# ---------------------------------------------------------------------------
+
+class _GeoState:
+    _lock = threading.Lock()
+    _stores = {}
+
+    @classmethod
+    def store(cls, trainer_id):
+        with cls._lock:
+            return cls._stores.setdefault(trainer_id,
+                                          {"snap": {}, "count": {}})
+
+    @classmethod
+    def reset(cls):
+        with cls._lock:
+            cls._stores.clear()
+
+
+@register_op("geo_sgd_send", inplace=True)
+def _geo_sgd_send(ctx, ins, attrs):
+    x = ins["X"][0]
+    endpoint, name = attrs["endpoint"], attrs["var_name"]
+    push_nums = int(attrs.get("push_nums", 100))
+    tid = int(attrs.get("trainer_id", 0))
+
+    def cb(arr):
+        arr = np.asarray(arr)
+        st = _GeoState.store(tid)
+        if name not in st["snap"]:
+            st["snap"][name] = arr.copy()
+            st["count"][name] = 0
+            return arr
+        st["count"][name] += 1
+        if st["count"][name] % push_nums:
+            return arr
+        delta = arr - st["snap"][name]
+        new = _client(attrs).geo_push_pull(endpoint, name, delta)
+        new = new.astype(arr.dtype)
+        st["snap"][name] = new.copy()
+        return new
+
+    out = io_callback(cb, jax.ShapeDtypeStruct(x.shape, x.dtype), x,
+                      ordered=True)
+    return {"Out": [out]}

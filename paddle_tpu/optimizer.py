@@ -710,9 +710,9 @@ class GradientMergeOptimizer:
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):
-        from .layers import math_ops, nn as nn_layers, tensor as tlayers
-        from .layers.control_flow import _CondBlockGuard, equal
-        from .layers.learning_rate_scheduler import autoincreased_step_counter
+        from .layers import nn as nn_layers
+        from .layers.control_flow import _CondBlockGuard
+        from .layers.learning_rate_scheduler import every_n_steps
 
         params_grads = self.inner.backward(
             loss, startup_program, parameter_list, no_grad_set)
@@ -720,12 +720,9 @@ class GradientMergeOptimizer:
             return self.inner.apply_gradients(params_grads), params_grads
 
         block = default_main_program().current_block()
-        step = autoincreased_step_counter(
-            counter_name=unique_name.generate("@GRADIENT_MERGE_STEP@"),
-            begin=1)
-        k_var = tlayers.fill_constant([1], "int64", self.k_steps)
-        zero = tlayers.fill_constant([1], "int64", 0)
-        cond = equal(math_ops.elementwise_mod(step, k_var), zero)
+        cond = every_n_steps(
+            self.k_steps,
+            counter_name=unique_name.generate("@GRADIENT_MERGE_STEP@"))
 
         merged = []
         for p, g in params_grads:

@@ -1,0 +1,14 @@
+"""Program-rewrite-based distribution (reference python/paddle/fluid/
+transpiler/): collective data parallelism, parameter-server mode, geo-SGD.
+"""
+from .collective import Collective, GradAllReduce, LocalSGD  # noqa: F401
+from .distribute_transpiler import (DistributeTranspiler,  # noqa: F401
+                                    DistributeTranspilerConfig)
+from .geo_sgd_transpiler import GeoSgdTranspiler  # noqa: F401
+from .memory_optimization_transpiler import (memory_optimize,  # noqa: F401
+                                             release_memory)
+from .ps_dispatcher import HashName, PSDispatcher, RoundRobin  # noqa: F401
+
+__all__ = ["Collective", "GradAllReduce", "LocalSGD", "DistributeTranspiler",
+           "DistributeTranspilerConfig", "GeoSgdTranspiler", "HashName",
+           "PSDispatcher", "RoundRobin", "memory_optimize", "release_memory"]

@@ -1,0 +1,198 @@
+"""DistributeTranspiler: rewrite one program into trainer + pserver
+programs for parameter-server training.
+
+Reference: python/paddle/fluid/transpiler/distribute_transpiler.py —
+`transpile(trainer_id, program, pservers, trainers, sync_mode)` rewrites
+the trainer program (grads -> send ops to their pserver, recv ops for
+updated params, barriers in sync mode :216) and builds per-endpoint
+pserver programs whose listen_and_serv op (distributed_ops/
+listen_and_serv_op.cc) runs one optimizer sub-block per received grad.
+
+TPU-native differences: tensors move host-side over the
+paddle_tpu.distributed RPC runtime (DCN/gRPC analogue; SURVEY.md §2.8 —
+ICI collectives don't apply to the PS topology); the pserver's optimizer
+sub-blocks still lower to XLA and run on the pserver host's devices.
+Whole-var placement uses a PSDispatcher; the reference's `slice_var_up`
+block-slicing is not replicated (GSPMD sharding is the TPU answer to
+oversized vars).
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+from ..framework import Program
+from .ps_dispatcher import PSDispatcher, RoundRobin
+from .util import optimize_ops as _optimize_ops
+
+__all__ = ["DistributeTranspiler", "DistributeTranspilerConfig"]
+
+
+class DistributeTranspilerConfig:
+    """Knob-compatible subset (reference distribute_transpiler.py:131)."""
+
+    slice_var_up = False
+    split_method = RoundRobin
+    min_block_size = 8192
+    sync_mode = True
+    runtime_split_send_recv = False
+    geo_sgd_mode = False
+    geo_sgd_need_push_nums = 100
+
+
+class DistributeTranspiler:
+    def __init__(self, config: DistributeTranspilerConfig = None):
+        self.config = config or DistributeTranspilerConfig()
+
+    # ------------------------------------------------------------------
+    def transpile(self, trainer_id, program=None, pservers="127.0.0.1:6174",
+                  trainers=1, sync_mode=None, startup_program=None,
+                  current_endpoint=""):
+        from ..framework import default_main_program, default_startup_program
+
+        self.trainer_id = trainer_id
+        self.trainer_num = trainers
+        self.sync_mode = self.config.sync_mode if sync_mode is None \
+            else sync_mode
+        self.origin_program = program or default_main_program()
+        self.startup_program = startup_program or default_startup_program()
+        self.pserver_endpoints = (pservers.split(",")
+                                  if isinstance(pservers, str) else
+                                  list(pservers))
+
+        block = self.origin_program.global_block()
+        self._opt_ops = _optimize_ops(block)
+        if not self._opt_ops:
+            raise ValueError("transpile() needs a program with optimizer "
+                             "ops (call minimize first)")
+        self._param_of_grad: Dict[str, str] = {}
+        params = []
+        for op in self._opt_ops:
+            p, g = op.inputs["Param"][0], op.inputs["Grad"][0]
+            self._param_of_grad[g] = p
+            params.append(block.var(p))
+        dispatcher: PSDispatcher = self.config.split_method(
+            self.pserver_endpoints)
+        self._ep_of_param = dict(
+            zip([p.name for p in params], dispatcher.dispatch(params)))
+        self._build_trainer_program()
+        return self
+
+    # ------------------------------------------------------------------
+    def _build_trainer_program(self):
+        """Clone the origin program minus optimizer ops; send each grad to
+        its param's pserver, then recv updated params (sync mode blocks on
+        the barrier inside the RPC layer)."""
+        self.trainer_program = self.origin_program.clone()
+        block = self.trainer_program.global_block()
+        opt_idx = {id(op) for op in _optimize_ops(block)}
+        block.ops = [op for op in block.ops if id(op) not in opt_idx]
+
+        for g, p in self._param_of_grad.items():
+            ep = self._ep_of_param[p]
+            block.append_op(
+                "send", inputs={"X": [g]}, outputs={},
+                attrs={"endpoint": ep, "var_name": g,
+                       "trainer_id": self.trainer_id,
+                       "sync_mode": self.sync_mode},
+                infer_shape=False)
+        if self.sync_mode:
+            block.append_op(
+                "send_barrier", inputs={}, outputs={},
+                attrs={"endpoints": self.pserver_endpoints,
+                       "trainer_id": self.trainer_id}, infer_shape=False)
+        for p, ep in self._ep_of_param.items():
+            block.append_op(
+                "recv", inputs={}, outputs={"Out": [p]},
+                attrs={"endpoint": ep, "var_name": p,
+                       "trainer_id": self.trainer_id}, infer_shape=False)
+        if self.sync_mode:
+            block.append_op(
+                "fetch_barrier", inputs={}, outputs={},
+                attrs={"endpoints": self.pserver_endpoints,
+                       "trainer_id": self.trainer_id}, infer_shape=False)
+        self.trainer_program._fp_cache = None
+
+    # ------------------------------------------------------------------
+    def get_trainer_program(self, wait_port=True) -> Program:
+        return self.trainer_program
+
+    def get_pserver_program(self, endpoint) -> Program:
+        """Program = vars owned by this endpoint + one listen_and_serv op
+        whose sub-blocks each run one param's optimizer ops."""
+        origin_block = self.origin_program.global_block()
+        prog = Program()
+        block = prog.global_block()
+
+        my_params = [p for p, ep in self._ep_of_param.items()
+                     if ep == endpoint]
+        opt_block_of: Dict[str, int] = {}
+        for p in my_params:
+            sub = prog._create_block(parent_idx=0)
+            for op in self._opt_ops:
+                if op.inputs["Param"][0] != p:
+                    continue
+                # copy referenced vars into the pserver program
+                for n in list(op.input_names()) + list(op.output_names()):
+                    if n and not block.has_var(n) \
+                            and origin_block.has_var(n):
+                        v = origin_block.var(n)
+                        block.create_var(
+                            name=n, shape=v.shape, dtype=v.dtype,
+                            persistable=True, stop_gradient=True)
+                sub.append_op(op.type, inputs=op.inputs,
+                              outputs=op.outputs, attrs=op.attrs,
+                              infer_shape=False)
+            prog._current_block_idx = 0
+            opt_block_of[p] = sub.idx
+
+        block.append_op(
+            "listen_and_serv", inputs={}, outputs={},
+            attrs={"endpoint": endpoint,
+                   "params": my_params,
+                   "grad_of_param": {p: g for g, p in
+                                     self._param_of_grad.items()},
+                   "opt_block_of": opt_block_of,
+                   "sync_mode": self.sync_mode,
+                   "Fanin": self.trainer_num},
+            infer_shape=False)
+        return prog
+
+    def get_startup_program(self, endpoint, pserver_program=None) -> Program:
+        """Init program for one pserver: only the vars it owns.
+
+        Ops are copied PRESERVING their original op ids: initializer
+        lowerings derive their PRNG streams from (program seed, op id)
+        (core/lowering.py rng_for), so id-preserving copies make the
+        pserver's param init bit-identical to a trainer running the full
+        startup program — the reference gets this "for free" by shipping
+        the same OpDescs around.
+        """
+        from ..framework import Operator
+
+        my_params = {p for p, ep in self._ep_of_param.items()
+                     if ep == endpoint}
+        # optimizer state (accumulators, lr) lives with the param's opt ops
+        needed = set(my_params)
+        for op in self._opt_ops:
+            if op.inputs["Param"][0] in my_params:
+                needed.update(n for n in op.input_names() if n)
+                needed.update(n for n in op.output_names() if n)
+        prog = Program()
+        prog.random_seed = self.startup_program.random_seed
+        block = prog.global_block()
+        src = self.startup_program.global_block()
+        for op in self.startup_program.global_block().ops:
+            outs = [n for n in op.output_names() if n]
+            if not outs or not all(o in needed for o in outs):
+                continue
+            for n in list(op.input_names()) + outs:
+                if n and not block.has_var(n) and src.has_var(n):
+                    v = src.var(n)
+                    block.create_var(name=n, shape=v.shape, dtype=v.dtype,
+                                     persistable=v.persistable,
+                                     stop_gradient=True)
+            new_op = Operator(block, op.type, op.inputs, op.outputs,
+                              op.attrs, op_id=op.id)
+            block.ops.append(new_op)
+        prog._fp_cache = None
+        return prog

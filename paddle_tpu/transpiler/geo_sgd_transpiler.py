@@ -1,0 +1,38 @@
+"""Geo-SGD transpiler: trainers train locally, periodically pushing
+parameter DELTAS to the pserver and pulling the merged global params.
+
+Reference: python/paddle/fluid/transpiler/geo_sgd_transpiler.py +
+GeoSgdCommunicator (operators/distributed/communicator.h:326) — each
+trainer keeps a snapshot of params; every `need_push_nums` steps it sends
+(param - snapshot), the pserver adds deltas into the global copy, and the
+trainer re-snapshots after pulling.
+"""
+from __future__ import annotations
+
+from .distribute_transpiler import (DistributeTranspiler,
+                                    DistributeTranspilerConfig)
+
+__all__ = ["GeoSgdTranspiler"]
+
+
+class GeoSgdTranspiler(DistributeTranspiler):
+    def __init__(self, config: DistributeTranspilerConfig = None):
+        config = config or DistributeTranspilerConfig()
+        config.geo_sgd_mode = True
+        config.sync_mode = False
+        super().__init__(config)
+
+    def _build_trainer_program(self):
+        """Trainer keeps its optimizer ops (local SGD steps); geo push/pull
+        ops mark the delta-sync points, executed by the Communicator every
+        geo_sgd_need_push_nums steps."""
+        self.trainer_program = self.origin_program.clone()
+        block = self.trainer_program.global_block()
+        for p, ep in self._ep_of_param.items():
+            block.append_op(
+                "geo_sgd_send", inputs={"X": [p]}, outputs={"Out": [p]},
+                attrs={"endpoint": ep, "var_name": p,
+                       "trainer_id": self.trainer_id,
+                       "push_nums": self.config.geo_sgd_need_push_nums},
+                infer_shape=False)
+        self.trainer_program._fp_cache = None

@@ -1,0 +1,21 @@
+"""Legacy var-reuse memory transpiler — API-compatible no-op.
+
+Reference: python/paddle/fluid/transpiler/memory_optimization_transpiler.py
+rewrote the program to reuse var buffers. On TPU the whole block compiles
+to one XLA computation whose buffer assignment already performs liveness
+analysis and buffer sharing (the same job as the reference's
+ir/memory_optimize_pass/), so there is nothing left for a source-level
+rewrite to do; the functions are kept so ported scripts run unchanged.
+"""
+from __future__ import annotations
+
+__all__ = ["memory_optimize", "release_memory"]
+
+
+def memory_optimize(input_program, skip_opt_set=None, print_log=False,
+                    level=0, skip_grads=True):
+    return None
+
+
+def release_memory(input_program, skip_opt_set=None):
+    return None

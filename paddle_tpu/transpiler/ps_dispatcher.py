@@ -1,0 +1,49 @@
+"""Parameter placement across parameter servers.
+
+Reference: python/paddle/fluid/transpiler/ps_dispatcher.py — RoundRobin
+and HashName policies deciding which pserver endpoint owns each variable.
+"""
+from __future__ import annotations
+
+__all__ = ["PSDispatcher", "RoundRobin", "HashName"]
+
+
+class PSDispatcher:
+    def __init__(self, pserver_endpoints):
+        self._eps = list(pserver_endpoints)
+        self._step = 0
+
+    @property
+    def eps(self):
+        return self._eps
+
+    def reset(self):
+        self._step = 0
+
+    def dispatch(self, varlist):
+        raise NotImplementedError
+
+
+class RoundRobin(PSDispatcher):
+    def dispatch(self, varlist):
+        out = []
+        for _ in varlist:
+            out.append(self._eps[self._step % len(self._eps)])
+            self._step += 1
+        return out
+
+
+class HashName(PSDispatcher):
+    """Stable hash of the var name (reference uses the same idea so that
+    trainer and pserver agree on placement without communication)."""
+
+    @staticmethod
+    def _hash(name: str) -> int:
+        h = 0
+        for c in name:
+            h = (h * 31 + ord(c)) & 0x7FFFFFFF
+        return h
+
+    def dispatch(self, varlist):
+        return [self._eps[self._hash(v.name if hasattr(v, "name") else str(v))
+                          % len(self._eps)] for v in varlist]
