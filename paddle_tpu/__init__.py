@@ -18,7 +18,8 @@ changing the import.
 from . import ops  # noqa: F401  — registers all op lowerings
 from .framework import (Program, program_guard, default_main_program,  # noqa: F401
                         default_startup_program, name_scope, unique_name,
-                        ParamAttr, Variable, in_dygraph_mode, cpu_places)
+                        ParamAttr, Variable, in_dygraph_mode, cpu_places,
+                        load_op_library)
 from .core.place import (CPUPlace, XLAPlace, TPUPlace, CUDAPlace,  # noqa: F401
                          CUDAPinnedPlace)
 from .core.scope import Scope, global_scope, scope_guard  # noqa: F401
@@ -39,6 +40,9 @@ from . import profiler  # noqa: F401
 from . import dataset  # noqa: F401
 from .dataset import DatasetFactory  # noqa: F401
 from . import contrib  # noqa: F401
+from . import datasets  # noqa: F401
+from . import inference  # noqa: F401
+from . import reader_decorator  # noqa: F401
 from . import transpiler  # noqa: F401
 from .transpiler import (DistributeTranspiler,  # noqa: F401
                          DistributeTranspilerConfig, memory_optimize,

@@ -1,0 +1,36 @@
+"""Built-in dataset readers (reference python/paddle/dataset/, 3.7k LoC:
+mnist/cifar/imdb/uci_housing/movielens/wmt14... download-and-parse
+generators).
+
+This environment has no network egress, so each corpus is a DETERMINISTIC
+SYNTHETIC GENERATOR with the reference's exact sample shapes, dtypes,
+vocabulary structure and reader API (train()/test() returning nullary
+reader creators). Training pipelines, feed shapes and tests are therefore
+drop-in compatible; accuracy numbers are not comparable to the real
+corpora. For mnist/cifar/uci_housing, set PADDLE_TPU_DATA_HOME to a
+directory containing <corpus>_<split>.npz files (arrays `x`, `y`) to
+train on real copies; the text corpora (imdb/movielens/wmt16) are
+synthetic-only.
+"""
+import os
+
+import numpy as np
+
+
+def real_data(name: str, split: str):
+    """Returns an (x, y) pair from $PADDLE_TPU_DATA_HOME/<name>_<split>.npz
+    or None when no real copy is installed."""
+    home = os.environ.get("PADDLE_TPU_DATA_HOME")
+    if not home:
+        return None
+    path = os.path.join(home, f"{name}_{split}.npz")
+    if not os.path.exists(path):
+        return None
+    blob = np.load(path)
+    return blob["x"], blob["y"]
+
+
+from . import cifar, imdb, mnist, movielens, uci_housing, wmt16  # noqa: F401,E402
+
+__all__ = ["mnist", "cifar", "uci_housing", "imdb", "movielens", "wmt16",
+           "real_data"]

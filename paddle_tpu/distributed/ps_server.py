@@ -34,6 +34,9 @@ class HeartBeatMonitor:
 
     def __init__(self, n_workers: int, timeout: float = 60.0):
         self.timeout = timeout
+        # never-connected workers count from monitor start, so a trainer
+        # that fails to launch is still detected as lost
+        self._start = time.monotonic()
         self._last_seen: Dict[int, float] = {}
         self._completed = set()
         self._lock = threading.Lock()
@@ -48,9 +51,13 @@ class HeartBeatMonitor:
     def lost_workers(self):
         now = time.monotonic()
         with self._lock:
-            return sorted(
-                w for w, t in self._last_seen.items()
-                if w not in self._completed and now - t > self.timeout)
+            lost = {w for w, t in self._last_seen.items()
+                    if w not in self._completed and now - t > self.timeout}
+            if now - self._start > self.timeout:
+                lost.update(w for w in range(self.n_workers)
+                            if w not in self._last_seen
+                            and w not in self._completed)
+            return sorted(lost)
 
 
 class PServerRuntime:
@@ -78,6 +85,9 @@ class PServerRuntime:
 
         # per-param optimizer programs (sub-block -> standalone Program)
         self._opt_progs = {p: self._opt_program(p) for p in self.params}
+        # lr-scheduler program: runs once per batch before the updates
+        lr_idx = ls.attrs.get("lr_block", -1)
+        self._lr_prog = self._block_program(lr_idx) if lr_idx >= 0 else None
 
         self.monitor = HeartBeatMonitor(self.fanin, heartbeat_timeout)
         self._lock = threading.Lock()
@@ -91,12 +101,15 @@ class PServerRuntime:
         self.endpoint = self._server.endpoint  # resolved port (":0" ok)
 
     # ------------------------------------------------------------------
-    def _opt_program(self, param):
-        from ..framework import Program
+    def _block_program(self, block_idx):
+        """Sub-block of the pserver program -> standalone Program
+        (op ids preserved: lr ops' PRNG/step determinism)."""
+        from ..framework import Operator, Program
 
         src = self.program
-        sub = src.blocks[self.opt_block_of[param]]
+        sub = src.blocks[block_idx]
         prog = Program()
+        prog.random_seed = src.random_seed
         blk = prog.global_block()
         src_g = src.global_block()
         for op in sub.ops:
@@ -105,9 +118,14 @@ class PServerRuntime:
                     v = src_g.var(n)
                     blk.create_var(name=n, shape=v.shape, dtype=v.dtype,
                                    persistable=True, stop_gradient=True)
-            blk.append_op(op.type, inputs=op.inputs, outputs=op.outputs,
-                          attrs=op.attrs, infer_shape=False)
+            new_op = Operator(blk, op.type, op.inputs, op.outputs,
+                              op.attrs, op_id=op.id)
+            blk.ops.append(new_op)
+        prog._fp_cache = None
         return prog
+
+    def _opt_program(self, param):
+        return self._block_program(self.opt_block_of[param])
 
     # ------------------------------------------------------------------
     def start(self):
@@ -119,7 +137,7 @@ class PServerRuntime:
     def wait_all_completed(self, timeout: Optional[float] = None):
         """Block until every trainer sent 'complete'. timeout=None blocks
         indefinitely (reference listen_and_serv semantics)."""
-        deadline = (time.monotonic() + timeout) if timeout else None
+        deadline = None if timeout is None else time.monotonic() + timeout
         with self._batch_cv:
             while len(self._completed) < self.fanin:
                 if deadline is not None and time.monotonic() >= deadline:
@@ -138,17 +156,22 @@ class PServerRuntime:
         lost = set(self.monitor.lost_workers())
         return self.fanin - len(self._completed | lost)
 
-    def _apply_param(self, param, grads):
+    def _apply_param(self, param, grads, tick_lr=True):
+        if tick_lr and self._lr_prog is not None:
+            # async mode: the schedule ticks per apply (no batch barrier)
+            self.exe.run(self._lr_prog, scope=self.scope)
         g_name = self.grad_of_param[param]
         merged = np.mean(grads, axis=0) if len(grads) > 1 else grads[0]
         self.scope.set(g_name, merged)
         self.exe.run(self._opt_progs[param], scope=self.scope)
 
     def _apply_batch_locked(self):
+        if self._lr_prog is not None:
+            self.exe.run(self._lr_prog, scope=self.scope)
         for p in self.params:
             buf = self._grad_buf[p]
             if buf:
-                self._apply_param(p, buf)
+                self._apply_param(p, buf, tick_lr=False)
                 self._grad_buf[p] = []
         self._applied_batch = self._batch_id
         self._batch_id += 1

@@ -322,3 +322,5 @@ from .nn import (Conv2D, Pool2D, FC, Linear, BatchNorm, Embedding,  # noqa: E402
                  LayerNorm, Dropout)
 from .parallel import DataParallel, prepare_context  # noqa: E402,F401
 from .base import grad  # noqa: E402,F401
+from . import jit  # noqa: E402,F401
+from .jit import TracedLayer  # noqa: E402,F401

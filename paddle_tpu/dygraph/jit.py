@@ -1,0 +1,123 @@
+"""Dygraph→static capture: the deploy bridge from eager mode.
+
+Reference: python/paddle/fluid/dygraph/jit.py:46 `TracedLayer.trace` over
+imperative/jit/ProgramDescTracer (program_desc_tracer.h:32) — re-runs of
+the traced layer go through an Executor on the captured ProgramDesc, and
+`save_inference_model` exports it for serving.
+
+Here the dygraph tape already records every executed op with stable var
+identities (dygraph.trace_op), so capture = replay the tape slice into a
+Program: parameters become persistable vars (values snapshotted into the
+TracedLayer's scope), leaf inputs become feeds.
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+__all__ = ["TracedLayer", "trace"]
+
+
+class TracedLayer:
+    def __init__(self, program, feed_names, fetch_names, param_values,
+                 startup_like=None):
+        from ..core.scope import Scope
+        from ..executor import Executor
+
+        self.program = program
+        self._feed_names = feed_names
+        self._fetch_names = fetch_names
+        self._scope = Scope()
+        for n, v in param_values.items():
+            self._scope.set(n, v)
+        self._exe = Executor()
+
+    @staticmethod
+    def trace(layer, inputs):
+        """Returns (outputs, traced_layer) — reference jit.py TracedLayer
+        API. Must run inside dygraph.guard()."""
+        from . import VarBase, _state
+
+        if not _state["enabled"] or _state["tape"] is None:
+            raise RuntimeError("TracedLayer.trace must run inside "
+                               "dygraph.guard() with gradients enabled")
+        inputs = list(inputs)
+        tape = _state["tape"]
+        start = len(tape)
+        outputs = layer(*inputs)
+        out_list = outputs if isinstance(outputs, (list, tuple)) \
+            else [outputs]
+        entries = tape[start:]
+        program, feed_names, fetch_names, params = _capture(
+            entries, inputs, out_list)
+        return outputs, TracedLayer(program, feed_names, fetch_names,
+                                    params)
+
+    def __call__(self, inputs):
+        feed = {n: (v.numpy() if hasattr(v, "numpy") else np.asarray(v))
+                for n, v in zip(self._feed_names, inputs)}
+        return self._exe.run(self.program, feed=feed,
+                             fetch_list=self._fetch_names,
+                             scope=self._scope)
+
+    def save_inference_model(self, dirname, feed=None, fetch=None):
+        from .. import io as fio
+        from ..core.scope import scope_guard
+
+        with scope_guard(self._scope):
+            fio.save_inference_model(
+                dirname, self._feed_names,
+                [self.program.global_block().var(n)
+                 for n in self._fetch_names],
+                self._exe, main_program=self.program)
+
+
+def _capture(entries, inputs, outputs):
+    """Tape slice -> Program. Vars keep their eager names; anything read
+    before being produced is either a traced input (feed) or a parameter
+    (persistable, value snapshotted)."""
+    from ..framework import Program
+
+    program = Program()
+    block = program.global_block()
+    produced = set()
+    params: Dict[str, np.ndarray] = {}
+    input_names = {v.name for v in inputs}
+
+    def ensure_var(v, persistable=False):
+        if not block.has_var(v.name):
+            block.create_var(name=v.name, shape=tuple(v.shape),
+                             dtype=v.dtype, persistable=persistable,
+                             stop_gradient=True)
+
+    for v in inputs:
+        ensure_var(v)
+
+    for e in entries:
+        for slot, vs in e.ins.items():
+            for v in vs:
+                if v.name in produced or v.name in input_names:
+                    ensure_var(v)
+                    continue
+                # read-before-write: a captured constant/parameter
+                ensure_var(v, persistable=True)
+                params.setdefault(v.name, np.asarray(v.value))
+        for slot, vs in e.outs.items():
+            for v in vs:
+                ensure_var(v)
+                produced.add(v.name)
+        block.append_op(
+            e.op_type,
+            inputs={s: [v.name for v in vs] for s, vs in e.ins.items()},
+            outputs={s: [v.name for v in vs] for s, vs in e.outs.items()},
+            attrs=dict(e.attrs), infer_shape=False)
+
+    feed_names = [v.name for v in inputs]
+    fetch_names = [v.name for v in outputs]
+    return program, feed_names, fetch_names, params
+
+
+def trace(layer, inputs):
+    """Module-level alias (reference dygraph.jit.trace)."""
+    return TracedLayer.trace(layer, inputs)

@@ -482,3 +482,31 @@ class ParamAttr:
         if isinstance(arg, Initializer):
             return ParamAttr(initializer=arg)
         raise TypeError(f"bad ParamAttr spec {arg!r}")
+
+
+def load_op_library(lib_path):
+    """Load user-defined ops into the registry.
+
+    Reference: framework.py:4752 fluid.load_op_library dlopens a C++ op
+    .so and merges its registrations into OpInfoMap
+    (framework/load_op_lib.h:42). The TPU-native custom-op contract is a
+    PYTHON module that calls paddle_tpu.core.registry.register_op with a
+    jax/pallas lowering (the analogue of tests/custom_op/relu_op.cc) —
+    pass its .py path. Returns the list of newly registered op types.
+    """
+    from .core.registry import REGISTRY
+
+    if not lib_path.endswith(".py"):
+        raise ValueError(
+            "load_op_library takes a .py module registering jax/pallas "
+            "lowerings via paddle_tpu.core.registry.register_op; native "
+            "code belongs inside the kernel (pallas) or the runtime "
+            "(native/), not in per-op .so plugins")
+    import importlib.util
+
+    before = set(REGISTRY.types())
+    spec = importlib.util.spec_from_file_location(
+        f"paddle_tpu_custom_{abs(hash(lib_path))}", lib_path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return sorted(set(REGISTRY.types()) - before)

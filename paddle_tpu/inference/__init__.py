@@ -1,0 +1,16 @@
+"""Deployment stack (reference paddle/fluid/inference/, SURVEY.md §2.6).
+
+The reference ships a separate C++ predictor with an analysis-pass
+pipeline and subgraph engines (TensorRT/Anakin/nGraph). On TPU the engine
+IS the compiler: a saved inference program (io.save_inference_model)
+lowers whole to one XLA computation, and `AnalysisPredictor` caches the
+compiled executable per input-shape set. `export_stablehlo` produces the
+portable AOT serving artifact. The C-ABI surface lives in native/src/
+(runtime data feed / buffers); program+params files are
+JSON + npz, loadable from any language.
+"""
+from .api import (AnalysisConfig, AnalysisPredictor,  # noqa: F401
+                  PaddleTensor, ZeroCopyTensor, create_paddle_predictor)
+
+__all__ = ["AnalysisConfig", "AnalysisPredictor", "PaddleTensor",
+           "ZeroCopyTensor", "create_paddle_predictor"]

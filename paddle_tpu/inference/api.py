@@ -1,0 +1,232 @@
+"""Inference/serving API.
+
+Reference: paddle/fluid/inference/api/ — `AnalysisConfig` +
+`AnalysisPredictor` (analysis_predictor.cc:78 Init, :223 Run, :461
+OptimizeInferenceProgram, :478 factory) with the ZeroCopyTensor interface,
+over a pass-managed optimized program.
+
+TPU-native mapping: the "analysis" phase is program pruning to the
+feed→fetch slice (done at save time, io.py) plus whole-program XLA
+compilation — constant folding, fusion and memory planning are XLA passes,
+so there is no separate pass manager to re-implement (the reference's
+nGraph/TensorRT subgraph engines are precedent; here the subgraph is
+always the whole program). AOT deployment exports the compiled function
+as portable StableHLO (`export_stablehlo`), the serving-artifact analogue
+of the reference's serialized TensorRT engines.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["AnalysisConfig", "AnalysisPredictor", "PaddleTensor",
+           "ZeroCopyTensor", "create_paddle_predictor"]
+
+
+class AnalysisConfig:
+    """Knob-compatible subset of paddle_analysis_config.h."""
+
+    def __init__(self, model_dir: Optional[str] = None,
+                 prog_file: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        self._model_dir = model_dir
+        self._prog_file = prog_file
+        self._params_file = params_file
+        self._use_feed_fetch_ops = True
+        self._ir_optim = True
+        self._memory_optim = True
+        self._use_device = "tpu"
+        self._math_threads = 1
+
+    # -- model location -------------------------------------------------
+    def set_model(self, x, y=None):
+        if y is None:
+            self._model_dir = x
+        else:
+            self._prog_file, self._params_file = x, y
+
+    def model_dir(self):
+        return self._model_dir
+
+    def prog_file(self):
+        return self._prog_file
+
+    def params_file(self):
+        return self._params_file
+
+    # -- toggles (XLA owns the optimizations these gated) ---------------
+    def switch_ir_optim(self, flag=True):
+        self._ir_optim = flag
+
+    def enable_memory_optim(self, flag=True):
+        self._memory_optim = flag
+
+    def switch_use_feed_fetch_ops(self, flag=True):
+        self._use_feed_fetch_ops = flag
+
+    def disable_gpu(self):
+        self._use_device = "cpu"
+
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        self._use_device = "tpu"  # device parity: the accelerator is TPU
+
+    def enable_tensorrt_engine(self, **kw):
+        raise NotImplementedError(
+            "TensorRT does not exist on TPU; the whole program is one XLA "
+            "computation already (see module docstring)")
+
+    def use_gpu(self):
+        return self._use_device == "tpu"
+
+    def set_cpu_math_library_num_threads(self, n):
+        self._math_threads = n
+
+
+class PaddleTensor:
+    """Input/output value for Predictor.run (paddle_api.h PaddleTensor)."""
+
+    def __init__(self, data=None, name=""):
+        self.name = name
+        self.data = np.asarray(data) if data is not None else None
+
+    @property
+    def shape(self):
+        return list(self.data.shape)
+
+    def as_ndarray(self):
+        return self.data
+
+
+class ZeroCopyTensor:
+    """Handle bound to one predictor input/output slot
+    (zero_copy_tensor.cc): copy_from_cpu stages the feed, copy_to_cpu
+    reads the result after zero_copy_run."""
+
+    def __init__(self, name, store: Dict[str, np.ndarray]):
+        self._name = name
+        self._store = store
+
+    def copy_from_cpu(self, arr):
+        self._store[self._name] = np.asarray(arr)
+
+    def copy_to_cpu(self):
+        return self._store[self._name]
+
+    def reshape(self, shape):
+        pass  # shapes are taken from the staged array
+
+    @property
+    def name(self):
+        return self._name
+
+
+class AnalysisPredictor:
+    def __init__(self, config: AnalysisConfig):
+        from ..core.scope import Scope
+        from ..executor import Executor
+        from .. import io as fio
+
+        self.config = config
+        self._scope = Scope()
+        self._exe = Executor()
+        d = config.model_dir()
+        model_file = params_file = None
+        if d is None:
+            # combined-file form: set_model(prog_file, params_file)
+            pf = config.prog_file()
+            if pf is None:
+                raise ValueError(
+                    "AnalysisConfig needs set_model(model_dir) or "
+                    "set_model(prog_file, params_file)")
+            d = os.path.dirname(pf) or "."
+            model_file = os.path.basename(pf)
+            params_file = os.path.basename(config.params_file()) \
+                if config.params_file() else None
+        from ..core.scope import scope_guard
+        with scope_guard(self._scope):
+            self._program, self._feed_names, fetch_vars = \
+                fio.load_inference_model(d, self._exe,
+                                         model_filename=model_file,
+                                         params_filename=params_file)
+        self._fetch_names = [v.name for v in fetch_vars]
+        self._fetch_vars = fetch_vars
+        self._inputs: Dict[str, np.ndarray] = {}
+        self._outputs: Dict[str, np.ndarray] = {}
+
+    # -- PaddleTensor path (analysis_predictor.cc:223 Run) --------------
+    def run(self, inputs: List[PaddleTensor]) -> List[PaddleTensor]:
+        feed = {}
+        for i, t in enumerate(inputs):
+            name = t.name or self._feed_names[i]
+            feed[name] = t.data
+        outs = self._exe.run(self._program, feed=feed,
+                             fetch_list=self._fetch_names,
+                             scope=self._scope)
+        return [PaddleTensor(o, n)
+                for o, n in zip(outs, self._fetch_names)]
+
+    # -- ZeroCopy path --------------------------------------------------
+    def get_input_names(self):
+        return list(self._feed_names)
+
+    def get_output_names(self):
+        return list(self._fetch_names)
+
+    def get_input_tensor(self, name):
+        return ZeroCopyTensor(name, self._inputs)
+
+    def get_output_tensor(self, name):
+        return ZeroCopyTensor(name, self._outputs)
+
+    def zero_copy_run(self):
+        outs = self._exe.run(self._program, feed=dict(self._inputs),
+                             fetch_list=self._fetch_names,
+                             scope=self._scope)
+        for n, o in zip(self._fetch_names, outs):
+            self._outputs[n] = np.asarray(o)
+
+    def clone(self):
+        return AnalysisPredictor(self.config)
+
+    def program(self):
+        return self._program
+
+    # -- AOT export (TPU-native deploy artifact) ------------------------
+    def export_stablehlo(self, path: str, example_feed: Dict[str, np.ndarray]):
+        """Serialize the compiled feed→fetch computation as StableHLO
+        (jax.export): a self-contained, runtime-loadable serving artifact —
+        params are baked in as constants, no Python/Program needed at
+        serving time."""
+        import jax
+        from jax import export as jexport
+        import jax.numpy as jnp
+
+        from ..core.lowering import LowerCtx, lower_block
+
+        block = self._program.global_block()
+        params = {n: jnp.asarray(self._scope.get(n))
+                  for n in self._scope.names()}
+        fetch_names = self._fetch_names
+        feed_names = sorted(example_feed)
+
+        def fn(*feeds):
+            env = dict(params)
+            env.update(zip(feed_names, feeds))
+            ctx = LowerCtx(jax.random.PRNGKey(0), is_test=True)
+            lower_block(block, env, ctx)
+            return tuple(env[n] for n in fetch_names)
+
+        args = tuple(jnp.asarray(example_feed[n]) for n in feed_names)
+        exported = jexport.export(jax.jit(fn))(*args)
+        blob = exported.serialize()
+        with open(path, "wb") as f:
+            f.write(blob)
+        return {"feed_names": feed_names, "fetch_names": fetch_names,
+                "bytes": len(blob)}
+
+
+def create_paddle_predictor(config: AnalysisConfig) -> AnalysisPredictor:
+    """Factory (analysis_predictor.cc:478 CreatePaddlePredictor)."""
+    return AnalysisPredictor(config)

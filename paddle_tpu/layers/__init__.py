@@ -6,6 +6,7 @@
 from .. import ops as _ops  # noqa: F401  (registers all lowerings)
 
 from .nn import *  # noqa: F401,F403
+from . import distributions  # noqa: F401
 from .tensor import *  # noqa: F401,F403
 from .io import *  # noqa: F401,F403
 from .metric_op import *  # noqa: F401,F403

@@ -1,0 +1,171 @@
+"""Probability distributions over IR Variables.
+
+Reference: python/paddle/fluid/layers/distributions.py — Uniform, Normal,
+Categorical, MultivariateNormalDiag with sample/entropy/log_prob/
+kl_divergence building ops into the current program.
+"""
+from __future__ import annotations
+
+import math
+
+from ..framework import Variable
+from . import nn
+from .math_ops import (elementwise_add, elementwise_div, elementwise_mul,
+                       elementwise_sub)
+from .tensor import assign, cast
+
+__all__ = ["Distribution", "Uniform", "Normal", "Categorical",
+           "MultivariateNormalDiag"]
+
+
+def _to_var(value, like=None, dtype="float32"):
+    if isinstance(value, Variable):
+        return value
+    import numpy as np
+    return assign(np.asarray(value, dtype=dtype))
+
+
+class Distribution:
+    def sample(self, shape, seed=0):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        raise NotImplementedError
+
+
+class Uniform(Distribution):
+    """U(low, high) (distributions.py Uniform)."""
+
+    def __init__(self, low, high):
+        self.low = _to_var(low)
+        self.high = _to_var(high)
+
+    def sample(self, shape, seed=0):
+        u = nn.uniform_random(shape, min=0.0, max=1.0, seed=seed)
+        width = elementwise_sub(self.high, self.low)
+        return elementwise_add(elementwise_mul(u, width, axis=-1),
+                               self.low, axis=-1)
+
+    def entropy(self):
+        return nn.log(elementwise_sub(self.high, self.low))
+
+    def log_prob(self, value):
+        # in-support density: -log(high-low), broadcast to value's shape
+        neg = nn.scale(nn.log(elementwise_sub(self.high, self.low)),
+                       scale=-1.0)
+        return elementwise_add(nn.scale(value, scale=0.0), neg, axis=-1)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = _to_var(loc)
+        self.scale = _to_var(scale)
+
+    def sample(self, shape, seed=0):
+        z = nn.gaussian_random(shape, mean=0.0, std=1.0, seed=seed)
+        return elementwise_add(elementwise_mul(z, self.scale, axis=-1),
+                               self.loc, axis=-1)
+
+    def entropy(self):
+        # 0.5 + 0.5 log(2 pi) + log sigma
+        c = 0.5 + 0.5 * math.log(2.0 * math.pi)
+        return nn.scale(nn.log(self.scale), scale=1.0, bias=c)
+
+    def log_prob(self, value):
+        var = elementwise_mul(self.scale, self.scale)
+        diff = elementwise_sub(value, self.loc, axis=-1)
+        quad = elementwise_div(elementwise_mul(diff, diff), var, axis=-1)
+        log_scale = nn.log(self.scale)
+        out = nn.scale(quad, scale=-0.5,
+                       bias=-0.5 * math.log(2.0 * math.pi))
+        return elementwise_sub(out, log_scale, axis=-1)
+
+    def kl_divergence(self, other: "Normal"):
+        # KL(N0||N1) = log(s1/s0) + (s0^2 + (m0-m1)^2)/(2 s1^2) - 1/2
+        var0 = elementwise_mul(self.scale, self.scale)
+        var1 = elementwise_mul(other.scale, other.scale)
+        dm = elementwise_sub(self.loc, other.loc)
+        num = elementwise_add(var0, elementwise_mul(dm, dm))
+        t1 = elementwise_sub(nn.log(other.scale), nn.log(self.scale))
+        t2 = nn.scale(elementwise_div(num, var1), scale=0.5, bias=-0.5)
+        return elementwise_add(t1, t2)
+
+
+class Categorical(Distribution):
+    """Distribution over logits (distributions.py Categorical)."""
+
+    def __init__(self, logits):
+        self.logits = logits
+
+    def _log_softmax(self):
+        return nn.log(nn.softmax(self.logits))
+
+    def entropy(self):
+        p = nn.softmax(self.logits)
+        lp = nn.log(p)
+        return nn.scale(nn.reduce_sum(elementwise_mul(p, lp), dim=-1),
+                        scale=-1.0)
+
+    def log_prob(self, value):
+        """value: int indices [batch]; returns log p[value]."""
+        lp = self._log_softmax()
+        oh = nn.one_hot(nn.unsqueeze(value, [-1]),
+                        depth=self.logits.shape[-1])
+        return nn.reduce_sum(elementwise_mul(lp, oh), dim=-1)
+
+    def kl_divergence(self, other: "Categorical"):
+        p = nn.softmax(self.logits)
+        diff = elementwise_sub(nn.log(p), nn.log(nn.softmax(other.logits)))
+        return nn.reduce_sum(elementwise_mul(p, diff), dim=-1)
+
+
+class MultivariateNormalDiag(Distribution):
+    def __init__(self, loc, scale):
+        """loc: [..., d]; scale: [..., d] diagonal std (the reference takes
+        a [d, d] matrix and uses its diagonal; pass the diagonal here)."""
+        self.loc = _to_var(loc)
+        self.scale = _to_var(scale)
+
+    def sample(self, shape, seed=0):
+        z = nn.gaussian_random(shape, mean=0.0, std=1.0, seed=seed)
+        return elementwise_add(elementwise_mul(z, self.scale, axis=-1),
+                               self.loc, axis=-1)
+
+    def entropy(self):
+        d = self.loc.shape[-1]
+        c = 0.5 * d * (1.0 + math.log(2.0 * math.pi))
+        return nn.scale(nn.reduce_sum(nn.log(self.scale), dim=-1),
+                        scale=1.0, bias=c)
+
+    def log_prob(self, value):
+        var = elementwise_mul(self.scale, self.scale)
+        diff = elementwise_sub(value, self.loc, axis=-1)
+        quad = nn.reduce_sum(
+            elementwise_div(elementwise_mul(diff, diff), var, axis=-1),
+            dim=-1)
+        d = self.loc.shape[-1]
+        logdet = nn.reduce_sum(nn.log(self.scale), dim=-1)
+        out = nn.scale(quad, scale=-0.5,
+                       bias=-0.5 * d * math.log(2.0 * math.pi))
+        return elementwise_sub(out, logdet)
+
+    def kl_divergence(self, other: "MultivariateNormalDiag"):
+        var0 = elementwise_mul(self.scale, self.scale)
+        var1 = elementwise_mul(other.scale, other.scale)
+        dm = elementwise_sub(self.loc, other.loc)
+        tr = nn.reduce_sum(elementwise_div(var0, var1), dim=-1)
+        quad = nn.reduce_sum(
+            elementwise_div(elementwise_mul(dm, dm), var1), dim=-1)
+        logdet = nn.reduce_sum(
+            elementwise_sub(nn.log(other.scale), nn.log(self.scale)),
+            dim=-1)
+        d = self.loc.shape[-1]
+        inner = nn.scale(elementwise_add(tr, quad), scale=0.5,
+                         bias=-0.5 * d)
+        return elementwise_add(nn.scale(logdet, scale=1.0), inner)

@@ -12,7 +12,8 @@ import contextlib
 import jax
 
 __all__ = ["profiler", "start_profiler", "stop_profiler", "reset_profiler",
-           "record_event", "cuda_profiler"]
+           "record_event", "cuda_profiler", "enable_host_profiler",
+           "export_chrome_tracing"]
 
 _trace_dir = None
 
@@ -44,9 +45,27 @@ def profiler(state="All", sorted_key=None,
 
 @contextlib.contextmanager
 def record_event(name):
-    """RecordEvent RAII (profiler.h:81) -> XPlane trace annotation."""
-    with jax.profiler.TraceAnnotation(name):
+    """RecordEvent RAII (profiler.h:81) -> XPlane trace annotation + native
+    host-phase event (native/src/profiler.cc), so the chrome trace merges
+    framework phases with the device timeline like the reference's
+    host+CUPTI merge (device_tracer.cc:58)."""
+    from .native import profiler_scope
+    with jax.profiler.TraceAnnotation(name), profiler_scope(name):
         yield
+
+
+def enable_host_profiler():
+    """Start recording host-phase events in the native profiler."""
+    from .native import profiler_enable
+    profiler_enable()
+
+
+def export_chrome_tracing(path: str) -> bool:
+    """Dump recorded host events as chrome://tracing JSON (the reference's
+    tools/timeline.py output format). Device-side traces live in the
+    jax.profiler output dir (TensorBoard/Perfetto)."""
+    from .native import profiler_dump
+    return profiler_dump(path) >= 0  # native: -1 = failure, else #events
 
 
 @contextlib.contextmanager

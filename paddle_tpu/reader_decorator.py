@@ -1,0 +1,188 @@
+"""Functional data-pipeline combinators.
+
+Reference: python/paddle/reader/decorator.py — readers are nullary
+callables returning sample generators; decorators compose them (shuffle,
+batch, buffered, map, chain, compose, firstn, cache, xmap_readers). These
+feed DataFeeder/DataLoader; on TPU the batched output goes straight to
+the host-infeed path.
+"""
+from __future__ import annotations
+
+import itertools
+import queue
+import random as _random
+import threading
+
+__all__ = ["cache", "map_readers", "buffered", "compose", "chain",
+           "shuffle", "firstn", "xmap_readers", "multiprocess_reader",
+           "batch", "ComposeNotAligned"]
+
+
+class ComposeNotAligned(ValueError):
+    pass
+
+
+def cache(reader):
+    all_data = []
+    cached = [False]
+
+    def r():
+        if not cached[0]:
+            all_data.extend(reader())
+            cached[0] = True
+        return iter(all_data)
+    return r
+
+
+def map_readers(func, *readers):
+    def r():
+        for vals in zip(*[rd() for rd in readers]):
+            yield func(*vals)
+    return r
+
+
+def shuffle(reader, buf_size):
+    def r():
+        buf = []
+        for e in reader():
+            buf.append(e)
+            if len(buf) >= buf_size:
+                _random.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            _random.shuffle(buf)
+            yield from buf
+    return r
+
+
+def chain(*readers):
+    def r():
+        return itertools.chain(*[rd() for rd in readers])
+    return r
+
+
+def compose(*readers, **kwargs):
+    check_alignment = kwargs.pop("check_alignment", True)
+
+    def make_tuple(x):
+        return x if isinstance(x, tuple) else (x,)
+
+    _end = object()
+
+    def r():
+        rs = [rd() for rd in readers]
+        if check_alignment:
+            # zip() would consume one extra element from longer readers
+            # before noticing a short one; zip_longest sees the ragged
+            # tail regardless of argument order
+            for items in itertools.zip_longest(*rs, fillvalue=_end):
+                if any(i is _end for i in items):
+                    raise ComposeNotAligned(
+                        "outputs of readers are not aligned")
+                yield sum((make_tuple(i) for i in items), ())
+        else:
+            for items in zip(*rs):
+                yield sum((make_tuple(i) for i in items), ())
+    return r
+
+
+def buffered(reader, size):
+    """Background-thread prefetch (the host half of the reference's
+    double-buffered reader, operators/reader/buffered_reader.cc)."""
+    end = object()
+
+    def r():
+        q = queue.Queue(maxsize=size)
+
+        def fill():
+            try:
+                for e in reader():
+                    q.put(e)
+            finally:
+                q.put(end)
+
+        t = threading.Thread(target=fill, daemon=True)
+        t.start()
+        while True:
+            e = q.get()
+            if e is end:
+                return
+            yield e
+    return r
+
+
+def firstn(reader, n):
+    def r():
+        return itertools.islice(reader(), n)
+    return r
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size,
+                 order=False):
+    """Thread-pool map over a reader (reference uses threads too)."""
+    end = object()
+
+    def r():
+        in_q = queue.Queue(buffer_size)
+        out_q = queue.Queue(buffer_size)
+
+        def feed():
+            for i, e in enumerate(reader()):
+                in_q.put((i, e))
+            for _ in range(process_num):
+                in_q.put(end)
+
+        def work():
+            while True:
+                item = in_q.get()
+                if item is end:
+                    out_q.put(end)
+                    return
+                i, e = item
+                out_q.put((i, mapper(e)))
+
+        threading.Thread(target=feed, daemon=True).start()
+        for _ in range(process_num):
+            threading.Thread(target=work, daemon=True).start()
+
+        finished = 0
+        pending = {}
+        next_i = 0
+        while finished < process_num:
+            item = out_q.get()
+            if item is end:
+                finished += 1
+                continue
+            i, v = item
+            if not order:
+                yield v
+            else:
+                pending[i] = v
+                while next_i in pending:
+                    yield pending.pop(next_i)
+                    next_i += 1
+        if order:
+            for i in sorted(pending):
+                yield pending[i]
+    return r
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    """API-compatible stand-in running the readers in threads: jax's
+    runtime does not survive fork(), the reference's mechanism."""
+    return buffered(chain(*readers), queue_size)
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Group samples into lists of batch_size (python/paddle/batch.py)."""
+    def r():
+        b = []
+        for e in reader():
+            b.append(e)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if b and not drop_last:
+            yield b
+    return r

@@ -70,6 +70,25 @@ class DistributeTranspiler:
             p, g = op.inputs["Param"][0], op.inputs["Grad"][0]
             self._param_of_grad[g] = p
             params.append(block.var(p))
+
+        # LR-scheduler ops (reference _get_lr_ops): the transitive
+        # producers of the opt ops' LearningRate inputs. They move to the
+        # pserver (run once per batch there) and leave the trainer.
+        opt_set = {id(op) for op in self._opt_ops}
+        lr_needed = {op.inputs["LearningRate"][0] for op in self._opt_ops
+                     if op.inputs.get("LearningRate")}
+        lr_ops_rev = []
+        for op in reversed(block.ops):
+            if id(op) in opt_set:
+                continue
+            if set(op.output_names()) & lr_needed:
+                lr_ops_rev.append(op)
+                lr_needed.update(n for n in op.input_names() if n)
+        self._lr_ops = list(reversed(lr_ops_rev))
+        lr_set = {id(op) for op in self._lr_ops}
+        self._removed_op_indices = [
+            i for i, op in enumerate(block.ops)
+            if id(op) in opt_set or id(op) in lr_set]
         dispatcher: PSDispatcher = self.config.split_method(
             self.pserver_endpoints)
         self._ep_of_param = dict(
@@ -84,8 +103,11 @@ class DistributeTranspiler:
         the barrier inside the RPC layer)."""
         self.trainer_program = self.origin_program.clone()
         block = self.trainer_program.global_block()
-        opt_idx = {id(op) for op in _optimize_ops(block)}
-        block.ops = [op for op in block.ops if id(op) not in opt_idx]
+        # drop optimizer AND lr-scheduler ops (indices match: clone is a
+        # deepcopy preserving op order)
+        removed = set(self._removed_op_indices)
+        block.ops = [op for i, op in enumerate(block.ops)
+                     if i not in removed]
 
         for g, p in self._param_of_grad.items():
             ep = self._ep_of_param[p]
@@ -119,26 +141,44 @@ class DistributeTranspiler:
     def get_pserver_program(self, endpoint) -> Program:
         """Program = vars owned by this endpoint + one listen_and_serv op
         whose sub-blocks each run one param's optimizer ops."""
+        from ..framework import Operator
+
         origin_block = self.origin_program.global_block()
         prog = Program()
+        prog.random_seed = self.origin_program.random_seed
         block = prog.global_block()
 
         my_params = [p for p, ep in self._ep_of_param.items()
                      if ep == endpoint]
+
+        def copy_var(n):
+            if n and not block.has_var(n) and origin_block.has_var(n):
+                v = origin_block.var(n)
+                block.create_var(name=n, shape=v.shape, dtype=v.dtype,
+                                 persistable=True, stop_gradient=True)
+
+        # lr-scheduler block: runs ONCE per batch before the per-param
+        # optimizer blocks (counters must tick once, not once per param)
+        lr_block_idx = -1
+        if self._lr_ops:
+            sub = prog._create_block(parent_idx=0)
+            for op in self._lr_ops:
+                for n in list(op.input_names()) + list(op.output_names()):
+                    copy_var(n)
+                new_op = Operator(sub, op.type, op.inputs, op.outputs,
+                                  op.attrs, op_id=op.id)
+                sub.ops.append(new_op)
+            prog._current_block_idx = 0
+            lr_block_idx = sub.idx
+
         opt_block_of: Dict[str, int] = {}
         for p in my_params:
             sub = prog._create_block(parent_idx=0)
             for op in self._opt_ops:
                 if op.inputs["Param"][0] != p:
                     continue
-                # copy referenced vars into the pserver program
                 for n in list(op.input_names()) + list(op.output_names()):
-                    if n and not block.has_var(n) \
-                            and origin_block.has_var(n):
-                        v = origin_block.var(n)
-                        block.create_var(
-                            name=n, shape=v.shape, dtype=v.dtype,
-                            persistable=True, stop_gradient=True)
+                    copy_var(n)
                 sub.append_op(op.type, inputs=op.inputs,
                               outputs=op.outputs, attrs=op.attrs,
                               infer_shape=False)
@@ -152,6 +192,7 @@ class DistributeTranspiler:
                    "grad_of_param": {p: g for g, p in
                                      self._param_of_grad.items()},
                    "opt_block_of": opt_block_of,
+                   "lr_block": lr_block_idx,
                    "sync_mode": self.sync_mode,
                    "Fanin": self.trainer_num},
             infer_shape=False)
@@ -171,12 +212,16 @@ class DistributeTranspiler:
 
         my_params = {p for p, ep in self._ep_of_param.items()
                      if ep == endpoint}
-        # optimizer state (accumulators, lr) lives with the param's opt ops
+        # optimizer state (accumulators, lr) lives with the param's opt
+        # ops; lr-scheduler ops add their own state (step counters)
         needed = set(my_params)
         for op in self._opt_ops:
             if op.inputs["Param"][0] in my_params:
                 needed.update(n for n in op.input_names() if n)
                 needed.update(n for n in op.output_names() if n)
+        for op in self._lr_ops:
+            needed.update(n for n in op.input_names() if n)
+            needed.update(n for n in op.output_names() if n)
         prog = Program()
         prog.random_seed = self.startup_program.random_seed
         block = prog.global_block()

