@@ -30,6 +30,19 @@ def real_data(name: str, split: str):
     return blob["x"], blob["y"]
 
 
+def real_reader(name: str, split: str):
+    """Nullary reader creator over a real corpus copy, or None when the
+    override is not installed (shared by mnist/cifar/uci_housing)."""
+    pair = real_data(name, split)
+    if pair is None:
+        return None
+    xs, ys = pair
+
+    def r():
+        yield from zip(xs, ys)
+    return r
+
+
 from . import cifar, imdb, mnist, movielens, uci_housing, wmt16  # noqa: F401,E402
 
 __all__ = ["mnist", "cifar", "uci_housing", "imdb", "movielens", "wmt16",

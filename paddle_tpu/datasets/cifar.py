@@ -8,16 +8,8 @@ __all__ = ["train10", "test10", "train100", "test100"]
 
 
 def _maybe_real(name, split):
-    from . import real_data
-
-    pair = real_data(name, split)
-    if pair is None:
-        return None
-    xs, ys = pair
-
-    def r():
-        yield from zip(xs, ys)
-    return r
+    from . import real_reader
+    return real_reader(name, split)
 
 
 def _reader(n, n_classes, seed):
@@ -40,8 +32,8 @@ def test10():
 
 
 def train100():
-    return _reader(4096, 100, seed=5)
+    return _maybe_real("cifar100", "train") or _reader(4096, 100, seed=5)
 
 
 def test100():
-    return _reader(512, 100, seed=6)
+    return _maybe_real("cifar100", "test") or _reader(512, 100, seed=6)

@@ -8,16 +8,8 @@ __all__ = ["train", "test"]
 
 
 def _maybe_real(name, split):
-    from . import real_data
-
-    pair = real_data(name, split)
-    if pair is None:
-        return None
-    xs, ys = pair
-
-    def r():
-        yield from zip(xs, ys)
-    return r
+    from . import real_reader
+    return real_reader(name, split)
 
 TRAIN_SIZE = 8192  # synthetic subset sizes (see datasets/__init__.py)
 TEST_SIZE = 1024

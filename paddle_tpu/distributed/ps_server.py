@@ -93,6 +93,7 @@ class PServerRuntime:
         self._lock = threading.Lock()
         self._batch_cv = threading.Condition(self._lock)
         self._grad_buf: Dict[str, list] = {p: [] for p in self.params}
+        self._async_seen = 0  # async mode: grads since last lr tick
         self._barrier_count = 0
         self._batch_id = 0
         self._applied_batch = 0
@@ -156,10 +157,7 @@ class PServerRuntime:
         lost = set(self.monitor.lost_workers())
         return self.fanin - len(self._completed | lost)
 
-    def _apply_param(self, param, grads, tick_lr=True):
-        if tick_lr and self._lr_prog is not None:
-            # async mode: the schedule ticks per apply (no batch barrier)
-            self.exe.run(self._lr_prog, scope=self.scope)
+    def _apply_param(self, param, grads):
         g_name = self.grad_of_param[param]
         merged = np.mean(grads, axis=0) if len(grads) > 1 else grads[0]
         self.scope.set(g_name, merged)
@@ -171,7 +169,7 @@ class PServerRuntime:
         for p in self.params:
             buf = self._grad_buf[p]
             if buf:
-                self._apply_param(p, buf, tick_lr=False)
+                self._apply_param(p, buf)
                 self._grad_buf[p] = []
         self._applied_batch = self._batch_id
         self._batch_id += 1
@@ -195,6 +193,12 @@ class PServerRuntime:
                 if self.sync_mode:
                     self._grad_buf[param].append(arr)
                 else:
+                    # lr schedule ticks once per FULL grad round, not once
+                    # per param (distribute_transpiler invariant)
+                    if self._lr_prog is not None and \
+                            self._async_seen % max(1, len(self.params)) == 0:
+                        self.exe.run(self._lr_prog, scope=self.scope)
+                    self._async_seen += 1
                     self._apply_param(param, [arr])
             return {"status": "ok"}, b""
 

@@ -20,8 +20,7 @@ __all__ = ["TracedLayer", "trace"]
 
 
 class TracedLayer:
-    def __init__(self, program, feed_names, fetch_names, param_values,
-                 startup_like=None):
+    def __init__(self, program, feed_names, fetch_names, param_values):
         from ..core.scope import Scope
         from ..executor import Executor
 

@@ -1,6 +1,12 @@
 """Model zoo: static-graph builders matching the reference's flagship
-benchmarks (BASELINE.json configs): MNIST LeNet (book/02), ResNet-50
-(PaddleCV), Transformer (PaddleNLP)."""
+benchmarks (BASELINE.json configs) and the tests/book tutorials: MNIST
+LeNet (book/02), word2vec (book/04), recommender (book/05), machine
+translation seq2seq (book/08), ResNet-50 / SE-ResNeXt (PaddleCV),
+Transformer (PaddleNLP)."""
 from . import lenet  # noqa: F401
+from . import recommender  # noqa: F401
 from . import resnet  # noqa: F401
+from . import se_resnext  # noqa: F401
+from . import seq2seq  # noqa: F401
 from . import transformer  # noqa: F401
+from . import word2vec  # noqa: F401

@@ -23,14 +23,14 @@ class ComposeNotAligned(ValueError):
 
 
 def cache(reader):
-    all_data = []
-    cached = [False]
+    state = {"data": None}
 
     def r():
-        if not cached[0]:
-            all_data.extend(reader())
-            cached[0] = True
-        return iter(all_data)
+        if state["data"] is None:
+            # materialize into a local first: a partial read that raises
+            # must not leave a half-filled cache behind
+            state["data"] = list(reader())
+        return iter(state["data"])
     return r
 
 
@@ -87,9 +87,16 @@ def compose(*readers, **kwargs):
     return r
 
 
+class _ReaderError:
+    def __init__(self, exc):
+        self.exc = exc
+
+
 def buffered(reader, size):
     """Background-thread prefetch (the host half of the reference's
-    double-buffered reader, operators/reader/buffered_reader.cc)."""
+    double-buffered reader, operators/reader/buffered_reader.cc). A
+    source-reader exception re-raises in the consumer, never a silently
+    truncated stream."""
     end = object()
 
     def r():
@@ -99,8 +106,9 @@ def buffered(reader, size):
             try:
                 for e in reader():
                     q.put(e)
-            finally:
                 q.put(end)
+            except BaseException as exc:  # propagate to consumer
+                q.put(_ReaderError(exc))
 
         t = threading.Thread(target=fill, daemon=True)
         t.start()
@@ -108,6 +116,8 @@ def buffered(reader, size):
             e = q.get()
             if e is end:
                 return
+            if isinstance(e, _ReaderError):
+                raise e.exc
             yield e
     return r
 
@@ -128,10 +138,13 @@ def xmap_readers(mapper, reader, process_num, buffer_size,
         out_q = queue.Queue(buffer_size)
 
         def feed():
-            for i, e in enumerate(reader()):
-                in_q.put((i, e))
-            for _ in range(process_num):
-                in_q.put(end)
+            try:
+                for i, e in enumerate(reader()):
+                    in_q.put((i, e))
+                for _ in range(process_num):
+                    in_q.put(end)
+            except BaseException as exc:
+                out_q.put(_ReaderError(exc))  # surface + unblock consumer
 
         def work():
             while True:
@@ -140,7 +153,11 @@ def xmap_readers(mapper, reader, process_num, buffer_size,
                     out_q.put(end)
                     return
                 i, e = item
-                out_q.put((i, mapper(e)))
+                try:
+                    out_q.put((i, mapper(e)))
+                except BaseException as exc:
+                    out_q.put(_ReaderError(exc))
+                    return
 
         threading.Thread(target=feed, daemon=True).start()
         for _ in range(process_num):
@@ -154,6 +171,8 @@ def xmap_readers(mapper, reader, process_num, buffer_size,
             if item is end:
                 finished += 1
                 continue
+            if isinstance(item, _ReaderError):
+                raise item.exc
             i, v = item
             if not order:
                 yield v
