@@ -9,6 +9,9 @@ from __future__ import annotations
 white_list = {
     "mul", "matmul", "matmul_v2", "conv2d", "conv3d", "depthwise_conv2d",
     "conv2d_transpose",
+    # fused attention kernels: bf16 operands hit the MXU fast path, all
+    # softmax/accumulation math stays f32 inside the kernel
+    "flash_attention", "ring_attention",
 }
 
 # Ops that must stay fp32 for numerics: reductions into losses, norms.

@@ -22,6 +22,13 @@ from . import distributed_ops  # noqa: F401
 from . import quant_ops  # noqa: F401
 from . import attention  # noqa: F401
 from . import rnn_ops  # noqa: F401
+from . import misc_ops  # noqa: F401
+from . import loss_extra  # noqa: F401
+from . import vision_extra  # noqa: F401
+from . import sequence_extra  # noqa: F401
+from . import rnn_fused  # noqa: F401
+from . import detection_extra  # noqa: F401
+from . import parity_final  # noqa: F401
 
 
 def registered_types():

@@ -1,0 +1,526 @@
+"""Detection ops completing Appendix A parity (operators/detection/).
+
+Dynamic-size results (NMS keeps, proposals) use padded fixed-size outputs
+with -1/0 fill — the XLA-static formulation of the reference's LoD
+outputs. Algorithms follow the reference semantics; comments cite the op.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+def _area(boxes):
+    return jnp.maximum(boxes[..., 2] - boxes[..., 0], 0) * \
+        jnp.maximum(boxes[..., 3] - boxes[..., 1], 0)
+
+
+def _iou(a, b):
+    """[N,4] x [M,4] -> [N,M] IoU (xyxy)."""
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    return inter / jnp.maximum(_area(a)[:, None] + _area(b)[None, :]
+                               - inter, 1e-10)
+
+
+# ---------------------------------------------------------------------------
+# RoI pooling family
+# ---------------------------------------------------------------------------
+
+
+def _roi_align_one(feat, roi, out_h, out_w, spatial_scale, sampling=2):
+    """feat [C, H, W]; roi [4] xyxy in input coords (roi_align_op)."""
+    c, h, w = feat.shape
+    x1, y1, x2, y2 = [roi[i] * spatial_scale for i in range(4)]
+    rw = jnp.maximum(x2 - x1, 1.0)
+    rh = jnp.maximum(y2 - y1, 1.0)
+    bin_w = rw / out_w
+    bin_h = rh / out_h
+    sy = (jnp.arange(out_h)[:, None] + (jnp.arange(sampling) + 0.5)[None]
+          / sampling).reshape(-1) * bin_h + y1   # [out_h*s]
+    sx = (jnp.arange(out_w)[:, None] + (jnp.arange(sampling) + 0.5)[None]
+          / sampling).reshape(-1) * bin_w + x1
+
+    def bilinear(yy, xx):
+        y0 = jnp.clip(jnp.floor(yy), 0, h - 1)
+        x0 = jnp.clip(jnp.floor(xx), 0, w - 1)
+        y1c = jnp.clip(y0 + 1, 0, h - 1)
+        x1c = jnp.clip(x0 + 1, 0, w - 1)
+        wy = yy - y0
+        wx = xx - x0
+        i = lambda a: a.astype(jnp.int32)
+        v = (feat[:, i(y0)][:, :, i(x0)] * (1 - wy)[:, None] * (1 - wx) +
+             feat[:, i(y1c)][:, :, i(x0)] * wy[:, None] * (1 - wx) +
+             feat[:, i(y0)][:, :, i(x1c)] * (1 - wy)[:, None] * wx +
+             feat[:, i(y1c)][:, :, i(x1c)] * wy[:, None] * wx)
+        return v  # [C, len(yy), len(xx)]
+
+    samp = bilinear(sy, sx)  # [C, out_h*s, out_w*s]
+    samp = samp.reshape(c, out_h, sampling, out_w, sampling)
+    return jnp.mean(samp, axis=(2, 4))
+
+
+@register_op("roi_align", nondiff_inputs=("ROIs", "RoisNum", "RoisLod"))
+def _roi_align(ctx, ins, attrs):
+    x = ins["X"][0]          # [N, C, H, W]
+    rois = ins["ROIs"][0]    # [R, 4]; batch index via RoisNum or all-0
+    oh = attrs.get("pooled_height", 1)
+    ow = attrs.get("pooled_width", 1)
+    scale = attrs.get("spatial_scale", 1.0)
+    samp = max(attrs.get("sampling_ratio", 2), 1)
+    bidx = _batch_index_of_rois(ins, rois.shape[0])
+    feats = x[bidx]  # [R, C, H, W]
+    out = jax.vmap(lambda f, r: _roi_align_one(f, r, oh, ow, scale,
+                                               samp))(feats, rois)
+    return {"Out": [out]}
+
+
+def _batch_index_of_rois(ins, n_rois):
+    """RoisNum [N] -> per-roi image index (shared by the RoI ops)."""
+    bidx = jnp.zeros((n_rois,), jnp.int32)
+    if "RoisNum" in ins:
+        nums = ins["RoisNum"][0].reshape(-1).astype(jnp.int32)
+        bidx = jnp.sum(jnp.arange(n_rois)[:, None] >=
+                       jnp.cumsum(nums)[None, :], axis=1).astype(jnp.int32)
+    elif "BatchRoINums" in ins:
+        nums = ins["BatchRoINums"][0].reshape(-1).astype(jnp.int32)
+        bidx = jnp.sum(jnp.arange(n_rois)[:, None] >=
+                       jnp.cumsum(nums)[None, :], axis=1).astype(jnp.int32)
+    return bidx
+
+
+@register_op("roi_pool", nondiff_inputs=("ROIs", "RoisNum"),
+             nondiff_outputs=("Argmax",))
+def _roi_pool(ctx, ins, attrs):
+    """max RoI pooling (roi_pool_op): integer bin grid, max per bin."""
+    x = ins["X"][0]
+    rois = ins["ROIs"][0]
+    oh = attrs.get("pooled_height", 1)
+    ow = attrs.get("pooled_width", 1)
+    scale = attrs.get("spatial_scale", 1.0)
+    n, c, h, w = x.shape
+
+    def one(feat, roi):
+        x1 = jnp.round(roi[0] * scale)
+        y1 = jnp.round(roi[1] * scale)
+        x2 = jnp.round(roi[2] * scale)
+        y2 = jnp.round(roi[3] * scale)
+        rh = jnp.maximum(y2 - y1 + 1, 1.0)
+        rw = jnp.maximum(x2 - x1 + 1, 1.0)
+        ys = jnp.arange(h)[None, :]   # bins x positions
+        ybin_lo = y1 + jnp.floor(jnp.arange(oh) * rh / oh)[:, None]
+        ybin_hi = y1 + jnp.ceil((jnp.arange(oh) + 1) * rh / oh)[:, None]
+        ymask = (ys >= ybin_lo) & (ys < ybin_hi)      # [oh, H]
+        xs = jnp.arange(w)[None, :]
+        xbin_lo = x1 + jnp.floor(jnp.arange(ow) * rw / ow)[:, None]
+        xbin_hi = x1 + jnp.ceil((jnp.arange(ow) + 1) * rw / ow)[:, None]
+        xmask = (xs >= xbin_lo) & (xs < xbin_hi)      # [ow, W]
+        m = ymask[:, None, :, None] & xmask[None, :, None, :]  # oh,ow,H,W
+        v = jnp.where(m[None], feat[:, None, None, :, :], -jnp.inf)
+        return jnp.max(v, axis=(3, 4))  # [C, oh, ow]
+
+    bidx = _batch_index_of_rois(ins, rois.shape[0])
+    out = jax.vmap(one)(x[bidx], rois)
+    return {"Out": [out], "Argmax": [jnp.zeros(out.shape, jnp.int64)]}
+
+
+@register_op("prroi_pool", nondiff_inputs=("ROIs", "BatchRoINums"))
+def _prroi_pool(ctx, ins, attrs):
+    ins2 = dict(ins)
+    if "BatchRoINums" in ins and "RoisNum" not in ins:
+        ins2["RoisNum"] = ins["BatchRoINums"]
+    return {"Out": _roi_align(ctx, ins2, attrs)["Out"]}
+
+
+@register_op("psroi_pool", nondiff_inputs=("ROIs",))
+def _psroi_pool(ctx, ins, attrs):
+    """position-sensitive RoI pooling: channel c*oh*ow is split so bin
+    (i, j) reads channel group (i*ow + j)."""
+    x = ins["X"][0]
+    rois = ins["ROIs"][0]
+    oh = attrs.get("pooled_height", 1)
+    ow = attrs.get("pooled_width", 1)
+    out_c = attrs.get("output_channels", x.shape[1] // (oh * ow))
+    scale = attrs.get("spatial_scale", 1.0)
+    aligned = _roi_align(ctx, {"X": [x], "ROIs": [rois]},
+                         {"pooled_height": oh, "pooled_width": ow,
+                          "spatial_scale": scale})["Out"][0]
+    r = aligned.shape[0]
+    # [R, out_c, oh*ow, oh, ow] -> take the matching group per bin
+    g = aligned.reshape(r, out_c, oh * ow, oh, ow)
+    sel = jnp.arange(oh * ow).reshape(oh, ow)
+    out = g[:, :, sel, jnp.arange(oh)[:, None], jnp.arange(ow)[None, :]]
+    return {"Out": [out]}
+
+
+# ---------------------------------------------------------------------------
+# anchors / priors
+# ---------------------------------------------------------------------------
+
+
+@register_op("anchor_generator", nondiff_inputs=("Input",),
+             nondiff_outputs=("Anchors", "Variances"))
+def _anchor_generator(ctx, ins, attrs):
+    """anchor_generator_op: dense anchors over the feature grid."""
+    feat = ins["Input"][0]
+    h, w = feat.shape[-2], feat.shape[-1]
+    sizes = attrs.get("anchor_sizes", [64.0, 128.0, 256.0, 512.0])
+    ratios = attrs.get("aspect_ratios", [0.5, 1.0, 2.0])
+    stride = attrs.get("stride", [16.0, 16.0])
+    variances = attrs.get("variances", [0.1, 0.1, 0.2, 0.2])
+    offset = attrs.get("offset", 0.5)
+    base = []
+    for s in sizes:
+        for r in ratios:
+            aw = s * np.sqrt(r)
+            ah = s / np.sqrt(r)
+            base.append([-aw / 2, -ah / 2, aw / 2, ah / 2])
+    base = jnp.asarray(base)  # [A, 4]
+    cx = (jnp.arange(w) + offset) * stride[0]
+    cy = (jnp.arange(h) + offset) * stride[1]
+    gx, gy = jnp.meshgrid(cx, cy)  # [h, w]
+    centers = jnp.stack([gx, gy, gx, gy], axis=-1)  # [h, w, 4]
+    anchors = centers[:, :, None, :] + base[None, None]
+    var = jnp.broadcast_to(jnp.asarray(variances), anchors.shape)
+    return {"Anchors": [anchors], "Variances": [var]}
+
+
+@register_op("density_prior_box", nondiff_inputs=("Input", "Image"),
+             nondiff_outputs=("Boxes", "Variances"))
+def _density_prior_box(ctx, ins, attrs):
+    """density_prior_box_op: fixed-size priors with densities per ratio."""
+    feat, img = ins["Input"][0], ins["Image"][0]
+    h, w = feat.shape[-2:]
+    ih, iw = img.shape[-2:]
+    fsizes = attrs.get("fixed_sizes", [32.0])
+    fratios = attrs.get("fixed_ratios", [1.0])
+    dens = attrs.get("densities", [1])
+    variances = attrs.get("variances", [0.1, 0.1, 0.2, 0.2])
+    offset = attrs.get("offset", 0.5)
+    sw, sh = iw / w, ih / h
+    boxes = []
+    for size, d in zip(fsizes, dens):
+        for r in fratios:
+            bw = size * np.sqrt(r)
+            bh = size / np.sqrt(r)
+            shift = size / d
+            for di in range(d):
+                for dj in range(d):
+                    boxes.append((bw, bh,
+                                  -size / 2 + shift / 2 + dj * shift,
+                                  -size / 2 + shift / 2 + di * shift))
+    cx = (jnp.arange(w) + offset) * sw
+    cy = (jnp.arange(h) + offset) * sh
+    gx, gy = jnp.meshgrid(cx, cy)
+    out = []
+    for bw, bh, ox, oy in boxes:
+        out.append(jnp.stack([
+            (gx + ox) - bw / 2, (gy + oy) - bh / 2,
+            (gx + ox) + bw / 2, (gy + oy) + bh / 2], axis=-1))
+    prior = jnp.stack(out, axis=2) / jnp.asarray([iw, ih, iw, ih])
+    var = jnp.broadcast_to(jnp.asarray(variances), prior.shape)
+    return {"Boxes": [prior], "Variances": [var]}
+
+
+# ---------------------------------------------------------------------------
+# matching / assignment / NMS
+# ---------------------------------------------------------------------------
+
+
+@register_op("bipartite_match", nondiff_inputs=("DistMat",),
+             nondiff_outputs=("ColToRowMatchIndices",
+                              "ColToRowMatchDist"))
+def _bipartite_match(ctx, ins, attrs):
+    """greedy bipartite matching on a distance matrix
+    (bipartite_match_op): repeatedly take the global max, retire its row
+    and column."""
+    dist = ins["DistMat"][0]  # [R, C]
+    r, c = dist.shape
+    n = min(r, c)
+
+    def step(carry, _):
+        d, match_idx, match_d = carry
+        flat = jnp.argmax(d)
+        i, j = flat // c, flat % c
+        v = d[i, j]
+        take = v > -1e9
+        match_idx = match_idx.at[j].set(jnp.where(take, i, match_idx[j]))
+        match_d = match_d.at[j].set(jnp.where(take, v, match_d[j]))
+        d = jnp.where(take, d.at[i, :].set(-1e10).at[:, j].set(-1e10), d)
+        return (d, match_idx, match_d), None
+
+    init = (dist, jnp.full((c,), -1, jnp.int32), jnp.zeros((c,)))
+    (_, idx, md), _ = jax.lax.scan(step, init, None, length=n)
+    mtype = attrs.get("match_type", "bipartite")
+    if mtype == "per_prediction":
+        thr = attrs.get("dist_threshold", 0.5)
+        best_row = jnp.argmax(dist, axis=0)
+        best_v = jnp.max(dist, axis=0)
+        extra = (idx < 0) & (best_v >= thr)
+        idx = jnp.where(extra, best_row.astype(jnp.int32), idx)
+        md = jnp.where(extra, best_v, md)
+    return {"ColToRowMatchIndices": [idx[None, :]],
+            "ColToRowMatchDist": [md[None, :]]}
+
+
+@register_op("target_assign",
+             nondiff_inputs=("MatchIndices", "NegIndices"),
+             nondiff_outputs=("OutWeight",))
+def _target_assign(ctx, ins, attrs):
+    """scatter per-prior targets from matched gt (target_assign_op)."""
+    x = ins["X"][0]  # [B, M, K] gt values
+    match = ins["MatchIndices"][0].astype(jnp.int32)  # [B, P]
+    mismatch_val = attrs.get("mismatch_value", 0.0)
+
+    def one(gt, m):
+        take = jnp.take(gt, jnp.maximum(m, 0), axis=0)
+        return jnp.where((m >= 0)[:, None], take, mismatch_val)
+
+    out = jax.vmap(one)(x, match)
+    w = (match >= 0).astype(x.dtype)[..., None]
+    return {"Out": [out], "OutWeight": [w]}
+
+
+def _nms_padded(boxes, scores, iou_thr, score_thr, keep):
+    """greedy NMS -> fixed `keep` indices, -1 padded."""
+    n = boxes.shape[0]
+    order = jnp.argsort(-scores)
+    boxes_s = boxes[order]
+    scores_s = scores[order]
+    alive = scores_s > score_thr
+
+    def step(carry, i):
+        alive, out = carry
+        take = alive[i]
+        out = out.at[i].set(jnp.where(take, order[i], -1))
+        ious = _iou(boxes_s[i][None, :], boxes_s)[0]
+        # only a box that was actually kept suppresses its overlaps
+        kill = take & (ious > iou_thr) & (jnp.arange(n) > i)
+        alive = alive & ~kill
+        alive = alive.at[i].set(False)
+        return (alive, out), take
+
+    (alive, out), took = jax.lax.scan(
+        step, (alive, jnp.full((n,), -1, jnp.int32)), jnp.arange(n))
+    # compact kept first, crop/pad to `keep`
+    sel = jnp.argsort(out < 0, stable=True)
+    out = out[sel]
+    if n >= keep:
+        out = out[:keep]
+    else:
+        out = jnp.concatenate([out, jnp.full((keep - n,), -1, jnp.int32)])
+    return out
+
+
+def _multiclass_nms_impl(ctx, ins, attrs):
+    """multiclass_nms_op: per-class NMS + global keep_top_k; padded
+    [B, keep, 6] output (class, score, x1, y1, x2, y2), -1 rows = empty."""
+    boxes = ins["BBoxes"][0]   # [B, N, 4]
+    scores = ins["Scores"][0]  # [B, C, N]
+    score_thr = attrs.get("score_threshold", 0.0)
+    nms_thr = attrs.get("nms_threshold", 0.3)
+    nms_top_k = attrs.get("nms_top_k", 64)
+    keep_top_k = attrs.get("keep_top_k", 16)
+    if keep_top_k <= 0:
+        keep_top_k = 16
+    bg = attrs.get("background_label", 0)
+
+    def one(bx, sc):
+        outs = []
+        for c in range(sc.shape[0]):
+            if c == bg:
+                continue
+            kept = _nms_padded(bx, sc[c], nms_thr, score_thr,
+                               min(nms_top_k, bx.shape[0]))
+            ksc = jnp.where(kept >= 0, sc[c][jnp.maximum(kept, 0)], -1.0)
+            kbx = bx[jnp.maximum(kept, 0)]
+            cls = jnp.full_like(ksc, float(c))
+            outs.append(jnp.concatenate(
+                [cls[:, None], ksc[:, None], kbx], axis=1))
+        allc = jnp.concatenate(outs)           # [C'*topk, 6]
+        order = jnp.argsort(-allc[:, 1])
+        allc = allc[order][:keep_top_k]
+        valid = allc[:, 1] > score_thr
+        return jnp.where(valid[:, None], allc, -1.0)
+
+    out = jax.vmap(one)(boxes, scores)
+    nums = jnp.sum(out[..., 1] > 0, axis=1).astype(jnp.int32)
+    return {"Out": [out], "NmsRoisNum": [nums], "Index": [
+        jnp.zeros((out.shape[0] * out.shape[1], 1), jnp.int32)]}
+
+
+register_op("multiclass_nms", nondiff_inputs=("BBoxes", "Scores"),
+            nondiff_outputs=("Out", "Index", "NmsRoisNum"))(
+    _multiclass_nms_impl)
+register_op("multiclass_nms2", nondiff_inputs=("BBoxes", "Scores"),
+            nondiff_outputs=("Out", "Index", "NmsRoisNum"))(
+    _multiclass_nms_impl)
+
+
+@register_op("mine_hard_examples",
+             nondiff_inputs=("ClsLoss", "LocLoss", "MatchIndices",
+                             "MatchDist"),
+             nondiff_outputs=("NegIndices", "UpdatedMatchIndices"))
+def _mine_hard_examples(ctx, ins, attrs):
+    """hard-negative mining (mine_hard_examples_op): pick the top-loss
+    unmatched priors at neg_pos_ratio."""
+    cls_loss = ins["ClsLoss"][0]  # [B, P]
+    match = ins["MatchIndices"][0].astype(jnp.int32)
+    ratio = attrs.get("neg_pos_ratio", 3.0)
+    p = cls_loss.shape[1]
+
+    def one(loss, m):
+        pos = m >= 0
+        n_pos = jnp.sum(pos)
+        n_neg = jnp.minimum((n_pos * ratio).astype(jnp.int32),
+                            p - n_pos)
+        neg_loss = jnp.where(pos, -jnp.inf, loss)
+        order = jnp.argsort(-neg_loss)
+        sel = jnp.arange(p) < n_neg
+        return jnp.where(sel, order, -1).astype(jnp.int32)
+
+    neg = jax.vmap(one)(cls_loss, match)
+    return {"NegIndices": [neg], "UpdatedMatchIndices": [match]}
+
+
+@register_op("polygon_box_transform", nondiff_inputs=("Input",),
+             nondiff_outputs=("Output",))
+def _polygon_box_transform(ctx, ins, attrs):
+    """quad geo-map -> corner offsets to absolute coords
+    (polygon_box_transform_op): out = grid*4 - in on active channels."""
+    x = ins["Input"][0]  # [N, 8, H, W]
+    n, c, h, w = x.shape
+    gx = jnp.arange(w)[None, :] * 4.0
+    gy = jnp.arange(h)[:, None] * 4.0
+    grid = jnp.stack([jnp.broadcast_to(gx, (h, w)),
+                      jnp.broadcast_to(gy, (h, w))] * (c // 2), axis=0)
+    return {"Output": [grid[None] - x]}
+
+
+@register_op("box_decoder_and_assign",
+             nondiff_inputs=("PriorBox", "BoxScore"))
+def _box_decoder_and_assign(ctx, ins, attrs):
+    """decode per-class deltas then pick the best-scoring class's box
+    (box_decoder_and_assign_op)."""
+    prior = ins["PriorBox"][0]        # [N, 4]
+    deltas = ins["TargetBox"][0]      # [N, C*4]
+    score = ins["BoxScore"][0]        # [N, C]
+    var = attrs.get("box_clip", 2.0)
+    n, c4 = deltas.shape
+    c = c4 // 4
+    pw = prior[:, 2] - prior[:, 0]
+    ph = prior[:, 3] - prior[:, 1]
+    pcx = prior[:, 0] + pw / 2
+    pcy = prior[:, 1] + ph / 2
+    d = deltas.reshape(n, c, 4)
+    dx, dy, dw, dh = d[..., 0], d[..., 1], d[..., 2], d[..., 3]
+    dw = jnp.clip(dw, -var, var)
+    dh = jnp.clip(dh, -var, var)
+    cx = pcx[:, None] + dx * pw[:, None]
+    cy = pcy[:, None] + dy * ph[:, None]
+    bw = jnp.exp(dw) * pw[:, None]
+    bh = jnp.exp(dh) * ph[:, None]
+    boxes = jnp.stack([cx - bw / 2, cy - bh / 2,
+                       cx + bw / 2, cy + bh / 2], axis=-1)  # [N, C, 4]
+    best = jnp.argmax(score, axis=1)
+    assigned = jnp.take_along_axis(
+        boxes, best[:, None, None].repeat(4, -1), axis=1)[:, 0]
+    return {"DecodeBox": [boxes.reshape(n, c4)],
+            "OutputAssignBox": [assigned]}
+
+
+@register_op("collect_fpn_proposals",
+             nondiff_inputs=("MultiLevelRois", "MultiLevelScores"),
+             nondiff_outputs=("FpnRois", "RoisNum"))
+def _collect_fpn_proposals(ctx, ins, attrs):
+    rois = jnp.concatenate(ins["MultiLevelRois"])
+    scores = jnp.concatenate([s.reshape(-1)
+                              for s in ins["MultiLevelScores"]])
+    n = attrs.get("post_nms_topN", rois.shape[0])
+    n = min(n, rois.shape[0])
+    _, idx = jax.lax.top_k(scores, n)
+    return {"FpnRois": [rois[idx]],
+            "RoisNum": [jnp.asarray([n], jnp.int32)]}
+
+
+@register_op("distribute_fpn_proposals", nondiff_inputs=("FpnRois",),
+             nondiff_outputs=("MultiFpnRois", "RestoreIndex",
+                              "MultiLevelRoIsNum"))
+def _distribute_fpn_proposals(ctx, ins, attrs):
+    """route each RoI to its FPN level by scale (distribute_fpn_
+    proposals_op); padded per-level outputs, inactive rows zeroed."""
+    rois = ins["FpnRois"][0]
+    min_level = attrs.get("min_level", 2)
+    max_level = attrs.get("max_level", 5)
+    refer_level = attrs.get("refer_level", 4)
+    refer_scale = attrs.get("refer_scale", 224)
+    n = rois.shape[0]
+    scale = jnp.sqrt(_area(rois))
+    lvl = jnp.floor(refer_level + jnp.log2(scale / refer_scale + 1e-8))
+    lvl = jnp.clip(lvl, min_level, max_level).astype(jnp.int32)
+    outs, nums = [], []
+    for l in range(min_level, max_level + 1):
+        m = (lvl == l)[:, None]
+        outs.append(jnp.where(m, rois, 0.0))
+        nums.append(jnp.sum(lvl == l))
+    return {"MultiFpnRois": outs,
+            "RestoreIndex": [jnp.arange(n, dtype=jnp.int32)[:, None]],
+            "MultiLevelRoIsNum": [jnp.stack(nums).astype(jnp.int32)]}
+
+
+@register_op("generate_proposals",
+             nondiff_inputs=("Scores", "BboxDeltas", "ImInfo", "Anchors",
+                             "Variances"),
+             nondiff_outputs=("RpnRois", "RpnRoiProbs", "RpnRoisNum"))
+def _generate_proposals(ctx, ins, attrs):
+    """RPN proposal generation (generate_proposals_op): decode deltas at
+    anchors, clip to image, top-k by score, NMS; padded output."""
+    scores = ins["Scores"][0]        # [N, A, H, W]
+    deltas = ins["BboxDeltas"][0]    # [N, A*4, H, W]
+    iminfo = ins["ImInfo"][0]        # [N, 3]
+    anchors = ins["Anchors"][0].reshape(-1, 4)
+    pre_n = attrs.get("pre_nms_topN", 256)
+    post_n = attrs.get("post_nms_topN", 64)
+    nms_thr = attrs.get("nms_thresh", 0.7)
+
+    def one(sc, dl, info):
+        # anchors are laid out [H, W, A, 4] (anchor_generator); flatten
+        # scores [A, H, W] and deltas [A*4, H, W] into the same H, W, A
+        # order (the reference transposes with axis={0,2,3,1},
+        # generate_proposals_op.cc)
+        s = sc.transpose(1, 2, 0).reshape(-1)
+        d = dl.reshape(-1, 4, dl.shape[-2], dl.shape[-1]) \
+            .transpose(2, 3, 0, 1).reshape(-1, 4)
+        aw = anchors[:, 2] - anchors[:, 0] + 1
+        ah = anchors[:, 3] - anchors[:, 1] + 1
+        acx = anchors[:, 0] + aw / 2
+        acy = anchors[:, 1] + ah / 2
+        cx = acx + d[:, 0] * aw
+        cy = acy + d[:, 1] * ah
+        bw = jnp.exp(jnp.clip(d[:, 2], -10, 10)) * aw
+        bh = jnp.exp(jnp.clip(d[:, 3], -10, 10)) * ah
+        boxes = jnp.stack([cx - bw / 2, cy - bh / 2,
+                           cx + bw / 2, cy + bh / 2], axis=1)
+        boxes = jnp.clip(boxes, 0.0,
+                         jnp.asarray([info[1], info[0],
+                                      info[1], info[0]]) - 1)
+        k = min(pre_n, s.shape[0])
+        top_s, top_i = jax.lax.top_k(s, k)
+        top_b = boxes[top_i]
+        kept = _nms_padded(top_b, top_s, nms_thr, -1e9,
+                           min(post_n, k))
+        out_b = jnp.where((kept >= 0)[:, None],
+                          top_b[jnp.maximum(kept, 0)], 0.0)
+        out_s = jnp.where(kept >= 0, top_s[jnp.maximum(kept, 0)], 0.0)
+        return out_b, out_s, jnp.sum(kept >= 0)
+
+    b, s, n = jax.vmap(one)(scores, deltas, iminfo)
+    return {"RpnRois": [b.reshape(-1, 4)],
+            "RpnRoiProbs": [s.reshape(-1, 1)],
+            "RpnRoisNum": [n.astype(jnp.int32)]}

@@ -1,0 +1,428 @@
+"""Loss/metric ops completing Appendix A parity: robust losses, CTC,
+CRF, sampled softmax, ranking metrics.
+
+CTC (warpctc) and the linear-chain CRF use log-semiring scans — the
+XLA-native replacement for the reference's hand-written DP kernels
+(operators/warpctc_op, linear_chain_crf_op).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import io_callback
+
+from ..core.registry import register_op
+
+NEG = -1e30
+
+
+@register_op("modified_huber_loss", nondiff_inputs=("Y",))
+def _modified_huber(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]  # y in {0,1}
+    yy = 2.0 * y - 1.0
+    z = x * yy
+    loss = jnp.where(z >= -1.0, jnp.square(jnp.maximum(1.0 - z, 0.0)),
+                     -4.0 * z)
+    return {"Out": [loss], "IntermediateVal": [z]}
+
+
+@register_op("sigmoid_focal_loss", nondiff_inputs=("Label", "FgNum"))
+def _sigmoid_focal_loss(ctx, ins, attrs):
+    x = ins["X"][0]                       # [N, C] logits
+    label = ins["Label"][0].reshape(-1)   # [N] in [0, C] (0 = background)
+    fg = jnp.maximum(ins["FgNum"][0].reshape(()).astype(x.dtype), 1.0)
+    gamma = attrs.get("gamma", 2.0)
+    alpha = attrs.get("alpha", 0.25)
+    c = x.shape[1]
+    target = jax.nn.one_hot(label - 1, c, dtype=x.dtype)  # label 0 -> none
+    p = jax.nn.sigmoid(x)
+    ce = jnp.logaddexp(0.0, jnp.where(target > 0, -x, x))
+    p_t = jnp.where(target > 0, p, 1.0 - p)
+    a_t = jnp.where(target > 0, alpha, 1.0 - alpha)
+    loss = a_t * jnp.power(1.0 - p_t, gamma) * ce / fg
+    return {"Out": [loss]}
+
+
+@register_op("teacher_student_sigmoid_loss", nondiff_inputs=("Label",))
+def _ts_sigmoid_loss(ctx, ins, attrs):
+    x = ins["X"][0]
+    label = ins["Label"][0]
+    # reference: label < -1 -> teacher branch encoded, here the documented
+    # piecewise form (teacher_student_sigmoid_loss_op.cc)
+    ce = jnp.logaddexp(0.0, x) - x * (label > 0.0)
+    soft = jnp.logaddexp(0.0, x) - x * jnp.clip(label, 0.0, 1.0)
+    return {"Y": [jnp.where(jnp.abs(label) <= 1.0, soft, ce)]}
+
+
+@register_op("cvm", nondiff_inputs=("CVM",))
+def _cvm(ctx, ins, attrs):
+    """continuous_value_model op: strip/keep the 2 leading show/click
+    columns (cvm_op.cc)."""
+    x = ins["X"][0]
+    if attrs.get("use_cvm", True):
+        return {"Y": [x]}
+    return {"Y": [x[:, 2:]]}
+
+
+@register_op("positive_negative_pair",
+             nondiff_inputs=("Score", "Label", "QueryID"),
+             nondiff_outputs=("PositivePair", "NegativePair", "NeutralPair"))
+def _pnpair(ctx, ins, attrs):
+    score = ins["Score"][0].reshape(-1)
+    label = ins["Label"][0].reshape(-1)
+    qid = ins["QueryID"][0].reshape(-1)
+    same_q = qid[:, None] == qid[None, :]
+    upper = jnp.triu(jnp.ones_like(same_q), k=1)
+    valid = same_q & (upper > 0)
+    ds = score[:, None] - score[None, :]
+    dl = label[:, None] - label[None, :]
+    pos = jnp.sum(valid & (ds * dl > 0))
+    neg = jnp.sum(valid & (ds * dl < 0))
+    neu = jnp.sum(valid & (dl != 0) & (ds == 0))
+    f = lambda v: v.astype(jnp.float32).reshape(1)
+    return {"PositivePair": [f(pos)], "NegativePair": [f(neg)],
+            "NeutralPair": [f(neu)]}
+
+
+# ---------------------------------------------------------------------------
+# CTC family
+# ---------------------------------------------------------------------------
+
+
+def _ctc_loss_single(logp, labels, blank):
+    """log p(labels | logits) via the standard alpha recursion.
+    logp: [T, C] log-softmax; labels: [L] padded with -1."""
+    L = labels.shape[0]
+    ext = jnp.full((2 * L + 1,), blank, jnp.int32)
+    ext = ext.at[1::2].set(jnp.maximum(labels, 0))
+    valid_lab = labels >= 0
+    n_ext = 2 * jnp.sum(valid_lab) + 1
+    S = ext.shape[0]
+
+    skip_ok = jnp.concatenate([
+        jnp.zeros((2,), bool),
+        (ext[2:] != blank) & (ext[2:] != ext[:-2])])
+
+    alpha0 = jnp.full((S,), NEG)
+    alpha0 = alpha0.at[0].set(logp[0, blank])
+    alpha0 = alpha0.at[1].set(jnp.where(n_ext > 1, logp[0, ext[1]], NEG))
+
+    def step(alpha, lp):
+        stay = alpha
+        prev1 = jnp.concatenate([jnp.full((1,), NEG), alpha[:-1]])
+        prev2 = jnp.where(skip_ok,
+                          jnp.concatenate([jnp.full((2,), NEG),
+                                           alpha[:-2]]), NEG)
+        merged = jnp.logaddexp(jnp.logaddexp(stay, prev1), prev2)
+        return merged + lp[ext], None
+
+    alpha, _ = jax.lax.scan(step, alpha0, logp[1:])
+    last = alpha[n_ext - 1]
+    last2 = jnp.where(n_ext > 1, alpha[n_ext - 2], NEG)
+    return -jnp.logaddexp(last, last2)
+
+
+@register_op("warpctc", nondiff_inputs=("Label",))
+def _warpctc(ctx, ins, attrs):
+    """CTC loss (warpctc_op). Inputs are padded: Logits [B, T, C] (or the
+    reference's LoD layout already padded by the layers front end),
+    Label [B, L] padded with -1."""
+    logits = ins["Logits"][0]
+    labels = ins["Label"][0].astype(jnp.int32)
+    blank = attrs.get("blank", 0)
+    if logits.ndim == 2:  # [T, C] single sequence
+        logits = logits[None]
+        labels = labels.reshape(1, -1)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    losses = jax.vmap(lambda lp, lb: _ctc_loss_single(lp, lb, blank))(
+        logp, labels)
+    if attrs.get("norm_by_times", False):
+        losses = losses / logits.shape[1]
+    return {"Loss": [losses.reshape(-1, 1).astype(logits.dtype)],
+            "WarpCTCGrad": [jnp.zeros_like(logits)]}
+
+
+@register_op("ctc_align", nondiff_inputs=("Input",),
+             nondiff_outputs=("Output",))
+def _ctc_align(ctx, ins, attrs):
+    """Greedy CTC decode: merge repeats then drop blanks; padded with -1
+    (ctc_align_op)."""
+    x = ins["Input"][0].astype(jnp.int32)  # [B, T] argmax ids
+    blank = attrs.get("blank", 0)
+    prev = jnp.concatenate([jnp.full_like(x[:, :1], -1), x[:, :-1]],
+                           axis=1)
+    keep = (x != blank) & (x != prev)
+    order = jnp.argsort(~keep, axis=1, stable=True)
+    gathered = jnp.take_along_axis(x, order, axis=1)
+    kept = jnp.take_along_axis(keep, order, axis=1)
+    return {"Output": [jnp.where(kept, gathered, -1).astype(jnp.int64)]}
+
+
+@register_op("edit_distance", nondiff_inputs=("Hyps", "Refs"),
+             nondiff_outputs=("Out", "SequenceNum"))
+def _edit_distance(ctx, ins, attrs):
+    """Levenshtein distance per row over -1-padded id sequences
+    (edit_distance_op). DP over a scan; O(L1*L2)."""
+    hyps = ins["Hyps"][0].astype(jnp.int32)
+    refs = ins["Refs"][0].astype(jnp.int32)
+    norm = attrs.get("normalized", True)
+
+    def one(h, r):
+        lh = jnp.sum(h >= 0)
+        lr = jnp.sum(r >= 0)
+        L2 = r.shape[0]
+        row0 = jnp.arange(L2 + 1, dtype=jnp.float32)
+
+        def outer(row, hi):
+            i, hv = hi
+
+            def inner(carry, j):
+                prev_diag, row_new = carry
+                cost = jnp.where(hv == r[j], 0.0, 1.0)
+                val = jnp.minimum(jnp.minimum(
+                    row[j + 1] + 1.0,        # delete
+                    row_new[j] + 1.0),       # insert
+                    prev_diag + cost)        # substitute
+                return (row[j + 1], row_new.at[j + 1].set(val)), None
+
+            row_new0 = jnp.zeros_like(row).at[0].set(i + 1.0)
+            (_, row_new), _ = jax.lax.scan(
+                inner, (row[0], row_new0), jnp.arange(L2))
+            # rows past the hyp length keep the previous values
+            return jnp.where(i < lh, row_new, row), None
+
+        rows, _ = jax.lax.scan(
+            outer, row0, (jnp.arange(h.shape[0], dtype=jnp.float32), h))
+        d = rows[lr]
+        return jnp.where(norm & (lr > 0), d / lr, d)
+
+    out = jax.vmap(one)(hyps, refs)
+    return {"Out": [out.reshape(-1, 1)],
+            "SequenceNum": [jnp.asarray([hyps.shape[0]], jnp.int64)]}
+
+
+# ---------------------------------------------------------------------------
+# linear-chain CRF (linear_chain_crf_op.cc) + viterbi decode
+# ---------------------------------------------------------------------------
+
+
+def _crf_norm_single(emission, transition, length):
+    """log Z via forward algorithm. emission [T, n]; transition
+    [n+2, n]: row 0 = start, row 1 = stop, rows 2.. = pairwise."""
+    T, n = emission.shape
+    start, stop, pair = transition[0], transition[1], transition[2:]
+    a0 = start + emission[0]
+
+    def step(carry, te):
+        t, e = te
+        nxt = jax.nn.logsumexp(carry[:, None] + pair, axis=0) + e
+        return jnp.where(t < length, nxt, carry), None
+
+    a, _ = jax.lax.scan(step, a0,
+                        (jnp.arange(1, T), emission[1:]))
+    return jax.nn.logsumexp(a + stop)
+
+
+def _crf_path_score(emission, transition, label, length):
+    T, n = emission.shape
+    start, stop, pair = transition[0], transition[1], transition[2:]
+    sc = start[label[0]] + emission[0, label[0]]
+
+    def step(carry, t):
+        valid = t < length
+        add = pair[label[t - 1], label[t]] + emission[t, label[t]]
+        return carry + jnp.where(valid, add, 0.0), None
+
+    sc, _ = jax.lax.scan(step, sc, jnp.arange(1, T))
+    last = jnp.clip(length - 1, 0, T - 1)
+    return sc + stop[label[last]]
+
+
+@register_op("linear_chain_crf", nondiff_inputs=("Label", "Length"))
+def _linear_chain_crf(ctx, ins, attrs):
+    """Padded formulation: Emission [B, T, n], Label [B, T],
+    Length [B] (defaults to full T)."""
+    em = ins["Emission"][0].astype(jnp.float32)
+    trans = ins["Transition"][0].astype(jnp.float32)
+    label = ins["Label"][0].astype(jnp.int32)
+    if em.ndim == 2:
+        em, label = em[None], label.reshape(1, -1)
+    B, T, n = em.shape
+    if "Length" in ins:
+        length = ins["Length"][0].reshape(-1).astype(jnp.int32)
+    else:
+        length = jnp.full((B,), T, jnp.int32)
+    logz = jax.vmap(lambda e, l: _crf_norm_single(e, trans, l))(em, length)
+    score = jax.vmap(lambda e, lb, l: _crf_path_score(e, trans, lb, l))(
+        em, label, length)
+    ll = logz - score
+    return {"LogLikelihood": [ll.reshape(-1, 1)],
+            "Alpha": [jnp.zeros_like(em)],
+            "EmissionExps": [jnp.exp(em)],
+            "TransitionExps": [jnp.exp(trans)]}
+
+
+@register_op("crf_decoding", nondiff_inputs=("Label", "Length"),
+             nondiff_outputs=("ViterbiPath",))
+def _crf_decoding(ctx, ins, attrs):
+    """Length-aware Viterbi: steps past a row's length carry state
+    through, so the backtrace starts from the LAST VALID position. With
+    Label given, returns per-position correctness 0/1 (crf_decoding_op)."""
+    em = ins["Emission"][0].astype(jnp.float32)
+    trans = ins["Transition"][0].astype(jnp.float32)
+    if em.ndim == 2:
+        em = em[None]
+    B, T, n = em.shape
+    if "Length" in ins:
+        length = ins["Length"][0].reshape(-1).astype(jnp.int32)
+    else:
+        length = jnp.full((B,), T, jnp.int32)
+    start, stop, pair = trans[0], trans[1], trans[2:]
+
+    def one(e, l):
+        a0 = start + e[0]
+
+        def fwd(carry, te):
+            t, et = te
+            scores = carry[:, None] + pair + et[None, :]
+            nxt = jnp.max(scores, axis=0)
+            bp = jnp.argmax(scores, axis=0)
+            valid = t < l
+            # past-the-end: carry alphas through, backpointer = identity
+            nxt = jnp.where(valid, nxt, carry)
+            bp = jnp.where(valid, bp, jnp.arange(n))
+            return nxt, bp
+
+        a, back = jax.lax.scan(fwd, a0, (jnp.arange(1, T), e[1:]))
+        lastt = jnp.argmax(a + stop)
+
+        def bwd(tag, bp):
+            return bp[tag], tag
+
+        first, path_rev = jax.lax.scan(bwd, lastt, back, reverse=True)
+        return jnp.concatenate([first[None], path_rev])
+
+    path = jax.vmap(one)(em, length)
+    if "Label" in ins:  # correctness-indicator mode
+        label = ins["Label"][0].reshape(B, -1).astype(path.dtype)
+        return {"ViterbiPath": [(path == label).astype(jnp.int64)]}
+    return {"ViterbiPath": [path.astype(jnp.int64)]}
+
+
+# ---------------------------------------------------------------------------
+# sampled softmax family
+# ---------------------------------------------------------------------------
+
+
+@register_op("nce", nondiff_inputs=("Label", "SampleWeight",
+                                    "CustomDistProbs", "CustomDistAlias",
+                                    "CustomDistAliasProbs"))
+def _nce(ctx, ins, attrs):
+    """Noise-contrastive estimation (nce_op): uniform negative sampling,
+    logistic loss over the true + sampled classes."""
+    x = ins["Input"][0]                  # [B, d]
+    w = ins["Weight"][0]                 # [N, d]
+    label = ins["Label"][0].reshape(-1).astype(jnp.int32)
+    b = ins["Bias"][0].reshape(-1) if "Bias" in ins else None
+    n_neg = attrs.get("num_neg_samples", 10)
+    total = attrs.get("num_total_classes", w.shape[0])
+    B = x.shape[0]
+    neg = jax.random.randint(ctx.rng, (B, n_neg), 0, total)
+    ids = jnp.concatenate([label[:, None], neg], axis=1)  # [B, 1+n]
+    wt = jnp.take(w, ids, axis=0)                         # [B, 1+n, d]
+    logits = jnp.einsum("bd,bkd->bk", x, wt)
+    if b is not None:
+        logits = logits + jnp.take(b, ids)
+    # logistic: true label positive, samples negative; uniform q
+    logq = jnp.log(jnp.asarray(n_neg / total, logits.dtype))
+    adj = logits - logq
+    labels01 = jnp.concatenate(
+        [jnp.ones((B, 1)), jnp.zeros((B, n_neg))], axis=1)
+    loss = jnp.sum(jnp.logaddexp(0.0, adj) - adj * labels01, axis=1)
+    return {"Cost": [loss.reshape(-1, 1)],
+            "SampleLogits": [logits],
+            "SampleLabels": [ids.astype(jnp.int64)]}
+
+
+@register_op("sample_logits", nondiff_inputs=("Labels",))
+def _sample_logits(ctx, ins, attrs):
+    """sampled_softmax_with_cross_entropy front half (sample_logits_op):
+    gather true + uniformly sampled logits, correct by log q."""
+    logits = ins["Logits"][0]            # [B, N]
+    labels = ins["Labels"][0].astype(jnp.int32)  # [B, nt]
+    n_samp = attrs.get("num_samples", 10)
+    B, N = logits.shape
+    nt = labels.shape[1]
+    samples = jax.random.randint(ctx.rng, (B, n_samp), 0, N)
+    ids = jnp.concatenate([labels, samples], axis=1)
+    picked = jnp.take_along_axis(logits, ids, axis=1)
+    if attrs.get("remove_accidental_hits", True):
+        acc = samples[:, None, :] == labels[:, :, None]  # [B, nt, ns]
+        hit = jnp.any(acc, axis=1)
+        picked = picked.at[:, nt:].add(jnp.where(hit, NEG, 0.0))
+    logq = jnp.log(jnp.asarray(n_samp / N, picked.dtype))
+    picked = picked - logq
+    new_labels = jnp.broadcast_to(jnp.arange(nt), (B, nt))
+    return {"SampledLogits": [picked],
+            "SampledLabels": [new_labels.astype(jnp.int64)],
+            "Samples": [ids.astype(jnp.int64)],
+            "Probabilities": [jnp.full_like(picked, 1.0 / N)],
+            "LogitsDim": [jnp.asarray(logits.shape, jnp.int64)],
+            "LabelsDim": [jnp.asarray(labels.shape, jnp.int64)]}
+
+
+@register_op("chunk_eval", nondiff_inputs=("Inference", "Label", "SeqLength"),
+             nondiff_outputs=("Precision", "Recall", "F1-Score",
+                              "NumInferChunks", "NumLabelChunks",
+                              "NumCorrectChunks"))
+def _chunk_eval(ctx, ins, attrs):
+    """IOB chunk metrics via a host callback (chunk_eval_op is pure
+    bookkeeping, not device math)."""
+    inf = ins["Inference"][0]
+    lab = ins["Label"][0]
+    n_types = attrs.get("num_chunk_types", 1)
+    scheme = attrs.get("chunk_scheme", "IOB")
+
+    def extract(seq):
+        # IOB: tag = type*2 (B) / type*2+1 (I); O = n_types*2
+        chunks = []
+        start, typ = None, None
+        for i, t in enumerate(list(seq)):
+            t = int(t)
+            if t >= n_types * 2:  # O
+                if start is not None:
+                    chunks.append((start, i, typ))
+                start = None
+                continue
+            ty, isB = t // 2, t % 2 == 0
+            if isB or start is None or ty != typ:
+                if start is not None:
+                    chunks.append((start, i, typ))
+                start, typ = i, ty
+        if start is not None:
+            chunks.append((start, len(seq), typ))
+        return set(chunks)
+
+    def cb(inf, lab):
+        ic = lc = cc = 0
+        for row_i, row_l in zip(np.asarray(inf).reshape(inf.shape[0], -1),
+                                np.asarray(lab).reshape(lab.shape[0], -1)):
+            a, b = extract(row_i), extract(row_l)
+            ic += len(a)
+            lc += len(b)
+            cc += len(a & b)
+        p = cc / ic if ic else 0.0
+        r = cc / lc if lc else 0.0
+        f = 2 * p * r / (p + r) if p + r else 0.0
+        mk = lambda v, d: np.asarray([v], d)
+        return (mk(p, np.float32), mk(r, np.float32), mk(f, np.float32),
+                mk(ic, np.int64), mk(lc, np.int64), mk(cc, np.int64))
+
+    structs = (jax.ShapeDtypeStruct((1,), jnp.float32),) * 3 + \
+        (jax.ShapeDtypeStruct((1,), jnp.int64),) * 3
+    p, r, f, ic, lc, cc = io_callback(cb, structs, inf, lab, ordered=True)
+    return {"Precision": [p], "Recall": [r], "F1-Score": [f],
+            "NumInferChunks": [ic], "NumLabelChunks": [lc],
+            "NumCorrectChunks": [cc]}

@@ -1,0 +1,425 @@
+"""Misc ops closing the SURVEY.md Appendix A parity list: tensor utils,
+SelectedRows compat, framework/host ops (save/load/py_func), distributed
+PS helper ops.
+
+Static-shape notes (XLA): ops whose reference semantics produce
+data-dependent shapes (`where`, `unique`) return padded, fixed-size
+results with a documented fill value — the TPU formulation of the same
+information (SURVEY.md §7 hard part (a)).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import io_callback
+
+from ..core.dtypes import as_np_dtype
+from ..core.registry import register_op
+
+# ---------------------------------------------------------------------------
+# tensor utils
+# ---------------------------------------------------------------------------
+
+
+@register_op("where", nondiff_inputs=("Condition",),
+             nondiff_outputs=("Out",))
+def _where_index(ctx, ins, attrs):
+    """Indices of true elements (where_index_op). Padded to cond.size rows
+    with -1 (XLA static shapes); valid rows come first."""
+    cond = ins["Condition"][0]
+    n = int(np.prod(cond.shape))
+    flat = cond.reshape(-1) != 0
+    order = jnp.argsort(~flat)  # trues first, stable
+    taken = jnp.where(flat[order], order, -1)
+    idx = jnp.stack(jnp.unravel_index(jnp.maximum(taken, 0), cond.shape),
+                    axis=1).astype(jnp.int64)
+    idx = jnp.where((taken >= 0)[:, None], idx, -1)
+    return {"Out": [idx]}
+
+
+@register_op("unique", nondiff_inputs=("X",), nondiff_outputs=("Out",
+                                                               "Index"))
+def _unique(ctx, ins, attrs):
+    x = ins["X"][0].reshape(-1)
+    u, inv = jnp.unique(x, return_inverse=True, size=x.shape[0],
+                        fill_value=x[0])
+    return {"Out": [u], "Index": [inv.astype(jnp.int64)]}
+
+
+@register_op("unique_with_counts", nondiff_inputs=("X",),
+             nondiff_outputs=("Out", "Index", "Count"))
+def _unique_with_counts(ctx, ins, attrs):
+    x = ins["X"][0].reshape(-1)
+    u, inv, cnt = jnp.unique(x, return_inverse=True, return_counts=True,
+                             size=x.shape[0], fill_value=x[0])
+    return {"Out": [u], "Index": [inv.astype(jnp.int64)],
+            "Count": [cnt.astype(jnp.int64)]}
+
+
+def _crop_impl(ctx, ins, attrs):
+    x = ins["X"][0]
+    shape = [int(s) for s in
+             (ins["Y"][0].shape if "Y" in ins else attrs["shape"])]
+    if "Offsets" in ins:
+        offs = tuple(ins["Offsets"][0][i].astype(jnp.int32)
+                     for i in range(x.ndim))
+        out = jax.lax.dynamic_slice(x, offs, shape)
+    else:
+        offsets = list(attrs.get("offsets") or [0] * x.ndim)
+        out = jax.lax.slice(x, offsets,
+                            [o + s for o, s in zip(offsets, shape)])
+    return {"Out": [out]}
+
+
+register_op("crop", nondiff_inputs=("Y", "Offsets"))(_crop_impl)
+register_op("crop_tensor", nondiff_inputs=("Shape", "Offsets"))(_crop_impl)
+
+
+@register_op("pad_constant_like")
+def _pad_constant_like(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]  # pad Y up to X's shape
+    val = attrs.get("pad_value", 0.0)
+    pads = [(0, int(xs - ys)) for xs, ys in zip(x.shape, y.shape)]
+    return {"Out": [jnp.pad(y, pads, constant_values=val)]}
+
+
+@register_op("fill")
+def _fill(ctx, ins, attrs):
+    arr = np.asarray(attrs["value"],
+                     dtype=as_np_dtype(attrs.get("dtype", "float32")))
+    return {"Out": [jnp.asarray(arr).reshape(attrs["shape"])]}
+
+
+@register_op("gaussian_random_batch_size_like", nondiff_inputs=("Input",))
+def _gaussian_batch_like(ctx, ins, attrs):
+    ref = ins["Input"][0]
+    shape = [int(s) for s in attrs["shape"]]
+    shape[attrs.get("output_dim_idx", 0)] = \
+        ref.shape[attrs.get("input_dim_idx", 0)]
+    out = attrs.get("mean", 0.0) + attrs.get("std", 1.0) * \
+        jax.random.normal(ctx.rng, tuple(shape))
+    return {"Out": [out.astype(as_np_dtype(attrs.get("dtype", "float32")))]}
+
+
+@register_op("random_crop", nondiff_inputs=("Seed",), stateful=True)
+def _random_crop(ctx, ins, attrs):
+    x = ins["X"][0]
+    shape = [int(s) for s in attrs["shape"]]
+    lead = x.ndim - len(shape)
+    starts = []
+    keys = jax.random.split(ctx.rng, len(shape))
+    for i, (dim, want) in enumerate(zip(x.shape[lead:], shape)):
+        starts.append(jax.random.randint(keys[i], (), 0, dim - want + 1))
+    full = [jnp.zeros((), jnp.int32)] * lead + starts
+    out = jax.lax.dynamic_slice(x, tuple(full),
+                                list(x.shape[:lead]) + shape)
+    return {"Out": [out], "SeedOut": ins.get("Seed", [jnp.zeros(1)])}
+
+
+@register_op("hash", nondiff_inputs=("X",), nondiff_outputs=("Out",))
+def _hash(ctx, ins, attrs):
+    """hash_op: polynomial bucket-hash of each id row (num_hash hashes
+    mod mod_by)."""
+    x = ins["X"][0].astype(jnp.uint32)
+    num_hash = attrs.get("num_hash", 1)
+    mod_by = attrs.get("mod_by", 100000)
+    outs = []
+    for h in range(num_hash):
+        mult = jnp.uint32(2654435761 + 97 * h)
+        acc = jnp.zeros(x.shape[:-1], jnp.uint32)
+        for j in range(x.shape[-1]):
+            acc = acc * mult + x[..., j]
+        outs.append((acc % jnp.uint32(mod_by)).astype(jnp.int64))
+    out = jnp.stack(outs, axis=-1)[..., None]
+    return {"Out": [out.reshape(x.shape[:-1] + (num_hash, 1))]}
+
+
+@register_op("coalesce_tensor")
+def _coalesce_tensor(ctx, ins, attrs):
+    """coalesce_tensor_op: fuse vars into one contiguous buffer. XLA owns
+    layout, so Output aliases Input and FusedOutput is the flat concat."""
+    xs = ins["Input"]
+    fused = jnp.concatenate([x.reshape(-1) for x in xs])
+    return {"Output": list(xs), "FusedOutput": [fused]}
+
+
+@register_op("squared_l2_distance")
+def _squared_l2_distance(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    sub = x - y
+    return {"Out": [jnp.sum(sub * sub, axis=tuple(range(1, sub.ndim)),
+                            keepdims=True).reshape(x.shape[0], 1)],
+            "sub_result": [sub]}
+
+
+@register_op("l1_norm")
+def _l1_norm(ctx, ins, attrs):
+    return {"Out": [jnp.sum(jnp.abs(ins["X"][0])).reshape(1)]}
+
+
+@register_op("fsp")
+def _fsp(ctx, ins, attrs):
+    """FSP matrix (distillation): Gram between two feature maps over
+    spatial dims: [b, c1, c2]."""
+    x, y = ins["X"][0], ins["Y"][0]
+    b, c1 = x.shape[0], x.shape[1]
+    c2 = y.shape[1]
+    hw = int(np.prod(x.shape[2:]))
+    xf = x.reshape(b, c1, hw)
+    yf = y.reshape(b, c2, hw)
+    return {"Out": [jnp.einsum("bch,bdh->bcd", xf, yf) / hw]}
+
+
+# ---------------------------------------------------------------------------
+# SelectedRows compat: sparse rows are dense on TPU (scatter-add grads are
+# XLA-native), so these become views/identities (selected_rows.h)
+# ---------------------------------------------------------------------------
+
+
+@register_op("get_tensor_from_selected_rows")
+def _get_tensor_from_sr(ctx, ins, attrs):
+    return {"Out": [ins["X"][0]]}
+
+
+@register_op("merge_selected_rows")
+def _merge_sr(ctx, ins, attrs):
+    return {"Out": [ins["X"][0]]}
+
+
+@register_op("split_selected_rows", nondiff_inputs=("X",))
+def _split_sr(ctx, ins, attrs):
+    x = ins["X"][0]
+    sections = attrs.get("height_sections", [])
+    outs, start = [], 0
+    for s in sections:
+        outs.append(x[start:start + s])
+        start += s
+    return {"Out": outs}
+
+
+# ---------------------------------------------------------------------------
+# framework/host ops
+# ---------------------------------------------------------------------------
+
+
+@register_op("delete_var")
+def _delete_var(ctx, ins, attrs):
+    return {}  # XLA buffer liveness handles deletion
+
+
+@register_op("get_places", nondiff_outputs=("Out",))
+def _get_places(ctx, ins, attrs):
+    return {"Out": [jnp.arange(attrs.get("device_count", 1) or 1,
+                               dtype=jnp.int64)]}
+
+
+@register_op("save", nondiff_inputs=("X",))
+def _save(ctx, ins, attrs):
+    """save_op: host-side persist of one var (operators/save_op.cc)."""
+    path = attrs["file_path"]
+    x = ins["X"][0]
+
+    def cb(arr):
+        import os
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        np.save(path, np.asarray(arr), allow_pickle=False)
+        return np.uint32(0)
+
+    return {"Out": [io_callback(cb, jax.ShapeDtypeStruct((), jnp.uint32),
+                                x, ordered=True)]}
+
+
+@register_op("save_combine", nondiff_inputs=("X",))
+def _save_combine(ctx, ins, attrs):
+    path = attrs["file_path"]
+    names = attrs.get("var_names") or [str(i) for i in
+                                       range(len(ins["X"]))]
+
+    def cb(*arrs):
+        import os
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        np.savez(path, **{n: np.asarray(a) for n, a in zip(names, arrs)})
+        return np.uint32(0)
+
+    return {"Out": [io_callback(cb, jax.ShapeDtypeStruct((), jnp.uint32),
+                                *ins["X"], ordered=True)]}
+
+
+@register_op("load")
+def _load(ctx, ins, attrs):
+    path = attrs["file_path"]
+    shape = tuple(attrs["shape"])
+    dtype = as_np_dtype(attrs.get("dtype", "float32"))
+
+    def cb():
+        p = path if path.endswith(".npy") else path + ".npy"
+        return np.load(p).astype(dtype)
+
+    return {"Out": [io_callback(cb, jax.ShapeDtypeStruct(shape, dtype),
+                                ordered=True)]}
+
+
+@register_op("load_combine")
+def _load_combine(ctx, ins, attrs):
+    path = attrs["file_path"]
+    shapes = attrs["shapes"]
+    dtypes = [as_np_dtype(d) for d in attrs["dtypes"]]
+    names = attrs["var_names"]
+
+    def cb():
+        blob = np.load(path if path.endswith(".npz") else path + ".npz")
+        return tuple(blob[n].astype(d) for n, d in zip(names, dtypes))
+
+    structs = tuple(jax.ShapeDtypeStruct(tuple(s), d)
+                    for s, d in zip(shapes, dtypes))
+    out = io_callback(cb, structs, ordered=True)
+    return {"Out": list(out)}
+
+
+_PY_FUNCS = {}
+
+
+def register_py_func(fn) -> int:
+    """Backs the py_func op (reference layers.py_func): returns the id to
+    store in the op's attrs."""
+    fid = len(_PY_FUNCS)
+    _PY_FUNCS[fid] = fn
+    return fid
+
+
+@register_op("py_func")
+def _py_func(ctx, ins, attrs):
+    fn = _PY_FUNCS[attrs["func_id"]]
+    shapes = attrs["out_shapes"]
+    dtypes = [as_np_dtype(d) for d in attrs["out_dtypes"]]
+    structs = tuple(jax.ShapeDtypeStruct(tuple(s), d)
+                    for s, d in zip(shapes, dtypes))
+
+    def cb(*arrs):
+        out = fn(*[np.asarray(a) for a in arrs])
+        out = out if isinstance(out, (list, tuple)) else [out]
+        return tuple(np.asarray(o).astype(d)
+                     for o, d in zip(out, dtypes))
+
+    out = io_callback(cb, structs, *ins.get("X", []), ordered=True)
+    return {"Out": list(out)}
+
+
+# ---------------------------------------------------------------------------
+# distributed PS helper ops (operators/distributed_ops/)
+# ---------------------------------------------------------------------------
+
+
+@register_op("gen_nccl_id")
+def _gen_nccl_id(ctx, ins, attrs):
+    return {}  # topology comes from the platform (SURVEY.md §2.8)
+
+
+@register_op("broadcast")
+def _broadcast(ctx, ins, attrs):
+    x = ins["X"][0]
+    # inside shard_map: everyone takes root's value; GSPMD mode: identity
+    from .collective import _axis_name, _in_shard_map
+    axis = _axis_name(attrs)
+    if _in_shard_map(axis):
+        root = attrs.get("root", 0)
+        idx = jax.lax.axis_index(axis)
+        x = jax.lax.psum(jnp.where(idx == root, x, jnp.zeros_like(x)),
+                         axis)
+    return {"Out": [x]}
+
+
+@register_op("prefetch")
+def _prefetch(ctx, ins, attrs):
+    """Pull a var from a pserver ahead of use (prefetch_op)."""
+    from .distributed_ops import _recv
+    return _recv(ctx, ins, attrs)
+
+
+@register_op("split_ids", nondiff_inputs=("Ids",),
+             nondiff_outputs=("Out",))
+def _split_ids(ctx, ins, attrs):
+    ids = ins["Ids"][0].reshape(-1)
+    n = attrs.get("num_splits") or len(attrs.get("endpoints", [])) or 1
+    # mod-placement, padded with -1 (trainer-side shard routing)
+    outs = []
+    for i in range(n):
+        mask = (ids % n) == i
+        order = jnp.argsort(~mask)
+        sel = jnp.where(mask[order], ids[order], -1)
+        outs.append(sel.reshape(-1, 1))
+    return {"Out": outs}
+
+
+@register_op("merge_ids", nondiff_inputs=("Ids", "Rows", "X"),
+             nondiff_outputs=("Out",))
+def _merge_ids(ctx, ins, attrs):
+    return {"Out": [jnp.concatenate([x.reshape(-1, x.shape[-1])
+                                     for x in ins["X"]])]}
+
+
+@register_op("split_byref", nondiff_inputs=("X",))
+def _split_byref(ctx, ins, attrs):
+    x = ins["X"][0]
+    sections = attrs.get("sections", [])
+    outs, start = [], 0
+    for s in sections:
+        outs.append(x[start:start + s])
+        start += s
+    return {"Out": outs}
+
+
+@register_op("ref_by_trainer_id", nondiff_inputs=("TrainerId",))
+def _ref_by_trainer_id(ctx, ins, attrs):
+    tid = ins["TrainerId"][0].reshape(()).astype(jnp.int32)
+    xs = ins["X"]
+    return {"Out": [jax.lax.switch(jnp.clip(tid, 0, len(xs) - 1),
+                                   [lambda i=i: xs[i]
+                                    for i in range(len(xs))])]}
+
+
+@register_op("fake_init")
+def _fake_init(ctx, ins, attrs):
+    """Marks a var as lazily-initialized-elsewhere (PS sparse tables);
+    materializes zeros so the XLA program stays total."""
+    shape = tuple(int(s) for s in attrs["shape"])
+    return {"Out": [jnp.zeros(shape,
+                              as_np_dtype(attrs.get("dtype", "float32")))]}
+
+
+@register_op("lookup_sparse_table", nondiff_inputs=("Ids",))
+def _lookup_sparse_table(ctx, ins, attrs):
+    w, ids = ins["W"][0], ins["Ids"][0]
+    return {"Out": [jnp.take(w, ids.reshape(-1) % w.shape[0], axis=0)]}
+
+
+@register_op("distributed_lookup_table", nondiff_inputs=("Ids",))
+def _distributed_lookup_table(ctx, ins, attrs):
+    w = ins["W"][0]
+    outs = []
+    for ids in ins["Ids"]:
+        outs.append(jnp.take(w, ids.reshape(-1) % w.shape[0], axis=0))
+    return {"Outputs": outs}
+
+
+@register_op("checkpoint_notify")
+def _checkpoint_notify(ctx, ins, attrs):
+    """Tell pservers to snapshot (checkpoint_notify_op): host callback to
+    each endpoint; endpoints that are down are skipped."""
+    eps = list(attrs.get("endpoints", []))
+    dirname = attrs.get("dirname", "")
+
+    def cb():
+        from ..distributed.rpc import RPCClient
+        c = RPCClient.instance(attrs.get("trainer_id", 0))
+        for ep in eps:
+            try:
+                c._call(ep, {"method": "checkpoint", "dirname": dirname})
+            except (ConnectionError, OSError):
+                pass
+        return np.uint32(0)
+
+    return {"Out": [io_callback(cb, jax.ShapeDtypeStruct((), jnp.uint32),
+                                ordered=True)]}

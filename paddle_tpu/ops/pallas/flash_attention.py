@@ -44,8 +44,10 @@ NEG_INF = -1e30
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
                 block_k, kv_len):
     # block shapes carry a leading singleton (bh) dim: q_ref[0] = [bq, d],
-    # k_ref[0]/v_ref[0] = [T, d] (full K/V for this head)
-    q = q_ref[0].astype(jnp.float32) * sm_scale
+    # k_ref[0]/v_ref[0] = [T, d] (full K/V for this head).
+    # Operands stay in their input dtype (bf16 under AMP) so the MXU runs
+    # its fast path; every accumulation is f32 via preferred_element_type.
+    q = q_ref[0]
     block_q, d = q.shape
     t = k_ref.shape[1]
     qi = pl.program_id(1)
@@ -58,10 +60,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
 
     def body(kb, carry):
         m, l, acc = carry
-        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :]
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
+        s = s * sm_scale
         if causal or kv_len < t:
             qpos = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
@@ -76,7 +79,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
         alpha = jnp.exp(m - m_new)
         l_new = alpha * l + jnp.sum(p, axis=1, keepdims=True)
         acc_new = alpha * acc + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return m_new, l_new, acc_new
 
@@ -137,8 +140,8 @@ def _fwd(q, k, v, sm_scale, causal, block_q, block_k, kv_len):
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, delta_ref, lse_ref, do_ref, dq_ref,
                    *, sm_scale, causal, block_k, kv_len):
-    q = q_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)
+    q = q_ref[0]
+    do = do_ref[0]
     lse = lse_ref[0, 0, :].astype(jnp.float32)
     block_q, d = q.shape
     t = k_ref.shape[1]
@@ -147,10 +150,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, delta_ref, lse_ref, do_ref, dq_ref,
     num_kb = t // block_k
 
     def body(kb, dq):
-        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        s = jax.lax.dot_general(q * sm_scale, k, (((1,), (1,)), ((), ())),
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :]
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
+        s = s * sm_scale
         if causal or kv_len < t:
             qpos = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
@@ -165,7 +169,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, delta_ref, lse_ref, do_ref, dq_ref,
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * sm_scale
         return dq + jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     if causal:
@@ -180,8 +184,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, delta_ref, lse_ref, do_ref, dq_ref,
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, delta_ref, lse_ref, do_ref,
                     dk_ref, dv_ref, *, sm_scale, causal, block_q, kv_len):
-    k = k_ref[0].astype(jnp.float32)
-    v = v_ref[0].astype(jnp.float32)
+    k = k_ref[0]
+    v = v_ref[0]
     block_k, d = k.shape
     t = q_ref.shape[1]
     ki = pl.program_id(1)
@@ -189,13 +193,14 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, delta_ref, lse_ref, do_ref,
 
     def body(qb, carry):
         dk, dv = carry
-        q = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
-        do = do_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        q = q_ref[0, pl.ds(qb * block_q, block_q), :]
+        do = do_ref[0, pl.ds(qb * block_q, block_q), :]
         lse = lse_ref[0, 0, pl.ds(qb * block_q, block_q)].astype(jnp.float32)
         delta = delta_ref[0, 0, pl.ds(qb * block_q, block_q)].astype(
             jnp.float32)[:, None]
-        s = jax.lax.dot_general(q * sm_scale, k, (((1,), (1,)), ((), ())),
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
+        s = s * sm_scale
         if causal or kv_len < t:
             qpos = qb * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
@@ -206,12 +211,14 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, delta_ref, lse_ref, do_ref,
                 keep = jnp.logical_and(keep, qpos >= kpos)
             s = jnp.where(keep, s, NEG_INF)
         p = jnp.exp(s - lse[:, None])
-        dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+        dv = dv + jax.lax.dot_general(p.astype(do.dtype), do,
+                                      (((0,), (0,)), ((), ())),
                                       preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * sm_scale
-        dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+        dk = dk + jax.lax.dot_general(ds.astype(q.dtype), q,
+                                      (((0,), (0,)), ((), ())),
                                       preferred_element_type=jnp.float32)
         return dk, dv
 

@@ -1,0 +1,507 @@
+"""Final Appendix-A parity batch: fc, DGC, YOLOv3 loss, two-stage
+detector target/label ops, hierarchical sigmoid, detection mAP.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import io_callback
+
+from ..core.registry import register_op
+from .detection_extra import _iou
+
+
+@register_op("fc")
+def _fc(ctx, ins, attrs):
+    """fc as a single op (the layers front end composes mul+add; the op
+    itself exists for loaded programs, fc_op.cc)."""
+    x = ins["Input"][0]
+    w = ins["W"][0]
+    ncd = attrs.get("in_num_col_dims", 1)
+    x2 = x.reshape(int(np.prod(x.shape[:ncd])), -1)
+    out = x2 @ w
+    if "Bias" in ins:
+        out = out + ins["Bias"][0].reshape(-1)
+    act = attrs.get("activation_type", "")
+    if act == "relu":
+        out = jax.nn.relu(out)
+    return {"Out": [out.reshape(x.shape[:ncd] + (w.shape[1],))]}
+
+
+@register_op("listen_and_serv")
+def _listen_and_serv(ctx, ins, attrs):
+    raise RuntimeError(
+        "listen_and_serv is a host server loop, not a device op: run its "
+        "program through Executor.run, which dispatches to "
+        "distributed.ps_server.run_pserver (executor.py)")
+
+
+# ---------------------------------------------------------------------------
+# DGC: deep gradient compression (dgc_op.cc, SURVEY.md §2.7.6)
+# ---------------------------------------------------------------------------
+
+
+@register_op("dgc_clip_by_norm")
+def _dgc_clip_by_norm(ctx, ins, attrs):
+    x = ins["X"][0]
+    max_norm = attrs.get("max_norm", 1.0)
+    n = jnp.sqrt(jnp.sum(x * x))
+    return {"Out": [x * jnp.minimum(1.0, max_norm / jnp.maximum(n, 1e-10))]}
+
+
+@register_op("dgc", nondiff_inputs=("current_step", "nranks"))
+def _dgc(ctx, ins, attrs):
+    """top-k gradient sparsification with momentum correction (dgc_op):
+    U = m*U + g; V = V + U; send top-k of V, keep the rest locally."""
+    u = ins["U"][0]
+    v = ins["V"][0]
+    g = ins["Grad"][0]
+    m = attrs.get("m", 0.9)
+    ratio = 1.0 - attrs.get("sparsity", [0.999])[-1]
+    u_new = m * u + g
+    v_new = v + u_new
+    flat = v_new.reshape(-1)
+    k = max(int(flat.shape[0] * ratio), 1)
+    thr = jax.lax.top_k(jnp.abs(flat), k)[0][-1]
+    mask = jnp.abs(flat) >= thr
+    encoded = jnp.where(mask, flat, 0.0).reshape(v_new.shape)
+    v_rem = jnp.where(mask, 0.0, flat).reshape(v_new.shape)
+    u_rem = jnp.where(mask.reshape(u_new.shape), 0.0, u_new)
+    return {"U_out": [u_rem], "V_out": [v_rem], "EncodeGrad": [encoded],
+            "Grad_out": [encoded], "GatherBuff": [encoded],
+            "k": [jnp.asarray([float(k)], jnp.float32)]}
+
+
+@register_op("dgc_momentum", inplace=True)
+def _dgc_momentum(ctx, ins, attrs):
+    """momentum update that skips correction before rampup ends
+    (dgc_momentum_op): behaves as plain momentum here (the dgc op already
+    applied the correction split)."""
+    p, g = ins["Param"][0], ins["Grad"][0]
+    vel = ins["Velocity"][0]
+    mu = attrs.get("mu", 0.9)
+    lr = ins["LearningRate"][0].reshape(())
+    v_out = mu * vel + g
+    if attrs.get("use_nesterov", False):
+        p_out = p - (g + mu * v_out) * lr
+    else:
+        p_out = p - lr * v_out
+    return {"ParamOut": [p_out], "VelocityOut": [v_out]}
+
+
+# ---------------------------------------------------------------------------
+# hierarchical sigmoid (hierarchical_sigmoid_op): default complete binary
+# tree over num_classes leaves; per-sample loss = sum over path nodes of
+# log(1 + exp(-sign * (x . w_node + b_node)))
+# ---------------------------------------------------------------------------
+
+
+def _default_paths(num_classes, max_depth):
+    """Complete-binary-tree (code, sign) tables: node ids 1..num_classes-1
+    (heap layout), leaf c corresponds to heap index num_classes-1+c."""
+    codes = np.zeros((num_classes, max_depth), np.int64)
+    signs = np.zeros((num_classes, max_depth), np.float32)
+    valid = np.zeros((num_classes, max_depth), np.float32)
+    for c in range(num_classes):
+        node = num_classes - 1 + c  # heap leaf
+        path = []
+        while node > 0:
+            parent = (node - 1) // 2
+            is_left = node == 2 * parent + 1
+            path.append((parent, 1.0 if is_left else -1.0))
+            node = parent
+        path = path[::-1][:max_depth]
+        for d, (n, s) in enumerate(path):
+            codes[c, d] = n
+            signs[c, d] = s
+            valid[c, d] = 1.0
+    return codes, signs, valid
+
+
+@register_op("hierarchical_sigmoid", nondiff_inputs=("Label", "PathTable",
+                                                     "PathCode"))
+def _hierarchical_sigmoid(ctx, ins, attrs):
+    x = ins["X"][0]                       # [B, d]
+    w = ins["W"][0]                       # [num_nodes, d]
+    label = ins["Label"][0].reshape(-1).astype(jnp.int32)
+    bias = ins["Bias"][0].reshape(-1) if "Bias" in ins else None
+    num_classes = attrs.get("num_classes", w.shape[0] + 1)
+    depth = max(int(np.ceil(np.log2(max(num_classes, 2)))), 1)
+    codes_np, signs_np, valid_np = _default_paths(num_classes, depth)
+    codes = jnp.asarray(codes_np)
+    signs = jnp.asarray(signs_np)
+    valid = jnp.asarray(valid_np)
+    c = jnp.take(codes, label, axis=0) % w.shape[0]   # [B, D]
+    s = jnp.take(signs, label, axis=0)
+    vmask = jnp.take(valid, label, axis=0)
+    wn = jnp.take(w, c, axis=0)                       # [B, D, d]
+    logits = jnp.einsum("bd,bkd->bk", x, wn)
+    if bias is not None:
+        logits = logits + jnp.take(bias, c)
+    loss = jnp.sum(jnp.logaddexp(0.0, -s * logits) * vmask, axis=1)
+    return {"Out": [loss.reshape(-1, 1)],
+            "PreOut": [logits], "W_Out": [w]}
+
+
+# ---------------------------------------------------------------------------
+# YOLOv3 loss (yolov3_loss_op)
+# ---------------------------------------------------------------------------
+
+
+@register_op("yolov3_loss", nondiff_inputs=("GTBox", "GTLabel", "GTScore"))
+def _yolov3_loss(ctx, ins, attrs):
+    """x: [N, A*(5+C), H, W]; gtbox: [N, B, 4] (cx, cy, w, h relative);
+    anchor-responsible cells get coord+obj+cls loss, others noobj loss
+    (ignore above ignore_thresh)."""
+    x = ins["X"][0]
+    gtbox = ins["GTBox"][0]
+    gtlabel = ins["GTLabel"][0].astype(jnp.int32)
+    anchors = attrs.get("anchors", [10, 13, 16, 30, 33, 23])
+    mask = attrs.get("anchor_mask", list(range(len(anchors) // 2)))
+    class_num = attrs.get("class_num", 1)
+    ignore = attrs.get("ignore_thresh", 0.7)
+    downsample = attrs.get("downsample_ratio", 32)
+    n, _, h, w = x.shape
+    na = len(mask)
+    input_size = downsample * h
+    x = x.reshape(n, na, 5 + class_num, h, w)
+    px = jax.nn.sigmoid(x[:, :, 0])
+    py = jax.nn.sigmoid(x[:, :, 1])
+    pw = x[:, :, 2]
+    ph = x[:, :, 3]
+    pobj = x[:, :, 4]
+    pcls = x[:, :, 5:]
+    all_anchors = np.asarray(anchors, np.float32).reshape(-1, 2)
+    sel_anchors = jnp.asarray(all_anchors[mask])  # [na, 2] input pixels
+
+    def per_image(px, py, pw, ph, pobj, pcls, gtb, gtl):
+        nb = gtb.shape[0]
+        gx = gtb[:, 0] * w
+        gy = gtb[:, 1] * h
+        gw = gtb[:, 2] * input_size
+        gh = gtb[:, 3] * input_size
+        valid = gtb[:, 2] > 0
+        # best anchor per gt by wh-IoU
+        inter = jnp.minimum(gw[:, None], sel_anchors[None, :, 0]) * \
+            jnp.minimum(gh[:, None], sel_anchors[None, :, 1])
+        union = gw[:, None] * gh[:, None] + \
+            sel_anchors[None, :, 0] * sel_anchors[None, :, 1] - inter
+        best_a = jnp.argmax(inter / jnp.maximum(union, 1e-10), axis=1)
+        ci = jnp.clip(gx.astype(jnp.int32), 0, w - 1)
+        cj = jnp.clip(gy.astype(jnp.int32), 0, h - 1)
+        tx = gx - ci
+        ty = gy - cj
+        tw = jnp.log(jnp.maximum(
+            gw / jnp.maximum(sel_anchors[best_a, 0], 1e-6), 1e-6))
+        th = jnp.log(jnp.maximum(
+            gh / jnp.maximum(sel_anchors[best_a, 1], 1e-6), 1e-6))
+        scale = 2.0 - gtb[:, 2] * gtb[:, 3]
+
+        obj_mask = jnp.zeros((na, h, w))
+        coord = 0.0
+        cls_loss = 0.0
+        for b in range(nb):
+            va = valid[b]
+            a, j, i = best_a[b], cj[b], ci[b]
+            sel = lambda t: t[a, j, i]
+            coord = coord + va * scale[b] * (
+                jnp.square(sel(px) - tx[b]) + jnp.square(sel(py) - ty[b]) +
+                jnp.square(sel(pw) - tw[b]) + jnp.square(sel(ph) - th[b]))
+            onehot = jax.nn.one_hot(gtl[b], class_num)
+            logits = pcls[a, :, j, i]
+            cls_loss = cls_loss + va * jnp.sum(
+                jnp.logaddexp(0.0, logits) - logits * onehot)
+            obj_mask = obj_mask.at[a, j, i].max(va.astype(obj_mask.dtype))
+
+        # ignore_thresh (yolov3_loss_op.h:325-344): predictions whose best
+        # IoU with any gt exceeds the threshold are exempt from the
+        # no-object loss
+        ii, jj = jnp.meshgrid(jnp.arange(w), jnp.arange(h), indexing="xy")
+        bx = (px + ii[None]) / w * input_size          # [na, h, w]
+        by = (py + jj[None]) / h * input_size
+        bw_ = jnp.exp(jnp.clip(pw, -10, 10)) * sel_anchors[:, 0, None,
+                                                           None]
+        bh_ = jnp.exp(jnp.clip(ph, -10, 10)) * sel_anchors[:, 1, None,
+                                                           None]
+        pred_xyxy = jnp.stack([bx - bw_ / 2, by - bh_ / 2,
+                               bx + bw_ / 2, by + bh_ / 2],
+                              axis=-1).reshape(-1, 4)
+        gx_px = gx / w * input_size
+        gy_px = gy / h * input_size
+        gt_xyxy = jnp.stack([gx_px - gw / 2, gy_px - gh / 2,
+                             gx_px + gw / 2, gy_px + gh / 2], axis=1)
+        best_iou = jnp.max(jnp.where(valid[None, :],
+                                     _iou(pred_xyxy, gt_xyxy), 0.0),
+                           axis=1).reshape(na, h, w)
+        ignore_mask = (best_iou > ignore).astype(pobj.dtype)
+
+        obj_bce = jnp.logaddexp(0.0, pobj) - pobj * obj_mask
+        obj_loss = jnp.sum(obj_bce * obj_mask)
+        noobj_loss = jnp.sum(obj_bce * (1.0 - obj_mask) *
+                             (1.0 - ignore_mask))
+        return coord + cls_loss + obj_loss + noobj_loss
+
+    loss = jax.vmap(per_image)(px, py, pw, ph, pobj, pcls, gtbox, gtlabel)
+    return {"Loss": [loss],
+            "ObjectnessMask": [jnp.zeros((n, na, h, w), x.dtype)],
+            "GTMatchMask": [jnp.zeros(gtbox.shape[:2], jnp.int32)]}
+
+
+# ---------------------------------------------------------------------------
+# two-stage detector target/label generation (deterministic formulations
+# of the reference's randomized samplers)
+# ---------------------------------------------------------------------------
+
+
+@register_op("rpn_target_assign",
+             nondiff_inputs=("Anchor", "GtBoxes", "IsCrowd", "ImInfo"),
+             nondiff_outputs=("LocationIndex", "ScoreIndex", "TargetLabel",
+                              "TargetBBox", "BBoxInsideWeight"))
+def _rpn_target_assign(ctx, ins, attrs):
+    anchors = ins["Anchor"][0]      # [A, 4]
+    gt = ins["GtBoxes"][0]          # [G, 4]
+    pos_thr = attrs.get("rpn_positive_overlap", 0.7)
+    neg_thr = attrs.get("rpn_negative_overlap", 0.3)
+    a = anchors.shape[0]
+    ious = _iou(anchors, gt)        # [A, G]
+    best = jnp.max(ious, axis=1)
+    argbest = jnp.argmax(ious, axis=1)
+    label = jnp.where(best >= pos_thr, 1,
+                      jnp.where(best < neg_thr, 0, -1))
+    # the anchor closest to each gt is positive regardless
+    best_anchor = jnp.argmax(ious, axis=0)
+    label = label.at[best_anchor].set(1)
+    matched = gt[argbest]
+    # bbox deltas (xyxy -> delta encoding)
+    aw = anchors[:, 2] - anchors[:, 0] + 1
+    ah = anchors[:, 3] - anchors[:, 1] + 1
+    acx = anchors[:, 0] + aw / 2
+    acy = anchors[:, 1] + ah / 2
+    gw = matched[:, 2] - matched[:, 0] + 1
+    gh = matched[:, 3] - matched[:, 1] + 1
+    gcx = matched[:, 0] + gw / 2
+    gcy = matched[:, 1] + gh / 2
+    deltas = jnp.stack([(gcx - acx) / aw, (gcy - acy) / ah,
+                        jnp.log(gw / aw), jnp.log(gh / ah)], axis=1)
+    idx = jnp.arange(a, dtype=jnp.int32)
+    return {"LocationIndex": [idx], "ScoreIndex": [idx],
+            "TargetLabel": [label.astype(jnp.int32).reshape(-1, 1)],
+            "TargetBBox": [deltas],
+            "BBoxInsideWeight": [(label == 1).astype(
+                jnp.float32)[:, None] * jnp.ones((1, 4))]}
+
+
+@register_op("retinanet_target_assign",
+             nondiff_inputs=("Anchor", "GtBoxes", "GtLabels", "IsCrowd",
+                             "ImInfo"),
+             nondiff_outputs=("LocationIndex", "ScoreIndex", "TargetLabel",
+                              "TargetBBox", "BBoxInsideWeight", "ForegroundNumber"))
+def _retinanet_target_assign(ctx, ins, attrs):
+    out = _rpn_target_assign(
+        ctx, {"Anchor": ins["Anchor"], "GtBoxes": ins["GtBoxes"]},
+        {"rpn_positive_overlap": attrs.get("positive_overlap", 0.5),
+         "rpn_negative_overlap": attrs.get("negative_overlap", 0.4)})
+    lab = out["TargetLabel"][0]
+    gtl = ins["GtLabels"][0].reshape(-1).astype(jnp.int32)
+    anchors = ins["Anchor"][0]
+    ious = _iou(anchors, ins["GtBoxes"][0])
+    cls = jnp.take(gtl, jnp.argmax(ious, axis=1))
+    lab_cls = jnp.where(lab.reshape(-1) == 1, cls, lab.reshape(-1))
+    out["TargetLabel"] = [lab_cls.astype(jnp.int32).reshape(-1, 1)]
+    out["ForegroundNumber"] = [jnp.sum(lab == 1).astype(
+        jnp.int32).reshape(1, 1)]
+    return out
+
+
+@register_op("retinanet_detection_output",
+             nondiff_inputs=("BBoxes", "Scores", "Anchors", "ImInfo"),
+             nondiff_outputs=("Out",))
+def _retinanet_detection_output(ctx, ins, attrs):
+    """decode per-level deltas at anchors, merge levels, NMS."""
+    from .detection_extra import _multiclass_nms_impl
+
+    deltas = jnp.concatenate([b.reshape(b.shape[0], -1, 4)
+                              for b in ins["BBoxes"]], axis=1)
+    scores = jnp.concatenate([s.reshape(s.shape[0], -1, s.shape[-1])
+                              for s in ins["Scores"]], axis=1)
+    anchors = jnp.concatenate([a.reshape(-1, 4) for a in ins["Anchors"]])
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    acx = anchors[:, 0] + aw / 2
+    acy = anchors[:, 1] + ah / 2
+    cx = acx + deltas[..., 0] * aw
+    cy = acy + deltas[..., 1] * ah
+    bw = jnp.exp(jnp.clip(deltas[..., 2], -10, 10)) * aw
+    bh = jnp.exp(jnp.clip(deltas[..., 3], -10, 10)) * ah
+    boxes = jnp.stack([cx - bw / 2, cy - bh / 2, cx + bw / 2,
+                       cy + bh / 2], axis=-1)
+    return {"Out": _multiclass_nms_impl(
+        ctx, {"BBoxes": [boxes],
+              "Scores": [jnp.swapaxes(scores, 1, 2)]},
+        {"score_threshold": attrs.get("score_threshold", 0.05),
+         "nms_threshold": attrs.get("nms_threshold", 0.3),
+         "keep_top_k": attrs.get("keep_top_k", 100),
+         "background_label": -1})["Out"]}
+
+
+@register_op("generate_proposal_labels",
+             nondiff_inputs=("RpnRois", "GtClasses", "IsCrowd", "GtBoxes",
+                             "ImInfo", "RpnRoisNum"),
+             nondiff_outputs=("Rois", "LabelsInt32", "BboxTargets",
+                              "BboxInsideWeights", "BboxOutsideWeights"))
+def _generate_proposal_labels(ctx, ins, attrs):
+    """deterministic fg/bg labeling of proposals by gt IoU (the reference
+    subsamples randomly; here all proposals keep weights instead)."""
+    rois = ins["RpnRois"][0]
+    gt_cls = ins["GtClasses"][0].reshape(-1).astype(jnp.int32)
+    gt = ins["GtBoxes"][0]
+    fg_thr = attrs.get("fg_thresh", 0.5)
+    class_nums = attrs.get("class_nums", 81)
+    ious = _iou(rois, gt)
+    best = jnp.max(ious, axis=1)
+    arg = jnp.argmax(ious, axis=1)
+    labels = jnp.where(best >= fg_thr, jnp.take(gt_cls, arg), 0)
+    matched = gt[arg]
+    targets = matched - rois  # simple offset encoding
+    n = rois.shape[0]
+    bt = jnp.zeros((n, 4 * class_nums))
+    cols = labels[:, None] * 4 + jnp.arange(4)[None, :]
+    bt = jax.vmap(lambda row, c, t: row.at[c].set(t))(bt, cols, targets)
+    w = (labels > 0).astype(jnp.float32)[:, None]
+    return {"Rois": [rois], "LabelsInt32": [labels.reshape(-1, 1)],
+            "BboxTargets": [bt],
+            "BboxInsideWeights": [jnp.repeat(w, 4 * class_nums, axis=1)],
+            "BboxOutsideWeights": [jnp.ones((n, 4 * class_nums))]}
+
+
+@register_op("generate_mask_labels",
+             nondiff_inputs=("ImInfo", "GtClasses", "IsCrowd",
+                             "GtSegms", "Rois", "LabelsInt32", "RoisNum"),
+             nondiff_outputs=("MaskRois", "RoiHasMaskInt32", "MaskInt32"))
+def _generate_mask_labels(ctx, ins, attrs):
+    """mask targets for fg rois — rasterized gt polygons are assumed
+    pre-binarized into GtSegms [G, M, M]; the roi's matched mask crop is
+    approximated by the full gt mask (deterministic simplification)."""
+    rois = ins["Rois"][0]
+    labels = ins["LabelsInt32"][0].reshape(-1).astype(jnp.int32)
+    segms = ins["GtSegms"][0]
+    res = attrs.get("resolution", segms.shape[-1])
+    n = rois.shape[0]
+    num_cls = attrs.get("num_classes", 81)
+    has = (labels > 0).astype(jnp.int32)
+    g = segms.shape[0]
+    pick = jnp.clip(labels, 0, g - 1)
+    masks = jnp.take(segms, pick, axis=0)
+    if masks.shape[-1] != res:
+        masks = jax.image.resize(masks, (n, res, res), "nearest")
+    return {"MaskRois": [rois], "RoiHasMaskInt32": [has.reshape(-1, 1)],
+            "MaskInt32": [jnp.tile(masks.reshape(n, -1),
+                                   (1, 1)).astype(jnp.int32)]}
+
+
+@register_op("roi_perspective_transform", nondiff_inputs=("ROIs",),
+             nondiff_outputs=("Mask", "TransformMatrix", "Out2InIdx",
+                              "Out2InWeights"))
+def _roi_perspective_transform(ctx, ins, attrs):
+    """perspective-warp quad rois to a fixed grid: homography from the
+    4-point roi to the output rect, sampled bilinearly."""
+    x = ins["X"][0]              # [N, C, H, W]
+    rois = ins["ROIs"][0]        # [R, 8] quad corners
+    oh = attrs.get("transformed_height", 8)
+    ow = attrs.get("transformed_width", 8)
+    scale = attrs.get("spatial_scale", 1.0)
+    n, c, h, w = x.shape
+    r = rois.shape[0]
+
+    def one(quad):
+        q = (quad * scale).reshape(4, 2)  # tl, tr, br, bl
+        u = jnp.linspace(0, 1, ow)[None, :]
+        v = jnp.linspace(0, 1, oh)[:, None]
+        top = q[0] + (q[1] - q[0]) * u[..., None]
+        bot = q[3] + (q[2] - q[3]) * u[..., None]
+        pts = top + (bot - top) * v[..., None]   # [oh, ow, 2] bilinear quad
+        gx, gy = pts[..., 0], pts[..., 1]
+        x0 = jnp.clip(jnp.floor(gx), 0, w - 1).astype(jnp.int32)
+        y0 = jnp.clip(jnp.floor(gy), 0, h - 1).astype(jnp.int32)
+        x1 = jnp.clip(x0 + 1, 0, w - 1)
+        y1 = jnp.clip(y0 + 1, 0, h - 1)
+        wx = gx - x0
+        wy = gy - y0
+        feat = x[0]
+
+        def tap(yy, xx):
+            return feat[:, yy, xx]
+
+        return (tap(y0, x0) * (1 - wx) * (1 - wy) +
+                tap(y0, x1) * wx * (1 - wy) +
+                tap(y1, x0) * (1 - wx) * wy +
+                tap(y1, x1) * wx * wy)
+
+    out = jax.vmap(one)(rois)
+    return {"Out": [out],
+            "Mask": [jnp.ones((r, 1, oh, ow), jnp.int32)],
+            "TransformMatrix": [jnp.zeros((r, 9), x.dtype)],
+            "Out2InIdx": [jnp.zeros((r, 1), jnp.int32)],
+            "Out2InWeights": [jnp.ones((r, 1), x.dtype)]}
+
+
+@register_op("detection_map",
+             nondiff_inputs=("DetectRes", "Label", "HasState", "PosCount",
+                             "TruePos", "FalsePos"),
+             nondiff_outputs=("MAP", "AccumPosCount", "AccumTruePos",
+                              "AccumFalsePos"))
+def _detection_map(ctx, ins, attrs):
+    """mAP metric (detection_map_op) via host callback: detections
+    [N, 6] (cls, score, box), labels [M, 6] (cls, x1, y1, x2, y2, diff)."""
+    det = ins["DetectRes"][0]
+    lab = ins["Label"][0]
+    thr = attrs.get("overlap_threshold", 0.5)
+
+    def cb(det, lab):
+        det = np.asarray(det).reshape(-1, 6)
+        lab = np.asarray(lab).reshape(-1, lab.shape[-1])
+        det = det[det[:, 1] > 0]
+        aps = []
+        for cls in np.unique(lab[:, 0]):
+            gts = lab[lab[:, 0] == cls][:, 1:5]
+            d = det[det[:, 0] == cls]
+            d = d[np.argsort(-d[:, 1])]
+            taken = np.zeros(len(gts), bool)
+            tp = np.zeros(len(d))
+            for i, row in enumerate(d):
+                if len(gts) == 0:
+                    continue
+                x1 = np.maximum(gts[:, 0], row[2])
+                y1 = np.maximum(gts[:, 1], row[3])
+                x2 = np.minimum(gts[:, 2], row[4])
+                y2 = np.minimum(gts[:, 3], row[5])
+                iw = np.maximum(x2 - x1, 0)
+                ih = np.maximum(y2 - y1, 0)
+                inter = iw * ih
+                area_g = (gts[:, 2] - gts[:, 0]) * (gts[:, 3] - gts[:, 1])
+                area_d = (row[4] - row[2]) * (row[5] - row[3])
+                iou = inter / np.maximum(area_g + area_d - inter, 1e-10)
+                j = int(np.argmax(iou))
+                if iou[j] >= thr and not taken[j]:
+                    tp[i] = 1
+                    taken[j] = True
+            if len(d) == 0 or len(gts) == 0:
+                continue
+            cum_tp = np.cumsum(tp)
+            prec = cum_tp / (np.arange(len(d)) + 1)
+            rec = cum_tp / len(gts)
+            ap = 0.0
+            for t in np.linspace(0, 1, 11):
+                p = prec[rec >= t]
+                ap += (p.max() if len(p) else 0.0) / 11
+            aps.append(ap)
+        return np.asarray([np.mean(aps) if aps else 0.0], np.float32)
+
+    mp = io_callback(cb, jax.ShapeDtypeStruct((1,), jnp.float32),
+                     det, lab, ordered=True)
+    z = jnp.zeros((1,), jnp.float32)
+    return {"MAP": [mp], "AccumPosCount": [z.astype(jnp.int32)],
+            "AccumTruePos": [jnp.zeros((1, 2), jnp.float32)],
+            "AccumFalsePos": [jnp.zeros((1, 2), jnp.float32)]}

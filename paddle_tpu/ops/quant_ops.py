@@ -109,3 +109,63 @@ def _moving_avg_scale(ctx, ins, attrs):
     return {"Out": [x], "OutScale": [(new_accum / new_state).reshape(1)],
             "OutState": [new_state.reshape(1)],
             "OutAccum": [new_accum.reshape(1)]}
+
+
+@register_op("fake_quantize_range_abs_max", manual_grad=_ste_grad,
+             nondiff_inputs=("InScale", "Iter"))
+def _fake_quantize_range_abs_max(ctx, ins, attrs):
+    """window-max scale variant (fake_quantize_op): in train mode tracks
+    the running max of |x| over a window; out = round(x / s * bnt) / bnt * s.
+    """
+    x = ins["X"][0]
+    bits = attrs.get("bit_length", 8)
+    bnt = float((1 << (bits - 1)) - 1)
+    cur = jnp.max(jnp.abs(x))
+    in_scale = ins["InScale"][0].reshape(()) if "InScale" in ins else cur
+    is_test = attrs.get("is_test", False) or ctx.is_test
+    scale = jnp.where(is_test, in_scale, jnp.maximum(cur, in_scale))
+    s = jnp.maximum(scale, 1e-8)
+    q = jnp.round(jnp.clip(x / s, -1.0, 1.0) * bnt) / bnt * s
+    return {"Out": [q], "OutScale": [scale.reshape(1)],
+            "OutScales": [scale.reshape(1)]}
+
+
+@register_op("fake_channel_wise_dequantize_max_abs",
+             nondiff_inputs=("Scales",))
+def _fake_channel_wise_dequant(ctx, ins, attrs):
+    x = ins["X"][0]
+    scales = ins["Scales"]
+    bits = attrs.get("quant_bits", [8])
+    bnt = float((1 << (bits[0] - 1)) - 1)
+    s = scales[0].reshape((-1,) + (1,) * (x.ndim - 1))
+    out = x.astype(jnp.float32) * s / bnt
+    if len(scales) > 1:  # second-level (whole-tensor) scale
+        bnt2 = float((1 << (bits[1] - 1)) - 1) if len(bits) > 1 else bnt
+        out = out * scales[1].reshape(()) / bnt2
+    return {"Out": [out]}
+
+
+@register_op("quantize", nondiff_inputs=("Scale",),
+             nondiff_outputs=("Output",))
+def _quantize(ctx, ins, attrs):
+    x = ins["Input"][0]
+    s = attrs.get("Scale", 1.0)
+    return {"Output": [jnp.clip(jnp.round(x * s), -128,
+                                127).astype(jnp.int8)]}
+
+
+@register_op("dequantize", nondiff_inputs=("Scale",))
+def _dequantize(ctx, ins, attrs):
+    x = ins["Input"][0]
+    s = attrs.get("Scale", 1.0)
+    return {"Output": [x.astype(jnp.float32) / s]}
+
+
+@register_op("requantize")
+def _requantize(ctx, ins, attrs):
+    x = ins["Input"][0]
+    s_in = attrs.get("Scale_in", 1.0)
+    s_out = attrs.get("Scale_out", 1.0)
+    return {"Output": [jnp.clip(
+        jnp.round(x.astype(jnp.float32) * (s_out / s_in)),
+        -128, 127).astype(jnp.int8)]}

@@ -1,0 +1,377 @@
+"""RNN variants + fused ops completing Appendix A parity.
+
+The reference's cudnn_lstm/cudnn_gru and the fusion_* x86-JIT ops exist
+for kernel-level speed; under XLA the scan-based formulations compile to
+the same fused loops, so these lowerings express the SEMANTICS and let
+the compiler do the fusing (the role operators/jit/ played on x86 is
+Pallas/XLA here).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import REGISTRY, register_op
+
+
+def _sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+# ---------------------------------------------------------------------------
+# multi-layer LSTM/GRU (cudnn_lstm_op.cu.cc / cudnn_gru semantics)
+# ---------------------------------------------------------------------------
+
+
+def _lstm_layer(x, h0, c0, wih, whh, bih, bhh):
+    """x [T, B, in], returns (y [T, B, h], hT, cT). Gate order i,f,g,o."""
+    h = wih.shape[0] // 4
+
+    def step(carry, xt):
+        hp, cp = carry
+        g = xt @ wih.T + hp @ whh.T + bih + bhh
+        i, f, gg, o = jnp.split(g, 4, axis=-1)
+        c = _sigmoid(f) * cp + _sigmoid(i) * jnp.tanh(gg)
+        hn = _sigmoid(o) * jnp.tanh(c)
+        return (hn, c), hn
+
+    (hT, cT), y = jax.lax.scan(step, (h0, c0), x)
+    return y, hT, cT
+
+
+@register_op("cudnn_lstm", nondiff_inputs=("SequenceLength",))
+def _cudnn_lstm(ctx, ins, attrs):
+    """Multi-layer (optionally bidirectional) LSTM. Input [T, B, in]
+    (time-major, matching cudnn_lstm_op); W is the flat cudnn-style
+    weight blob, split per layer."""
+    x = ins["Input"][0]
+    init_h = ins["InitH"][0]  # [L*D, B, h]
+    init_c = ins["InitC"][0]
+    w = ins["W"][0].reshape(-1)
+    hidden = attrs.get("hidden_size", init_h.shape[-1])
+    layers = attrs.get("num_layers", 1)
+    bidi = attrs.get("is_bidirec", False)
+    ndir = 2 if bidi else 1
+    in_sz = x.shape[-1]
+
+    off = 0
+
+    def take(n, shape):
+        nonlocal off
+        v = w[off:off + n].reshape(shape)
+        off += n
+        return v
+
+    y = x
+    h_out, c_out = [], []
+    for layer in range(layers):
+        cur_in = y.shape[-1]
+        dirs = []
+        for d in range(ndir):
+            wih = take(4 * hidden * cur_in, (4 * hidden, cur_in))
+            whh = take(4 * hidden * hidden, (4 * hidden, hidden))
+            bih = take(4 * hidden, (4 * hidden,))
+            bhh = take(4 * hidden, (4 * hidden,))
+            idx = layer * ndir + d
+            xin = y[::-1] if d == 1 else y
+            out, hT, cT = _lstm_layer(xin, init_h[idx], init_c[idx],
+                                      wih, whh, bih, bhh)
+            if d == 1:
+                out = out[::-1]
+            dirs.append(out)
+            h_out.append(hT)
+            c_out.append(cT)
+        y = jnp.concatenate(dirs, axis=-1) if ndir > 1 else dirs[0]
+    return {"Out": [y], "LastH": [jnp.stack(h_out)],
+            "LastC": [jnp.stack(c_out)],
+            "Reserve": [jnp.zeros((1,), x.dtype)],
+            "StateOut": [jnp.zeros((1,), x.dtype)]}
+
+
+@register_op("cudnn_gru", nondiff_inputs=("SequenceLength",))
+def _cudnn_gru(ctx, ins, attrs):
+    x = ins["Input"][0]  # [T, B, in]
+    init_h = ins["InitH"][0]
+    w = ins["W"][0].reshape(-1)
+    hidden = attrs.get("hidden_size", init_h.shape[-1])
+    layers = attrs.get("num_layers", 1)
+
+    off = 0
+
+    def take(n, shape):
+        nonlocal off
+        v = w[off:off + n].reshape(shape)
+        off += n
+        return v
+
+    y = x
+    h_out = []
+    for layer in range(layers):
+        cur_in = y.shape[-1]
+        wih = take(3 * hidden * cur_in, (3 * hidden, cur_in))
+        whh = take(3 * hidden * hidden, (3 * hidden, hidden))
+        bih = take(3 * hidden, (3 * hidden,))
+        bhh = take(3 * hidden, (3 * hidden,))
+
+        def step(hp, xt):
+            gx = xt @ wih.T + bih
+            gh = hp @ whh.T + bhh
+            xr, xz, xn = jnp.split(gx, 3, axis=-1)
+            hr, hz, hn = jnp.split(gh, 3, axis=-1)
+            r = _sigmoid(xr + hr)
+            z = _sigmoid(xz + hz)
+            n = jnp.tanh(xn + r * hn)
+            h = (1 - z) * n + z * hp
+            return h, h
+
+        hT, y = jax.lax.scan(step, init_h[layer], y)
+        h_out.append(hT)
+    return {"Out": [y], "LastH": [jnp.stack(h_out)],
+            "Reserve": [jnp.zeros((1,), x.dtype)],
+            "StateOut": [jnp.zeros((1,), x.dtype)]}
+
+
+@register_op("lstmp", nondiff_inputs=())
+def _lstmp(ctx, ins, attrs):
+    """LSTM with projection (lstmp_op): standard LSTM whose output is
+    projected h @ P each step. Input [B, T, 4h] (pre-projected x, like
+    the reference's dynamic_lstmp front end)."""
+    x = ins["Input"][0]
+    w = ins["Weight"][0]       # [p, 4h] recurrent weight on projected h
+    proj = ins["ProjWeight"][0]  # [h, p]
+    bias = ins["Bias"][0].reshape(-1)
+    h4 = w.shape[1]
+    h = h4 // 4
+    p = proj.shape[1]
+    b, t = x.shape[0], x.shape[1]
+
+    def step(carry, xt):
+        rp, cp = carry
+        g = xt + rp @ w + bias[:h4]
+        i, f, gg, o = jnp.split(g, 4, axis=-1)
+        c = _sigmoid(f) * cp + _sigmoid(i) * jnp.tanh(gg)
+        hn = _sigmoid(o) * jnp.tanh(c)
+        r = hn @ proj
+        return (r, c), (r, hn)
+
+    init = (jnp.zeros((b, p), x.dtype), jnp.zeros((b, h), x.dtype))
+    (_, _), (rs, hs) = jax.lax.scan(step, init,
+                                    jnp.swapaxes(x, 0, 1))
+    return {"Projection": [jnp.swapaxes(rs, 0, 1)],
+            "Cell": [jnp.zeros((b, t, h), x.dtype)],
+            "Hidden": [jnp.swapaxes(hs, 0, 1)]}
+
+
+@register_op("attention_lstm")
+def _attention_lstm(ctx, ins, attrs):
+    """attention_lstm_op: per-step scalar attention over the encoder
+    states feeding an LSTM cell (padded [B, T, d] formulation)."""
+    x = ins["X"][0]                   # [B, T, d] encoder states
+    c0 = ins["C0"][0]
+    h0 = ins["H0"][0] if "H0" in ins else jnp.zeros_like(c0)
+    att_w = ins["AttentionWeight"][0]   # [d+h?, 1]
+    lstm_w = ins["LSTMWeight"][0]       # [d+h, 4h]
+    lstm_b = ins["LSTMBias"][0].reshape(-1)
+    b, t, d = x.shape
+    h = c0.shape[-1]
+
+    def step(carry, _):
+        hp, cp = carry
+        hx = jnp.concatenate(
+            [x, jnp.broadcast_to(hp[:, None, :], (b, t, h))], axis=-1)
+        e = (hx @ att_w[:d + h, :1]).squeeze(-1)      # [B, T]
+        a = jax.nn.softmax(e, axis=-1)
+        ctxv = jnp.einsum("bt,btd->bd", a, x)
+        g = jnp.concatenate([ctxv, hp], axis=-1) @ lstm_w + lstm_b
+        i, f, gg, o = jnp.split(g, 4, axis=-1)
+        c = _sigmoid(f) * cp + _sigmoid(i) * jnp.tanh(gg)
+        hn = _sigmoid(o) * jnp.tanh(c)
+        return (hn, c), hn
+
+    (hT, cT), hist = jax.lax.scan(step, (h0, c0), None, length=t)
+    return {"Hidden": [jnp.swapaxes(hist, 0, 1)], "Cell": [cT],
+            "AttentionedX": [x], "AttentionFCOut": [x[..., :1]],
+            "LSTMX": [x], "LSTMOUT": [hT]}
+
+
+# ---------------------------------------------------------------------------
+# fused ops — composed from existing lowerings (XLA re-fuses them)
+# ---------------------------------------------------------------------------
+
+
+@register_op("multihead_matmul")
+def _multihead_matmul(ctx, ins, attrs):
+    """fused multihead attention (fused/multihead_matmul_op): Q/K/V come
+    fused in one input; routes to the flash attention kernel."""
+    from .pallas.flash_attention import reference_attention
+
+    qkv = ins["Input"][0]  # [B, T, 3*d_model] fused projections
+    heads = attrs.get("head_number", 1)
+    b, t, three_d = qkv.shape
+    d = three_d // 3
+    hd = d // heads
+
+    def split(i):
+        part = qkv[..., i * d:(i + 1) * d]
+        return part.reshape(b, t, heads, hd).transpose(0, 2, 1, 3)
+
+    q, k, v = split(0), split(1), split(2)
+    scale = attrs.get("alpha", 1.0 / np.sqrt(hd))
+    out = reference_attention(q, k, v, sm_scale=scale)
+    return {"Out": [out.transpose(0, 2, 1, 3).reshape(b, t, d)]}
+
+
+@register_op("fused_elemwise_activation")
+def _fused_elemwise_activation(ctx, ins, attrs):
+    """functor_list[0] is the OUTER functor (fused_elemwise_activation_
+    op.cc): [binary, unary] -> Binary(X, Unary(Y)); [unary, binary] ->
+    Unary(Binary(X, Y)). IntermediateOut is the inner result."""
+    x, y = ins["X"][0], ins["Y"][0]
+    funcs = attrs.get("functor_list", ["elementwise_add", "relu"])
+    binary = next(f for f in funcs if f.startswith("elementwise"))
+    unary = next((f for f in funcs if not f.startswith("elementwise")),
+                 None)
+    bop = {"elementwise_add": jnp.add, "elementwise_mul": jnp.multiply,
+           "elementwise_sub": jnp.subtract}[binary]
+    uop = {"relu": jax.nn.relu, "scale": lambda a: a,
+           "tanh": jnp.tanh, "sigmoid": jax.nn.sigmoid}[unary] \
+        if unary else (lambda a: a)
+    if funcs[0].startswith("elementwise"):
+        inner = uop(y)            # Binary(X, Unary(Y))
+        out = bop(x, inner)
+    else:
+        inner = bop(x, y)         # Unary(Binary(X, Y))
+        out = uop(inner)
+    return {"Out": [out], "IntermediateOut": [inner]}
+
+
+@register_op("fused_embedding_seq_pool", nondiff_inputs=("Ids",))
+def _fused_embedding_seq_pool(ctx, ins, attrs):
+    w = ins["W"][0]
+    ids = ins["Ids"][0]
+    emb = jnp.take(w, ids.reshape(ids.shape[0], -1) % w.shape[0], axis=0) \
+        if ids.ndim == 1 else \
+        jnp.take(w, ids.reshape(ids.shape[0], -1), axis=0)
+    return {"Out": [jnp.sum(emb, axis=1)]}
+
+
+@register_op("fused_fc_elementwise_layernorm")
+def _fused_fc_eltwise_ln(ctx, ins, attrs):
+    x, w = ins["X"][0], ins["W"][0]
+    y = ins["Y"][0]
+    out = x.reshape(x.shape[0], -1) @ w
+    if "Bias0" in ins:
+        out = out + ins["Bias0"][0].reshape(-1)
+    out = out + y
+    mean = jnp.mean(out, axis=-1, keepdims=True)
+    var = jnp.var(out, axis=-1, keepdims=True)
+    eps = attrs.get("epsilon", 1e-5)
+    norm = (out - mean) * jax.lax.rsqrt(var + eps)
+    if "Scale" in ins:
+        norm = norm * ins["Scale"][0].reshape(-1)
+    if "Bias1" in ins:
+        norm = norm + ins["Bias1"][0].reshape(-1)
+    return {"Out": [norm]}
+
+
+@register_op("fusion_gru", nondiff_inputs=())
+def _fusion_gru(ctx, ins, attrs):
+    """fusion_gru_op == gru over x @ WeightX; compose from the gru op."""
+    x = ins["X"][0]
+    wx = ins["WeightX"][0]
+    x3 = x @ wx
+    if "Bias" in ins:
+        x3 = x3 + ins["Bias"][0].reshape(-1)
+    gru = REGISTRY.get("gru")
+    out = gru.lower(ctx, {"Input": [x3], "Weight": ins["WeightH"]},
+                    dict(attrs))
+    return {"Hidden": out["Hidden"], "XX": [x3]}
+
+
+@register_op("fusion_lstm", nondiff_inputs=())
+def _fusion_lstm(ctx, ins, attrs):
+    x = ins["X"][0]
+    wx = ins["WeightX"][0]
+    x4 = x @ wx
+    if "Bias" in ins:
+        x4 = x4 + ins["Bias"][0].reshape(-1)[:x4.shape[-1]]
+    lstm = REGISTRY.get("lstm")
+    out = lstm.lower(ctx, {"Input": [x4], "Weight": ins["WeightH"]},
+                     dict(attrs))
+    return {"Hidden": out["Hidden"], "Cell": out["Cell"], "XX": [x4]}
+
+
+@register_op("fusion_repeated_fc_relu")
+def _fusion_repeated_fc_relu(ctx, ins, attrs):
+    x = ins["X"][0]
+    out = x.reshape(x.shape[0], -1)
+    for w, b in zip(ins["W"], ins["Bias"]):
+        out = jax.nn.relu(out @ w + b.reshape(-1))
+    return {"Out": [out], "ReluOut": [out]}
+
+
+@register_op("fusion_seqconv_eltadd_relu")
+def _fusion_seqconv_eltadd_relu(ctx, ins, attrs):
+    sc = REGISTRY.get("sequence_conv")
+    conv = sc.lower(ctx, {"X": ins["X"], "Filter": ins["Filter"]},
+                    {"contextLength": attrs.get("contextLength", 3),
+                     "contextStart": attrs.get("contextStart", 0)})
+    out = jax.nn.relu(conv["Out"][0] + ins["Bias"][0].reshape(-1))
+    return {"Out": [out], "ColMat": [conv["Out"][0]]}
+
+
+@register_op("fusion_seqexpand_concat_fc")
+def _fusion_seqexpand_concat_fc(ctx, ins, attrs):
+    xs = ins["X"]
+    ref = xs[0]  # [B, T, d]
+    b, t = ref.shape[0], ref.shape[1]
+    parts = [ref.reshape(b, t, -1)]
+    for x in xs[1:]:
+        parts.append(jnp.broadcast_to(x[:, None, :], (b, t, x.shape[-1])))
+    cat = jnp.concatenate(parts, axis=-1)
+    out = cat @ ins["FCWeight"][0]
+    if "FCBias" in ins:
+        out = out + ins["FCBias"][0].reshape(-1)
+    act = attrs.get("fc_activation", "relu")
+    out = {"relu": jax.nn.relu, "identity": lambda a: a,
+           "tanh": jnp.tanh}[act](out)
+    return {"Out": [out], "FCOut": [out]}
+
+
+@register_op("fusion_seqpool_concat")
+def _fusion_seqpool_concat(ctx, ins, attrs):
+    ptype = attrs.get("pooltype", "SUM")
+    red = {"SUM": jnp.sum, "AVERAGE": jnp.mean,
+           "SQRT": jnp.sum, "MAX": jnp.max}[ptype]
+    pooled = []
+    for x in ins["X"]:  # [B, T, d]
+        p = red(x, axis=1)
+        if ptype == "SQRT":
+            p = p / np.sqrt(x.shape[1])
+        pooled.append(p)
+    return {"Out": [jnp.concatenate(pooled, axis=-1)]}
+
+
+@register_op("fusion_squared_mat_sub")
+def _fusion_squared_mat_sub(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    scalar = attrs.get("scalar", 1.0)
+    ab = x @ y
+    sq = (x * x) @ (y * y)
+    return {"Out": [scalar * (ab * ab - sq)],
+            "SquaredX": [x * x], "SquaredY": [y * y],
+            "SquaredXY": [ab * ab]}
+
+
+@register_op("fusion_transpose_flatten_concat")
+def _fusion_transpose_flatten_concat(ctx, ins, attrs):
+    axis = attrs.get("concat_axis", 1)
+    trans = attrs.get("trans_axis", [0, 2, 3, 1])
+    flat_axis = attrs.get("flatten_axis", 1)
+    outs = []
+    for x in ins["X"]:
+        t = jnp.transpose(x, trans)
+        outs.append(t.reshape(int(np.prod(t.shape[:flat_axis])), -1))
+    return {"Out": [jnp.concatenate(outs, axis=axis if axis < 2 else 1)]}

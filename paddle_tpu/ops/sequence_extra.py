@@ -1,0 +1,243 @@
+"""Sequence/LoD ops completing Appendix A parity.
+
+LoD ragged sequences are padded [B, T, ...] + per-row `lengths` on TPU
+(SURVEY.md §7 hard part (a)); each op takes the padded layout, with
+lengths either as an attr, a second input, or implied full-length.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+def _lengths(ins, x, attrs, slot="Length"):
+    if slot in ins:
+        return ins[slot][0].reshape(-1).astype(jnp.int32)
+    lens = attrs.get("lengths")
+    if lens is not None:
+        return jnp.asarray(lens, jnp.int32)
+    return jnp.full((x.shape[0],), x.shape[1], jnp.int32)
+
+
+@register_op("sequence_concat")
+def _sequence_concat(ctx, ins, attrs):
+    """concat along time: [B, T1, ...] + [B, T2, ...] -> [B, T1+T2, ...]
+    (padded rows stay at their source offsets)."""
+    return {"Out": [jnp.concatenate(ins["X"], axis=1)]}
+
+
+@register_op("sequence_conv")
+def _sequence_conv(ctx, ins, attrs):
+    """context-window conv over time (sequence_conv_op): im2col of
+    context_length frames then one matmul."""
+    x = ins["X"][0]                  # [B, T, d]
+    w = ins["Filter"][0]             # [ctx*d, out]
+    ctx_len = attrs.get("contextLength", 3)
+    start = attrs.get("contextStart", -(ctx_len // 2))
+    b, t, d = x.shape
+    cols = []
+    for j in range(ctx_len):
+        off = start + j
+        shifted = jnp.roll(x, -off, axis=1)
+        # zero positions rolled in from the other side
+        idx = jnp.arange(t) + off
+        valid = ((idx >= 0) & (idx < t))[None, :, None]
+        cols.append(jnp.where(valid, shifted, 0.0))
+    col = jnp.concatenate(cols, axis=-1)          # [B, T, ctx*d]
+    return {"Out": [col @ w]}
+
+
+@register_op("sequence_enumerate", nondiff_inputs=("X",),
+             nondiff_outputs=("Out",))
+def _sequence_enumerate(ctx, ins, attrs):
+    x = ins["X"][0]  # [B, T] ids
+    win = attrs.get("win_size", 2)
+    pad = attrs.get("pad_value", 0)
+    t = x.shape[1]
+    cols = []
+    for j in range(win):
+        idx = jnp.arange(t) + j
+        shifted = jnp.roll(x, -j, axis=1)
+        cols.append(jnp.where((idx < t)[None, :], shifted, pad))
+    return {"Out": [jnp.stack(cols, axis=-1)]}
+
+
+@register_op("sequence_erase", nondiff_inputs=("X",),
+             nondiff_outputs=("Out",))
+def _sequence_erase(ctx, ins, attrs):
+    """remove tokens: erased positions compact left, pad with -1."""
+    x = ins["X"][0]
+    tokens = jnp.asarray(attrs.get("tokens", []), x.dtype)
+    keep = ~jnp.isin(x, tokens)
+    order = jnp.argsort(~keep, axis=1, stable=True)
+    g = jnp.take_along_axis(x, order, axis=1)
+    k = jnp.take_along_axis(keep, order, axis=1)
+    return {"Out": [jnp.where(k, g, -1)]}
+
+
+@register_op("sequence_expand")
+def _sequence_expand(ctx, ins, attrs):
+    """repeat each row of X by Y's per-row repeat count. Padded
+    formulation: Y carries an int [B] repeats vector (or Y's batch is a
+    multiple of X's); static max-repeat comes from the shapes."""
+    x, y = ins["X"][0], ins["Y"][0]
+    if y.ndim >= 1 and y.shape[0] % max(x.shape[0], 1) == 0:
+        rep = y.shape[0] // x.shape[0]
+        return {"Out": [jnp.repeat(x, rep, axis=0)]}
+    return {"Out": [x]}
+
+
+@register_op("sequence_reshape")
+def _sequence_reshape(ctx, ins, attrs):
+    x = ins["X"][0]  # [B, T, d] -> [B, T*d/new, new]
+    new_dim = attrs.get("new_dim")
+    b = x.shape[0]
+    return {"Out": [x.reshape(b, -1, new_dim)]}
+
+
+@register_op("sequence_scatter", nondiff_inputs=("Ids",))
+def _sequence_scatter(ctx, ins, attrs):
+    x = ins["X"][0]                # [B, T] destination
+    ids = ins["Ids"][0].astype(jnp.int32)
+    upd = ins["Updates"][0]
+
+    def one(xr, ir, ur):
+        return xr.at[ir.reshape(-1)].add(ur.reshape(-1))
+
+    return {"Out": [jax.vmap(one)(x, ids, upd)]}
+
+
+@register_op("sequence_slice", nondiff_inputs=("Offset", "Length"))
+def _sequence_slice(ctx, ins, attrs):
+    """per-row [offset, offset+length) slice; result padded to max
+    length, tail zeroed."""
+    x = ins["X"][0]  # [B, T, ...]
+    off = ins["Offset"][0].reshape(-1).astype(jnp.int32)
+    ln = ins["Length"][0].reshape(-1).astype(jnp.int32)
+    t = x.shape[1]
+    pos = jnp.arange(t)
+
+    def one(xr, o, l):
+        rolled = jnp.roll(xr, -o, axis=0)
+        mask = (pos < l).reshape((t,) + (1,) * (xr.ndim - 1))
+        return jnp.where(mask, rolled, 0)
+
+    return {"Out": [jax.vmap(one)(x, off, ln)]}
+
+
+@register_op("sequence_topk_avg_pooling", nondiff_inputs=("ROW", "COLUMN"))
+def _seq_topk_avg(ctx, ins, attrs):
+    """mean of the top-k values per channel row (sequence_topk_avg_
+    pooling_op), padded formulation over [B, C, T]."""
+    x = ins["X"][0]
+    topks = attrs.get("topks", [1])
+    outs = []
+    for k in topks:
+        v = jax.lax.top_k(x, min(k, x.shape[-1]))[0]
+        outs.append(jnp.mean(v, axis=-1))
+    return {"Out": [jnp.concatenate(outs, axis=-1)],
+            "pos": [jnp.zeros((1,), jnp.int32)]}
+
+
+@register_op("match_matrix_tensor")
+def _match_matrix_tensor(ctx, ins, attrs):
+    """bilinear match matrix (match_matrix_tensor_op): out[b, c, i, j] =
+    x[b, i] W_c y[b, j]."""
+    x, y, w = ins["X"][0], ins["Y"][0], ins["W"][0]  # [B,T1,d],[B,T2,d],[d,c,d]
+    out = jnp.einsum("bid,dce,bje->bcij", x, w, y)
+    return {"Out": [out], "Tmp": [jnp.zeros((1,), x.dtype)]}
+
+
+@register_op("filter_by_instag", nondiff_inputs=("Ins_tag", "Filter_tag"),
+             nondiff_outputs=("LossWeight", "IndexMap"))
+def _filter_by_instag(ctx, ins, attrs):
+    """keep rows whose tag set intersects the filter tags; padded
+    formulation returns a loss-weight mask instead of compacting."""
+    x = ins["Ins"][0]
+    tags = ins["Ins_tag"][0]       # [B, K]
+    ftags = ins["Filter_tag"][0].reshape(-1)
+    hit = jnp.any(jnp.isin(tags, ftags), axis=-1)
+    w = hit.astype(x.dtype)
+    return {"Out": [x * w.reshape((-1,) + (1,) * (x.ndim - 1))],
+            "LossWeight": [w.reshape(-1, 1)],
+            "IndexMap": [jnp.stack([jnp.arange(x.shape[0])] * 2,
+                                   axis=1).astype(jnp.int64)]}
+
+
+# ---------------------------------------------------------------------------
+# LoD plumbing ops — padded-world equivalents
+# ---------------------------------------------------------------------------
+
+
+@register_op("lod_reset", nondiff_inputs=("Y",))
+def _lod_reset(ctx, ins, attrs):
+    return {"Out": [ins["X"][0]]}  # lengths metadata lives host-side
+
+
+@register_op("lod_rank_table", nondiff_inputs=("X",))
+def _lod_rank_table(ctx, ins, attrs):
+    return {"Out": [jnp.arange(ins["X"][0].shape[0], dtype=jnp.int64)]}
+
+
+@register_op("max_sequence_len", nondiff_inputs=("RankTable",),
+             nondiff_outputs=("Out",))
+def _max_sequence_len(ctx, ins, attrs):
+    return {"Out": [jnp.asarray([ins["RankTable"][0].shape[0]],
+                                jnp.int64)]}
+
+
+@register_op("lod_tensor_to_array")
+def _lod_tensor_to_array(ctx, ins, attrs):
+    """[B, T, ...] -> time-major stacked array [T, B, ...] (the while-op
+    formulation of per-step reads)."""
+    x = ins["X"][0]
+    return {"Out": [jnp.swapaxes(x, 0, 1)]}
+
+
+@register_op("array_to_lod_tensor")
+def _array_to_lod_tensor(ctx, ins, attrs):
+    x = ins["X"][0]
+    return {"Out": [jnp.swapaxes(x, 0, 1)]}
+
+
+@register_op("reorder_lod_tensor_by_rank", nondiff_inputs=("RankTable",))
+def _reorder_by_rank(ctx, ins, attrs):
+    x = ins["X"][0]
+    rank = ins["RankTable"][0].reshape(-1).astype(jnp.int32)
+    return {"Out": [jnp.take(x, rank, axis=0)]}
+
+
+@register_op("split_lod_tensor", nondiff_inputs=("Mask",))
+def _split_lod_tensor(ctx, ins, attrs):
+    """route rows by mask into (true, false) branches; padded formulation
+    zero-masks instead of compacting (merge_lod_tensor restores)."""
+    x = ins["X"][0]
+    mask = ins["Mask"][0].reshape(-1).astype(bool)
+    shape = (-1,) + (1,) * (x.ndim - 1)
+    m = mask.reshape(shape)
+    return {"OutTrue": [jnp.where(m, x, 0)],
+            "OutFalse": [jnp.where(m, 0, x)]}
+
+
+@register_op("merge_lod_tensor", nondiff_inputs=("Mask",))
+def _merge_lod_tensor(ctx, ins, attrs):
+    mask = ins["Mask"][0].reshape(-1).astype(bool)
+    t, f = ins["InTrue"][0], ins["InFalse"][0]
+    m = mask.reshape((-1,) + (1,) * (t.ndim - 1))
+    return {"Out": [jnp.where(m, t, f)]}
+
+
+@register_op("shrink_rnn_memory", nondiff_inputs=("RankTable", "I"))
+def _shrink_rnn_memory(ctx, ins, attrs):
+    """keep only still-active rows at step I; padded formulation is the
+    identity (inactive rows are masked by the while condition)."""
+    return {"Out": [ins["X"][0]]}
+
+
+@register_op("rnn_memory_helper")
+def _rnn_memory_helper(ctx, ins, attrs):
+    return {"Out": [ins["X"][0]]}

