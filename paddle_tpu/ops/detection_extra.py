@@ -81,18 +81,27 @@ def _roi_align(ctx, ins, attrs):
     return {"Out": [out]}
 
 
+def _index_from_counts(nums, n):
+    """Segment counts [S] -> per-element segment index [n]."""
+    return jnp.sum(jnp.arange(n)[:, None] >=
+                   jnp.cumsum(nums)[None, :], axis=1).astype(jnp.int32)
+
+
 def _batch_index_of_rois(ins, n_rois):
-    """RoisNum [N] -> per-roi image index (shared by the RoI ops)."""
-    bidx = jnp.zeros((n_rois,), jnp.int32)
-    if "RoisNum" in ins:
-        nums = ins["RoisNum"][0].reshape(-1).astype(jnp.int32)
-        bidx = jnp.sum(jnp.arange(n_rois)[:, None] >=
-                       jnp.cumsum(nums)[None, :], axis=1).astype(jnp.int32)
-    elif "BatchRoINums" in ins:
-        nums = ins["BatchRoINums"][0].reshape(-1).astype(jnp.int32)
-        bidx = jnp.sum(jnp.arange(n_rois)[:, None] >=
-                       jnp.cumsum(nums)[None, :], axis=1).astype(jnp.int32)
-    return bidx
+    """Per-roi image index from RoisNum counts [N], BatchRoINums counts,
+    or RoisLod offsets [0, n1, n1+n2, ...] (the LoD-form mapping of
+    roi_align_op.cc). All rois map to image 0 when none is present."""
+    nums = None
+    for key in ("RoisNum", "BatchRoINums"):
+        if key in ins:
+            nums = ins[key][0].reshape(-1).astype(jnp.int32)
+            break
+    if nums is None and "RoisLod" in ins:
+        lod = ins["RoisLod"][0].reshape(-1).astype(jnp.int32)
+        nums = lod[1:] - lod[:-1]
+    if nums is None:
+        return jnp.zeros((n_rois,), jnp.int32)
+    return _index_from_counts(nums, n_rois)
 
 
 @register_op("roi_pool", nondiff_inputs=("ROIs", "RoisNum"),
@@ -340,17 +349,21 @@ def _multiclass_nms_impl(ctx, ins, attrs):
                                min(nms_top_k, bx.shape[0]))
             ksc = jnp.where(kept >= 0, sc[c][jnp.maximum(kept, 0)], -1.0)
             kbx = bx[jnp.maximum(kept, 0)]
-            cls = jnp.full_like(ksc, float(c))
+            # padded slots get class -1 so validity is unambiguous even
+            # when real scores can be <= 0 (multiclass_nms_op.cc pads by
+            # emitting fewer rows; here class -1 marks an empty row)
+            cls = jnp.where(kept >= 0, float(c), -1.0)
             outs.append(jnp.concatenate(
                 [cls[:, None], ksc[:, None], kbx], axis=1))
         allc = jnp.concatenate(outs)           # [C'*topk, 6]
-        order = jnp.argsort(-allc[:, 1])
-        allc = allc[order][:keep_top_k]
-        valid = allc[:, 1] > score_thr
+        # sort real rows first (padded rows carry score -1 AND cls -1)
+        sort_key = jnp.where(allc[:, 0] >= 0, -allc[:, 1], jnp.inf)
+        allc = allc[jnp.argsort(sort_key)][:keep_top_k]
+        valid = allc[:, 0] >= 0
         return jnp.where(valid[:, None], allc, -1.0)
 
     out = jax.vmap(one)(boxes, scores)
-    nums = jnp.sum(out[..., 1] > 0, axis=1).astype(jnp.int32)
+    nums = jnp.sum(out[..., 0] >= 0, axis=1).astype(jnp.int32)
     return {"Out": [out], "NmsRoisNum": [nums], "Index": [
         jnp.zeros((out.shape[0] * out.shape[1], 1), jnp.int32)]}
 

@@ -91,10 +91,16 @@ def _pnpair(ctx, ins, attrs):
 # ---------------------------------------------------------------------------
 
 
-def _ctc_loss_single(logp, labels, blank):
+def _ctc_loss_single(logp, labels, blank, length=None):
     """log p(labels | logits) via the standard alpha recursion.
-    logp: [T, C] log-softmax; labels: [L] padded with -1."""
+    logp: [T, C] log-softmax; labels: [L] padded with -1; length = true
+    number of timesteps (padded steps t >= length emit nothing — the
+    reference consumes exact per-sequence lengths via LoD/LogitsLength,
+    warpctc_op.cc)."""
     L = labels.shape[0]
+    T = logp.shape[0]
+    if length is None:
+        length = T
     ext = jnp.full((2 * L + 1,), blank, jnp.int32)
     ext = ext.at[1::2].set(jnp.maximum(labels, 0))
     valid_lab = labels >= 0
@@ -109,37 +115,55 @@ def _ctc_loss_single(logp, labels, blank):
     alpha0 = alpha0.at[0].set(logp[0, blank])
     alpha0 = alpha0.at[1].set(jnp.where(n_ext > 1, logp[0, ext[1]], NEG))
 
-    def step(alpha, lp):
+    def step(alpha, inp):
+        lp, t = inp
         stay = alpha
         prev1 = jnp.concatenate([jnp.full((1,), NEG), alpha[:-1]])
         prev2 = jnp.where(skip_ok,
                           jnp.concatenate([jnp.full((2,), NEG),
                                            alpha[:-2]]), NEG)
         merged = jnp.logaddexp(jnp.logaddexp(stay, prev1), prev2)
-        return merged + lp[ext], None
+        # padded timestep: alpha is frozen (no transition, no emission)
+        return jnp.where(t < length, merged + lp[ext], alpha), None
 
-    alpha, _ = jax.lax.scan(step, alpha0, logp[1:])
+    alpha, _ = jax.lax.scan(step, alpha0,
+                            (logp[1:], jnp.arange(1, T)))
     last = alpha[n_ext - 1]
     last2 = jnp.where(n_ext > 1, alpha[n_ext - 2], NEG)
     return -jnp.logaddexp(last, last2)
 
 
-@register_op("warpctc", nondiff_inputs=("Label",))
+@register_op("warpctc", nondiff_inputs=("Label", "LogitsLength",
+                                        "LabelLength"))
 def _warpctc(ctx, ins, attrs):
     """CTC loss (warpctc_op). Inputs are padded: Logits [B, T, C] (or the
     reference's LoD layout already padded by the layers front end),
-    Label [B, L] padded with -1."""
+    Label [B, L] padded with -1; LogitsLength [B] gives the true timestep
+    count per sequence (padded steps contribute nothing, matching the
+    reference's LoD-sliced sequences)."""
     logits = ins["Logits"][0]
     labels = ins["Label"][0].astype(jnp.int32)
     blank = attrs.get("blank", 0)
     if logits.ndim == 2:  # [T, C] single sequence
         logits = logits[None]
         labels = labels.reshape(1, -1)
+    b, t = logits.shape[0], logits.shape[1]
+    if "LogitsLength" in ins:
+        lengths = ins["LogitsLength"][0].reshape(-1).astype(jnp.int32)
+    else:
+        lengths = jnp.full((b,), t, jnp.int32)
+    if "LabelLength" in ins:
+        lab_len = ins["LabelLength"][0].reshape(-1).astype(jnp.int32)
+        # re-pad labels beyond their true length with -1
+        labels = jnp.where(
+            jnp.arange(labels.shape[1])[None, :] < lab_len[:, None],
+            labels, -1)
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-    losses = jax.vmap(lambda lp, lb: _ctc_loss_single(lp, lb, blank))(
-        logp, labels)
+    losses = jax.vmap(
+        lambda lp, lb, ln: _ctc_loss_single(lp, lb, blank, ln))(
+        logp, labels, lengths)
     if attrs.get("norm_by_times", False):
-        losses = losses / logits.shape[1]
+        losses = losses / jnp.maximum(lengths, 1).astype(losses.dtype)
     return {"Loss": [losses.reshape(-1, 1).astype(logits.dtype)],
             "WarpCTCGrad": [jnp.zeros_like(logits)]}
 

@@ -39,12 +39,24 @@ def _where_index(ctx, ins, attrs):
     return {"Out": [idx]}
 
 
+def _unique_fill(x):
+    """Padding sentinel for the static-shape unique outputs: dtype max for
+    ints, +inf for floats — distinguishable from any value that sorts
+    before it, unlike padding with x[0] (real data). Valid count is
+    max(Index) + 1; padded Out slots hold the sentinel."""
+    if jnp.issubdtype(x.dtype, jnp.floating):
+        return jnp.array(jnp.inf, x.dtype)
+    if x.dtype == jnp.bool_:
+        return jnp.array(True)
+    return jnp.array(jnp.iinfo(x.dtype).max, x.dtype)
+
+
 @register_op("unique", nondiff_inputs=("X",), nondiff_outputs=("Out",
                                                                "Index"))
 def _unique(ctx, ins, attrs):
     x = ins["X"][0].reshape(-1)
     u, inv = jnp.unique(x, return_inverse=True, size=x.shape[0],
-                        fill_value=x[0])
+                        fill_value=_unique_fill(x))
     return {"Out": [u], "Index": [inv.astype(jnp.int64)]}
 
 
@@ -53,7 +65,10 @@ def _unique(ctx, ins, attrs):
 def _unique_with_counts(ctx, ins, attrs):
     x = ins["X"][0].reshape(-1)
     u, inv, cnt = jnp.unique(x, return_inverse=True, return_counts=True,
-                             size=x.shape[0], fill_value=x[0])
+                             size=x.shape[0], fill_value=_unique_fill(x))
+    # padded slots (positions past the last real unique) report count 0
+    n_real = jnp.max(inv) + 1
+    cnt = jnp.where(jnp.arange(u.shape[0]) < n_real, cnt, 0)
     return {"Out": [u], "Index": [inv.astype(jnp.int64)],
             "Count": [cnt.astype(jnp.int64)]}
 

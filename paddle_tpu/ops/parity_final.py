@@ -10,7 +10,7 @@ import jax.numpy as jnp
 from jax.experimental import io_callback
 
 from ..core.registry import register_op
-from .detection_extra import _iou
+from .detection_extra import _batch_index_of_rois, _index_from_counts, _iou
 
 
 @register_op("fc")
@@ -378,12 +378,15 @@ def _generate_proposal_labels(ctx, ins, attrs):
 
 @register_op("generate_mask_labels",
              nondiff_inputs=("ImInfo", "GtClasses", "IsCrowd",
-                             "GtSegms", "Rois", "LabelsInt32", "RoisNum"),
+                             "GtSegms", "Rois", "LabelsInt32", "RoisNum",
+                             "GtNum"),
              nondiff_outputs=("MaskRois", "RoiHasMaskInt32", "MaskInt32"))
 def _generate_mask_labels(ctx, ins, attrs):
     """mask targets for fg rois — rasterized gt polygons are assumed
-    pre-binarized into GtSegms [G, M, M]; the roi's matched mask crop is
-    approximated by the full gt mask (deterministic simplification)."""
+    pre-binarized into GtSegms [G, M, M]; each roi takes the mask of its
+    MATCHED gt instance (mask_util + IoU argmax over same-class gts,
+    reference generate_mask_labels_op.cc), approximated by the full gt
+    mask (deterministic simplification: no per-roi crop)."""
     rois = ins["Rois"][0]
     labels = ins["LabelsInt32"][0].reshape(-1).astype(jnp.int32)
     segms = ins["GtSegms"][0]
@@ -391,8 +394,39 @@ def _generate_mask_labels(ctx, ins, attrs):
     n = rois.shape[0]
     num_cls = attrs.get("num_classes", 81)
     has = (labels > 0).astype(jnp.int32)
-    g = segms.shape[0]
-    pick = jnp.clip(labels, 0, g - 1)
+    g, m = segms.shape[0], segms.shape[-1]
+    # gt boxes from mask extents, in [0, 1] image-normalized coords
+    occ_x = jnp.any(segms > 0, axis=1)  # [G, M] columns
+    occ_y = jnp.any(segms > 0, axis=2)  # [G, M] rows
+    idx = jnp.arange(m, dtype=jnp.float32)
+    gx1 = jnp.min(jnp.where(occ_x, idx, m), axis=1) / m
+    gx2 = (jnp.max(jnp.where(occ_x, idx, -1.0), axis=1) + 1) / m
+    gy1 = jnp.min(jnp.where(occ_y, idx, m), axis=1) / m
+    gy2 = (jnp.max(jnp.where(occ_y, idx, -1.0), axis=1) + 1) / m
+    gt_boxes = jnp.stack([gx1, gy1, gx2, gy2], axis=1)  # [G, 4]
+    # per-roi image index (RoisNum counts); each roi is normalized by its
+    # own image's ImInfo row so cross-image IoUs are at least consistent
+    roi_img = _batch_index_of_rois(ins, n)
+    if "ImInfo" in ins and ins["ImInfo"][0].size >= 2:
+        im = ins["ImInfo"][0].reshape(-1, ins["ImInfo"][0].shape[-1])
+        ih = im[jnp.clip(roi_img, 0, im.shape[0] - 1), 0]
+        iw = im[jnp.clip(roi_img, 0, im.shape[0] - 1), 1]
+    else:
+        ih = jnp.maximum(jnp.max(rois[:, 3]), 1.0)
+        iw = jnp.maximum(jnp.max(rois[:, 2]), 1.0)
+    rois_norm = rois[:, :4] / jnp.stack(
+        jnp.broadcast_arrays(iw, ih, iw, ih), axis=-1).reshape(-1, 4)
+    ious = _iou(rois_norm, gt_boxes)  # [R, G]
+    if "GtClasses" in ins:
+        gt_cls = ins["GtClasses"][0].reshape(-1).astype(jnp.int32)
+        ious = jnp.where(labels[:, None] == gt_cls[None, :], ious, -1.0)
+    # gt -> image partition (GtNum counts, the LoD analogue on GtSegms):
+    # restrict matching to gts of the roi's own image when provided
+    if "GtNum" in ins:
+        gnums = ins["GtNum"][0].reshape(-1).astype(jnp.int32)
+        gt_img = _index_from_counts(gnums, g)
+        ious = jnp.where(roi_img[:, None] == gt_img[None, :], ious, -2.0)
+    pick = jnp.argmax(ious, axis=1).astype(jnp.int32)
     masks = jnp.take(segms, pick, axis=0)
     if masks.shape[-1] != res:
         masks = jax.image.resize(masks, (n, res, res), "nearest")
@@ -401,12 +435,15 @@ def _generate_mask_labels(ctx, ins, attrs):
                                    (1, 1)).astype(jnp.int32)]}
 
 
-@register_op("roi_perspective_transform", nondiff_inputs=("ROIs",),
+@register_op("roi_perspective_transform",
+             nondiff_inputs=("ROIs", "RoisNum", "RoisLod"),
              nondiff_outputs=("Mask", "TransformMatrix", "Out2InIdx",
                               "Out2InWeights"))
 def _roi_perspective_transform(ctx, ins, attrs):
     """perspective-warp quad rois to a fixed grid: homography from the
-    4-point roi to the output rect, sampled bilinearly."""
+    4-point roi to the output rect, sampled bilinearly. Each roi samples
+    its own image (roi_perspective_transform_op.cc:265 roi2image), mapped
+    here via the RoisNum counts (all rois -> image 0 when absent)."""
     x = ins["X"][0]              # [N, C, H, W]
     rois = ins["ROIs"][0]        # [R, 8] quad corners
     oh = attrs.get("transformed_height", 8)
@@ -414,8 +451,9 @@ def _roi_perspective_transform(ctx, ins, attrs):
     scale = attrs.get("spatial_scale", 1.0)
     n, c, h, w = x.shape
     r = rois.shape[0]
+    bidx = _batch_index_of_rois(ins, r)
 
-    def one(quad):
+    def one(feat, quad):
         q = (quad * scale).reshape(4, 2)  # tl, tr, br, bl
         u = jnp.linspace(0, 1, ow)[None, :]
         v = jnp.linspace(0, 1, oh)[:, None]
@@ -429,7 +467,6 @@ def _roi_perspective_transform(ctx, ins, attrs):
         y1 = jnp.clip(y0 + 1, 0, h - 1)
         wx = gx - x0
         wy = gy - y0
-        feat = x[0]
 
         def tap(yy, xx):
             return feat[:, yy, xx]
@@ -439,7 +476,7 @@ def _roi_perspective_transform(ctx, ins, attrs):
                 tap(y1, x0) * (1 - wx) * wy +
                 tap(y1, x1) * wx * wy)
 
-    out = jax.vmap(one)(rois)
+    out = jax.vmap(one)(x[bidx], rois)
     return {"Out": [out],
             "Mask": [jnp.ones((r, 1, oh, ow), jnp.int32)],
             "TransformMatrix": [jnp.zeros((r, 9), x.dtype)],

@@ -1,0 +1,146 @@
+"""Regression tests for the round-1 advisor findings (ADVICE.md):
+
+1. roi_perspective_transform sampled image 0 for every roi (batch > 1)
+2. generate_mask_labels indexed gt masks by class label, not matched
+   instance
+3. warpctc ignored per-sequence logit lengths (padded timesteps emitted)
+4. multiclass_nms counted valid rows by score > 0, inconsistent with the
+   padding threshold
+5. unique padded with x[0], indistinguishable from real data
+
+Each test fails against the pre-fix lowering.
+"""
+import numpy as np
+
+import paddle_tpu  # registers ops  # noqa: F401
+
+from test_parity_ops import run
+
+
+def test_roi_perspective_transform_uses_roi_image():
+    # image 0 all zeros, image 1 all ones; the only roi lives on image 1
+    x = np.stack([np.zeros((1, 8, 8), np.float32),
+                  np.ones((1, 8, 8), np.float32)])
+    quad = np.array([[1.0, 1.0, 6.0, 1.0, 6.0, 6.0, 1.0, 6.0]], np.float32)
+    out = run("roi_perspective_transform",
+              {"X": [x], "ROIs": [quad],
+               "RoisNum": [np.array([0, 1], np.int32)]},
+              {"transformed_height": 4, "transformed_width": 4,
+               "spatial_scale": 1.0})["Out"][0]
+    assert np.allclose(np.asarray(out), 1.0), \
+        "roi on image 1 must sample image 1"
+
+
+def test_generate_mask_labels_matches_instance_not_class():
+    # two gt instances of the SAME class: instance 0 fills the left half,
+    # instance 1 the right half. A roi over the left region must get
+    # instance 0's mask (class-indexed lookup would return segms[1]).
+    m = 8
+    seg0 = np.zeros((m, m), np.float32)
+    seg0[:, : m // 2] = 1.0
+    seg1 = np.zeros((m, m), np.float32)
+    seg1[:, m // 2:] = 1.0
+    segms = np.stack([seg0, seg1])
+    rois = np.array([[0.0, 0.0, 7.0, 15.0]], np.float32)  # left strip
+    out = run("generate_mask_labels",
+              {"Rois": [rois],
+               "LabelsInt32": [np.array([[1]], np.int32)],
+               "GtClasses": [np.array([1, 1], np.int32)],
+               "GtSegms": [segms],
+               "ImInfo": [np.array([[16.0, 16.0, 1.0]], np.float32)]},
+              {"resolution": m, "num_classes": 2})
+    mask = np.asarray(out["MaskInt32"][0]).reshape(m, m)
+    assert np.array_equal(mask, seg0.astype(np.int32)), \
+        "roi over the left instance must take instance 0's mask"
+
+
+def test_warpctc_respects_logit_lengths():
+    rng = np.random.RandomState(7)
+    t, c = 6, 4
+    logits_full = rng.randn(1, t, c).astype(np.float32)
+    labels = np.array([[1, 2, -1]], np.int32)
+    # exact-length reference: only the first 4 timesteps exist
+    ref = float(np.asarray(run(
+        "warpctc", {"Logits": [logits_full[:, :4]], "Label": [labels]},
+        {"blank": 0})["Loss"][0])[0])
+    padded = float(np.asarray(run(
+        "warpctc", {"Logits": [logits_full], "Label": [labels],
+                    "LogitsLength": [np.array([4], np.int64)]},
+        {"blank": 0})["Loss"][0])[0])
+    assert abs(ref - padded) < 1e-4, \
+        f"padded timesteps changed the loss: {ref} vs {padded}"
+
+
+def test_multiclass_nms_counts_negative_score_detections():
+    # logits-style scores below zero but above the threshold must be
+    # counted as valid detections
+    boxes = np.array([[[0, 0, 10, 10], [20, 20, 30, 30]]], np.float32)
+    scores = np.array([[[-0.2, -0.3],    # class 0 = background
+                        [-0.2, -0.3]]], np.float32)  # class 1
+    out = run("multiclass_nms", {"BBoxes": [boxes], "Scores": [scores]},
+              {"score_threshold": -0.5, "nms_threshold": 0.3,
+               "nms_top_k": 2, "keep_top_k": 2, "background_label": 0})
+    nums = np.asarray(out["NmsRoisNum"][0])
+    assert nums[0] == 2, f"expected 2 valid detections, got {nums[0]}"
+    rows = np.asarray(out["Out"][0])[0]
+    assert (rows[:2, 0] == 1).all()          # class 1 rows are valid
+    assert np.allclose(sorted(rows[:2, 1]), [-0.3, -0.2], atol=1e-6)
+
+
+def test_unique_padding_is_distinguishable():
+    x = np.array([3, 1, 3, 2], np.int64)
+    out = run("unique", {"X": [x]})
+    u = np.asarray(out["Out"][0])
+    inv = np.asarray(out["Index"][0])
+    n_real = inv.max() + 1
+    assert n_real == 3
+    assert set(u[:n_real].tolist()) == {1, 2, 3}
+    # pad slots hold the dtype-max sentinel, never a real value
+    # (u.dtype, not the feed dtype: jax may truncate int64 -> int32)
+    assert (u[n_real:] == np.iinfo(u.dtype).max).all()
+
+    uc = run("unique_with_counts", {"X": [x]})
+    cnt = np.asarray(uc["Count"][0])
+    assert cnt[n_real:].sum() == 0 and cnt[:n_real].sum() == 4
+
+    # bool input must not crash (iinfo is undefined for bool)
+    ub = run("unique", {"X": [np.array([True, False, True])]})
+    assert set(np.asarray(ub["Out"][0])[:2].tolist()) == {False, True}
+
+
+def test_roi_batch_index_from_lod_offsets():
+    # RoisLod offsets [0, 0, 1] == RoisNum [0, 1]: roi is on image 1
+    x = np.stack([np.zeros((1, 8, 8), np.float32),
+                  np.ones((1, 8, 8), np.float32)])
+    quad = np.array([[1.0, 1.0, 6.0, 1.0, 6.0, 6.0, 1.0, 6.0]], np.float32)
+    out = run("roi_perspective_transform",
+              {"X": [x], "ROIs": [quad],
+               "RoisLod": [np.array([0, 0, 1], np.int32)]},
+              {"transformed_height": 4, "transformed_width": 4,
+               "spatial_scale": 1.0})["Out"][0]
+    assert np.allclose(np.asarray(out), 1.0)
+
+
+def test_generate_mask_labels_partitions_gts_by_image():
+    # identical left-half masks in two images; roi belongs to image 1 so
+    # it must match gt 1 even though gt 0 has identical box + class
+    m = 8
+    seg = np.zeros((m, m), np.float32)
+    seg[:, : m // 2] = 1.0
+    seg_marked = seg.copy()
+    seg_marked[0, 0] = 0.0  # distinguishable corner pixel
+    segms = np.stack([seg, seg_marked])
+    rois = np.array([[0.0, 0.0, 7.0, 15.0]], np.float32)
+    out = run("generate_mask_labels",
+              {"Rois": [rois],
+               "LabelsInt32": [np.array([[1]], np.int32)],
+               "GtClasses": [np.array([1, 1], np.int32)],
+               "GtSegms": [segms],
+               "RoisNum": [np.array([0, 1], np.int32)],
+               "GtNum": [np.array([1, 1], np.int32)],
+               "ImInfo": [np.array([[16.0, 16.0, 1.0],
+                                    [16.0, 16.0, 1.0]], np.float32)]},
+              {"resolution": m, "num_classes": 2})
+    mask = np.asarray(out["MaskInt32"][0]).reshape(m, m)
+    assert np.array_equal(mask, seg_marked.astype(np.int32)), \
+        "roi on image 1 must match image 1's gt instance"
