@@ -52,6 +52,7 @@ from .data_feeder import DataFeeder  # noqa: F401
 from .reader import DataLoader, PyReader  # noqa: F401
 from .clip import set_gradient_clip  # noqa: F401
 from .install_check import run_check  # noqa: F401
+from .core.flags import FLAGS, get_flags, set_flags  # noqa: F401
 
 
 def data(name, shape, dtype="float32", lod_level=0):

@@ -1,0 +1,223 @@
+"""Runtime flag registry + environment bootstrap.
+
+Reference: the 136 gflags in platform/flags.cc:33-449 (DEFINE_* at a
+central site, `DECLARE_*` at use sites) exported to Python via
+core.globals, and the env bootstrap `read_env_flags` in
+python/paddle/fluid/__init__.py:165 which imports `FLAGS_*` environment
+variables at package import.
+
+TPU-first differences: most reference flags configure subsystems XLA owns
+outright (CUDA allocator fractions, cudnn autotune, NCCL rings), so the
+set here is the flags that have a real knob in THIS runtime, plus a small
+compatibility tier of reference names that are accepted, stored, and
+documented as no-ops (so reference scripts that set them keep running).
+
+Usage:
+    from paddle_tpu.core.flags import FLAGS
+    if FLAGS.check_nan_inf: ...
+    FLAGS.executor_cache_capacity = 16
+
+    # paddle-compatible API (core.globals analogue):
+    fluid.get_flags(["FLAGS_check_nan_inf"])
+    fluid.set_flags({"FLAGS_check_nan_inf": True})
+
+Environment: `FLAGS_<name>=<value>` is read once at import (bools accept
+0/1/true/false). `paddle_tpu.core.flags.reload_from_env()` re-reads.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict, List
+
+__all__ = ["FLAGS", "DEFINE_bool", "DEFINE_int32", "DEFINE_int64",
+           "DEFINE_double", "DEFINE_string", "get_flags", "set_flags",
+           "flag_info", "reload_from_env"]
+
+
+class _Flag:
+    __slots__ = ("name", "default", "value", "ftype", "help", "noop")
+
+    def __init__(self, name, default, ftype, help_, noop=False):
+        self.name = name
+        self.default = default
+        self.value = default
+        self.ftype = ftype
+        self.help = help_
+        self.noop = noop
+
+
+_REGISTRY: Dict[str, _Flag] = {}
+_LOCK = threading.Lock()
+
+
+def _define(name, default, ftype, help_, noop=False):
+    with _LOCK:
+        if name in _REGISTRY:
+            raise ValueError(f"flag {name!r} already defined")
+        _REGISTRY[name] = _Flag(name, default, ftype, help_, noop)
+    _load_one_from_env(name)
+    return _REGISTRY[name]
+
+
+def DEFINE_bool(name, default, help_=""):
+    return _define(name, bool(default), bool, help_)
+
+
+def DEFINE_int32(name, default, help_=""):
+    return _define(name, int(default), int, help_)
+
+
+DEFINE_int64 = DEFINE_int32
+
+
+def DEFINE_double(name, default, help_=""):
+    return _define(name, float(default), float, help_)
+
+
+def DEFINE_string(name, default, help_=""):
+    return _define(name, str(default), str, help_)
+
+
+def _parse(ftype, raw: str):
+    if ftype is bool:
+        return raw.strip().lower() in ("1", "true", "yes", "on")
+    return ftype(raw)
+
+
+def _load_one_from_env(name):
+    raw = os.environ.get(f"FLAGS_{name}")
+    if raw is not None:
+        f = _REGISTRY[name]
+        f.value = _parse(f.ftype, raw)
+
+
+def reload_from_env():
+    """Re-read every FLAGS_* environment variable (read_env_flags)."""
+    for name in _REGISTRY:
+        _load_one_from_env(name)
+
+
+class _FlagsNamespace:
+    """Attribute access: FLAGS.check_nan_inf. Unknown names raise."""
+
+    def __getattr__(self, name):
+        try:
+            return _REGISTRY[name].value
+        except KeyError:
+            raise AttributeError(f"unknown flag {name!r}") from None
+
+    def __setattr__(self, name, value):
+        f = _REGISTRY.get(name)
+        if f is None:
+            raise AttributeError(f"unknown flag {name!r}")
+        f.value = _parse(f.ftype, value) if isinstance(value, str) \
+            else f.ftype(value)
+
+    def __dir__(self):
+        return sorted(_REGISTRY)
+
+
+FLAGS = _FlagsNamespace()
+
+
+def get_flags(names) -> Dict[str, Any]:
+    """fluid.get_flags(["FLAGS_x", ...]) -> {name: value}."""
+    if isinstance(names, str):
+        names = [names]
+    out = {}
+    for n in names:
+        key = n[6:] if n.startswith("FLAGS_") else n
+        if key not in _REGISTRY:
+            raise ValueError(f"unknown flag {n!r}")
+        out[n] = _REGISTRY[key].value
+    return out
+
+
+def set_flags(kv: Dict[str, Any]):
+    """fluid.set_flags({"FLAGS_x": v, ...})."""
+    for n, v in kv.items():
+        key = n[6:] if n.startswith("FLAGS_") else n
+        if key not in _REGISTRY:
+            raise ValueError(f"unknown flag {n!r}")
+        setattr(FLAGS, key, v)
+
+
+def trace_signature() -> tuple:
+    """Values of every flag that is baked into a traced/jitted executable.
+    Executor cache keys include this so set_flags invalidates stale
+    compilations instead of being silently ignored."""
+    return (FLAGS.check_nan_inf, FLAGS.flash_attention_block_q,
+            FLAGS.flash_attention_block_k, FLAGS.pallas_interpret)
+
+
+def flag_info() -> List[dict]:
+    """All flags with metadata (for docs / debugging)."""
+    return [{"name": f.name, "value": f.value, "default": f.default,
+             "type": f.ftype.__name__, "help": f.help, "noop": f.noop}
+            for f in _REGISTRY.values()]
+
+
+# ---------------------------------------------------------------------------
+# Flag definitions — the live knobs
+# ---------------------------------------------------------------------------
+
+DEFINE_bool(
+    "check_nan_inf", False,
+    "Debug mode: after every lowered op, verify each floating-point "
+    "output is finite via an ordered host callback; raises naming the op "
+    "and output var. Reference: operator.cc:820-822 / flags.cc:44. "
+    "Heavy — debug only.")
+
+DEFINE_int32(
+    "executor_cache_capacity", 64,
+    "Max compiled executables kept per Executor (LRU evicted). Each entry "
+    "is one (program fingerprint, feed shapes, fetches) specialization. "
+    "Reference analogue: the per-program Prepare cache in executor.py.")
+
+DEFINE_int32(
+    "reader_queue_depth", 2,
+    "Default host infeed queue capacity for DataLoader/PyReader when the "
+    "user does not pass one (reader double-buffering depth). Reference: "
+    "buffered_reader.cc double-buffer + pybind queue capacity.")
+
+DEFINE_int32(
+    "flash_attention_block_q", 128,
+    "Default q-block tile for the Pallas flash-attention kernel when the "
+    "op attr does not specify one. Multiples of 128 only.")
+
+DEFINE_int32(
+    "flash_attention_block_k", 128,
+    "Default k-block tile for the Pallas flash-attention kernel when the "
+    "op attr does not specify one. Multiples of 128 only.")
+
+DEFINE_bool(
+    "pallas_interpret", False,
+    "Force Pallas kernels into interpret mode even on TPU (debugging "
+    "numerics; very slow).")
+
+DEFINE_string(
+    "profiler_trace_dir", "",
+    "When set, fluid.profiler writes chrome-trace/XPlane dumps here by "
+    "default. Reference: FLAGS profile_path (flags.cc).")
+
+# --- compatibility tier: accepted + stored, no effect on TPU ------------
+for _name, _default, _help in [
+    ("eager_delete_tensor_gb", 0.0,
+     "no-op: XLA buffer assignment owns device memory lifetime"),
+    ("fraction_of_gpu_memory_to_use", 0.92,
+     "no-op: no CUDA allocator in this runtime"),
+    ("cudnn_deterministic", False,
+     "no-op: XLA:TPU compilation is deterministic"),
+    ("allocator_strategy", "auto_growth",
+     "no-op: kept for reference-script compatibility"),
+    ("cpu_deterministic", False,
+     "no-op: single jitted computation is deterministic"),
+    ("local_exe_sub_scope_limit", 0.5,
+     "no-op: no per-device sub-scopes; XLA owns live-range memory"),
+]:
+    f = _define(_name, _default,
+                bool if isinstance(_default, bool)
+                else float if isinstance(_default, float)
+                else str if isinstance(_default, str) else int,
+                _help, noop=True)

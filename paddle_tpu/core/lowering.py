@@ -57,8 +57,26 @@ def _gather_slot(env, names):
     return vals
 
 
+def _nan_inf_guard(op_type, name, val):
+    """FLAGS_check_nan_inf: ordered host callback raising on non-finite
+    op outputs (reference operator.cc:820-822 checks every output tensor
+    when the flag is set). Debug mode — serializes the computation."""
+    from jax.experimental import io_callback
+
+    def cb(arr):
+        a = np.asarray(arr)
+        if not np.isfinite(a).all():
+            raise FloatingPointError(
+                f"Operator {op_type} output {name!r} contains Inf/Nan "
+                f"(FLAGS_check_nan_inf)")
+        return np.zeros((), np.bool_)
+
+    io_callback(cb, jax.ShapeDtypeStruct((), np.bool_), val, ordered=True)
+
+
 def run_op(op, env, ctx):
     """Execute one op's lowering against env (name -> array)."""
+    from .flags import FLAGS
     opdef = REGISTRY.get(op.type)
     ins = {}
     for slot, names in op.inputs.items():
@@ -70,6 +88,7 @@ def run_op(op, env, ctx):
     # semantics (conditional_block false branch) read carried state
     opctx.env = env
     outs = opdef.lower(opctx, ins, op.attrs)
+    check = FLAGS.check_nan_inf
     for slot, names in op.outputs.items():
         if slot not in outs:
             continue
@@ -77,6 +96,9 @@ def run_op(op, env, ctx):
         for name, val in zip(names, vals):
             if name:
                 env[name] = val
+                if check and hasattr(val, "dtype") and \
+                        is_floating(val.dtype):
+                    _nan_inf_guard(op.type, name, val)
 
 
 class _OpCtx:

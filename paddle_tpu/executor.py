@@ -40,8 +40,12 @@ class _CompiledStep:
 
 class Executor:
     def __init__(self, place: Optional[Place] = None):
+        from collections import OrderedDict
         self.place = place or default_place()
-        self._cache: Dict[tuple, _CompiledStep] = {}
+        # LRU-ordered: bounded by FLAGS_executor_cache_capacity so
+        # long-running sessions that rebuild programs don't accumulate
+        # executables forever.
+        self._cache: "OrderedDict[tuple, _CompiledStep]" = OrderedDict()
         self._step_counters: Dict[str, int] = {}
         # Strong refs to CompiledPrograms in the cache: keys use
         # id(compiled), which is only stable while the object is alive.
@@ -89,12 +93,23 @@ class Executor:
 
         key = self._cache_key(program, feed_arrays, fetch_names, compiled)
         step_fn = self._cache.get(key) if use_program_cache else None
-        if step_fn is None:
+        if step_fn is not None:
+            self._cache.move_to_end(key)  # LRU touch
+        else:
             step_fn = self._compile(program, block, feed_arrays, fetch_names,
                                     scope, compiled)
             self._cache[key] = step_fn
             if compiled is not None:
                 self._compiled_refs[id(compiled)] = compiled
+            from .core.flags import FLAGS
+            cap = FLAGS.executor_cache_capacity
+            while cap > 0 and len(self._cache) > cap:
+                old_key, _ = self._cache.popitem(last=False)
+                # drop the compiled-program strong ref if no other cache
+                # entry still uses it
+                cid = old_key[3]
+                if cid is not None and all(k[3] != cid for k in self._cache):
+                    self._compiled_refs.pop(cid, None)
 
         state = {}
         for n in step_fn.state_in_names:
@@ -135,10 +150,12 @@ class Executor:
         return out
 
     def _cache_key(self, program, feed_arrays, fetch_names, compiled):
+        from .core.flags import trace_signature
         feed_sig = tuple(sorted(
             (n, a.shape, str(a.dtype)) for n, a in feed_arrays.items()))
         return (program.fingerprint(), feed_sig, tuple(fetch_names),
-                id(compiled) if compiled is not None else None)
+                id(compiled) if compiled is not None else None,
+                trace_signature())
 
     def _compile(self, program, block, feed_arrays, fetch_names, scope,
                  compiled) -> _CompiledStep:

@@ -12,11 +12,16 @@ from .pallas.flash_attention import flash_attention, reference_attention
 
 @register_op("flash_attention", stateful=True)
 def _flash_attention_op(ctx, ins, attrs):
+    from ..core.flags import FLAGS
     q, k, v = ins["Q"][0], ins["K"][0], ins["V"][0]
     causal = attrs.get("causal", False)
     sm_scale = attrs.get("sm_scale", None)
     dropout = 0.0 if ctx.is_test else attrs.get("attn_dropout", 0.0)
-    if attrs.get("block_q", 128) == 0:  # explicit exact-path request
+    # tile sizes: op attr wins; FLAGS_flash_attention_block_{q,k} give the
+    # session default (tunable without rebuilding the program)
+    bq = attrs.get("block_q", FLAGS.flash_attention_block_q)
+    bk = attrs.get("block_k", FLAGS.flash_attention_block_k)
+    if bq == 0:  # explicit exact-path request
         out = reference_attention(q, k, v, causal=causal, sm_scale=sm_scale,
                                   dropout=dropout,
                                   rng=ctx.rng if dropout else None)
@@ -26,8 +31,6 @@ def _flash_attention_op(ctx, ins, attrs):
         out = reference_attention(q, k, v, causal=causal, sm_scale=sm_scale,
                                   dropout=dropout, rng=ctx.rng)
     else:
-        out = flash_attention(
-            q, k, v, causal=causal, sm_scale=sm_scale,
-            block_q=attrs.get("block_q", 128),
-            block_k=attrs.get("block_k", 128))
+        out = flash_attention(q, k, v, causal=causal, sm_scale=sm_scale,
+                              block_q=bq, block_k=bk)
     return {"Out": [out]}

@@ -31,7 +31,8 @@ except ImportError:  # pragma: no cover
 
 
 def _interpret():
-    return jax.default_backend() != "tpu"
+    from ...core.flags import FLAGS
+    return FLAGS.pallas_interpret or jax.default_backend() != "tpu"
 
 
 NEG_INF = -1e30

@@ -18,11 +18,15 @@ __all__ = ["profiler", "start_profiler", "stop_profiler", "reset_profiler",
 _trace_dir = None
 
 
-def start_profiler(state="All", tracer_option=None,
-                   output_dir="/tmp/paddle_tpu_profile"):
+def _default_trace_dir():
+    from .core.flags import FLAGS
+    return FLAGS.profiler_trace_dir or "/tmp/paddle_tpu_profile"
+
+
+def start_profiler(state="All", tracer_option=None, output_dir=None):
     global _trace_dir
-    _trace_dir = output_dir
-    jax.profiler.start_trace(output_dir)
+    _trace_dir = output_dir or _default_trace_dir()
+    jax.profiler.start_trace(_trace_dir)
 
 
 def stop_profiler(sorted_key=None, profile_path=None):
@@ -34,8 +38,8 @@ def reset_profiler():
 
 
 @contextlib.contextmanager
-def profiler(state="All", sorted_key=None,
-             profile_path="/tmp/paddle_tpu_profile", tracer_option=None):
+def profiler(state="All", sorted_key=None, profile_path=None,
+             tracer_option=None):
     start_profiler(state, tracer_option, profile_path)
     try:
         yield

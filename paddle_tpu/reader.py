@@ -58,7 +58,9 @@ class _GeneratorLoader:
 
     # -- iteration with prefetch ----------------------------------------
     def __iter__(self):
-        q: "queue.Queue" = queue.Queue(maxsize=self.capacity or 2)
+        from .core.flags import FLAGS
+        q: "queue.Queue" = queue.Queue(
+            maxsize=self.capacity or FLAGS.reader_queue_depth)
         sentinel = object()
 
         def worker():
@@ -89,8 +91,11 @@ class _GeneratorLoader:
 
 class DataLoader:
     @staticmethod
-    def from_generator(feed_list=None, capacity=2, use_double_buffer=True,
-                       iterable=True, return_list=False):
+    def from_generator(feed_list=None, capacity=None,
+                       use_double_buffer=True, iterable=True,
+                       return_list=False):
+        """capacity=None defers to FLAGS_reader_queue_depth at iteration
+        time (reference default: 2)."""
         return _GeneratorLoader(feed_list or [], capacity, iterable,
                                 return_list, use_double_buffer)
 
@@ -101,8 +106,8 @@ class DataLoader:
 
 
 class PyReader(_GeneratorLoader):
-    def __init__(self, feed_list=None, capacity=2, use_double_buffer=True,
-                 iterable=True, return_list=False):
+    def __init__(self, feed_list=None, capacity=None,
+                 use_double_buffer=True, iterable=True, return_list=False):
         super().__init__(feed_list or [], capacity, iterable, return_list,
                          use_double_buffer)
 

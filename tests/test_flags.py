@@ -1,0 +1,127 @@
+"""Flag registry + env bootstrap + wired knobs.
+
+Reference: platform/flags.cc (central DEFINE_* registry),
+python/paddle/fluid/__init__.py:165 read_env_flags, core.globals get/set.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.core import flags as flags_mod
+from paddle_tpu.core.flags import FLAGS
+
+
+def test_get_set_flags_api():
+    assert fluid.get_flags("FLAGS_check_nan_inf") == {
+        "FLAGS_check_nan_inf": False}
+    fluid.set_flags({"FLAGS_executor_cache_capacity": 8})
+    assert FLAGS.executor_cache_capacity == 8
+    fluid.set_flags({"FLAGS_executor_cache_capacity": 64})
+    with pytest.raises(ValueError):
+        fluid.get_flags("FLAGS_no_such_flag")
+    with pytest.raises(AttributeError):
+        FLAGS.no_such_flag
+
+
+def test_env_bootstrap(monkeypatch):
+    monkeypatch.setenv("FLAGS_reader_queue_depth", "7")
+    monkeypatch.setenv("FLAGS_pallas_interpret", "true")
+    flags_mod.reload_from_env()
+    assert FLAGS.reader_queue_depth == 7
+    assert FLAGS.pallas_interpret is True
+    monkeypatch.delenv("FLAGS_reader_queue_depth")
+    monkeypatch.delenv("FLAGS_pallas_interpret")
+    FLAGS.reader_queue_depth = 2
+    FLAGS.pallas_interpret = False
+
+
+def test_compat_noop_flags_accepted():
+    # reference scripts set these; they must be storable without effect
+    fluid.set_flags({"FLAGS_eager_delete_tensor_gb": 1.5,
+                     "FLAGS_fraction_of_gpu_memory_to_use": 0.5})
+    info = {f["name"]: f for f in flags_mod.flag_info()}
+    assert info["eager_delete_tensor_gb"]["noop"] is True
+    assert info["eager_delete_tensor_gb"]["value"] == 1.5
+
+
+def test_check_nan_inf_raises_with_op_name():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[2, 2], dtype="float32",
+                        append_batch_size=False)
+        y = layers.log(x)  # log(-1) = nan
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    bad = np.array([[1.0, -1.0], [2.0, 3.0]], np.float32)
+    fluid.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            with pytest.raises(Exception) as ei:
+                exe.run(main, feed={"x": bad}, fetch_list=[y])
+        assert "Inf/Nan" in str(ei.value)
+        # a clean input passes with the flag still on
+        with fluid.scope_guard(scope):
+            out, = exe.run(main, feed={"x": np.abs(bad)}, fetch_list=[y])
+        assert np.isfinite(out).all()
+    finally:
+        fluid.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def test_set_flags_invalidates_cached_executables():
+    # a trace-time flag flipped AFTER the first run must not be silently
+    # ignored by the executable cache
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[2], dtype="float32",
+                        append_batch_size=False)
+        y = layers.log(x)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    bad = np.array([1.0, -1.0], np.float32)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        exe.run(main, feed={"x": bad}, fetch_list=[y])  # cached, no guard
+        fluid.set_flags({"FLAGS_check_nan_inf": True})
+        try:
+            with pytest.raises(Exception, match="Inf/Nan"):
+                exe.run(main, feed={"x": bad}, fetch_list=[y])
+        finally:
+            fluid.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def test_reader_queue_depth_flag_used_when_capacity_unset():
+    fluid.set_flags({"FLAGS_reader_queue_depth": 5})
+    try:
+        loader = fluid.DataLoader.from_generator(feed_list=[])
+        assert loader.capacity is None  # resolved at iteration time
+
+        def rd():
+            yield {"a": np.zeros(1)}
+
+        loader.set_batch_generator(rd)
+        assert len(list(loader())) == 1  # smoke: queue built from flag
+    finally:
+        fluid.set_flags({"FLAGS_reader_queue_depth": 2})
+
+
+def test_executor_cache_evicts_lru():
+    fluid.set_flags({"FLAGS_executor_cache_capacity": 2})
+    try:
+        exe = fluid.Executor()
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            for i in range(4):  # 4 distinct programs -> 4 cache keys
+                main, startup = fluid.Program(), fluid.Program()
+                with fluid.program_guard(main, startup):
+                    x = layers.data("x", shape=[2], dtype="float32",
+                                    append_batch_size=False)
+                    y = layers.scale(x, scale=float(i + 1))
+                exe.run(startup)
+                out, = exe.run(main, feed={"x": np.ones(2, np.float32)},
+                               fetch_list=[y])
+                assert out[0] == i + 1
+        assert len(exe._cache) <= 2
+    finally:
+        fluid.set_flags({"FLAGS_executor_cache_capacity": 64})
