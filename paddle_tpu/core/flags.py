@@ -36,47 +36,52 @@ __all__ = ["FLAGS", "DEFINE_bool", "DEFINE_int32", "DEFINE_int64",
 
 
 class _Flag:
-    __slots__ = ("name", "default", "value", "ftype", "help", "noop")
+    __slots__ = ("name", "default", "value", "ftype", "help", "noop",
+                 "traced")
 
-    def __init__(self, name, default, ftype, help_, noop=False):
+    def __init__(self, name, default, ftype, help_, noop=False,
+                 traced=False):
         self.name = name
         self.default = default
         self.value = default
         self.ftype = ftype
         self.help = help_
         self.noop = noop
+        # traced flags are baked into jitted executables; their values
+        # join the executor cache key (trace_signature)
+        self.traced = traced
 
 
 _REGISTRY: Dict[str, _Flag] = {}
 _LOCK = threading.Lock()
 
 
-def _define(name, default, ftype, help_, noop=False):
+def _define(name, default, ftype, help_, noop=False, traced=False):
     with _LOCK:
         if name in _REGISTRY:
             raise ValueError(f"flag {name!r} already defined")
-        _REGISTRY[name] = _Flag(name, default, ftype, help_, noop)
+        _REGISTRY[name] = _Flag(name, default, ftype, help_, noop, traced)
     _load_one_from_env(name)
     return _REGISTRY[name]
 
 
-def DEFINE_bool(name, default, help_=""):
-    return _define(name, bool(default), bool, help_)
+def DEFINE_bool(name, default, help_="", traced=False):
+    return _define(name, bool(default), bool, help_, traced=traced)
 
 
-def DEFINE_int32(name, default, help_=""):
-    return _define(name, int(default), int, help_)
+def DEFINE_int32(name, default, help_="", traced=False):
+    return _define(name, int(default), int, help_, traced=traced)
 
 
 DEFINE_int64 = DEFINE_int32
 
 
-def DEFINE_double(name, default, help_=""):
-    return _define(name, float(default), float, help_)
+def DEFINE_double(name, default, help_="", traced=False):
+    return _define(name, float(default), float, help_, traced=traced)
 
 
-def DEFINE_string(name, default, help_=""):
-    return _define(name, str(default), str, help_)
+def DEFINE_string(name, default, help_="", traced=False):
+    return _define(name, str(default), str, help_, traced=traced)
 
 
 def _parse(ftype, raw: str):
@@ -89,7 +94,15 @@ def _load_one_from_env(name):
     raw = os.environ.get(f"FLAGS_{name}")
     if raw is not None:
         f = _REGISTRY[name]
-        f.value = _parse(f.ftype, raw)
+        try:
+            f.value = _parse(f.ftype, raw)
+        except (ValueError, TypeError):
+            # a bad env value must not make the package unimportable
+            import warnings
+            warnings.warn(
+                f"ignoring malformed environment variable FLAGS_{name}="
+                f"{raw!r} (expected {f.ftype.__name__}); keeping "
+                f"{f.value!r}")
 
 
 def reload_from_env():
@@ -144,11 +157,11 @@ def set_flags(kv: Dict[str, Any]):
 
 
 def trace_signature() -> tuple:
-    """Values of every flag that is baked into a traced/jitted executable.
+    """Values of every traced=True flag (baked into jitted executables).
     Executor cache keys include this so set_flags invalidates stale
-    compilations instead of being silently ignored."""
-    return (FLAGS.check_nan_inf, FLAGS.flash_attention_block_q,
-            FLAGS.flash_attention_block_k, FLAGS.pallas_interpret)
+    compilations instead of being silently ignored. Derived from the
+    registry: a new traced flag is covered automatically."""
+    return tuple(f.value for _, f in sorted(_REGISTRY.items()) if f.traced)
 
 
 def flag_info() -> List[dict]:
@@ -167,7 +180,7 @@ DEFINE_bool(
     "Debug mode: after every lowered op, verify each floating-point "
     "output is finite via an ordered host callback; raises naming the op "
     "and output var. Reference: operator.cc:820-822 / flags.cc:44. "
-    "Heavy — debug only.")
+    "Heavy — debug only.", traced=True)
 
 DEFINE_int32(
     "executor_cache_capacity", 64,
@@ -184,17 +197,17 @@ DEFINE_int32(
 DEFINE_int32(
     "flash_attention_block_q", 128,
     "Default q-block tile for the Pallas flash-attention kernel when the "
-    "op attr does not specify one. Multiples of 128 only.")
+    "op attr does not specify one. Multiples of 128 only.", traced=True)
 
 DEFINE_int32(
     "flash_attention_block_k", 128,
     "Default k-block tile for the Pallas flash-attention kernel when the "
-    "op attr does not specify one. Multiples of 128 only.")
+    "op attr does not specify one. Multiples of 128 only.", traced=True)
 
 DEFINE_bool(
     "pallas_interpret", False,
     "Force Pallas kernels into interpret mode even on TPU (debugging "
-    "numerics; very slow).")
+    "numerics; very slow).", traced=True)
 
 DEFINE_string(
     "profiler_trace_dir", "",

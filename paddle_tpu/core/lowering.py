@@ -41,6 +41,9 @@ class LowerCtx:
         self.base_key = base_key
         self.is_test = is_test
         self.mesh = mesh
+        # True while lowering a sub-block inside lax.cond/while_loop —
+        # ordered effects are not allowed there (see _nan_inf_guard)
+        self.in_control_flow = False
 
     def rng_for(self, op_id: int):
         return jax.random.fold_in(self.base_key, np.uint32(op_id))
@@ -57,10 +60,13 @@ def _gather_slot(env, names):
     return vals
 
 
-def _nan_inf_guard(op_type, name, val):
-    """FLAGS_check_nan_inf: ordered host callback raising on non-finite
-    op outputs (reference operator.cc:820-822 checks every output tensor
-    when the flag is set). Debug mode — serializes the computation."""
+def _nan_inf_guard(op_type, name, val, in_control_flow):
+    """FLAGS_check_nan_inf: host callback on every float op output
+    (reference operator.cc:820-822 checks every output tensor when the
+    flag is set). Top level uses an ordered io_callback that RAISES on
+    Inf/Nan; inside lax.cond/while_loop sub-blocks ordered effects are
+    rejected by JAX, so the guard degrades to jax.debug.callback, which
+    reports loudly but cannot abort the run. Debug mode only."""
     from jax.experimental import io_callback
 
     def cb(arr):
@@ -71,7 +77,17 @@ def _nan_inf_guard(op_type, name, val):
                 f"(FLAGS_check_nan_inf)")
         return np.zeros((), np.bool_)
 
-    io_callback(cb, jax.ShapeDtypeStruct((), np.bool_), val, ordered=True)
+    if in_control_flow:
+        def report(arr):
+            a = np.asarray(arr)
+            if not np.isfinite(a).all():
+                print(f"FLAGS_check_nan_inf: operator {op_type} output "
+                      f"{name!r} contains Inf/Nan (inside control flow; "
+                      f"run aborts are only possible at top level)")
+        jax.debug.callback(report, val)
+    else:
+        io_callback(cb, jax.ShapeDtypeStruct((), np.bool_), val,
+                    ordered=True)
 
 
 def run_op(op, env, ctx):
@@ -98,7 +114,8 @@ def run_op(op, env, ctx):
                 env[name] = val
                 if check and hasattr(val, "dtype") and \
                         is_floating(val.dtype):
-                    _nan_inf_guard(op.type, name, val)
+                    _nan_inf_guard(op.type, name, val,
+                                   ctx.in_control_flow)
 
 
 class _OpCtx:
@@ -125,8 +142,13 @@ class _OpCtx:
         return self._op.block.program.blocks[idx]
 
     def lower_sub_block(self, block, env):
-        for op in block.ops:
-            run_op(op, env, self._ctx)
+        prev = self._ctx.in_control_flow
+        self._ctx.in_control_flow = True
+        try:
+            for op in block.ops:
+                run_op(op, env, self._ctx)
+        finally:
+            self._ctx.in_control_flow = prev
         return env
 
 

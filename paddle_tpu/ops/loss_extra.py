@@ -441,11 +441,13 @@ def _chunk_eval(ctx, ins, attrs):
         r = cc / lc if lc else 0.0
         f = 2 * p * r / (p + r) if p + r else 0.0
         mk = lambda v, d: np.asarray([v], d)
+        # int32 counts: int64 result shapes are rejected by io_callback
+        # when jax_enable_x64 is off (the default here)
         return (mk(p, np.float32), mk(r, np.float32), mk(f, np.float32),
-                mk(ic, np.int64), mk(lc, np.int64), mk(cc, np.int64))
+                mk(ic, np.int32), mk(lc, np.int32), mk(cc, np.int32))
 
     structs = (jax.ShapeDtypeStruct((1,), jnp.float32),) * 3 + \
-        (jax.ShapeDtypeStruct((1,), jnp.int64),) * 3
+        (jax.ShapeDtypeStruct((1,), jnp.int32),) * 3
     p, r, f, ic, lc, cc = io_callback(cb, structs, inf, lab, ordered=True)
     return {"Precision": [p], "Recall": [r], "F1-Score": [f],
             "NumInferChunks": [ic], "NumLabelChunks": [lc],

@@ -81,24 +81,11 @@ def _conv3d(ctx, ins, attrs):
 @register_op("conv2d_transpose")
 def _conv2d_transpose(ctx, ins, attrs):
     x, w = ins["Input"][0], ins["Filter"][0]  # w: [C_in, C_out/g, kh, kw]
-    strides = tuple(attrs.get("strides", [1, 1]))
-    pads = attrs.get("paddings", [0, 0])
-    dil = tuple(attrs.get("dilations", [1, 1]))
-    groups = attrs.get("groups", 1)
-    if groups != 1:
-        raise NotImplementedError("grouped conv2d_transpose")
-    # Gradient-of-conv formulation: transpose conv == lhs-dilated conv with
-    # flipped kernel (what conv2d_transpose_op.cc computes via col2im).
-    kh, kw = w.shape[2], w.shape[3]
-    padding = [(dil[0] * (kh - 1) - pads[0], dil[0] * (kh - 1) - pads[0]),
-               (dil[1] * (kw - 1) - pads[1], dil[1] * (kw - 1) - pads[1])]
-    w_t = jnp.flip(w, axis=(2, 3)).swapaxes(0, 1)  # -> [C_out, C_in, kh, kw]
-    out = jax.lax.conv_general_dilated(
-        x, w_t, window_strides=(1, 1), padding=padding,
-        lhs_dilation=strides, rhs_dilation=dil,
-        dimension_numbers=jax.lax.conv_dimension_numbers(
-            x.shape, w_t.shape, ("NCHW", "OIHW", "NCHW")),
-        preferred_element_type=jnp.float32).astype(x.dtype)
+    from .vision_extra import _conv_transpose
+    out = _conv_transpose(x, w, attrs.get("strides", [1, 1]),
+                          attrs.get("paddings", [0, 0]), 2,
+                          groups=attrs.get("groups", 1),
+                          dilations=attrs.get("dilations", [1, 1]))
     return {"Output": [out]}
 
 

@@ -206,9 +206,12 @@ def _has_nan(ctx, ins, attrs):
 
 @register_op("where_index", nondiff_outputs=("Out",))
 def _where_index(ctx, ins, attrs):
-    raise NotImplementedError(
-        "`where` (nonzero-indices) has a data-dependent output shape and "
-        "cannot lower to XLA; use masked ops instead")
+    """Nonzero indices (where_index_op). Same padded static-shape design
+    as the `where` lowering in misc_ops.py: valid rows first, -1 padded
+    to cond.size rows (XLA needs static shapes)."""
+    from .misc_ops import _where_index as _impl
+    cond = ins.get("Condition", ins.get("X"))
+    return _impl(ctx, {"Condition": cond}, attrs)
 
 
 @register_op("label_smooth")

@@ -99,22 +99,44 @@ def _spp(ctx, ins, attrs):
 # ---------------------------------------------------------------------------
 
 
-def _conv_transpose(x, w, strides, paddings, nd, groups=1):
-    dn = jax.lax.conv_dimension_numbers(
-        x.shape, w.shape,
-        ("NCHW", "IOHW", "NCHW") if nd == 2 else
-        ("NCDHW", "IODHW", "NCDHW"))
-    pads = [(p, p) for p in paddings]
-    return jax.lax.conv_transpose(
-        x, w, tuple(strides), pads, dimension_numbers=dn,
-        transpose_kernel=True)
+def _conv_transpose(x, w, strides, paddings, nd, groups=1,
+                    dilations=None):
+    """Transposed conv, any spatial rank (conv2d/3d_transpose_op.cc
+    col2im semantics), shared by conv2d_transpose / conv3d_transpose /
+    depthwise_conv2d_transpose: gradient-of-conv formulation —
+    lhs-dilate by stride, flip the kernel, swap in/out channels.
+    w: [C_in, C_out/g, k...]."""
+    spatial = tuple(range(2, 2 + nd))
+    k = w.shape[2:]
+    cin, cog = w.shape[0], w.shape[1]
+    dil = tuple(dilations or (1,) * nd)
+    padding = [(dil[i] * (k[i] - 1) - paddings[i],
+                dil[i] * (k[i] - 1) - paddings[i]) for i in range(nd)]
+    w_f = jnp.flip(w, axis=spatial)
+    if groups == 1:
+        w_t = w_f.swapaxes(0, 1)               # [C_out, C_in, k...]
+    else:
+        # per-group swap: [g, C_in/g, C_out/g, k] -> [C_out, C_in/g, k]
+        w_f = w_f.reshape((groups, cin // groups, cog) + k)
+        w_t = jnp.moveaxis(w_f, 2, 1).reshape(
+            (groups * cog, cin // groups) + k)
+    dn_str = ("NCHW", "OIHW", "NCHW") if nd == 2 else \
+        ("NCDHW", "OIDHW", "NCDHW")
+    return jax.lax.conv_general_dilated(
+        x, w_t, window_strides=(1,) * nd, padding=padding,
+        lhs_dilation=tuple(strides), rhs_dilation=dil,
+        feature_group_count=groups,
+        dimension_numbers=jax.lax.conv_dimension_numbers(
+            x.shape, w_t.shape, dn_str),
+        preferred_element_type=jnp.float32).astype(x.dtype)
 
 
 @register_op("conv3d_transpose")
 def _conv3d_transpose(ctx, ins, attrs):
     x, w = ins["Input"][0], ins["Filter"][0]
     out = _conv_transpose(x, w, attrs.get("strides", [1, 1, 1]),
-                          attrs.get("paddings", [0, 0, 0]), 3)
+                          attrs.get("paddings", [0, 0, 0]), 3,
+                          groups=attrs.get("groups", 1))
     return {"Output": [out]}
 
 
