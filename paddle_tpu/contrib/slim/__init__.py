@@ -1,4 +1,7 @@
-"""Model compression (reference: contrib/slim — quantization/prune/NAS/
-distillation). Round-1 scope: quantization-aware training (fake-quant
-rewrite) + magnitude pruning utilities."""
+"""Model compression (reference: contrib/slim — quantization, pruning,
+distillation, NAS). Quantization-aware training (fake-quant rewrite),
+structured pruning over the Program IR (mask + shrink modes), and
+distillation (teacher-program merge + L2/soft-label/FSP losses)."""
+from . import distillation  # noqa: F401
+from . import prune  # noqa: F401
 from . import quantization  # noqa: F401

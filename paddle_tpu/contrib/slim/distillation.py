@@ -1,0 +1,158 @@
+"""Knowledge distillation: merge a teacher program into the student's and
+attach distillation losses.
+
+Reference: contrib/slim/distillation/distiller.py (L2Distiller :25,
+FSPDistiller :103, SoftLabelDistiller :195 — each builds a *Pass that
+appends its loss subgraph onto the merged graph) and the
+DistillationStrategy that merges teacher/student programs.
+
+Here the merge clones the teacher's ops into the student program with a
+name prefix (shared feed vars are mapped, not cloned), copies teacher
+weights into the scope under the prefixed names with stop_gradient so
+only the student trains, and the distillers emit ordinary IR ops — the
+whole distillation step stays ONE XLA computation.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["merge", "L2Distiller", "SoftLabelDistiller", "FSPDistiller"]
+
+PREFIX = "teacher_"
+
+
+def merge(teacher_program, student_program, data_name_map=None,
+          scope=None, teacher_scope=None, name_prefix=PREFIX):
+    """Clone teacher ops/vars into the student program.
+
+    data_name_map: {teacher_feed_name: student_feed_name} — those vars
+    are shared instead of cloned. Teacher vars are renamed with
+    name_prefix and marked stop_gradient (the reference merge sets
+    teacher vars untrainable). Teacher parameter values are copied from
+    teacher_scope (default: same scope) under the new names.
+    """
+    from ...core.scope import global_scope
+    data_name_map = dict(data_name_map or {})
+    scope = scope or global_scope()
+    teacher_scope = teacher_scope or scope
+
+    t_block = teacher_program.global_block()
+    s_block = student_program.global_block()
+
+    def map_name(n):
+        if not n:
+            return n
+        return data_name_map.get(n, name_prefix + n)
+
+    for v in t_block.vars.values():
+        if v.name in data_name_map:
+            continue
+        nn = name_prefix + v.name
+        if not s_block.has_var(nn):
+            s_block.create_var(name=nn, shape=v.shape, dtype=v.dtype,
+                               persistable=v.persistable,
+                               stop_gradient=True)
+        if teacher_scope.has(v.name) and v.persistable:
+            scope.set(nn, teacher_scope.get_numpy(v.name))
+
+    for op in t_block.ops:
+        if op.type in ("feed", "fetch"):
+            continue
+        ins = {s: [map_name(n) for n in names]
+               for s, names in op.inputs.items()}
+        outs = {s: [map_name(n) for n in names]
+                for s, names in op.outputs.items()}
+        attrs = dict(op.attrs)
+        attrs["is_test"] = True  # teacher always runs in inference mode
+        s_block.append_op(op.type, inputs=ins, outputs=outs, attrs=attrs)
+    student_program._fp_cache = None
+    return student_program
+
+
+def _teacher_var(block, name):
+    """Resolve a teacher feature map: merge() renames teacher vars with
+    PREFIX, but maps derived inside the student program (e.g. reshapes
+    of merged vars) already carry their final name."""
+    if block.has_var(PREFIX + name):
+        return block.var(PREFIX + name)
+    return block.var(name)
+
+
+class L2Distiller:
+    """L2 loss between a student and a teacher feature map
+    (reference distiller.py:25)."""
+
+    def __init__(self, student_feature_map, teacher_feature_map,
+                 distillation_loss_weight=1.0):
+        self.student_feature_map = student_feature_map
+        self.teacher_feature_map = teacher_feature_map
+        self.weight = distillation_loss_weight
+
+    def distiller_loss(self, program):
+        from ... import layers
+        from ...framework import program_guard
+        with program_guard(program):
+            block = program.global_block()
+            s = block.var(self.student_feature_map)
+            t = _teacher_var(block, self.teacher_feature_map)
+            loss = layers.reduce_mean(layers.square(
+                layers.elementwise_sub(s, t)))
+            return layers.scale(loss, scale=float(self.weight))
+
+
+class SoftLabelDistiller:
+    """Soft-target cross entropy between softened logits
+    (reference distiller.py:195)."""
+
+    def __init__(self, student_feature_map, teacher_feature_map,
+                 student_temperature=1.0, teacher_temperature=1.0,
+                 distillation_loss_weight=1.0):
+        self.student_feature_map = student_feature_map
+        self.teacher_feature_map = teacher_feature_map
+        self.student_temperature = student_temperature
+        self.teacher_temperature = teacher_temperature
+        self.weight = distillation_loss_weight
+
+    def distiller_loss(self, program):
+        from ... import layers
+        from ...framework import program_guard
+        with program_guard(program):
+            block = program.global_block()
+            s = block.var(self.student_feature_map)
+            t = _teacher_var(block, self.teacher_feature_map)
+            s_soft = layers.softmax(
+                layers.scale(s, scale=1.0 / self.student_temperature))
+            t_soft = layers.softmax(
+                layers.scale(t, scale=1.0 / self.teacher_temperature))
+            ce = layers.cross_entropy(s_soft, t_soft, soft_label=True)
+            return layers.scale(layers.reduce_mean(ce),
+                                scale=float(self.weight))
+
+
+class FSPDistiller:
+    """Flow-of-solution-procedure matrices L2 loss
+    (reference distiller.py:103; uses the fsp op, fsp_op.cc)."""
+
+    def __init__(self, student_pairs, teacher_pairs,
+                 distillation_loss_weight=1.0):
+        self.student_pairs = student_pairs
+        self.teacher_pairs = teacher_pairs
+        self.weight = distillation_loss_weight
+
+    def distiller_loss(self, program):
+        from ... import layers
+        from ...framework import program_guard
+        with program_guard(program):
+            block = program.global_block()
+            losses = []
+            for (s0, s1), (t0, t1) in zip(self.student_pairs,
+                                          self.teacher_pairs):
+                s_fsp = layers.fsp_matrix(block.var(s0), block.var(s1))
+                t_fsp = layers.fsp_matrix(_teacher_var(block, t0),
+                                          _teacher_var(block, t1))
+                losses.append(layers.reduce_mean(layers.square(
+                    layers.elementwise_sub(s_fsp, t_fsp))))
+            total = losses[0]
+            for l in losses[1:]:
+                total = layers.elementwise_add(total, l)
+            return layers.scale(total, scale=float(self.weight))

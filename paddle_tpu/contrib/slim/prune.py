@@ -1,0 +1,215 @@
+"""Structured pruning over the Program IR + Scope.
+
+Reference: contrib/slim/prune/pruner.py (StructurePruner: group-sort by
+l1_norm along a pruning axis, drop the lowest-ratio groups) and
+prune_strategy.py (_prune_parameters: walk the graph so downstream
+consumers of a pruned output-channel axis are pruned consistently).
+
+TPU-first design: XLA compiles static shapes, so two modes exist —
+
+- mask mode (``lazy=True``): pruned groups are ZEROED in the Scope.
+  Shapes (and therefore the compiled executable) are unchanged, the
+  sparsity is recoverable by finetuning, and the same program keeps
+  running. This is the mode to use mid-training.
+- shrink mode (``lazy=False``): parameters are physically sliced and the
+  program's var shapes rewritten, producing a smaller model + a fresh
+  compile. Downstream dependents (the next matmul/conv input axis,
+  batch-norm scale/bias/mean/variance) are pruned to match, following
+  the reference's graph walk.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["Pruner", "StructurePruner", "prune_program"]
+
+
+class Pruner:
+    """Base class (reference pruner.py:22)."""
+
+    def prune(self, param):
+        raise NotImplementedError
+
+
+class StructurePruner(Pruner):
+    """Group pruning by axis + criterion (reference pruner.py:33)."""
+
+    def __init__(self, pruning_axis=None, criterions=None):
+        self.pruning_axis = pruning_axis or {"*": 0}
+        self.criterions = criterions or {"*": "l1_norm"}
+
+    def cal_pruned_idx(self, name, param, ratio, axis=None):
+        """Indices of the lowest-criterion groups along axis
+        (reference pruner.py:55)."""
+        criterion = self.criterions.get(name, self.criterions["*"])
+        if axis is None:
+            axis = self.pruning_axis.get(name, self.pruning_axis["*"])
+        prune_num = int(round(param.shape[axis] * ratio))
+        reduce_dims = tuple(i for i in range(param.ndim) if i != axis)
+        if criterion != "l1_norm":
+            raise ValueError(f"unsupported criterion {criterion!r}")
+        scores = np.sum(np.abs(param), axis=reduce_dims)
+        return np.argsort(scores)[:prune_num]
+
+    def prune_tensor(self, tensor, pruned_idx, pruned_axis, lazy=False):
+        """Zero (lazy) or slice out (shrink) the given groups
+        (reference pruner.py:82)."""
+        if lazy:
+            out = np.array(tensor)
+            sl = [slice(None)] * out.ndim
+            sl[pruned_axis] = pruned_idx
+            out[tuple(sl)] = 0.0
+            return out
+        mask = np.ones(tensor.shape[pruned_axis], bool)
+        mask[pruned_idx] = False
+        return np.take(tensor, np.where(mask)[0], axis=pruned_axis)
+
+
+# ---------------------------------------------------------------------------
+# program-level one-shot pruning (reference prune_strategy.py)
+# ---------------------------------------------------------------------------
+
+# how an op consumes a var whose producer axis-0 was pruned:
+# op type -> (weight slot, input-channel axis of that weight)
+_CONSUMER_AXIS = {"mul": ("Y", 0), "matmul": ("Y", 0), "fc": ("W", 0),
+                  "conv2d": ("Filter", 1), "depthwise_conv2d":
+                  ("Filter", 1)}
+# ops whose per-channel params follow the producer's pruned axis
+_CHANNEL_FOLLOWERS = {"batch_norm": ("Scale", "Bias", "Mean", "Variance")}
+
+
+def _producer_out(op):
+    for slot in ("Out", "Output", "Y"):
+        names = op.outputs.get(slot)
+        if names and names[0]:
+            return names[0]
+    return None
+
+
+def prune_program(program, scope, params, ratios, pruner=None,
+                  lazy=False):
+    """Prune named parameters by ratio and keep the program consistent.
+
+    params: list of parameter names (conv Filter / fc W) to prune along
+    their output axis (axis 0 for fc/mul weights' columns? no — axis 1
+    for fc W output features, axis 0 for conv filters). The axis is
+    taken from the op that owns the parameter. Returns
+    {param_name: pruned_idx}.
+    """
+    pruner = pruner or StructurePruner()
+    block = program.global_block()
+    pruned = {}
+
+    for pname, ratio in zip(params, ratios):
+        # find the op consuming this parameter as a weight
+        owner, w_axis, out_name = None, None, None
+        for op in block.ops:
+            if op.type in ("conv2d", "depthwise_conv2d") and \
+                    pname in op.inputs.get("Filter", []):
+                owner, w_axis = op, 0        # output channels
+            elif op.type in ("mul", "matmul") and \
+                    pname in op.inputs.get("Y", []):
+                owner, w_axis = op, 1        # output features
+            elif op.type == "fc" and pname in op.inputs.get("W", []):
+                owner, w_axis = op, 1
+            if owner is not None:
+                out_name = _producer_out(owner)
+                break
+        if owner is None:
+            raise ValueError(f"parameter {pname!r} is not a conv/fc "
+                             f"weight in this program")
+
+        w = scope.get_numpy(pname)
+        idx = pruner.cal_pruned_idx(pname, w, ratio, axis=w_axis)
+        pruned[pname] = idx
+        scope.set(pname, pruner.prune_tensor(w, idx, w_axis, lazy))
+        if not lazy:
+            v = block.var(pname)
+            shape = list(v.shape)
+            shape[w_axis] -= len(idx)
+            v.shape = shape
+
+        # bias of the same op follows the pruned output axis
+        for bslot in ("Bias",):
+            bnames = owner.inputs.get(bslot, [])
+            if bnames and bnames[0] and scope.has(bnames[0]):
+                b = scope.get_numpy(bnames[0])
+                ax = b.ndim - 1
+                scope.set(bnames[0], pruner.prune_tensor(b, idx, ax, lazy))
+                if not lazy:
+                    bv = block.var(bnames[0])
+                    s = list(bv.shape)
+                    s[ax] -= len(idx)
+                    bv.shape = s
+
+        # walk downstream consumers of the pruned output
+        _prune_consumers(block, scope, pruner, out_name, idx, lazy,
+                         dim=w.shape[w_axis], _seen=set())
+    if not lazy:
+        program._fp_cache = None
+    return pruned
+
+
+def _prune_shaped(block, scope, pruner, name, idx, ax, lazy):
+    t = scope.get_numpy(name)
+    scope.set(name, pruner.prune_tensor(t, idx, ax, lazy))
+    if not lazy:
+        v = block.var(name)
+        s = list(v.shape)
+        s[ax] -= len(idx)
+        v.shape = s
+
+
+def _prune_consumers(block, scope, pruner, var_name, idx, lazy, dim,
+                     _depth=0, _seen=None):
+    """Follow the pruned producer output through its consumers; `dim` is
+    the pre-prune size of the pruned axis (identifies broadcast biases).
+    `_seen` guards diamonds (an op or weight reached via two branches
+    must be pruned once); deep chains raise instead of silently leaving
+    a consumer unpruned."""
+    if var_name is None:
+        return
+    if _depth > 32:
+        raise RuntimeError(
+            f"prune walk exceeded depth 32 at var {var_name!r}; "
+            f"downstream consumers would be left inconsistent")
+    _seen = _seen if _seen is not None else set()
+    for op in block.ops:
+        in_names = [n for names in op.inputs.values() for n in names]
+        if var_name not in in_names or id(op) in _seen:
+            continue
+        _seen.add(id(op))
+        if op.type in _CONSUMER_AXIS:
+            slot, ax = _CONSUMER_AXIS[op.type]
+            wn = op.inputs.get(slot, [None])[0]
+            if wn and scope.has(wn) and ("w", wn) not in _seen:
+                _seen.add(("w", wn))
+                _prune_shaped(block, scope, pruner, wn, idx, ax, lazy)
+        elif op.type in _CHANNEL_FOLLOWERS:
+            for slot in _CHANNEL_FOLLOWERS[op.type]:
+                nn = op.inputs.get(slot, [None])[0]
+                if nn and scope.has(nn) and ("w", nn) not in _seen:
+                    _seen.add(("w", nn))
+                    _prune_shaped(block, scope, pruner, nn, idx, 0, lazy)
+            # bn output carries the pruned channel axis onward
+            _prune_consumers(block, scope, pruner, _producer_out(op),
+                             idx, lazy, dim, _depth + 1, _seen)
+        elif op.type in ("elementwise_add", "elementwise_sub",
+                         "elementwise_mul"):
+            # a broadcast 1-D persistable operand (fc bias, scale vector)
+            # rides the pruned axis and must follow it
+            for n in in_names:
+                if n == var_name or not scope.has(n) or \
+                        ("w", n) in _seen:
+                    continue
+                t = scope.get_numpy(n)
+                if t.ndim == 1 and t.shape[0] == dim:
+                    _seen.add(("w", n))
+                    _prune_shaped(block, scope, pruner, n, idx, 0, lazy)
+            _prune_consumers(block, scope, pruner, _producer_out(op),
+                             idx, lazy, dim, _depth + 1, _seen)
+        elif op.type in ("relu", "sigmoid", "tanh", "gelu", "dropout",
+                         "pool2d", "scale"):
+            # shape-preserving on the channel axis: keep walking
+            _prune_consumers(block, scope, pruner, _producer_out(op),
+                             idx, lazy, dim, _depth + 1, _seen)

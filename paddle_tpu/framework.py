@@ -353,6 +353,18 @@ class Program:
         p._fp_cache = None
         if for_test:
             for blk in p.blocks:
+                # drop backward + optimizer ops (reference framework.py
+                # clone(for_test=True) semantics). Filter, don't
+                # truncate: forward ops appended AFTER minimize()
+                # (metrics, evaluators) must survive. Backward ops
+                # produce @GRAD vars; optimizer ops consume them.
+                def _is_train_op(op):
+                    if op.type.startswith("grad::"):
+                        return True
+                    names = [n for ns in list(op.outputs.values()) +
+                             list(op.inputs.values()) for n in ns if n]
+                    return any(n.endswith("@GRAD") for n in names)
+                blk.ops = [op for op in blk.ops if not _is_train_op(op)]
                 for op in blk.ops:
                     if "is_test" in op.attrs:
                         op.attrs["is_test"] = True

@@ -36,6 +36,7 @@ __all__ = [
     "uniform_random", "gaussian_random", "sampling_id", "dropout",
     "logical_and", "logical_or", "logical_xor", "logical_not", "sign",
     "where", "unique", "shard_index", "hash", "grid_sampler", "erf",
+    "fsp_matrix", "warpctc",
     "flash_attention", "sums", "elementwise_add", "elementwise_sub", "elementwise_mul",
     "elementwise_div", "elementwise_max", "elementwise_min",
     "elementwise_pow", "elementwise_mod", "elementwise_floordiv",
@@ -1157,11 +1158,56 @@ def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
 
 
 def hash(input, hash_size, num_hash=1, name=None):
-    raise NotImplementedError("hash op: host-side feature hashing TBD")
+    """Feature hashing of int ids (reference nn.py hash / hash_op.cc):
+    out[i, j] = hash_j(row i) % hash_size, int64 [N, num_hash]."""
+    helper = LayerHelper("hash")
+    out = helper.create_variable_for_type_inference("int64", True)
+    helper.append_op(type="hash", inputs={"X": [input.name]},
+                     outputs={"Out": [out.name]},
+                     attrs={"num_hash": num_hash, "mod_by": hash_size})
+    return out
 
 
 def grid_sampler(x, grid, name=None):
-    raise NotImplementedError("grid_sampler lowering TBD")
+    """Bilinear sampling of x at normalized grid locations (reference
+    nn.py grid_sampler / grid_sampler_op.cc)."""
+    helper = LayerHelper("grid_sampler")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="grid_sampler",
+                     inputs={"X": [x.name], "Grid": [grid.name]},
+                     outputs={"Output": [out.name]})
+    return out
+
+
+def fsp_matrix(x, y):
+    """Flow-of-solution-procedure matrix between two feature maps
+    (reference nn.py fsp_matrix / fsp_op.cc; used by FSPDistiller)."""
+    helper = LayerHelper("fsp")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="fsp", inputs={"X": [x.name], "Y": [y.name]},
+                     outputs={"Out": [out.name]})
+    return out
+
+
+def warpctc(input, label, blank=0, norm_by_times=False,
+            input_length=None, label_length=None):
+    """CTC loss over padded [B, T, C] logits (reference nn.py warpctc /
+    warpctc_op.cc). input_length/label_length give true lengths so
+    padded timesteps emit nothing."""
+    helper = LayerHelper("warpctc")
+    loss = helper.create_variable_for_type_inference(input.dtype)
+    grad = helper.create_variable_for_type_inference(input.dtype, True)
+    ins = {"Logits": [input.name], "Label": [label.name]}
+    if input_length is not None:
+        ins["LogitsLength"] = [input_length.name]
+    if label_length is not None:
+        ins["LabelLength"] = [label_length.name]
+    helper.append_op(type="warpctc", inputs=ins,
+                     outputs={"Loss": [loss.name],
+                              "WarpCTCGrad": [grad.name]},
+                     attrs={"blank": blank,
+                            "norm_by_times": norm_by_times})
+    return loss
 
 
 def sums(input, out=None):

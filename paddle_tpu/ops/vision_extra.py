@@ -136,7 +136,8 @@ def _conv3d_transpose(ctx, ins, attrs):
     x, w = ins["Input"][0], ins["Filter"][0]
     out = _conv_transpose(x, w, attrs.get("strides", [1, 1, 1]),
                           attrs.get("paddings", [0, 0, 0]), 3,
-                          groups=attrs.get("groups", 1))
+                          groups=attrs.get("groups", 1),
+                          dilations=attrs.get("dilations", [1, 1, 1]))
     return {"Output": [out]}
 
 

@@ -138,7 +138,6 @@ def _run_output_checks(op, spec):
 def _float_out_names(out_map, direct):
     names = []
     for slot, arrs in direct.items():
-        opdef_nondiff = REGISTRY.get_nondiff_outputs if False else None
         for nm, arr in zip(out_map[slot], arrs):
             if np.issubdtype(arr.dtype, np.floating):
                 names.append((slot, nm))

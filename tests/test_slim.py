@@ -1,0 +1,202 @@
+"""slim: structured pruning + distillation (reference contrib/slim/
+prune/pruner.py, distillation/distiller.py). Quantization is covered in
+test_jit_and_extras.py."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.contrib.slim.distillation import (L2Distiller,
+                                                  SoftLabelDistiller,
+                                                  FSPDistiller, merge)
+from paddle_tpu.contrib.slim.prune import StructurePruner, prune_program
+
+rng = np.random.RandomState(7)
+
+
+def _toy_data(n=64):
+    x = rng.randn(n, 8).astype(np.float32)
+    w_true = rng.randn(8, 1).astype(np.float32)
+    y = (x @ w_true + 0.1 * rng.randn(n, 1)).astype(np.float32)
+    return x, y
+
+
+def _build_mlp(hidden=16, prefix=""):
+    x = layers.data("x", shape=[-1, 8], dtype="float32",
+                    append_batch_size=False)
+    y = layers.data("y", shape=[-1, 1], dtype="float32",
+                    append_batch_size=False)
+    from paddle_tpu.framework import ParamAttr
+    h = layers.fc(x, size=hidden, act="relu",
+                  param_attr=ParamAttr(name=f"{prefix}fc1.w"),
+                  bias_attr=ParamAttr(name=f"{prefix}fc1.b"))
+    pred = layers.fc(h, size=1,
+                     param_attr=ParamAttr(name=f"{prefix}fc2.w"),
+                     bias_attr=ParamAttr(name=f"{prefix}fc2.b"))
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    return loss, pred, h
+
+
+def test_structure_pruner_idx_and_tensor():
+    p = StructurePruner({"*": 0}, {"*": "l1_norm"})
+    w = np.array([[3.0, 3.0], [0.1, 0.1], [2.0, 2.0], [0.2, 0.2]],
+                 np.float32)
+    idx = p.cal_pruned_idx("w", w, 0.5, axis=0)
+    assert set(idx.tolist()) == {1, 3}  # two smallest l1 rows
+    shr = p.prune_tensor(w, idx, 0, lazy=False)
+    assert shr.shape == (2, 2) and shr[0, 0] == 3.0
+    msk = p.prune_tensor(w, idx, 0, lazy=True)
+    assert msk.shape == w.shape and msk[1].sum() == 0 and msk[0, 0] == 3.0
+
+
+def _train(exe, prog, feed, loss, steps, scope):
+    with fluid.scope_guard(scope):
+        for _ in range(steps):
+            lv, = exe.run(prog, feed=feed, fetch_list=[loss])
+    return float(lv)
+
+
+def test_prune_then_finetune_recovers():
+    """Mask-prune 50% of hidden units, then finetune: loss recovers
+    (reference prune_strategy sensitivity loop, collapsed to one shot)."""
+    x, y = _toy_data()
+    feed = {"x": x, "y": y}
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup):
+        loss, pred, h = _build_mlp()
+        fluid.optimizer.Adam(learning_rate=0.02).minimize(loss)
+    exe = fluid.Executor()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+    trained = _train(exe, main, feed, loss, 60, scope)
+
+    pruned = prune_program(main, scope, ["fc1.w"], [0.5], lazy=True)
+    assert len(pruned["fc1.w"]) == 8   # half of 16 hidden units
+    # pruned columns of fc1.w and matching rows of fc2.w are zero
+    w1 = scope.get_numpy("fc1.w")
+    w2 = scope.get_numpy("fc2.w")
+    assert np.allclose(w1[:, pruned["fc1.w"]], 0)
+    assert np.allclose(w2[pruned["fc1.w"], :], 0)
+
+    after_prune = _train(exe, main, feed, loss, 1, scope)
+    finetuned = _train(exe, main, feed, loss, 60, scope)
+    assert finetuned <= after_prune + 1e-6
+    assert finetuned < trained * 3 + 0.05, \
+        (trained, after_prune, finetuned)
+
+
+def test_prune_shrink_rewrites_shapes():
+    """Shrink mode physically slices params + rewrites var shapes; the
+    smaller program still runs."""
+    x, y = _toy_data(16)
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup):
+        loss, pred, h = _build_mlp()
+    exe = fluid.Executor()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+    prune_program(main, scope, ["fc1.w"], [0.25], lazy=False)
+    assert scope.get_numpy("fc1.w").shape == (8, 12)
+    assert scope.get_numpy("fc1.b").shape == (12,)
+    assert scope.get_numpy("fc2.w").shape == (12, 1)
+    assert main.global_block().var("fc1.w").shape == [8, 12]
+    with fluid.scope_guard(scope):
+        lv, = exe.run(main, feed={"x": x, "y": y}, fetch_list=[loss])
+    assert np.isfinite(lv).all()
+
+
+def test_distillation_student_learns_from_teacher():
+    """Teacher-program merge + KD losses: the student's combined loss
+    (task + L2 + soft-label) decreases and the KD term shrinks."""
+    x, y = _toy_data()
+    feed = {"x": x, "y": y}
+
+    # train a teacher
+    t_main, t_startup = fluid.Program(), fluid.Program()
+    t_scope = fluid.Scope()
+    with fluid.program_guard(t_main, t_startup):
+        t_loss, t_pred, t_h = _build_mlp(hidden=32)
+        fluid.optimizer.Adam(learning_rate=0.02).minimize(t_loss)
+    exe = fluid.Executor()
+    with fluid.scope_guard(t_scope):
+        exe.run(t_startup)
+    _train(exe, t_main, feed, t_loss, 80, t_scope)
+    t_infer = t_main.clone(for_test=True)
+
+    # student + merged teacher; minimize under the student startup so
+    # accumulator inits land there, run once AFTER graph construction
+    s_main, s_startup = fluid.Program(), fluid.Program()
+    s_scope = fluid.Scope()
+    with fluid.program_guard(s_main, s_startup):
+        s_loss, s_pred, s_h = _build_mlp(hidden=8)
+    merge(t_infer, s_main, data_name_map={"x": "x", "y": "y"},
+          scope=s_scope, teacher_scope=t_scope)
+
+    l2 = L2Distiller(s_pred.name, t_pred.name,
+                     distillation_loss_weight=1.0)
+    kd_loss = l2.distiller_loss(s_main)
+    with fluid.program_guard(s_main, s_startup):
+        total = fluid.layers.elementwise_add(s_loss, kd_loss)
+        fluid.optimizer.Adam(learning_rate=0.02).minimize(total)
+
+    with fluid.scope_guard(s_scope):
+        exe.run(s_startup)
+        first = exe.run(s_main, feed=feed,
+                        fetch_list=[total, kd_loss])
+        for _ in range(60):
+            last = exe.run(s_main, feed=feed,
+                           fetch_list=[total, kd_loss])
+    assert float(last[0]) < float(first[0])
+    assert float(last[1]) < float(first[1])
+    # teacher weights must not have been trained by the student step
+    np.testing.assert_allclose(
+        s_scope.get_numpy("teacher_fc1.w"), t_scope.get_numpy("fc1.w"))
+
+
+def test_soft_label_and_fsp_distillers_build():
+    x, _ = _toy_data(8)
+    t_main, t_startup = fluid.Program(), fluid.Program()
+    t_scope = fluid.Scope()
+    with fluid.program_guard(t_main, t_startup):
+        t_loss, t_pred, t_h = _build_mlp(hidden=8)
+    exe = fluid.Executor()
+    with fluid.scope_guard(t_scope):
+        exe.run(t_startup)
+
+    s_main, s_startup = fluid.Program(), fluid.Program()
+    s_scope = fluid.Scope()
+    with fluid.program_guard(s_main, s_startup):
+        s_loss, s_pred, s_h = _build_mlp(hidden=8)
+    with fluid.scope_guard(s_scope):
+        exe.run(s_startup)
+    merge(t_main.clone(for_test=True), s_main,
+          data_name_map={"x": "x", "y": "y"}, scope=s_scope,
+          teacher_scope=t_scope)
+    sl = SoftLabelDistiller(s_pred.name, t_pred.name,
+                            student_temperature=2.0,
+                            teacher_temperature=2.0)
+    sl_loss = sl.distiller_loss(s_main)
+
+    # fsp wants [N, C, H, W] maps: lift hidden/pred to 4D via reshape
+    with fluid.program_guard(s_main):
+        s4a = layers.reshape(s_main.global_block().var(s_h.name),
+                             [-1, 8, 1, 1])
+        s4b = layers.reshape(s_main.global_block().var(s_pred.name),
+                             [-1, 1, 1, 1])
+        t4a = layers.reshape(
+            s_main.global_block().var("teacher_" + t_h.name),
+            [-1, 8, 1, 1])
+        t4b = layers.reshape(
+            s_main.global_block().var("teacher_" + t_pred.name),
+            [-1, 1, 1, 1])
+    # the lifted teacher maps are student-program vars already — the
+    # distiller resolves them directly (no PREFIX re-application)
+    fsp = FSPDistiller([(s4a.name, s4b.name)], [(t4a.name, t4b.name)])
+    fsp_loss = fsp.distiller_loss(s_main)
+
+    y = np.zeros((8, 1), np.float32)
+    with fluid.scope_guard(s_scope):
+        out, fsp_out = exe.run(s_main, feed={"x": x, "y": y},
+                               fetch_list=[sl_loss, fsp_loss])
+    assert np.isfinite(out).all() and np.isfinite(fsp_out).all()
