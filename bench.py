@@ -1,12 +1,14 @@
-"""Benchmark: BERT-base pretraining step throughput on one TPU chip.
+"""Benchmark: single-chip training-step throughput on real TPU.
 
-Matches BASELINE.json config 3 ("BERT-base pretraining — tokens/sec/chip").
-The whole training step (fwd + vjp-backward + AdamW) is one XLA program
-produced by the Executor. vs_baseline = measured MFU / 0.50 (the north-star
-">=50% MFU" target; the reference publishes no numeric baseline —
-BASELINE.md).
+Matches BASELINE.json: the primary metric is BERT-base pretraining
+tokens/sec/chip (config 3); BENCH_MODEL=resnet50 measures the ResNet-50
+ImageNet config (the north-star MFU workload, config 0). Each step
+(fwd + vjp-backward + optimizer) is ONE XLA program produced by the
+Executor. vs_baseline = measured MFU / 0.50 (the ">=50% MFU" north
+star; the reference publishes no numeric baseline — BASELINE.md).
 
-Prints ONE JSON line.
+Prints ONE JSON line for the selected model (default: bert).
+BENCH_MODEL=both prints two lines (bert first).
 """
 from __future__ import annotations
 
@@ -43,11 +45,23 @@ def model_flops_per_token(cfg, seq_len):
     return dense + attn
 
 
-def main():
+def _timed_steps(exe, prog, feed, loss, steps):
+    # compile + warmup
+    exe.run(prog, feed=feed, fetch_list=[loss])
+    exe.run(prog, feed=feed, fetch_list=[loss])
+    t0 = time.perf_counter()
+    lv = None
+    for _ in range(steps):
+        lv, = exe.run(prog, feed=feed, fetch_list=[loss])
+    dt = (time.perf_counter() - t0) / steps
+    return dt, lv
+
+
+def bench_bert():
     import paddle_tpu as fluid
     from paddle_tpu.models import transformer
 
-    batch = int(os.environ.get("BENCH_BATCH", "16"))
+    batch = int(os.environ.get("BENCH_BATCH", "32"))
     seq_len = int(os.environ.get("BENCH_SEQ", "512"))
     steps = int(os.environ.get("BENCH_STEPS", "20"))
     amp = os.environ.get("BENCH_AMP", "1") == "1"
@@ -62,25 +76,16 @@ def main():
                                               amp=amp)
         exe = fluid.Executor()
         exe.run(startup)
-
         rng = np.random.RandomState(0)
         toks = rng.randint(0, cfg.vocab_size,
                            (batch, seq_len)).astype(np.int64)
         feed = {"tokens": toks, "labels": toks}
-
-        # compile + warmup
-        exe.run(main_prog, feed=feed, fetch_list=[loss])
-        exe.run(main_prog, feed=feed, fetch_list=[loss])
-
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            lv, = exe.run(main_prog, feed=feed, fetch_list=[loss])
-        dt = (time.perf_counter() - t0) / steps
+        dt, lv = _timed_steps(exe, main_prog, feed, loss, steps)
 
     tokens_per_sec = batch * seq_len / dt
     flops = model_flops_per_token(cfg, seq_len) * batch * seq_len
     mfu = flops / dt / peak_flops_per_chip()
-    print(json.dumps({
+    return {
         "metric": "bert_base_pretrain_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/s",
@@ -88,7 +93,51 @@ def main():
         "extra": {"step_ms": round(dt * 1000, 2), "mfu": round(mfu, 4),
                   "batch": batch, "seq_len": seq_len,
                   "loss": float(np.asarray(lv))},
-    }))
+    }
+
+
+def bench_resnet50():
+    import paddle_tpu as fluid
+    from paddle_tpu.models import resnet
+
+    batch = int(os.environ.get("BENCH_BATCH", "64"))
+    steps = int(os.environ.get("BENCH_STEPS", "20"))
+    amp = os.environ.get("BENCH_AMP", "1") == "1"
+
+    main_prog, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main_prog, startup), fluid.scope_guard(scope):
+        loss, acc, feeds = resnet.build_train(amp=amp)
+        exe = fluid.Executor()
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        img = rng.randn(batch, 3, 224, 224).astype(np.float32)
+        lbl = rng.randint(0, 1000, (batch, 1)).astype(np.int64)
+        feed = {"image": img, "label": lbl}
+        dt, lv = _timed_steps(exe, main_prog, feed, loss, steps)
+
+    images_per_sec = batch / dt
+    flops = 3 * resnet.flops_per_image() * batch  # fwd + 2x bwd
+    mfu = flops / dt / peak_flops_per_chip()
+    return {
+        "metric": "resnet50_imagenet_images_per_sec_per_chip",
+        "value": round(images_per_sec, 1),
+        "unit": "images/s",
+        "vs_baseline": round(mfu / 0.50, 4),
+        "extra": {"step_ms": round(dt * 1000, 2), "mfu": round(mfu, 4),
+                  "batch": batch, "loss": float(np.asarray(lv))},
+    }
+
+
+def main():
+    model = os.environ.get("BENCH_MODEL", "bert")
+    if model == "both":
+        print(json.dumps(bench_bert()))
+        print(json.dumps(bench_resnet50()))
+    elif model == "resnet50":
+        print(json.dumps(bench_resnet50()))
+    else:
+        print(json.dumps(bench_bert()))
 
 
 if __name__ == "__main__":

@@ -72,10 +72,18 @@ class StructurePruner(Pruner):
 # how an op consumes a var whose producer axis-0 was pruned:
 # op type -> (weight slot, input-channel axis of that weight)
 _CONSUMER_AXIS = {"mul": ("Y", 0), "matmul": ("Y", 0), "fc": ("W", 0),
-                  "conv2d": ("Filter", 1), "depthwise_conv2d":
-                  ("Filter", 1)}
+                  "conv2d": ("Filter", 1)}
 # ops whose per-channel params follow the producer's pruned axis
 _CHANNEL_FOLLOWERS = {"batch_norm": ("Scale", "Bias", "Mean", "Variance")}
+# ops that consume/reduce the channel axis — the walk legitimately ends
+_TERMINAL = {"softmax_with_cross_entropy", "cross_entropy",
+             "cross_entropy2", "mean", "reduce_mean", "reduce_sum",
+             "accuracy", "softmax", "mse_loss", "square_error_cost",
+             "sigmoid_cross_entropy_with_logits", "fetch", "feed",
+             "auc", "top_k"}
+# shape-preserving on the channel axis: the walk continues through them
+_PASSTHROUGH = {"relu", "sigmoid", "tanh", "gelu", "dropout", "pool2d",
+                "scale", "relu6", "leaky_relu"}
 
 
 def _producer_out(op):
@@ -91,10 +99,9 @@ def prune_program(program, scope, params, ratios, pruner=None,
     """Prune named parameters by ratio and keep the program consistent.
 
     params: list of parameter names (conv Filter / fc W) to prune along
-    their output axis (axis 0 for fc/mul weights' columns? no — axis 1
-    for fc W output features, axis 0 for conv filters). The axis is
-    taken from the op that owns the parameter. Returns
-    {param_name: pruned_idx}.
+    their output axis — axis 0 (output channels) for conv filters,
+    axis 1 (output features) for fc/mul weights — determined from the
+    op that owns the parameter. Returns {param_name: pruned_idx}.
     """
     pruner = pruner or StructurePruner()
     block = program.global_block()
@@ -122,25 +129,15 @@ def prune_program(program, scope, params, ratios, pruner=None,
         w = scope.get_numpy(pname)
         idx = pruner.cal_pruned_idx(pname, w, ratio, axis=w_axis)
         pruned[pname] = idx
-        scope.set(pname, pruner.prune_tensor(w, idx, w_axis, lazy))
-        if not lazy:
-            v = block.var(pname)
-            shape = list(v.shape)
-            shape[w_axis] -= len(idx)
-            v.shape = shape
+        _prune_shaped(block, scope, pruner, pname, idx, w_axis, lazy)
 
         # bias of the same op follows the pruned output axis
         for bslot in ("Bias",):
             bnames = owner.inputs.get(bslot, [])
             if bnames and bnames[0] and scope.has(bnames[0]):
-                b = scope.get_numpy(bnames[0])
-                ax = b.ndim - 1
-                scope.set(bnames[0], pruner.prune_tensor(b, idx, ax, lazy))
-                if not lazy:
-                    bv = block.var(bnames[0])
-                    s = list(bv.shape)
-                    s[ax] -= len(idx)
-                    bv.shape = s
+                ax = scope.get_numpy(bnames[0]).ndim - 1
+                _prune_shaped(block, scope, pruner, bnames[0], idx, ax,
+                              lazy)
 
         # walk downstream consumers of the pruned output
         _prune_consumers(block, scope, pruner, out_name, idx, lazy,
@@ -165,8 +162,11 @@ def _prune_consumers(block, scope, pruner, var_name, idx, lazy, dim,
     """Follow the pruned producer output through its consumers; `dim` is
     the pre-prune size of the pruned axis (identifies broadcast biases).
     `_seen` guards diamonds (an op or weight reached via two branches
-    must be pruned once); deep chains raise instead of silently leaving
-    a consumer unpruned."""
+    must be pruned once). In shrink mode an op the walk cannot classify
+    raises — leaving its weight unpruned would ship a shape-inconsistent
+    program; in mask (lazy) mode downstream pruning is an optimization
+    (masked units already emit zeros once their bias is zeroed), so the
+    walk just stops there."""
     if var_name is None:
         return
     if _depth > 32:
@@ -179,7 +179,17 @@ def _prune_consumers(block, scope, pruner, var_name, idx, lazy, dim,
         if var_name not in in_names or id(op) in _seen:
             continue
         _seen.add(id(op))
-        if op.type in _CONSUMER_AXIS:
+        if op.type == "depthwise_conv2d":
+            # depthwise filter is [C, 1, kh, kw]: the pruned channel axis
+            # is 0, and the output keeps the (pruned) channel count, so
+            # the walk continues past it
+            wn = op.inputs.get("Filter", [None])[0]
+            if wn and scope.has(wn) and ("w", wn) not in _seen:
+                _seen.add(("w", wn))
+                _prune_shaped(block, scope, pruner, wn, idx, 0, lazy)
+            _prune_consumers(block, scope, pruner, _producer_out(op),
+                             idx, lazy, dim, _depth + 1, _seen)
+        elif op.type in _CONSUMER_AXIS:
             slot, ax = _CONSUMER_AXIS[op.type]
             wn = op.inputs.get(slot, [None])[0]
             if wn and scope.has(wn) and ("w", wn) not in _seen:
@@ -208,8 +218,14 @@ def _prune_consumers(block, scope, pruner, var_name, idx, lazy, dim,
                     _prune_shaped(block, scope, pruner, n, idx, 0, lazy)
             _prune_consumers(block, scope, pruner, _producer_out(op),
                              idx, lazy, dim, _depth + 1, _seen)
-        elif op.type in ("relu", "sigmoid", "tanh", "gelu", "dropout",
-                         "pool2d", "scale"):
-            # shape-preserving on the channel axis: keep walking
+        elif op.type in _PASSTHROUGH:
             _prune_consumers(block, scope, pruner, _producer_out(op),
                              idx, lazy, dim, _depth + 1, _seen)
+        elif op.type in _TERMINAL:
+            pass  # channel axis is consumed here; nothing to prune
+        elif not lazy:
+            raise RuntimeError(
+                f"shrink-mode prune walk cannot classify op "
+                f"{op.type!r} consuming {var_name!r}; its weights would "
+                f"be left shape-inconsistent (use lazy=True mask "
+                f"pruning, or extend the walk tables)")

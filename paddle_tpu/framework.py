@@ -357,13 +357,31 @@ class Program:
                 # clone(for_test=True) semantics). Filter, don't
                 # truncate: forward ops appended AFTER minimize()
                 # (metrics, evaluators) must survive. Backward ops
-                # produce @GRAD vars; optimizer ops consume them.
-                def _is_train_op(op):
+                # produce @GRAD vars; optimizer ops consume them; LR
+                # schedulers and accumulator ticks (increment on
+                # @STEP_COUNTER@, beta-pow scaling) mutate ONLY
+                # persistable state in place — running them during eval
+                # would corrupt the training schedule.
+                def _mutates_state_only(op, blk):
+                    outs = [n for ns in op.outputs.values()
+                            for n in ns if n]
+                    if not outs:
+                        return False
+                    ins = {n for ns in op.inputs.values() for n in ns}
+                    for n in outs:
+                        v = blk._find_var_recursive(n)
+                        if v is None or not v.persistable or n not in ins:
+                            return False
+                    return True
+
+                def _is_train_op(op, blk=blk):
                     if op.type.startswith("grad::"):
                         return True
                     names = [n for ns in list(op.outputs.values()) +
                              list(op.inputs.values()) for n in ns if n]
-                    return any(n.endswith("@GRAD") for n in names)
+                    if any(n.endswith("@GRAD") for n in names):
+                        return True
+                    return _mutates_state_only(op, blk)
                 blk.ops = [op for op in blk.ops if not _is_train_op(op)]
                 for op in blk.ops:
                     if "is_test" in op.attrs:

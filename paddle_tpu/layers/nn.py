@@ -1159,7 +1159,8 @@ def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
 
 def hash(input, hash_size, num_hash=1, name=None):
     """Feature hashing of int ids (reference nn.py hash / hash_op.cc):
-    out[i, j] = hash_j(row i) % hash_size, int64 [N, num_hash]."""
+    out[i, j, 0] = hash_j(row i) % hash_size, int64 [N, num_hash, 1]
+    (the trailing 1 matches the reference's LoD-tensor layout)."""
     helper = LayerHelper("hash")
     out = helper.create_variable_for_type_inference("int64", True)
     helper.append_op(type="hash", inputs={"X": [input.name]},

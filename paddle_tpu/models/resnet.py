@@ -66,8 +66,10 @@ def resnet50(img, class_dim=1000):
 
 
 def build_train(img_shape=(3, 224, 224), class_dim=1000, depth=50,
-                lr=0.1, momentum=0.9):
-    """Full training graph: returns (loss, acc, feeds)."""
+                lr=0.1, momentum=0.9, amp=False):
+    """Full training graph: returns (loss, acc, feeds). amp=True puts
+    the convs/matmuls on the bf16 MXU path via the mixed-precision
+    rewrite (BN and the loss stay fp32)."""
     from .. import optimizer as opt
     img = layers.data("image", shape=list(img_shape), dtype="float32")
     label = layers.data("label", shape=[1], dtype="int64")
@@ -75,5 +77,45 @@ def build_train(img_shape=(3, 224, 224), class_dim=1000, depth=50,
     loss = layers.mean(
         layers.softmax_with_cross_entropy(logits, label))
     acc = layers.accuracy(layers.softmax(logits), label)
-    opt.Momentum(lr, momentum).minimize(loss)
+    opt_inst = opt.Momentum(lr, momentum)
+    if amp:
+        from ..contrib import mixed_precision as mp
+        opt_inst = mp.decorate(opt_inst)
+    opt_inst.minimize(loss)
     return loss, acc, [img, label]
+
+
+def flops_per_image(depth=50, img_hw=224, class_dim=1000):
+    """Analytic matmul/conv MAC*2 flops for one forward image, computed
+    from the actual layer dims (for MFU accounting in bench.py)."""
+    block_fn_name, counts = _DEPTH_CFG[depth]
+    total = 0
+    hw = img_hw // 2  # stem conv stride 2
+    total += 2 * (7 * 7 * 3) * 64 * hw * hw
+    hw //= 2  # maxpool stride 2
+    c_in = 64
+    for stage, n in enumerate(counts):
+        filters = 64 * (2 ** stage)
+        for i in range(n):
+            stride = 2 if i == 0 and stage > 0 else 1
+            out_hw = hw // stride
+            if block_fn_name == "bottleneck":
+                total += 2 * (1 * 1 * c_in) * filters * hw * hw
+                total += 2 * (3 * 3 * filters) * filters * out_hw * out_hw
+                total += 2 * (1 * 1 * filters) * (filters * 4) * \
+                    out_hw * out_hw
+                if c_in != filters * 4 or stride != 1:
+                    total += 2 * (1 * 1 * c_in) * (filters * 4) * \
+                        out_hw * out_hw
+                c_in = filters * 4
+            else:
+                total += 2 * (3 * 3 * c_in) * filters * out_hw * out_hw
+                total += 2 * (3 * 3 * filters) * filters * \
+                    out_hw * out_hw
+                if c_in != filters or stride != 1:
+                    total += 2 * (1 * 1 * c_in) * filters * \
+                        out_hw * out_hw
+                c_in = filters
+            hw = out_hw
+    total += 2 * c_in * class_dim  # head fc
+    return total

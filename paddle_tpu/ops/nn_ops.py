@@ -46,7 +46,8 @@ def _conv2d_impl(x, w, attrs):
         feature_group_count=groups,
         dimension_numbers=jax.lax.conv_dimension_numbers(
             x.shape, w.shape, _conv_dn(fmt)),
-        preferred_element_type=jnp.float32).astype(x.dtype)
+        preferred_element_type=(jnp.float32 if x.dtype == jnp.float32
+                                else None)).astype(x.dtype)
 
 
 @register_op("conv2d")
@@ -74,7 +75,8 @@ def _conv3d(ctx, ins, attrs):
         feature_group_count=attrs.get("groups", 1),
         dimension_numbers=jax.lax.conv_dimension_numbers(
             x.shape, w.shape, ("NCDHW", "OIDHW", "NCDHW")),
-        preferred_element_type=jnp.float32).astype(x.dtype)
+        preferred_element_type=(jnp.float32 if x.dtype == jnp.float32
+                                else None)).astype(x.dtype)
     return {"Output": [out]}
 
 

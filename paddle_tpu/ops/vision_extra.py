@@ -128,7 +128,8 @@ def _conv_transpose(x, w, strides, paddings, nd, groups=1,
         feature_group_count=groups,
         dimension_numbers=jax.lax.conv_dimension_numbers(
             x.shape, w_t.shape, dn_str),
-        preferred_element_type=jnp.float32).astype(x.dtype)
+        preferred_element_type=(jnp.float32 if x.dtype == jnp.float32
+                                else None)).astype(x.dtype)
 
 
 @register_op("conv3d_transpose")
