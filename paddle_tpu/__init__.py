@@ -35,6 +35,9 @@ from . import clip  # noqa: F401
 from . import nets  # noqa: F401
 from . import metrics  # noqa: F401
 from . import io  # noqa: F401
+from . import io_sharded  # noqa: F401
+from .io_sharded import (save_sharded_persistables,  # noqa: F401
+                         load_sharded_persistables)
 from . import dygraph  # noqa: F401
 from . import profiler  # noqa: F401
 from . import dataset  # noqa: F401

@@ -107,23 +107,27 @@ class CompiledProgram:
             self._mesh = Mesh(devs, axis_names=("dp",))
         return self._mesh
 
-    def build_jit(self, step_fn, state_in_names, feed_arrays):
+    def build_jit(self, step_fn, state_in_names, feed_arrays,
+                  state_out_names=()):
         """jit `step_fn(state, feeds, step_idx)` with SPMD shardings:
         feeds sharded on the batch axes, params per state_spec_fn
         (replicated by default). GSPMD then emits gradient AllReduces /
         TP collectives over ICI — the entire reference multi-device
         scheduler (SURVEY.md §2.1 details/) reduces to these
-        in_shardings."""
+        in_shardings. State OUTPUTS are pinned to the same shardings so
+        the round-tripped state dict feeds the next step (and sharded
+        checkpoints) without GSPMD drifting a param's layout."""
         if not self._is_data_parallel or len(jax.devices()) == 1:
             return jax.jit(step_fn, donate_argnums=(0,))
         mesh = self.mesh()
         repl = NamedSharding(mesh, P())
         spec_fn = self._state_spec_fn
-        state_shard = {}
-        for n in state_in_names:
+
+        def shard_of(n):
             spec = spec_fn(n) if spec_fn is not None else None
-            state_shard[n] = NamedSharding(mesh, spec) if spec is not None \
-                else repl
+            return NamedSharding(mesh, spec) if spec is not None else repl
+
+        state_shard = {n: shard_of(n) for n in state_in_names}
         unknown = [a for a in self._batch_axes if a not in mesh.axis_names]
         if unknown:
             raise ValueError(
@@ -140,6 +144,8 @@ class CompiledProgram:
                 feed_shard[n] = batch
             else:
                 feed_shard[n] = repl
+        out_state = {n: shard_of(n) for n in state_out_names}
         return jax.jit(step_fn, donate_argnums=(0,),
                        in_shardings=(state_shard, feed_shard, repl),
-                       out_shardings=None)
+                       out_shardings=(None, out_state) if out_state
+                       else None)

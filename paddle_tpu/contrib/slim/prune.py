@@ -78,12 +78,14 @@ _CHANNEL_FOLLOWERS = {"batch_norm": ("Scale", "Bias", "Mean", "Variance")}
 # ops that consume/reduce the channel axis — the walk legitimately ends
 _TERMINAL = {"softmax_with_cross_entropy", "cross_entropy",
              "cross_entropy2", "mean", "reduce_mean", "reduce_sum",
-             "accuracy", "softmax", "mse_loss", "square_error_cost",
+             "accuracy", "mse_loss", "square_error_cost",
              "sigmoid_cross_entropy_with_logits", "fetch", "feed",
              "auc", "top_k"}
 # shape-preserving on the channel axis: the walk continues through them
+# (softmax keeps the axis — anything consuming its output still needs
+# consistent pruning)
 _PASSTHROUGH = {"relu", "sigmoid", "tanh", "gelu", "dropout", "pool2d",
-                "scale", "relu6", "leaky_relu"}
+                "scale", "relu6", "leaky_relu", "softmax"}
 
 
 def _producer_out(op):
@@ -180,13 +182,23 @@ def _prune_consumers(block, scope, pruner, var_name, idx, lazy, dim,
             continue
         _seen.add(id(op))
         if op.type == "depthwise_conv2d":
-            # depthwise filter is [C, 1, kh, kw]: the pruned channel axis
-            # is 0, and the output keeps the (pruned) channel count, so
-            # the walk continues past it
+            # depthwise filter is [C*mult, 1, kh, kw]; only channel
+            # multiplier 1 maps pruned input channels 1:1 onto filter
+            # rows and output channels
             wn = op.inputs.get("Filter", [None])[0]
-            if wn and scope.has(wn) and ("w", wn) not in _seen:
-                _seen.add(("w", wn))
-                _prune_shaped(block, scope, pruner, wn, idx, 0, lazy)
+            if wn and scope.has(wn):
+                wshape = scope.get_numpy(wn).shape
+                if wshape[0] != dim:
+                    if not lazy:
+                        raise RuntimeError(
+                            f"shrink-mode prune cannot handle depthwise "
+                            f"filter {wn!r} with channel multiplier "
+                            f"{wshape[0] // dim} (filter rows "
+                            f"{wshape[0]} != channels {dim})")
+                    continue
+                if ("w", wn) not in _seen:
+                    _seen.add(("w", wn))
+                    _prune_shaped(block, scope, pruner, wn, idx, 0, lazy)
             _prune_consumers(block, scope, pruner, _producer_out(op),
                              idx, lazy, dim, _depth + 1, _seen)
         elif op.type in _CONSUMER_AXIS:

@@ -42,6 +42,10 @@ class OpDef:
     # Marks ops that mutate persistable state (optimizer updates): their
     # outputs may alias inputs by var name (ParamOut == Param).
     inplace: bool = False
+    # Semantic version, bumped on incompatible attr/behavior changes;
+    # checked when loading saved programs/checkpoints (the reference's
+    # op_compatible_info.h version gating).
+    version: int = 1
 
 
 class OpRegistry:
@@ -74,7 +78,8 @@ REGISTRY = OpRegistry()
 
 
 def register_op(op_type, *, nondiff_inputs=(), nondiff_outputs=(), stateful=False,
-                manual_grad=None, custom_grad_maker=None, inplace=False):
+                manual_grad=None, custom_grad_maker=None, inplace=False,
+                version=1):
     """Decorator: @register_op("mul") def _mul(ctx, ins, attrs): ..."""
 
     def deco(fn):
@@ -83,7 +88,8 @@ def register_op(op_type, *, nondiff_inputs=(), nondiff_outputs=(), stateful=Fals
             nondiff_inputs=tuple(nondiff_inputs),
             nondiff_outputs=tuple(nondiff_outputs),
             stateful=stateful, manual_grad=manual_grad,
-            custom_grad_maker=custom_grad_maker, inplace=inplace))
+            custom_grad_maker=custom_grad_maker, inplace=inplace,
+            version=version))
         return fn
 
     return deco

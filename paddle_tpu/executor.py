@@ -162,18 +162,26 @@ class Executor:
         # State-in: persistables already initialised in scope OR consumed
         # by some op before being produced.
         persistables = {v.name for v in program.list_vars() if v.persistable}
-        produced = set()
+        produced_all = set()
         consumed_first = set()
         for blk in program.blocks:
             for op in blk.ops:
                 for n in op.input_names():
-                    if n in persistables and n not in produced:
+                    if n in persistables and n not in produced_all:
                         consumed_first.add(n)
                 for n in op.output_names():
-                    produced.add(n)
+                    produced_all.add(n)
+        # State OUTPUTS come from the global block only: a persistable
+        # produced solely inside a sub-block never surfaces in the
+        # top-level env, so excluding it keeps build_jit's pinned
+        # out_shardings aligned with exactly the keys the traced step
+        # returns.
+        produced_global = {n for op in block.ops
+                           for n in op.output_names()}
         state_in = sorted(n for n in persistables
                           if scope.has(n) or n in consumed_first)
-        state_out = sorted(persistables & (produced | set(state_in)))
+        state_out = sorted(persistables &
+                           (produced_global | set(state_in)))
         seed = program.random_seed
 
         mesh = compiled.mesh() if compiled is not None and \
@@ -187,11 +195,15 @@ class Executor:
             ctx = LowerCtx(base_key, mesh=mesh)
             lower_block(block, env, ctx)
             fetches = [env[n] for n in fetch_names]
-            new_state = {n: env[n] for n in state_out if n in env}
+            # state_out is computed from the global block, so every name
+            # is in env (feeds/state loaded + top-level ops ran); carry
+            # state-in values through unchanged if an op never wrote them
+            new_state = {n: env.get(n, state.get(n)) for n in state_out}
             return fetches, new_state
 
         if compiled is not None:
-            fn = compiled.build_jit(step, state_in, feed_arrays)
+            fn = compiled.build_jit(step, state_in, feed_arrays,
+                                    state_out_names=state_out)
         else:
             fn = jax.jit(step, donate_argnums=(0,))
         return _CompiledStep(fn, state_in, state_out, fetch_names)

@@ -395,6 +395,7 @@ class Program:
     # -- serialization ---------------------------------------------------
     def to_dict(self):
         return {"version": self._version, "random_seed": self.random_seed,
+                "op_versions": op_version_map(self),
                 "blocks": [b.to_dict() for b in self.blocks]}
 
     def to_json(self) -> str:
@@ -402,6 +403,7 @@ class Program:
 
     @staticmethod
     def from_dict(d) -> "Program":
+        check_op_versions(d.get("op_versions", {}))
         p = Program()
         p.random_seed = d.get("random_seed", 0)
         p.blocks = []
@@ -449,6 +451,37 @@ class Program:
 # -- global default programs (framework.py:4573) -------------------------
 _main_program = Program()
 _startup_program = Program()
+
+
+def op_version_map(program) -> dict:
+    """{op type -> registered semantic version} for every op the program
+    uses (reference op_compatible_info: version map saved with the
+    program and checked on load)."""
+    from .core.registry import REGISTRY
+    out = {}
+    for blk in program.blocks:
+        for op in blk.ops:
+            if op.type not in out:
+                out[op.type] = REGISTRY.get(op.type).version \
+                    if REGISTRY.has(op.type) else 1
+    return out
+
+
+def check_op_versions(saved: dict):
+    """Refuse to load a program/checkpoint whose ops are NEWER than this
+    build supports (reference op_compatible_info.h DEFINITELY_NOT)."""
+    from .core.registry import REGISTRY
+    problems = []
+    for t, v in (saved or {}).items():
+        if not REGISTRY.has(t):
+            problems.append(f"{t!r} (not registered in this build)")
+        elif int(v) > REGISTRY.get(t).version:
+            problems.append(
+                f"{t!r} (saved v{v} > supported "
+                f"v{REGISTRY.get(t).version})")
+    if problems:
+        raise RuntimeError(
+            "incompatible saved program: " + "; ".join(problems))
 
 
 def default_main_program() -> Program:
