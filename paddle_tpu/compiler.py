@@ -144,7 +144,15 @@ class CompiledProgram:
                 feed_shard[n] = batch
             else:
                 feed_shard[n] = repl
-        out_state = {n: shard_of(n) for n in state_out_names}
+        # Pin state out_shardings only when every state output is also a
+        # state input — then each returned value provably exists and the
+        # pytree matches. A program with produced-but-not-consumed
+        # persistables may drop keys at trace time (lowerings returning
+        # {}), so fall back to letting XLA choose.
+        if set(state_out_names) <= set(state_in_names):
+            out_state = {n: shard_of(n) for n in state_out_names}
+        else:
+            out_state = None
         return jax.jit(step_fn, donate_argnums=(0,),
                        in_shardings=(state_shard, feed_shard, repl),
                        out_shardings=(None, out_state) if out_state

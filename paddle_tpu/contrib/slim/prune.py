@@ -186,7 +186,7 @@ def _prune_consumers(block, scope, pruner, var_name, idx, lazy, dim,
             # multiplier 1 maps pruned input channels 1:1 onto filter
             # rows and output channels
             wn = op.inputs.get("Filter", [None])[0]
-            if wn and scope.has(wn):
+            if wn and scope.has(wn) and ("w", wn) not in _seen:
                 wshape = scope.get_numpy(wn).shape
                 if wshape[0] != dim:
                     if not lazy:

@@ -69,8 +69,9 @@ class PServerRuntime:
         from ..executor import Executor
 
         ls = next(op for op in pserver_program.global_block().ops
-                  if op.type == "listen_and_serv")
+                  if op.type in ("listen_and_serv", "fl_listen_and_serv"))
         self.program = pserver_program
+        self._notifications = []  # distributed_notify records
         self.params = list(ls.attrs["params"])
         self.grad_of_param = dict(ls.attrs["grad_of_param"])
         self.opt_block_of = dict(ls.attrs["opt_block_of"])
@@ -257,6 +258,23 @@ class PServerRuntime:
             return {"status": "ok"}, b""
 
         if method == "ping":
+            return {"status": "ok"}, b""
+
+        if method == "notify":
+            # distributed_notify_op: record + ack; SAVE-type notifies
+            # snapshot the server's persistable state like
+            # checkpoint_notify (checkpoint_notify_op.cc)
+            ntype = header.get("type", "NOTIFY")
+            self._notifications.append(ntype)
+            if ntype.upper().startswith("SAVE"):
+                import numpy as _np
+                import os as _os
+                d = header.get("dir", "pserver_ckpt")
+                _os.makedirs(d, exist_ok=True)
+                blob = {n: self.scope.get_numpy(n) for n in self.params
+                        if self.scope.has(n)}
+                _np.savez(_os.path.join(
+                    d, f"{self.endpoint.replace(':', '_')}.npz"), **blob)
             return {"status": "ok"}, b""
 
         return {"status": f"unknown method {method!r}"}, b""

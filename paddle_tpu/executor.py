@@ -71,7 +71,7 @@ class Executor:
         # A listen_and_serv program IS the parameter-server loop: block in
         # the host-side runtime instead of lowering (the reference's
         # exe.run(pserver_prog) does the same, listen_and_serv_op.cc).
-        if any(op.type == "listen_and_serv"
+        if any(op.type in ("listen_and_serv", "fl_listen_and_serv")
                for op in program.global_block().ops):
             from .distributed.ps_server import run_pserver
             run_pserver(program, scope=scope)
@@ -195,10 +195,15 @@ class Executor:
             ctx = LowerCtx(base_key, mesh=mesh)
             lower_block(block, env, ctx)
             fetches = [env[n] for n in fetch_names]
-            # state_out is computed from the global block, so every name
-            # is in env (feeds/state loaded + top-level ops ran); carry
-            # state-in values through unchanged if an op never wrote them
-            new_state = {n: env.get(n, state.get(n)) for n in state_out}
+            # carry state-in values through unchanged if no op wrote
+            # them; drop declared outputs a lowering never produced
+            # (ops returning {} — comm init, delete_var): storing None
+            # in the scope would poison the next run
+            new_state = {}
+            for n in state_out:
+                v = env.get(n, state.get(n))
+                if v is not None:
+                    new_state[n] = v
             return fetches, new_state
 
         if compiled is not None:

@@ -150,10 +150,10 @@ def load_sharded_persistables(executor, dirname, main_program=None,
         dtype = np.dtype(entry["dtype"])
         shards = entry["shards"]
 
-        if mesh is None:
-            # host serving: assemble the full array from all shards,
-            # verifying they cover it (a partial multi-host checkpoint
-            # must fail loudly, not return uninitialized memory)
+        def _assemble():
+            # assemble the full array from all shards, verifying they
+            # cover it (a partial multi-host checkpoint must fail
+            # loudly, not return uninitialized memory)
             full = np.empty(shape, dtype)
             covered = 0
             for s in shards:
@@ -166,13 +166,20 @@ def load_sharded_persistables(executor, dirname, main_program=None,
                     f"{int(np.prod(shape))} elements — missing process "
                     f"shards? (manifest.*.json files must accompany "
                     f"multi-host checkpoints)")
-            scope.set(name, full)
+            return full
+
+        if mesh is None:
+            scope.set(name, _assemble())  # host serving
             continue
-        if entry["spec"] is None or (
-                len(shards) == 1 and all(
-                    i == [0, s] for i, s in zip(shards[0]["index"],
-                                                shape))):
-            # replicated / single shard: plain load + placement
+        if entry["spec"] is None:
+            # saved without a NamedSharding spec (e.g. positional/GSPMD
+            # sharding): assemble everything, place replicated
+            sharding = NamedSharding(mesh, _spec_from_json(None))
+            scope.set(name, jax.device_put(_assemble(), sharding))
+            continue
+        if len(shards) == 1 and all(
+                i == [0, s] for i, s in zip(shards[0]["index"], shape)):
+            # replicated / single full shard: plain load + placement
             full = np.load(os.path.join(dirname, shards[0]["file"]))
             sharding = NamedSharding(mesh, _spec_from_json(entry["spec"]))
             scope.set(name, jax.device_put(full, sharding))

@@ -29,6 +29,7 @@ from . import sequence_extra  # noqa: F401
 from . import rnn_fused  # noqa: F401
 from . import detection_extra  # noqa: F401
 from . import parity_final  # noqa: F401
+from . import straggler_ops  # noqa: F401
 
 
 def registered_types():

@@ -1,0 +1,485 @@
+"""Final op-parity stragglers: deformable convolution family, inference
+conv fusions, BoxPS sparse pull/push, federated PS loop, reader ops.
+
+References: deformable_conv_op.cc, deformable_psroi_pooling_op.cc,
+conv_fusion_op.cc, fused/fusion_conv_inception_op.cc,
+fused/fused_embedding_fc_lstm_op.cc, fused/fusion_seqpool_cvm_concat_op.cc,
+pull_box_sparse_op.cc, distributed_ops/fl_listen_and_serv_op.cc,
+distributed_ops/distributed_notify_op.cc, fill_zeros_like_op.cc (2),
+controlflow/conditional_block_op.cc (Infer variant),
+reader/read_op.cc + reader_op_registry.cc (create_custom_reader).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import REGISTRY, register_op
+
+# ---------------------------------------------------------------------------
+# deformable convolution (v2 with modulation mask; v1 without)
+# ---------------------------------------------------------------------------
+
+
+def _bilinear_sample_nchw(img, ys, xs):
+    """img [C, H, W]; ys/xs arbitrary same-shaped float coords. Samples
+    outside the image are zero (deformable_conv_op.cu bilinear with
+    zero padding)."""
+    c, h, w = img.shape
+    y0 = jnp.floor(ys)
+    x0 = jnp.floor(xs)
+    wy = ys - y0
+    wx = xs - x0
+
+    def tap(yi, xi):
+        inb = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+        yc = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+        xc = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+        v = img[:, yc, xc]          # [C, ...coords]
+        return jnp.where(inb[None], v, 0.0)
+
+    return (tap(y0, x0) * ((1 - wy) * (1 - wx))[None] +
+            tap(y0, x0 + 1) * ((1 - wy) * wx)[None] +
+            tap(y0 + 1, x0) * (wy * (1 - wx))[None] +
+            tap(y0 + 1, x0 + 1) * (wy * wx)[None])
+
+
+def _deformable_conv_impl(ctx, ins, attrs, modulated):
+    x = ins["Input"][0]          # [N, C, H, W]
+    offset = ins["Offset"][0]    # [N, 2*dg*kh*kw, Ho, Wo]
+    w = ins["Filter"][0]         # [Co, C/g, kh, kw]
+    mask = ins["Mask"][0] if modulated and "Mask" in ins else None
+    strides = attrs.get("strides", [1, 1])
+    pads = attrs.get("paddings", [0, 0])
+    dil = attrs.get("dilations", [1, 1])
+    groups = attrs.get("groups", 1)
+    dg = attrs.get("deformable_groups", 1)
+    n, c, h, wd = x.shape
+    co, cig, kh, kw = w.shape
+    ho = (h + 2 * pads[0] - (dil[0] * (kh - 1) + 1)) // strides[0] + 1
+    wo = (wd + 2 * pads[1] - (dil[1] * (kw - 1) + 1)) // strides[1] + 1
+
+    # base sampling grid per output position and kernel tap
+    oy = jnp.arange(ho) * strides[0] - pads[0]
+    ox = jnp.arange(wo) * strides[1] - pads[1]
+    ky = jnp.arange(kh) * dil[0]
+    kx = jnp.arange(kw) * dil[1]
+    base_y = oy[None, :, None] + ky[:, None, None]   # [kh, Ho, 1]
+    base_x = ox[None, None, :] + kx[:, None, None]   # [kw, 1, Wo]
+    base_y = jnp.broadcast_to(base_y[:, None], (kh, kw, ho, wo))
+    base_x = jnp.broadcast_to(base_x[None, :, :, :].reshape(1, kw, 1, wo),
+                              (kh, kw, ho, wo))
+
+    off = offset.reshape(n, dg, kh * kw, 2, ho, wo)
+    dy = off[:, :, :, 0].reshape(n, dg, kh, kw, ho, wo)
+    dx = off[:, :, :, 1].reshape(n, dg, kh, kw, ho, wo)
+    ys = base_y[None, None] + dy     # [N, dg, kh, kw, Ho, Wo]
+    xs = base_x[None, None] + dx
+    if mask is not None:
+        m = mask.reshape(n, dg, kh, kw, ho, wo)
+    else:
+        m = jnp.ones((n, dg, kh, kw, ho, wo), x.dtype)
+
+    cpg = c // dg  # channels per deformable group
+
+    def one_image(img, ys_i, xs_i, m_i):
+        # img [C, H, W] -> cols [C, kh, kw, Ho, Wo]
+        def one_dg(img_g, ys_g, xs_g, m_g):
+            v = _bilinear_sample_nchw(img_g, ys_g, xs_g)
+            return v * m_g[None]
+        imgs = img.reshape(dg, cpg, h, wd)
+        cols = jax.vmap(one_dg)(imgs, ys_i, xs_i, m_i)
+        return cols.reshape(c, kh, kw, ho, wo)
+
+    cols = jax.vmap(one_image)(x, ys, xs, m)  # [N, C, kh, kw, Ho, Wo]
+
+    # grouped contraction with the filter
+    cols_g = cols.reshape(n, groups, c // groups, kh, kw, ho, wo)
+    w_g = w.reshape(groups, co // groups, cig, kh, kw)
+    out = jnp.einsum("ngcijhw,gocij->ngohw", cols_g, w_g)
+    return {"Output": [out.reshape(n, co, ho, wo).astype(x.dtype)]}
+
+
+@register_op("deformable_conv", nondiff_inputs=())
+def _deformable_conv(ctx, ins, attrs):
+    """Modulated deformable conv v2 (deformable_conv_op.cc): per-tap
+    learned offsets + modulation mask, bilinear sampling, grouped
+    contraction — one einsum on the MXU after vectorized gathers."""
+    return _deformable_conv_impl(ctx, ins, attrs, modulated=True)
+
+
+@register_op("deformable_conv_v1", nondiff_inputs=())
+def _deformable_conv_v1(ctx, ins, attrs):
+    """Deformable conv v1 (deformable_conv_v1_op.cc): offsets only."""
+    return _deformable_conv_impl(ctx, ins, attrs, modulated=False)
+
+
+@register_op("deformable_psroi_pooling",
+             nondiff_inputs=("ROIs",), nondiff_outputs=("TopCount",))
+def _deformable_psroi_pooling(ctx, ins, attrs):
+    """Position-sensitive RoI pooling with learned per-part offsets
+    (deformable_psroi_pooling_op.cc): bin (i, j) reads channel group
+    i*pw+j, its sampling window shifted by Trans * trans_std * roi
+    span; values averaged over a sample grid."""
+    x = ins["Input"][0]          # [N, C, H, W]
+    rois = ins["ROIs"][0]        # [R, 4] xyxy
+    trans = ins["Trans"][0] if "Trans" in ins else None  # [R, 2, ph, pw]
+    ph = attrs.get("pooled_height", attrs.get("pooled_size", 3))
+    pw = attrs.get("pooled_width", attrs.get("pooled_size", 3))
+    out_c = attrs.get("output_dim", x.shape[1] // (ph * pw))
+    scale = attrs.get("spatial_scale", 1.0)
+    trans_std = attrs.get("trans_std", 0.1)
+    samp = max(int(attrs.get("sample_per_part", 2)), 1)
+    n, c, h, w = x.shape
+    r = rois.shape[0]
+    from .detection_extra import _batch_index_of_rois
+    bidx = _batch_index_of_rois(ins, r)
+
+    if trans is None:
+        trans = jnp.zeros((r, 2, ph, pw), x.dtype)
+
+    def one(feat, roi, tr):
+        x1, y1, x2, y2 = roi[0] * scale, roi[1] * scale, \
+            roi[2] * scale, roi[3] * scale
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bin_w, bin_h = rw / pw, rh / ph
+        iy = jnp.arange(ph, dtype=x.dtype)
+        ix = jnp.arange(pw, dtype=x.dtype)
+        # per-bin origin + learned shift
+        oy = y1 + iy[:, None] * bin_h + tr[1] * trans_std * rh
+        ox = x1 + ix[None, :] * bin_w + tr[0] * trans_std * rw
+        # sample grid inside each bin
+        sy = (jnp.arange(samp, dtype=x.dtype) + 0.5) / samp * bin_h
+        sx = (jnp.arange(samp, dtype=x.dtype) + 0.5) / samp * bin_w
+        ys = oy[:, :, None, None] + sy[None, None, :, None]
+        xs = ox[:, :, None, None] + sx[None, None, None, :]
+        vals = _bilinear_sample_nchw(feat, ys, xs)  # [C, ph, pw, s, s]
+        mean = vals.mean(axis=(3, 4))               # [C, ph, pw]
+        # position-sensitive: channel group (i*pw + j) for bin (i, j)
+        g = mean.reshape(out_c, ph * pw, ph, pw)
+        sel = jnp.arange(ph * pw).reshape(ph, pw)
+        return g[:, sel, jnp.arange(ph)[:, None], jnp.arange(pw)[None, :]]
+
+    out = jax.vmap(one)(x[bidx], rois, trans)
+    return {"Output": [out],
+            "TopCount": [jnp.full((r, out_c, ph, pw), samp * samp,
+                                  jnp.int32)]}
+
+
+# ---------------------------------------------------------------------------
+# inference conv fusions
+# ---------------------------------------------------------------------------
+
+_ACTS = {"identity": lambda v: v, "relu": jax.nn.relu,
+         "sigmoid": jax.nn.sigmoid, "tanh": jnp.tanh,
+         "relu6": lambda v: jnp.clip(v, 0, 6)}
+
+
+def _act(name):
+    try:
+        return _ACTS[name]
+    except KeyError:
+        raise NotImplementedError(
+            f"fused conv activation {name!r} not supported "
+            f"(have {sorted(_ACTS)})") from None
+
+
+@register_op("conv2d_fusion")
+def _conv2d_fusion(ctx, ins, attrs):
+    """y = act(alpha1*conv(x) + alpha2*z + bias), optionally split by
+    channel (conv_fusion_op.cc:25-33)."""
+    from .nn_ops import _conv2d_impl
+    x, w = ins["Input"][0], ins["Filter"][0]
+    y = _conv2d_impl(x, w, attrs)
+    if "Bias" in ins:
+        y = y + ins["Bias"][0].reshape(1, -1, 1, 1)
+    if "ResidualData" in ins and ins["ResidualData"][0].size:
+        y = y + ins["ResidualData"][0]
+    y = _act(attrs.get("activation", "relu"))(y)
+    split = attrs.get("split_channels") or []
+    if split:
+        parts, start = [], 0
+        for sc in split:
+            parts.append(y[:, start:start + sc])
+            start += sc
+        return {"Output": [y], "Outputs": parts}
+    return {"Output": [y]}
+
+
+@register_op("conv2d_inception_fusion")
+def _conv2d_inception_fusion(ctx, ins, attrs):
+    """GoogleNet inception module fused into one op
+    (fused/fusion_conv_inception_op.cc). Channel bookkeeping follows the
+    reference InferShape exactly (out C = c0 + (c1-2*c2in) + (c2-c3in) +
+    c3): branch A = 1x1 on a 3x3 avg-pooled input; branch B = an
+    aggregated 1x1 whose tail two chunks seed the 3x3 branches; branch C
+    keeps (c2 - c3in) of its 3x3 output, handing the rest to branch D's
+    second 3x3."""
+    from .nn_ops import _conv2d_impl, _pool2d_impl
+    x = ins["Input"][0]
+    f0, f1, f2, f3 = ins["Filter"]
+    biases = ins.get("Bias", [None] * 4)
+    act = _act(attrs.get("activation", "relu"))
+
+    def conv(inp, w, b, k):
+        pad = (k - 1) // 2
+        y = _conv2d_impl(inp, w, {"strides": [1, 1],
+                                  "paddings": [pad, pad]})
+        if b is not None:
+            y = y + b.reshape(1, -1, 1, 1)
+        return act(y)
+
+    c2i = f2.shape[1]
+    c3i = f3.shape[1]
+    pooled = _pool2d_impl(x, {"pooling_type": "avg", "ksize": [3, 3],
+                              "strides": [1, 1], "paddings": [1, 1]})
+    b_a = conv(pooled, f0, biases[0], f0.shape[2])
+    t = conv(x, f1, biases[1], f1.shape[2])
+    keep1 = t.shape[1] - 2 * c2i
+    r1, s_a, s_b = (t[:, :keep1], t[:, keep1:keep1 + c2i],
+                    t[:, keep1 + c2i:])
+    u_a = conv(s_a, f2, biases[2], f2.shape[2])
+    u_b = conv(s_b, f2, biases[2], f2.shape[2])
+    keep2 = u_a.shape[1] - c3i
+    r2 = u_a[:, :keep2]
+    feed = u_b[:, keep2:]
+    b_d = conv(feed, f3, biases[3], f3.shape[2])
+    out = jnp.concatenate([b_a, r1, r2, b_d], axis=1)
+    return {"Output": [out],
+            "TempOutput": [t, jnp.concatenate([u_a, u_b], axis=1)]}
+
+
+@register_op("fused_embedding_fc_lstm", nondiff_inputs=("Ids",))
+def _fused_embedding_fc_lstm(ctx, ins, attrs):
+    """embedding lookup + (pre-computed) fc + lstm in one op
+    (fused/fused_embedding_fc_lstm_op.cc:122-170). Embeddings already
+    hold table @ fc-weight, so the recurrence consumes looked-up rows
+    directly."""
+    ids = ins["Ids"][0].reshape(ins["Ids"][0].shape[0], -1)  # [B, T]
+    emb = ins["Embeddings"][0]       # [V, 4H]
+    wh = ins["WeightH"][0]           # [H, 4H]
+    bias = ins["Bias"][0].reshape(-1)
+    hdim = wh.shape[0]
+    b, t = ids.shape
+    xx = jnp.take(emb, ids.reshape(-1), axis=0).reshape(b, t, -1)
+    h0 = ins["H0"][0] if "H0" in ins else jnp.zeros((b, hdim), xx.dtype)
+    c0 = ins["C0"][0] if "C0" in ins else jnp.zeros((b, hdim), xx.dtype)
+    if attrs.get("use_peepholes", False):
+        raise NotImplementedError(
+            "fused_embedding_fc_lstm: peephole connections are not "
+            "implemented; rebuild the model with use_peepholes=False")
+    gate_b = bias[:4 * hdim]
+
+    def step(carry, x_t):
+        h, c = carry
+        gates = x_t + h @ wh + gate_b
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        c_new = f * c + i * jnp.tanh(g)
+        h_new = o * jnp.tanh(c_new)
+        return (h_new, c_new), (h_new, c_new)
+
+    (_, _), (hs, cs) = jax.lax.scan(step, (h0, c0),
+                                    jnp.swapaxes(xx, 0, 1))
+    hidden = jnp.swapaxes(hs, 0, 1)
+    cell = jnp.swapaxes(cs, 0, 1)
+    return {"Hidden": [hidden], "Cell": [cell], "XX": [xx]}
+
+
+@register_op("fusion_seqpool_cvm_concat")
+def _fusion_seqpool_cvm_concat(ctx, ins, attrs):
+    """seq-pool each input, strip/keep CVM columns, concat
+    (fused/fusion_seqpool_cvm_concat_op.cc:59-63)."""
+    pooltype = attrs.get("pooltype", "SUM")
+    use_cvm = attrs.get("use_cvm", True)
+    sp = REGISTRY.get("sequence_pool")
+    outs = []
+    for x in ins["X"]:
+        pooled = sp.lower(ctx, {"X": [x]}, {"pooltype": pooltype})["Out"][0]
+        pooled = pooled.reshape(pooled.shape[0], -1)
+        if not use_cvm:
+            pooled = pooled[:, 2:]
+        outs.append(pooled)
+    return {"Out": [jnp.concatenate(outs, axis=1)]}
+
+
+# ---------------------------------------------------------------------------
+# BoxPS sparse embedding service (pull/push)
+# ---------------------------------------------------------------------------
+
+_BOX_SPARSE_TABLES = {}
+
+
+def box_sparse_init(table_id, vocab, dim, dtype=np.float32, seed=0):
+    """Host-side BoxPS stand-in: a dense table served per pull
+    (framework/fleet/box_wrapper.h semantics, minus the external lib)."""
+    rng = np.random.RandomState(seed)
+    _BOX_SPARSE_TABLES[int(table_id)] = (
+        rng.normal(0, 0.01, (vocab, dim)).astype(dtype))
+    return _BOX_SPARSE_TABLES[int(table_id)]
+
+
+@register_op("pull_box_sparse", nondiff_inputs=("Ids",),
+             nondiff_outputs=("Out",))
+def _pull_box_sparse(ctx, ins, attrs):
+    """Fetch embedding rows from the (host) BoxPS table per ids slot
+    (pull_box_sparse_op.cc:62-67)."""
+    from jax.experimental import io_callback
+    size = int(attrs.get("size", 1))
+    table_id = int(attrs.get("table_id", 0))
+    outs = []
+    for ids in ins["Ids"]:
+        flat = ids.reshape(-1)
+
+        def cb(ids_np, table_id=table_id, size=size):
+            tbl = _BOX_SPARSE_TABLES.get(table_id)
+            if tbl is None:
+                tbl = box_sparse_init(table_id, 1 << 20, size)
+            return tbl[np.asarray(ids_np).astype(np.int64)
+                       % tbl.shape[0]].astype(np.float32)
+
+        rows = io_callback(
+            cb, jax.ShapeDtypeStruct((flat.shape[0], size), jnp.float32),
+            flat, ordered=True)
+        outs.append(rows.reshape(ids.shape + (size,)))
+    return {"Out": outs}
+
+
+@register_op("push_box_sparse", nondiff_inputs=("Ids",))
+def _push_box_sparse(ctx, ins, attrs):
+    """Apply gradient rows back into the BoxPS table (SGD on the host
+    side, push_box_sparse_op.cc)."""
+    from jax.experimental import io_callback
+    table_id = int(attrs.get("table_id", 0))
+    lr = float(attrs.get("learning_rate", 0.01))
+    outs = []
+    for ids, g in zip(ins["Ids"], ins.get("Out@GRAD", ins.get("Grad",
+                                                              []))):
+        flat = ids.reshape(-1)
+        gflat = g.reshape(flat.shape[0], -1)
+
+        def cb(ids_np, g_np, table_id=table_id, lr=lr):
+            tbl = _BOX_SPARSE_TABLES.get(table_id)
+            if tbl is not None:
+                idx = np.asarray(ids_np).astype(np.int64) % tbl.shape[0]
+                np.subtract.at(tbl, idx, lr * np.asarray(g_np))
+            return np.zeros((), np.bool_)
+
+        outs.append(io_callback(cb, jax.ShapeDtypeStruct((), jnp.bool_),
+                                flat, gflat, ordered=True))
+    return {"Out": [o for o in outs]} if outs else {}
+
+
+# ---------------------------------------------------------------------------
+# federated PS / notify / misc
+# ---------------------------------------------------------------------------
+
+
+@register_op("fl_listen_and_serv")
+def _fl_listen_and_serv(ctx, ins, attrs):
+    """Federated parameter-server loop (fl_listen_and_serv_op.cc): same
+    host-side runtime as listen_and_serv — the Executor routes programs
+    containing either op to distributed/ps_server.py before lowering, so
+    this lowering only fires if someone embeds it mid-program."""
+    raise RuntimeError(
+        "fl_listen_and_serv must be the program's top-level server loop "
+        "(run it via Executor.run on the server program)")
+
+
+@register_op("distributed_notify")
+def _distributed_notify(ctx, ins, attrs):
+    """Fire-and-forget notification RPC to trainer/server endpoints
+    (distributed_ops/distributed_notify_op.cc); down endpoints are
+    skipped like checkpoint_notify."""
+    from jax.experimental import io_callback
+
+    def cb():
+        from ..distributed.rpc import RPCClient
+        client = RPCClient.instance()
+        for ep in attrs.get("endpoints", []):
+            try:
+                client._call(ep, {"method": "notify",
+                                  "type": attrs.get("type", "NOTIFY")})
+            except Exception:
+                pass  # down endpoints are skipped (reference behavior)
+        return np.zeros((), np.bool_)
+
+    io_callback(cb, jax.ShapeDtypeStruct((), jnp.bool_), ordered=True)
+    return {}
+
+
+@register_op("fill_zeros_like2")
+def _fill_zeros_like2(ctx, ins, attrs):
+    """fill_zeros_like with an explicit dtype attr
+    (fill_zeros_like_op.cc FillZerosLike2)."""
+    from ..core.dtypes import as_np_dtype
+    x = ins["X"][0]
+    dtype = attrs.get("dtype")
+    return {"Out": [jnp.zeros(x.shape,
+                              as_np_dtype(dtype) if dtype else x.dtype)]}
+
+
+@register_op("conditional_block_infer")
+def _conditional_block_infer(ctx, ins, attrs):
+    """Inference variant of conditional_block
+    (conditional_block_op.cc ConditionalBlockInferOp): same lowering,
+    is_test forced."""
+    cond = REGISTRY.get("conditional_block")
+    return cond.lower(ctx, ins, {**attrs, "is_test": True})
+
+
+# ---------------------------------------------------------------------------
+# reader ops: host queue -> feed vars
+# ---------------------------------------------------------------------------
+
+_CUSTOM_READERS = {}
+
+
+def register_reader(reader_id, fn):
+    """Bind a host generator-like callable for `read`/create_custom_reader
+    (reader_op_registry.cc). fn() -> tuple of np arrays matching the
+    read op's declared shapes/dtypes."""
+    _CUSTOM_READERS[int(reader_id)] = fn
+
+
+@register_op("create_custom_reader", nondiff_outputs=("Out",))
+def _create_custom_reader(ctx, ins, attrs):
+    """Returns a handle scalar naming the bound host reader; the
+    decorated sub-program of the reference's custom reader becomes the
+    host callable registered via register_reader."""
+    rid = int(attrs.get("reader_id", 0))
+    if rid not in _CUSTOM_READERS:
+        raise RuntimeError(
+            f"no host reader registered under id {rid}; call "
+            f"paddle_tpu.ops.straggler_ops.register_reader first")
+    return {"Out": [jnp.asarray(rid, jnp.int32)]}
+
+
+@register_op("read", nondiff_inputs=("Reader",), nondiff_outputs=("Out",))
+def _read(ctx, ins, attrs):
+    """Pop one batch from the bound host reader into the output vars
+    (reader/read_op.cc). Shapes/dtypes must be static (attrs) — the TPU
+    answer to the reference's LoDTensor queue is a fixed-shape host
+    infeed."""
+    from jax.experimental import io_callback
+    from ..core.dtypes import as_np_dtype
+    rid_arr = ins["Reader"][0]
+    shapes = attrs["shapes"]
+    # canonicalize (int64 -> int32 when x64 is off): io_callback rejects
+    # 64-bit result dtypes under the default config
+    dtypes = [jax.dtypes.canonicalize_dtype(as_np_dtype(d))
+              for d in attrs["dtypes"]]
+
+    def cb(rid):
+        fn = _CUSTOM_READERS[int(np.asarray(rid))]
+        batch = fn()
+        return tuple(np.asarray(b, dt).reshape(s)
+                     for b, s, dt in zip(batch, shapes, dtypes))
+
+    structs = tuple(jax.ShapeDtypeStruct(tuple(s), dt)
+                    for s, dt in zip(shapes, dtypes))
+    outs = io_callback(cb, structs, rid_arr, ordered=True)
+    return {"Out": list(outs)}

@@ -945,3 +945,67 @@ spec("flash_attention",
      attrs={"causal": False, "block_q": 128, "block_k": 128},
      grad=["Q", "K", "V"], is_test=True)
 spec("where_index", ins={"Condition": _B1})
+
+# ===========================================================================
+# batch 3: straggler ops (straggler_ops.py)
+# ===========================================================================
+spec("deformable_conv",
+     ins={"Input": f32(1, 2, 5, 5), "Filter": f32(3, 2, 3, 3),
+          "Offset": f32(1, 18, 5, 5, lo=-0.5, hi=0.5),
+          "Mask": pos(1, 9, 5, 5, lo=0.5, hi=1.0)},
+     attrs={"strides": [1, 1], "paddings": [1, 1], "dilations": [1, 1],
+            "groups": 1, "deformable_groups": 1},
+     grad=["Input", "Filter"], grad_tol=3e-2)
+spec("deformable_conv_v1",
+     ins={"Input": f32(1, 2, 5, 5), "Filter": f32(3, 2, 3, 3),
+          "Offset": f32(1, 18, 5, 5, lo=-0.5, hi=0.5)},
+     attrs={"strides": [1, 1], "paddings": [1, 1], "dilations": [1, 1],
+            "groups": 1, "deformable_groups": 1})
+spec("deformable_psroi_pooling",
+     ins={"Input": f32(1, 8, 6, 6),
+          "ROIs": np.array([[0, 0, 4, 4]], np.float32),
+          "Trans": f32(1, 2, 2, 2, lo=-0.1, hi=0.1)},
+     attrs={"pooled_height": 2, "pooled_width": 2, "output_dim": 2,
+            "spatial_scale": 1.0, "trans_std": 0.1,
+            "sample_per_part": 2})
+spec("conv2d_fusion",
+     ins={"Input": f32(1, 2, 4, 4), "Filter": f32(3, 2, 3, 3),
+          "Bias": f32(3)},
+     attrs={"strides": [1, 1], "paddings": [1, 1], "activation": "relu"})
+spec("conv2d_inception_fusion",
+     ins={"Input": f32(1, 4, 5, 5),
+          "Filter": [("inc_f0", f32(2, 4, 1, 1)),
+                     ("inc_f1", f32(7, 4, 1, 1)),
+                     ("inc_f2", f32(5, 2, 3, 3)),
+                     ("inc_f3", f32(4, 3, 3, 3))],
+          "Bias": [("inc_b0", f32(2)), ("inc_b1", f32(7)),
+                   ("inc_b2", f32(5)), ("inc_b3", f32(4))]},
+     attrs={"activation": "relu"})
+spec("fused_embedding_fc_lstm",
+     ins={"Ids": np.array([[[1], [3], [0]]], np.int64),
+          "Embeddings": f32(6, 16), "WeightH": f32(4, 16),
+          "Bias": f32(1, 16)})
+spec("fusion_seqpool_cvm_concat",
+     ins={"X": [("fspcc_a", f32(2, 3, 4)), ("fspcc_b", f32(2, 3, 4))],
+          "CVM": f32(2, 2)},
+     attrs={"pooltype": "SUM", "use_cvm": True})
+spec("pull_box_sparse",
+     ins={"Ids": np.array([[1], [5]], np.int64)},
+     attrs={"size": 4, "table_id": 7}, exact=False)
+spec("fill_zeros_like2", ins={"X": _X}, attrs={"dtype": "float32"},
+     expect=lambda i, a: {"Out": [np.zeros_like(i["X"])]})
+
+skip("push_box_sparse", "host-side table update paired with "
+                        "pull_box_sparse; covered in "
+                        "tests/test_straggler_ops.py")
+skip("fl_listen_and_serv", "host-side federated PS loop; routed to "
+                           "distributed/ps_server.py by the Executor "
+                           "like listen_and_serv")
+skip("distributed_notify", "host RPC side effect; covered in "
+                           "tests/test_straggler_ops.py")
+skip("conditional_block_infer", "needs a sub-block program; delegates "
+                                "to the conditional_block lowering")
+skip("read", "host reader infeed; covered in "
+             "tests/test_straggler_ops.py")
+skip("create_custom_reader", "host reader binding; covered in "
+                             "tests/test_straggler_ops.py")

@@ -1,0 +1,98 @@
+"""Targeted tests for the straggler op batch (straggler_ops.py):
+deformable conv equals plain conv at zero offsets, BoxPS pull/push
+round-trip, host reader infeed, conditional_block_infer delegation."""
+import numpy as np
+
+import jax.numpy as jnp
+
+import paddle_tpu  # noqa: F401 — registers ops
+from paddle_tpu.core.registry import REGISTRY
+from paddle_tpu.ops import straggler_ops
+
+from test_parity_ops import run
+
+rng = np.random.RandomState(42)
+
+
+def test_deformable_conv_zero_offset_equals_conv():
+    """Zero offsets + unit mask degrade to a standard convolution."""
+    x = rng.randn(1, 2, 5, 5).astype(np.float32)
+    w = rng.randn(3, 2, 3, 3).astype(np.float32)
+    attrs = {"strides": [1, 1], "paddings": [1, 1], "dilations": [1, 1],
+             "groups": 1, "deformable_groups": 1}
+    off = np.zeros((1, 18, 5, 5), np.float32)
+    mask = np.ones((1, 9, 5, 5), np.float32)
+    got = np.asarray(run("deformable_conv",
+                         {"Input": [x], "Filter": [w], "Offset": [off],
+                          "Mask": [mask]}, attrs)["Output"][0])
+    want = np.asarray(run("conv2d", {"Input": [x], "Filter": [w]},
+                          attrs)["Output"][0])
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_deformable_conv_mask_scales_contribution():
+    x = np.ones((1, 1, 3, 3), np.float32)
+    w = np.ones((1, 1, 1, 1), np.float32)
+    attrs = {"strides": [1, 1], "paddings": [0, 0], "dilations": [1, 1],
+             "groups": 1, "deformable_groups": 1}
+    off = np.zeros((1, 2, 3, 3), np.float32)
+    half = np.full((1, 1, 3, 3), 0.5, np.float32)
+    got = np.asarray(run("deformable_conv",
+                         {"Input": [x], "Filter": [w], "Offset": [off],
+                          "Mask": [half]}, attrs)["Output"][0])
+    np.testing.assert_allclose(got, 0.5, rtol=1e-6)
+
+
+def test_pull_push_box_sparse_roundtrip():
+    straggler_ops.box_sparse_init(table_id=3, vocab=10, dim=4, seed=1)
+    ids = np.array([[2], [7]], np.int64)
+    out1 = np.asarray(run("pull_box_sparse", {"Ids": [ids]},
+                          {"size": 4, "table_id": 3})["Out"][0])
+    assert out1.shape == (2, 1, 4)
+    # push a gradient for id 2 and re-pull: the row must move
+    g = np.ones((2, 1, 4), np.float32)
+    run("push_box_sparse", {"Ids": [ids], "Grad": [g]},
+        {"table_id": 3, "learning_rate": 0.5})
+    out2 = np.asarray(run("pull_box_sparse", {"Ids": [ids]},
+                          {"size": 4, "table_id": 3})["Out"][0])
+    np.testing.assert_allclose(out2, out1 - 0.5, rtol=1e-5, atol=1e-6)
+
+
+def test_read_op_pops_host_batches():
+    batches = [(np.full((2, 3), i, np.float32),
+                np.full((2, 1), i, np.int64)) for i in range(3)]
+    it = iter(batches)
+    straggler_ops.register_reader(11, lambda: next(it))
+    handle = run("create_custom_reader", {}, {"reader_id": 11})["Out"][0]
+    outs = run("read", {"Reader": [handle]},
+               {"shapes": [[2, 3], [2, 1]],
+                "dtypes": ["float32", "int64"]})["Out"]
+    assert float(np.asarray(outs[0])[0, 0]) == 0.0
+    outs = run("read", {"Reader": [handle]},
+               {"shapes": [[2, 3], [2, 1]],
+                "dtypes": ["float32", "int64"]})["Out"]
+    assert float(np.asarray(outs[0])[0, 0]) == 1.0
+
+
+def test_inception_fusion_channel_contract():
+    """Output channels follow the reference InferShape formula
+    (fusion_conv_inception_op.cc:38-42)."""
+    x = rng.randn(1, 4, 5, 5).astype(np.float32)
+    f0 = rng.randn(2, 4, 1, 1).astype(np.float32)
+    f1 = rng.randn(7, 4, 1, 1).astype(np.float32)
+    f2 = rng.randn(5, 2, 3, 3).astype(np.float32)
+    f3 = rng.randn(4, 3, 3, 3).astype(np.float32)
+    out = run("conv2d_inception_fusion",
+              {"Input": [x], "Filter": [f0, f1, f2, f3]},
+              {"activation": "relu"})["Output"][0]
+    want_c = 2 + (7 - 2 * 2) + (5 - 3) + 4
+    assert out.shape == (1, want_c, 5, 5)
+
+
+def test_fl_listen_and_serv_routes_like_ps():
+    assert REGISTRY.has("fl_listen_and_serv")
+    # the executor routes fl programs to the PS runtime before lowering;
+    # direct lowering must refuse loudly
+    import pytest
+    with pytest.raises(RuntimeError, match="server loop"):
+        run("fl_listen_and_serv", {}, {})
