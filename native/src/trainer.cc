@@ -1,0 +1,186 @@
+// C-ABI training entry points: load a saved program and run training
+// steps from pure C/C++ — the counterpart of the reference's
+// train/demo/demo_trainer.cc (load ProgramDesc + persistables, run the
+// Executor in a loop) and train/test_train_recognize_digits.cc.
+//
+// On TPU the compute path IS the XLA runtime driven through JAX, so the
+// native trainer embeds CPython (the inverse of the usual ctypes
+// direction; the CPython C API is the sanctioned binding layer here) and
+// drives paddle_tpu.native_trainer. C callers never touch Python types:
+// feeds cross the ABI as raw buffers + shape/dtype strings.
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+
+namespace {
+
+struct Trainer {
+  PyObject* obj;  // paddle_tpu.native_trainer.NativeTrainer
+};
+
+// GIL helper working both embedded (we own the interpreter) and hosted
+// (this .so was ctypes-loaded inside a running Python).
+class Gil {
+ public:
+  Gil() : state_(PyGILState_Ensure()) {}
+  ~Gil() { PyGILState_Release(state_); }
+
+ private:
+  PyGILState_STATE state_;
+};
+
+thread_local std::string g_last_error;
+
+void capture_py_error(const char* where) {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyObject* s = value ? PyObject_Str(value) : nullptr;
+  g_last_error = std::string(where) + ": " +
+                 (s ? PyUnicode_AsUTF8(s) : "unknown python error");
+  Py_XDECREF(s);
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+}  // namespace
+
+extern "C" {
+
+const char* ptn_trainer_last_error() { return g_last_error.c_str(); }
+
+// Initialize the embedded interpreter (no-op when already hosted inside
+// Python). repo_root is prepended to sys.path; jax is pinned to the CPU
+// backend unless PTN_TRAINER_KEEP_PLATFORM is set (the TPU-tunnel
+// backend must not be claimed by a side process).
+int ptn_trainer_init(const char* repo_root) {
+  bool embedded = false;
+  if (!Py_IsInitialized()) {
+    if (!getenv("PTN_TRAINER_KEEP_PLATFORM")) setenv("JAX_PLATFORMS", "cpu", 1);
+    Py_InitializeEx(0);
+    embedded = true;
+  }
+  int rc = 0;
+  {
+    Gil gil;
+    PyObject* sys_path = PySys_GetObject("path");  // borrowed
+    if (repo_root && *repo_root) {
+      PyObject* p = PyUnicode_FromString(repo_root);
+      PyList_Insert(sys_path, 0, p);
+      Py_DECREF(p);
+    }
+    PyObject* mod = PyImport_ImportModule("paddle_tpu.native_trainer");
+    if (!mod) {
+      capture_py_error("import paddle_tpu.native_trainer");
+      rc = -1;
+    } else {
+      Py_DECREF(mod);
+    }
+  }
+  if (embedded) {
+    // Release the GIL the init thread acquired with Py_InitializeEx so
+    // other C threads can enter via PyGILState_Ensure.
+    PyEval_SaveThread();
+  }
+  return rc;
+}
+
+// Load a model directory saved by
+// paddle_tpu.native_trainer.save_trainer_model (program JSON +
+// persistables) — the analogue of demo_trainer.cc reading the
+// __model__ ProgramDesc + params. Returns a handle or NULL.
+void* ptn_trainer_load(const char* model_dir) {
+  Gil gil;
+  PyObject* mod = PyImport_ImportModule("paddle_tpu.native_trainer");
+  if (!mod) {
+    capture_py_error("import");
+    return nullptr;
+  }
+  PyObject* obj =
+      PyObject_CallMethod(mod, "load_trainer", "s", model_dir);
+  Py_DECREF(mod);
+  if (!obj) {
+    capture_py_error("load_trainer");
+    return nullptr;
+  }
+  return new Trainer{obj};
+}
+
+// One training step. feeds cross as n parallel arrays:
+//   names[i]        var name
+//   bufs[i]/nbytes  raw little-endian buffer
+//   dtypes[i]       numpy dtype string ("float32", "int64", ...)
+//   shapes[i]/ranks flattened dims
+// Returns the scalar loss; NaN on failure (see ptn_trainer_last_error).
+double ptn_trainer_run_step(void* handle, int n, const char** names,
+                            const void** bufs, const uint64_t* nbytes,
+                            const char** dtypes, const int64_t* shapes,
+                            const int* ranks) {
+  Gil gil;
+  Trainer* t = static_cast<Trainer*>(handle);
+  PyObject* feed = PyList_New(n);
+  const int64_t* sp = shapes;
+  for (int i = 0; i < n; ++i) {
+    PyObject* shape = PyTuple_New(ranks[i]);
+    for (int d = 0; d < ranks[i]; ++d)
+      PyTuple_SET_ITEM(shape, d, PyLong_FromLongLong(sp[d]));
+    sp += ranks[i];
+    PyObject* entry = Py_BuildValue(
+        "(sy#sO)", names[i], static_cast<const char*>(bufs[i]),
+        static_cast<Py_ssize_t>(nbytes[i]), dtypes[i], shape);
+    Py_DECREF(shape);
+    if (!entry) {
+      capture_py_error("build feed entry");
+      Py_DECREF(feed);
+      return NAN;
+    }
+    PyList_SET_ITEM(feed, i, entry);
+  }
+  PyObject* r = PyObject_CallMethod(t->obj, "run_step_raw", "O", feed);
+  Py_DECREF(feed);
+  if (!r) {
+    capture_py_error("run_step");
+    return NAN;
+  }
+  double loss = PyFloat_AsDouble(r);
+  Py_DECREF(r);
+  return loss;
+}
+
+// Persist the trainer's current state back into the model dir.
+int ptn_trainer_save(void* handle, const char* model_dir) {
+  Gil gil;
+  Trainer* t = static_cast<Trainer*>(handle);
+  PyObject* r = PyObject_CallMethod(t->obj, "save", "s", model_dir);
+  if (!r) {
+    capture_py_error("save");
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+void ptn_trainer_destroy(void* handle) {
+  Trainer* t = static_cast<Trainer*>(handle);
+  if (t) {
+    Gil gil;
+    Py_XDECREF(t->obj);
+    delete t;
+  }
+}
+
+// Convenience for the native test: run arbitrary setup Python (e.g.
+// build + save the model being trained).
+int ptn_trainer_exec(const char* code) {
+  Gil gil;
+  if (PyRun_SimpleString(code) != 0) {
+    g_last_error = "ptn_trainer_exec: python raised";
+    return -1;
+  }
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,108 @@
+// Pure-C++ training entry test: build+save a model (via embedded
+// setup), then LOAD and TRAIN it entirely through the C ABI — the
+// counterpart of the reference's train/demo/demo_trainer.cc +
+// train/test_train_recognize_digits.cc.
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <random>
+#include <string>
+#include <vector>
+
+extern "C" {
+int ptn_trainer_init(const char* repo_root);
+void* ptn_trainer_load(const char* model_dir);
+double ptn_trainer_run_step(void* handle, int n, const char** names,
+                            const void** bufs, const uint64_t* nbytes,
+                            const char** dtypes, const int64_t* shapes,
+                            const int* ranks);
+int ptn_trainer_save(void* handle, const char* model_dir);
+void ptn_trainer_destroy(void* handle);
+int ptn_trainer_exec(const char* code);
+const char* ptn_trainer_last_error();
+}
+
+#define CHECK(cond)                                                   \
+  do {                                                                \
+    if (!(cond)) {                                                    \
+      std::fprintf(stderr, "FAILED: %s (line %d): %s\n", #cond,       \
+                   __LINE__, ptn_trainer_last_error());               \
+      std::exit(1);                                                   \
+    }                                                                 \
+  } while (0)
+
+int main(int argc, char** argv) {
+  const char* repo = argc > 1 ? argv[1] : "..";
+  CHECK(ptn_trainer_init(repo) == 0);
+
+  // Build + save a digit-classifier program (the reference demo trains
+  // recognize_digits; same shape of model at toy scale).
+  const char* setup = R"PY(
+import numpy as np
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.native_trainer import save_trainer_model
+
+main, startup = fluid.Program(), fluid.Program()
+with fluid.program_guard(main, startup):
+    img = layers.data("img", shape=[16, 64], dtype="float32",
+                      append_batch_size=False)
+    label = layers.data("label", shape=[16, 1], dtype="int64",
+                        append_batch_size=False)
+    h = layers.fc(img, size=32, act="relu")
+    logits = layers.fc(h, size=10)
+    loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
+    fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
+save_trainer_model("/tmp/ptn_trainer_model", main, startup, loss.name)
+)PY";
+  CHECK(ptn_trainer_exec(setup) == 0);
+
+  void* tr = ptn_trainer_load("/tmp/ptn_trainer_model");
+  CHECK(tr != nullptr);
+
+  // Synthetic separable data generated in C: class = argmax-ish of a
+  // linear map, so the model can actually learn it.
+  std::mt19937 rng(7);
+  std::normal_distribution<float> nd(0.f, 1.f);
+  const int B = 16, D = 64;
+  std::vector<float> img(B * D);
+  std::vector<int32_t> label(B);
+
+  const char* names[2] = {"img", "label"};
+  const char* dtypes[2] = {"float32", "int32"};
+  const int64_t shapes[4] = {B, D, B, 1};
+  const int ranks[2] = {2, 2};
+
+  double first = 0, last = 0;
+  for (int step = 0; step < 40; ++step) {
+    for (int i = 0; i < B; ++i) {
+      float best = -1e30f;
+      int cls = 0;
+      for (int d = 0; d < D; ++d) {
+        img[i * D + d] = nd(rng);
+        if (d < 10 && img[i * D + d] > best) {
+          best = img[i * D + d];
+          cls = d;
+        }
+      }
+      label[i] = cls;
+    }
+    const void* bufs[2] = {img.data(), label.data()};
+    const uint64_t nbytes[2] = {img.size() * sizeof(float),
+                                label.size() * sizeof(int32_t)};
+    double loss = ptn_trainer_run_step(tr, 2, names, bufs, nbytes,
+                                       dtypes, shapes, ranks);
+    CHECK(!std::isnan(loss));
+    if (step == 0) first = loss;
+    last = loss;
+  }
+  std::printf("c-trainer: loss %.4f -> %.4f over 40 steps\n", first, last);
+  CHECK(last < first * 0.8);
+
+  CHECK(ptn_trainer_save(tr, "/tmp/ptn_trainer_model_out") == 0);
+  ptn_trainer_destroy(tr);
+  std::printf("trainer_test OK\n");
+  return 0;
+}

@@ -1,0 +1,78 @@
+"""Python half of the C-ABI trainer (native/src/trainer.cc).
+
+Reference: train/demo/demo_trainer.cc loads a saved ProgramDesc + params
+and drives Executor::Run from C++. Here the saved artifact is the
+Program JSON pair + persistables (io.py wire format); the C side feeds
+raw buffers which this module reassembles into numpy without copies.
+"""
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+__all__ = ["save_trainer_model", "load_trainer", "NativeTrainer"]
+
+
+def save_trainer_model(dirname, main_program, startup_program,
+                       loss_name, scope=None):
+    """Persist everything a native trainer needs: both programs, the
+    loss fetch name, and current persistables (if a scope is given)."""
+    import paddle_tpu as fluid
+    os.makedirs(dirname, exist_ok=True)
+    with open(os.path.join(dirname, "main_program.json"), "w") as f:
+        f.write(main_program.to_json())
+    with open(os.path.join(dirname, "startup_program.json"), "w") as f:
+        f.write(startup_program.to_json())
+    with open(os.path.join(dirname, "meta.json"), "w") as f:
+        json.dump({"loss_name": loss_name}, f)
+    if scope is not None:
+        with fluid.scope_guard(scope):
+            fluid.io.save_persistables(None, os.path.join(dirname,
+                                                          "params"),
+                                       main_program)
+
+
+class NativeTrainer:
+    def __init__(self, dirname):
+        import paddle_tpu as fluid
+        self._fluid = fluid
+        with open(os.path.join(dirname, "main_program.json")) as f:
+            self.main = fluid.Program.from_dict(json.loads(f.read()))
+        with open(os.path.join(dirname, "startup_program.json")) as f:
+            self.startup = fluid.Program.from_dict(json.loads(f.read()))
+        with open(os.path.join(dirname, "meta.json")) as f:
+            self.loss_name = json.load(f)["loss_name"]
+        self.scope = fluid.Scope()
+        self.exe = fluid.Executor()
+        with fluid.scope_guard(self.scope):
+            self.exe.run(self.startup)
+            params_dir = os.path.join(dirname, "params")
+            if os.path.isdir(params_dir):
+                fluid.io.load_persistables(self.exe, params_dir,
+                                           self.main)
+
+    def run_step_raw(self, feed_entries):
+        """feed_entries: [(name, raw_bytes, dtype_str, shape_tuple)]
+        from the C ABI; returns the scalar loss as float."""
+        feed = {name: np.frombuffer(buf, dtype=np.dtype(dtype))
+                .reshape(shape)
+                for name, buf, dtype, shape in feed_entries}
+        return self.run_step(feed)
+
+    def run_step(self, feed):
+        """numpy-dict convenience mirror of run_step_raw."""
+        with self._fluid.scope_guard(self.scope):
+            loss, = self.exe.run(self.main, feed=feed,
+                                 fetch_list=[self.loss_name])
+        return float(np.asarray(loss).reshape(()))
+
+    def save(self, dirname):
+        save_trainer_model(dirname, self.main, self.startup,
+                           self.loss_name, scope=self.scope)
+        return True
+
+
+def load_trainer(dirname) -> NativeTrainer:
+    return NativeTrainer(dirname)

@@ -68,6 +68,9 @@ def _while(ctx, ins, attrs):
     return {"Out": [out[k] for k in attrs["output_vars"]]}
 
 
+_WARNED_UNSET = set()  # once-per-var unset-output warnings
+
+
 @register_op("conditional_block")
 def _conditional_block(ctx, ins, attrs):
     block = ctx.sub_block(attrs["sub_block"])
@@ -90,9 +93,38 @@ def _conditional_block(ctx, ins, attrs):
 
     def false_fn(env):
         shapes = jax.eval_shape(true_fn, env)
-        return tuple(
-            prev.get(k, env.get(k, jnp.zeros(s.shape, s.dtype)))
-            for k, s in zip(out_names, shapes))
+        outs = []
+        for k, s in zip(out_names, shapes):
+            if k in prev:
+                outs.append(prev[k])
+            elif k in env:
+                outs.append(env[k])
+            else:
+                # The reference leaves the var UNCREATED when the branch
+                # is skipped (conditional_block_op.cc) — a later read is
+                # an error there. XLA needs a value, so emit a loud
+                # sentinel (NaN / int-max) instead of silent zeros, and
+                # warn once per var at trace time. (For exhaustive
+                # IfElse/Switch chains where a complementary branch
+                # always writes the var, the sentinel never escapes and
+                # the warning is benign.)
+                if k not in _WARNED_UNSET:
+                    _WARNED_UNSET.add(k)
+                    import warnings
+                    warnings.warn(
+                        f"conditional_block output {k!r} has no value "
+                        f"when the branch is skipped; reads on skipped "
+                        f"paths see NaN/int-max sentinels (reference "
+                        f"semantics: var uncreated). Benign if a "
+                        f"complementary branch always writes it.")
+                if jnp.issubdtype(s.dtype, jnp.floating):
+                    outs.append(jnp.full(s.shape, jnp.nan, s.dtype))
+                elif s.dtype == jnp.bool_:
+                    outs.append(jnp.zeros(s.shape, s.dtype))
+                else:
+                    outs.append(jnp.full(s.shape,
+                                         jnp.iinfo(s.dtype).max, s.dtype))
+        return tuple(outs)
 
     out = jax.lax.cond(pred, true_fn, false_fn, outer_env)
     return {"Out": list(out)}

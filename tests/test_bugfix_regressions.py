@@ -144,3 +144,54 @@ def test_generate_mask_labels_partitions_gts_by_image():
     mask = np.asarray(out["MaskInt32"][0]).reshape(m, m)
     assert np.array_equal(mask, seg_marked.astype(np.int32)), \
         "roi on image 1 must match image 1's gt instance"
+
+
+def test_conditional_block_skipped_output_is_loud():
+    """A conditional_block output with no prior value must surface as a
+    NaN sentinel + warning when the branch is skipped — not silent
+    zeros (VERDICT r2: IfElse silent-wrong-numerics hazard)."""
+    import warnings
+    import jax.numpy as jnp
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup):
+        blk = main.global_block()
+        x = layers.data("cbx", shape=[2], dtype="float32",
+                        append_batch_size=False)
+        cond_var = blk.create_var(name="cb_cond", dtype="bool", shape=[1])
+        blk.create_var(name="cb_cond_full", dtype="bool")
+        blk.append_op("less_than", inputs={"X": [x.name], "Y": [x.name]},
+                      outputs={"Out": ["cb_cond_full"]})
+        blk.append_op("reduce_all", inputs={"X": ["cb_cond_full"]},
+                      outputs={"Out": [cond_var.name]},
+                      attrs={"dim": [0], "keep_dim": False})
+        sub = main._create_block()
+        with fluid.program_guard(main):
+            sub_out = sub.create_var(name="cb_out", dtype="float32",
+                                     stop_gradient=False)
+            sub.append_op("scale", inputs={"X": [x.name]},
+                          outputs={"Out": ["cb_out"]},
+                          attrs={"scale": 2.0})
+        main._rollback()
+        blk.create_var(name="cb_out", dtype="float32")
+        blk.append_op("conditional_block",
+                      inputs={"Cond": [cond_var.name], "Input": [x.name]},
+                      outputs={"Out": ["cb_out"]},
+                      attrs={"sub_block": sub.idx,
+                             "input_vars": [x.name],
+                             "output_vars": ["cb_out"]})
+    exe = fluid.Executor()
+    from paddle_tpu.ops import controlflow as cf
+    cf._WARNED_UNSET.discard("cb_out")  # warning is once-per-var
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            out, = exe.run(main, feed={"cbx": np.ones(2, np.float32)},
+                           fetch_list=["cb_out"])
+        assert any("no value" in str(x.message) for x in w)
+    # x < x is always false -> branch skipped -> loud NaN, not zeros
+    assert np.isnan(out).all()
