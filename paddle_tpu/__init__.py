@@ -40,6 +40,9 @@ from .io_sharded import (save_sharded_persistables,  # noqa: F401
                          load_sharded_persistables)
 from . import dygraph  # noqa: F401
 from . import profiler  # noqa: F401
+from . import debugger  # noqa: F401
+from . import trainer_desc  # noqa: F401
+from .core import memory  # noqa: F401
 from . import dataset  # noqa: F401
 from .dataset import DatasetFactory  # noqa: F401
 from . import contrib  # noqa: F401

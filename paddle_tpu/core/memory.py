@@ -1,0 +1,72 @@
+"""Memory accounting: device (HBM) + host scope usage.
+
+Reference: memory/allocation/allocator_facade.cc owns GPU memory with
+fraction caps (FLAGS_fraction_of_gpu_memory_to_use) and the
+scope-memory monitor (details/scope_buffered_monitor.cc) tracks
+per-scope tensor bytes. On TPU, XLA buffer assignment owns device
+memory — this module SURFACES it (PJRT memory_stats) instead of
+managing it, and adds the scope-bytes monitor the round-2 review
+flagged as missing.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+__all__ = ["device_memory_stats", "scope_memory_stats",
+           "assert_hbm_within"]
+
+
+def device_memory_stats(device=None) -> Dict[str, int]:
+    """PJRT allocator stats for one device: bytes_in_use,
+    peak_bytes_in_use, bytes_limit (keys present when the backend
+    reports them; CPU backends may return {})."""
+    import jax
+    dev = device or jax.devices()[0]
+    stats = getattr(dev, "memory_stats", None)
+    if stats is None:
+        return {}
+    try:
+        return dict(stats() or {})
+    except Exception:
+        return {}
+
+
+def scope_memory_stats(scope=None) -> Dict[str, int]:
+    """Bytes held by a Scope, split host (numpy) vs device (jax.Array);
+    the scope_buffered_monitor.cc analogue."""
+    import numpy as np
+    import jax
+    from .scope import global_scope
+    scope = scope or global_scope()
+    host = dev = count = 0
+    for name in scope.names():
+        v = scope.find_var(name)  # None for declared-but-unset vars
+        if v is None:
+            continue
+        count += 1
+        nbytes = int(getattr(v, "nbytes", 0) or 0)
+        if isinstance(v, jax.Array) and not isinstance(v, np.ndarray):
+            dev += nbytes
+        else:
+            host += nbytes
+    return {"vars": count, "host_bytes": host, "device_bytes": dev,
+            "total_bytes": host + dev}
+
+
+def assert_hbm_within(fraction: float, device=None) -> Optional[float]:
+    """Guard: raise if bytes_in_use exceeds `fraction` of the device
+    limit (the TPU reading of FLAGS_fraction_of_gpu_memory_to_use as a
+    *check* rather than a reservation). Returns the current fraction,
+    or None when the backend reports no stats."""
+    s = device_memory_stats(device)
+    used = s.get("bytes_in_use")
+    limit = s.get("bytes_limit")
+    if not used or not limit:
+        return None
+    frac = used / limit
+    if frac > fraction:
+        raise MemoryError(
+            f"HBM usage {used / 2**30:.2f} GiB is "
+            f"{frac:.1%} of the {limit / 2**30:.2f} GiB limit "
+            f"(> allowed {fraction:.1%})")
+    return frac

@@ -92,27 +92,93 @@ class QueueDataset(DatasetBase):
 
 class InMemoryDataset(DatasetBase):
     """Load-then-shuffle dataset (data_set.h:92 LoadIntoMemory,
-    :99 LocalShuffle, :102 GlobalShuffle). On TPU the memory copy lives in
-    the native feed's shuffle window; global_shuffle over hosts reduces to
-    seeding per-host windows differently (file-level sharding happens in
-    fleet.util.get_file_shard)."""
+    :99 LocalShuffle, :102 GlobalShuffle).
+
+    Global shuffle redesign: the reference routes every sample through
+    the pservers to land on a random worker. On TPU pods the filelist is
+    on a shared filesystem, so the same result needs no traffic — every
+    worker scans the full list, keeps samples whose (seeded) global
+    permutation index maps to it, and serves them in permuted order.
+    Each sample lands on exactly one worker, order is globally random,
+    workers never exchange bytes."""
+
+    def __init__(self):
+        super().__init__()
+        self._mem = None          # list of parsed samples
+        self._order = None        # serving order (indices into _mem)
 
     def load_into_memory(self):
-        pass  # streaming + windowed shuffle; kept for API parity
+        feed = _PyFeed(self._slots(), self._batch_size, self._filelist,
+                       drop_last=True, shuffle=False, seed=0)
+        self._mem = list(feed._samples())
+        self._order = np.arange(len(self._mem))
 
     def local_shuffle(self):
         self._shuffle = True
+        if self._mem is not None:
+            rng = np.random.RandomState(self._seed)
+            self._order = rng.permutation(len(self._mem))
 
-    def global_shuffle(self, fleet=None):
+    def global_shuffle(self, fleet=None, thread_num=None,
+                       filelist_shared=True):
+        """filelist_shared=True (the reference's global-shuffle usage):
+        every worker set the FULL filelist; the shared-seed permutation
+        stride-partitions samples across workers. Set False when each
+        worker's filelist is already a disjoint shard (the
+        fleet.util.get_file_shard pattern) — then this degrades to a
+        local shuffle, because stride-slicing a worker-local sample set
+        would silently drop (n-1)/n of the data."""
         self._shuffle = True
+        wid, nworkers = 0, 1
         if fleet is not None:
-            self._seed = getattr(fleet, "worker_index", lambda: 0)()
+            wid = getattr(fleet, "worker_index", lambda: 0)()
+            nworkers = getattr(fleet, "worker_num", lambda: 1)()
+        if self._mem is None:
+            self.load_into_memory()
+        if not filelist_shared or nworkers <= 1:
+            rng = np.random.RandomState(self._seed + 12345)
+            self._order = rng.permutation(len(self._mem))
+            return
+        # identical permutation on every worker (shared seed), then each
+        # worker keeps its stride-slice of the permuted order
+        rng = np.random.RandomState(self._seed + 12345)
+        perm = rng.permutation(len(self._mem))
+        self._order = perm[wid::max(nworkers, 1)]
 
     def release_memory(self):
-        pass
+        self._mem = None
+        self._order = None
 
     def set_fleet_send_batch_size(self, _n):
-        pass
+        pass  # no inter-worker sends in the shared-FS design
+
+    def batches(self, drop_last=True):
+        if self._mem is None:
+            yield from super().batches(drop_last)
+            return
+        slots = self._slots()
+        shapes = {}
+        for v in self._use_vars:
+            dims = [d for d in v.shape if d is not None and d > 0]
+            shapes[v.name] = dims or [1]
+        packer = _PyFeed(slots, self._batch_size, [], drop_last,
+                         False, 0)
+        buf = []
+        for i in self._order:
+            buf.append(self._mem[i])
+            if len(buf) == self._batch_size:
+                yield self._reshape(packer._pack(buf), slots, shapes)
+                buf = []
+        if buf and not drop_last:
+            yield self._reshape(packer._pack(buf), slots, shapes)
+
+    @staticmethod
+    def _reshape(batch, slots, shapes):
+        out = {}
+        for name, _, _ in slots:
+            arr = batch[name]
+            out[name] = arr.reshape([arr.shape[0]] + shapes[name])
+        return out
 
 
 class DatasetFactory:

@@ -1,0 +1,79 @@
+"""Program visualization + introspection.
+
+Reference: python/paddle/fluid/debugger.py draw_block_graphviz (+ the
+C++ ir/graph_viz_pass.cc pass that dumps .dot per graph), and
+platform/lodtensor_printer.cc (fetch-var printing — here layers.Print /
+the `print` op carry that role via jax.debug.print).
+"""
+from __future__ import annotations
+
+__all__ = ["draw_block_graphviz", "program_to_dot", "pprint_program"]
+
+
+def _esc(s):
+    return str(s).replace('"', '\\"')
+
+
+def program_to_dot(program, block_idx=0, skip_vars=()) -> str:
+    """Render one block as graphviz dot text: op nodes (boxes) wired to
+    var nodes (ellipses); parameters shaded."""
+    block = program.blocks[block_idx]
+    lines = ["digraph G {", "  rankdir=TB;",
+             '  node [fontsize=10, fontname="Helvetica"];']
+    var_ids = {}
+
+    def var_node(name):
+        if name in var_ids or name in skip_vars:
+            return var_ids.get(name)
+        vid = f"var_{len(var_ids)}"
+        var_ids[name] = vid
+        v = block._find_var_recursive(name)
+        shape = getattr(v, "shape", None) if v is not None else None
+        style = 'style=filled, fillcolor="#c0d0f0"' \
+            if v is not None and v.is_parameter else \
+            'style=filled, fillcolor="#eeeeee"'
+        lines.append(
+            f'  {vid} [label="{_esc(name)}\\n{_esc(shape)}", '
+            f"shape=ellipse, {style}];")
+        return vid
+
+    for i, op in enumerate(block.ops):
+        oid = f"op_{i}"
+        lines.append(
+            f'  {oid} [label="{_esc(op.type)}", shape=box, '
+            f'style=filled, fillcolor="#f0d0c0"];')
+        for names in op.inputs.values():
+            for n in names:
+                if n and n not in skip_vars:
+                    lines.append(f"  {var_node(n)} -> {oid};")
+        for names in op.outputs.values():
+            for n in names:
+                if n and n not in skip_vars:
+                    lines.append(f"  {oid} -> {var_node(n)};")
+    lines.append("}")
+    return "\n".join(lines)
+
+
+def draw_block_graphviz(block, highlights=None, path="program.dot"):
+    """Reference-compatible entry (debugger.py draw_block_graphviz):
+    writes dot text for `block` to `path`."""
+    dot = program_to_dot(block.program, block.idx)
+    with open(path, "w") as f:
+        f.write(dot)
+    return path
+
+
+def pprint_program(program, file=None) -> str:
+    """Human-readable op listing per block (the reference's
+    Program.to_string analogue for quick debugging)."""
+    out = []
+    for blk in program.blocks:
+        out.append(f"block {blk.idx} (parent {blk.parent_idx}):")
+        for op in blk.ops:
+            ins = {s: n for s, n in op.inputs.items() if n}
+            outs = {s: n for s, n in op.outputs.items() if n}
+            out.append(f"  {op.type}({ins}) -> {outs}")
+    text = "\n".join(out)
+    if file is not None:
+        print(text, file=file)
+    return text

