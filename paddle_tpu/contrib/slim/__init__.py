@@ -3,5 +3,6 @@ distillation, NAS). Quantization-aware training (fake-quant rewrite),
 structured pruning over the Program IR (mask + shrink modes), and
 distillation (teacher-program merge + L2/soft-label/FSP losses)."""
 from . import distillation  # noqa: F401
+from . import nas  # noqa: F401
 from . import prune  # noqa: F401
 from . import quantization  # noqa: F401

@@ -1,0 +1,158 @@
+"""Neural architecture search: simulated-annealing controller + search
+space + light NAS loop.
+
+Reference: contrib/slim/searcher/controller.py (EvolutionaryController
+:28, SAController :59 — token-list states, annealed acceptance of
+lower-reward mutations), contrib/slim/nas/search_space.py +
+light_nas_strategy.py (tokens -> candidate program, train briefly,
+reward = accuracy under a latency/flops constraint). The
+controller-server/agent RPC split collapses here: on TPU the search
+loop is host-side anyway, so LightNAS drives the controller directly.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+__all__ = ["EvolutionaryController", "SAController", "SearchSpace",
+           "LightNAS"]
+
+
+class EvolutionaryController:
+    """Token-list search controller base (reference controller.py:28)."""
+
+    def update(self, tokens, reward):
+        raise NotImplementedError
+
+    def reset(self, range_table, init_tokens=None, constrain_func=None):
+        raise NotImplementedError
+
+    def next_tokens(self):
+        raise NotImplementedError
+
+
+class SAController(EvolutionaryController):
+    """Simulated annealing over token lists (reference controller.py:59):
+    mutate a fraction of tokens; accept worse rewards with probability
+    exp((r_new - r_best) / T), T decaying geometrically."""
+
+    def __init__(self, range_table=None, reduce_rate=0.85,
+                 init_temperature=1024.0, max_iter_number=300, seed=0):
+        self._range_table = list(range_table or [])
+        self._reduce_rate = reduce_rate
+        self._init_temperature = init_temperature
+        self._max_iter_number = max_iter_number
+        self._rng = np.random.RandomState(seed)
+        self._iter = 0
+        self._tokens = None
+        self._reward = -math.inf
+        self._best_tokens = None
+        self._best_reward = -math.inf
+        self._constrain_func = None
+
+    def reset(self, range_table, init_tokens=None, constrain_func=None):
+        self._range_table = list(range_table)
+        self._constrain_func = constrain_func
+        self._tokens = list(init_tokens) if init_tokens is not None else \
+            [int(self._rng.randint(r)) for r in self._range_table]
+        self._iter = 0
+        self._reward = -math.inf
+        self._best_tokens = list(self._tokens)
+        self._best_reward = -math.inf
+        return self._tokens
+
+    @property
+    def best_tokens(self):
+        return list(self._best_tokens or [])
+
+    @property
+    def max_reward(self):
+        return self._best_reward
+
+    def update(self, tokens, reward):
+        """Accept/reject `tokens` given its measured reward."""
+        self._iter += 1
+        temperature = self._init_temperature * \
+            self._reduce_rate ** self._iter
+        if reward > self._reward or self._rng.rand() < math.exp(
+                min((reward - self._reward) / max(temperature, 1e-9),
+                    0.0)):
+            self._reward = reward
+            self._tokens = list(tokens)
+        if reward > self._best_reward:
+            self._best_reward = reward
+            self._best_tokens = list(tokens)
+
+    def next_tokens(self):
+        """Mutate the current state; respects constrain_func by
+        re-sampling (reference SAController.next_tokens)."""
+        for _ in range(100):
+            cand = list(self._tokens)
+            n_mut = max(1, int(len(cand) * 0.3))
+            for i in self._rng.choice(len(cand), n_mut, replace=False):
+                cand[i] = int(self._rng.randint(self._range_table[i]))
+            if self._constrain_func is None or self._constrain_func(cand):
+                return cand
+        return list(self._tokens)
+
+
+class SearchSpace:
+    """tokens <-> candidate model (reference nas/search_space.py): a
+    subclass defines the range table, builds a train program from a
+    token list, and scores it."""
+
+    def init_tokens(self):
+        raise NotImplementedError
+
+    def range_table(self):
+        raise NotImplementedError
+
+    def create_net(self, tokens):
+        """-> (startup_program, train_program, loss_var, feeds)"""
+        raise NotImplementedError
+
+    def flops(self, tokens) -> float:
+        return 0.0
+
+
+class LightNAS:
+    """Search loop (reference nas/light_nas_strategy.py): controller
+    proposes tokens, the space builds + briefly trains the candidate,
+    reward = score under an optional flops budget."""
+
+    def __init__(self, search_space, controller=None, max_flops=None,
+                 search_steps=10, train_steps=20, seed=0):
+        self.space = search_space
+        self.max_flops = max_flops
+        self.search_steps = search_steps
+        self.train_steps = train_steps
+        self.controller = controller or SAController(seed=seed)
+        constrain = None
+        if max_flops is not None:
+            constrain = lambda toks: self.space.flops(toks) <= max_flops
+        self.controller.reset(self.space.range_table(),
+                              self.space.init_tokens(), constrain)
+        self.history = []
+
+    def _evaluate(self, tokens, feed_batches):
+        import paddle_tpu as fluid
+        startup, train_prog, loss, feeds = self.space.create_net(tokens)
+        scope = fluid.Scope()
+        exe = fluid.Executor()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            lv = None
+            for i in range(self.train_steps):
+                feed = feed_batches[i % len(feed_batches)]
+                lv, = exe.run(train_prog, feed=feed, fetch_list=[loss])
+        return -float(np.asarray(lv).reshape(()))  # reward = -loss
+
+    def search(self, feed_batches):
+        """Run the annealed search; returns (best_tokens, best_reward)."""
+        for _ in range(self.search_steps):
+            tokens = self.controller.next_tokens()
+            reward = self._evaluate(tokens, feed_batches)
+            self.controller.update(tokens, reward)
+            self.history.append((tokens, reward))
+        return self.controller.best_tokens, self.controller.max_reward
