@@ -35,7 +35,8 @@ class EvolutionaryController:
 class SAController(EvolutionaryController):
     """Simulated annealing over token lists (reference controller.py:59):
     mutate a fraction of tokens; accept worse rewards with probability
-    exp((r_new - r_best) / T), T decaying geometrically."""
+    exp((r_new - r_current) / T) against the last ACCEPTED reward,
+    T decaying geometrically."""
 
     def __init__(self, range_table=None, reduce_rate=0.85,
                  init_temperature=1024.0, max_iter_number=300, seed=0):
@@ -56,6 +57,10 @@ class SAController(EvolutionaryController):
         self._constrain_func = constrain_func
         self._tokens = list(init_tokens) if init_tokens is not None else \
             [int(self._rng.randint(r)) for r in self._range_table]
+        if constrain_func is not None and not constrain_func(self._tokens):
+            raise ValueError(
+                f"init tokens {self._tokens} violate the constraint "
+                f"(e.g. flops budget)")
         self._iter = 0
         self._reward = -math.inf
         self._best_tokens = list(self._tokens)
@@ -84,9 +89,18 @@ class SAController(EvolutionaryController):
             self._best_reward = reward
             self._best_tokens = list(tokens)
 
+    @property
+    def exhausted(self):
+        """True once max_iter_number updates have been consumed
+        (reference controller.py stop condition)."""
+        return self._iter >= self._max_iter_number
+
     def next_tokens(self):
         """Mutate the current state; respects constrain_func by
         re-sampling (reference SAController.next_tokens)."""
+        if self.exhausted:
+            raise StopIteration(
+                f"SAController exhausted after {self._iter} iterations")
         for _ in range(100):
             cand = list(self._tokens)
             n_mut = max(1, int(len(cand) * 0.3))
@@ -94,7 +108,9 @@ class SAController(EvolutionaryController):
                 cand[i] = int(self._rng.randint(self._range_table[i]))
             if self._constrain_func is None or self._constrain_func(cand):
                 return cand
-        return list(self._tokens)
+        raise RuntimeError(
+            "could not find a constraint-satisfying mutation in 100 "
+            "attempts; the budget is too tight for this search space")
 
 
 class SearchSpace:
@@ -109,7 +125,7 @@ class SearchSpace:
         raise NotImplementedError
 
     def create_net(self, tokens):
-        """-> (startup_program, train_program, loss_var, feeds)"""
+        """-> (startup_program, train_program, loss_var)"""
         raise NotImplementedError
 
     def flops(self, tokens) -> float:
@@ -137,7 +153,7 @@ class LightNAS:
 
     def _evaluate(self, tokens, feed_batches):
         import paddle_tpu as fluid
-        startup, train_prog, loss, feeds = self.space.create_net(tokens)
+        startup, train_prog, loss = self.space.create_net(tokens)[:3]
         scope = fluid.Scope()
         exe = fluid.Executor()
         with fluid.scope_guard(scope):
@@ -151,6 +167,8 @@ class LightNAS:
     def search(self, feed_batches):
         """Run the annealed search; returns (best_tokens, best_reward)."""
         for _ in range(self.search_steps):
+            if getattr(self.controller, "exhausted", False):
+                break
             tokens = self.controller.next_tokens()
             reward = self._evaluate(tokens, feed_batches)
             self.controller.update(tokens, reward)

@@ -238,14 +238,23 @@ def test_light_nas_searches_hidden_width():
             with fluid.program_guard(main, startup):
                 loss, pred, h = _build_mlp(hidden=widths[tokens[0]])
                 fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
-            return startup, main, loss, None
+            return startup, main, loss
 
+    # budget excludes width 16: the constraint must actually bind
+    budget = 8 * 8 * 2
     nas = LightNAS(WidthSpace(), search_steps=6, train_steps=15,
-                   max_flops=16 * 8 * 2, seed=0)
+                   max_flops=budget, seed=0)
     best, reward = nas.search([{"x": x, "y": y}])
     assert len(nas.history) == 6
-    # budget excludes nothing here (16 allowed); reward is a real loss
     assert np.isfinite(reward)
-    # constraint honored throughout
-    assert all(WidthSpace().flops(t) <= 16 * 8 * 2
-               for t, _ in nas.history)
+    assert all(WidthSpace().flops(t) <= budget for t, _ in nas.history)
+    assert WidthSpace().flops(best) <= budget
+    # over-budget init tokens must be refused loudly
+    import pytest as _pytest
+
+    class BadInit(WidthSpace):
+        def init_tokens(self):
+            return [3]  # width 16 > budget
+
+    with _pytest.raises(ValueError, match="constraint"):
+        LightNAS(BadInit(), search_steps=1, max_flops=budget)
