@@ -215,7 +215,22 @@ def generic_grad_lower(ctx, ins, attrs):
     fake_op = _FakeOp(fwd_type, fwd_attrs, attrs["fwd_id"], ctx)
 
     if opdef.manual_grad is not None:
-        return opdef.manual_grad(_OpCtx(ctx._ctx, fake_op), ins, fwd_attrs)
+        # positionally realign multi-output cotangent lists: _gather_slot
+        # drops empty-name entries, so without the mask a manual grad
+        # would zip Outputs@GRAD[0] against Ids[1] etc. Missing
+        # cotangents become None — manual grads must skip them.
+        ins2 = dict(ins)
+        for slot in fwd_out_slots:
+            gslot = slot + GRAD_SUFFIX
+            mask = grad_mask.get(slot)
+            if gslot in ins2 and mask is not None and \
+                    sum(mask) == len(ins2[gslot]) and \
+                    len(mask) != len(ins2[gslot]):
+                avail = list(ins2[gslot])
+                ins2[gslot] = [avail.pop(0) if present else None
+                               for present in mask]
+        return opdef.manual_grad(_OpCtx(ctx._ctx, fake_op), ins2,
+                                 fwd_attrs)
 
     diff_slots = [s for s in fwd_ins
                   if s not in opdef.nondiff_inputs

@@ -72,6 +72,7 @@ class PServerRuntime:
                   if op.type in ("listen_and_serv", "fl_listen_and_serv"))
         self.program = pserver_program
         self._notifications = []  # distributed_notify records
+        self._sparse_tables = {}  # host-sharded embedding shards
         self.params = list(ls.attrs["params"])
         self.grad_of_param = dict(ls.attrs["grad_of_param"])
         self.opt_block_of = dict(ls.attrs["opt_block_of"])
@@ -260,6 +261,14 @@ class PServerRuntime:
         if method == "ping":
             return {"status": "ok"}, b""
 
+        if method in ("sparse_pull", "sparse_push"):
+            from .sparse_table import (_handle_sparse,
+                                       _make_shard_from_header)
+            r = _handle_sparse(self._sparse_tables, header, payload,
+                               _make_shard_from_header)
+            if r is not None:
+                return r
+
         if method == "notify":
             # distributed_notify_op: record + ack; SAVE-type notifies
             # snapshot the server's persistable state like
@@ -273,6 +282,18 @@ class PServerRuntime:
                 _os.makedirs(d, exist_ok=True)
                 blob = {n: self.scope.get_numpy(n) for n in self.params
                         if self.scope.has(n)}
+                # sparse embedding shards: ids + rows per table (the
+                # largest state in a §7.10 job must not be dropped)
+                for tname, shard in self._sparse_tables.items():
+                    with shard._lock:
+                        keys = _np.asarray(sorted(shard._rows),
+                                           _np.int64)
+                        rows = _np.stack(
+                            [shard._rows[int(k)] for k in keys]) \
+                            if len(keys) else \
+                            _np.zeros((0, shard.dim), _np.float32)
+                    blob[f"__sparse__{tname}__ids"] = keys
+                    blob[f"__sparse__{tname}__rows"] = rows
                 _np.savez(_os.path.join(
                     d, f"{self.endpoint.replace(':', '_')}.npz"), **blob)
             return {"status": "ok"}, b""

@@ -1,0 +1,183 @@
+"""Host-sharded sparse embedding tables over the PS RPC layer.
+
+Reference: large-scale sparse training — SelectedRows embeddings pulled/
+pushed row-wise through the parameter server (distributed_lookup_table_op,
+operators/distributed/parameter_prefetch.cc, DownpourWorker pull/push
+sparse, fleet_wrapper.h:55). SURVEY §7.10 names this the TPU answer to
+vocab tables too big for one chip: the dense model trains on device, the
+embedding rows live host-side, sharded across pservers by id (HashName
+dispatch, ps_dispatcher.py), crossing only as the few rows a batch
+touches.
+
+Server side: SparseTableServer holds {table: rows} shards, serves
+sparse_pull (lazy zero-or-seeded init per row) and sparse_push (row SGD).
+PServerRuntime embeds the same handlers so a transpiled PS job can carry
+sparse tables alongside dense params.
+
+Client side: SparseTableClient shards ids by `id % n_endpoints`, pulls
+rows, scatters them back into batch order; push reverses it.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List
+
+import numpy as np
+
+from .rpc import RPCClient, RPCServer
+
+__all__ = ["SparseTableShard", "SparseTableServer", "SparseTableClient"]
+
+
+class SparseTableShard:
+    """One server's shard of one table: rows materialized on first touch
+    (the reference's lazy per-key init in the PS)."""
+
+    def __init__(self, dim, init_std=0.01, seed=0, lr=0.1):
+        self.dim = int(dim)
+        self.init_std = float(init_std)
+        self.lr = float(lr)
+        self._rows: Dict[int, np.ndarray] = {}
+        self._rng = np.random.RandomState(seed)
+        self._lock = threading.Lock()
+
+    def pull(self, ids: np.ndarray) -> np.ndarray:
+        out = np.empty((len(ids), self.dim), np.float32)
+        with self._lock:
+            for i, key in enumerate(np.asarray(ids, np.int64)):
+                row = self._rows.get(int(key))
+                if row is None:
+                    row = (self._rng.normal(0, self.init_std, self.dim)
+                           .astype(np.float32))
+                    self._rows[int(key)] = row
+                out[i] = row
+        return out
+
+    def push(self, ids: np.ndarray, grads: np.ndarray, lr=None):
+        lr = self.lr if lr is None else float(lr)
+        with self._lock:
+            for key, g in zip(np.asarray(ids, np.int64),
+                              np.asarray(grads, np.float32)):
+                row = self._rows.get(int(key))
+                if row is None:
+                    row = np.zeros(self.dim, np.float32)
+                self._rows[int(key)] = row - lr * g
+
+    def __len__(self):
+        return len(self._rows)
+
+
+def _handle_sparse(tables, header, payload, make_shard):
+    """Shared pull/push handler (used by SparseTableServer and embedded
+    in PServerRuntime)."""
+    from .rpc import pack_array, unpack_array
+    method = header.get("method")
+    if method == "sparse_pull":
+        name = header["name"]
+        shard = tables.get(name)
+        if shard is None:
+            shard = tables.setdefault(name, make_shard(header))
+        ids = unpack_array(header, payload)
+        rows = shard.pull(ids.reshape(-1))
+        meta, body = pack_array(rows)
+        return {"status": "ok", **meta}, body
+    if method == "sparse_push":
+        name = header["name"]
+        shard = tables.get(name)
+        if shard is None:
+            shard = tables.setdefault(name, make_shard(header))
+        n_ids = int(header["n_ids"])
+        ids = np.frombuffer(payload[:8 * n_ids], np.int64)
+        grads = np.frombuffer(payload[8 * n_ids:], np.float32) \
+            .reshape(len(ids), shard.dim)
+        shard.push(ids, grads, lr=header.get("lr"))
+        return {"status": "ok"}, b""
+    return None
+
+
+def _make_shard_from_header(header):
+    return SparseTableShard(dim=int(header.get("dim", 1)),
+                            init_std=float(header.get("init_std", 0.01)),
+                            seed=int(header.get("seed", 0)),
+                            lr=float(header.get("lr", 0.1) or 0.1))
+
+
+class SparseTableServer:
+    """Standalone sparse-table PS (one shard server)."""
+
+    def __init__(self, endpoint="127.0.0.1:0"):
+        self.tables: Dict[str, SparseTableShard] = {}
+        self._server = RPCServer(endpoint, self._handle)
+        self.endpoint = self._server.endpoint
+
+    def _handle(self, header, payload):
+        r = _handle_sparse(self.tables, header, payload,
+                           _make_shard_from_header)
+        if r is not None:
+            return r
+        if header.get("method") == "ping":
+            return {"status": "ok"}, b""
+        return {"status": f"unknown method {header.get('method')!r}"}, b""
+
+    def start(self):
+        self._server.start()
+        return self
+
+    def stop(self):
+        self._server.stop()
+
+
+class SparseTableClient:
+    """Trainer-side view of a table sharded across endpoints by
+    `id % n_endpoints` (HashName dispatch, ps_dispatcher.py)."""
+
+    def __init__(self, table_name: str, endpoints: List[str], dim: int,
+                 trainer_id=0, lr=0.1, init_std=0.01, seed=0):
+        self.name = table_name
+        self.endpoints = list(endpoints)
+        self.dim = int(dim)
+        self.lr = float(lr)
+        self.init_std = float(init_std)
+        self.seed = int(seed)
+        self._client = RPCClient.instance(trainer_id)
+
+    def _meta(self):
+        return {"name": self.name, "dim": self.dim, "lr": self.lr,
+                "init_std": self.init_std, "seed": self.seed}
+
+    def _shard_ids(self, flat_ids):
+        n = len(self.endpoints)
+        owner = flat_ids % n
+        return [(ep_i, np.where(owner == ep_i)[0])
+                for ep_i in range(n)]
+
+    def pull(self, ids: np.ndarray) -> np.ndarray:
+        from .rpc import pack_array, unpack_array
+        flat = np.asarray(ids, np.int64).reshape(-1)
+        out = np.empty((flat.size, self.dim), np.float32)
+        for ep_i, pos in self._shard_ids(flat):
+            if not pos.size:
+                continue
+            meta, body = pack_array(flat[pos])
+            h, p = self._client._call(
+                self.endpoints[ep_i],
+                {"method": "sparse_pull", **self._meta(), **meta}, body)
+            if h.get("status") != "ok":
+                raise RuntimeError(f"sparse_pull -> {h}")
+            out[pos] = unpack_array(h, p)
+        return out.reshape(tuple(np.asarray(ids).shape) + (self.dim,))
+
+    def push(self, ids: np.ndarray, grads: np.ndarray):
+        flat = np.asarray(ids, np.int64).reshape(-1)
+        g = np.asarray(grads, np.float32).reshape(flat.size, self.dim)
+        for ep_i, pos in self._shard_ids(flat):
+            if not pos.size:
+                continue
+            payload = flat[pos].tobytes() + \
+                np.ascontiguousarray(g[pos]).tobytes()
+            h, _ = self._client._call(
+                self.endpoints[ep_i],
+                {"method": "sparse_push", **self._meta(),
+                 "n_ids": int(pos.size)}, payload)
+            if h.get("status") != "ok":
+                raise RuntimeError(f"sparse_push -> {h}")

@@ -410,8 +410,76 @@ def _lookup_sparse_table(ctx, ins, attrs):
     return {"Out": [jnp.take(w, ids.reshape(-1) % w.shape[0], axis=0)]}
 
 
-@register_op("distributed_lookup_table", nondiff_inputs=("Ids",))
+def _ps_sparse_client(attrs):
+    from ..distributed.sparse_table import SparseTableClient
+    name = attrs.get("table_name") or \
+        (attrs.get("table_names") or ["emb"])[0]
+    return SparseTableClient(
+        name, list(attrs["endpoints"]), int(attrs["emb_dim"]),
+        trainer_id=int(attrs.get("trainer_id", 0)),
+        lr=float(attrs.get("sparse_lr", 0.1)))
+
+
+def _distributed_lookup_grad(ctx, ins, attrs):
+    """PS mode: push the sparse rows' gradients to the owning pservers
+    (DownpourWorker push-sparse); nothing flows to a device-side W.
+    Dense mode: scatter-add rows into W@GRAD."""
+    grads = ins.get("Outputs@GRAD", [])
+    if attrs.get("endpoints"):
+        client = _ps_sparse_client(attrs)
+        for ids, g in zip(ins["Ids"], grads):
+            if g is None:
+                continue  # this output has no cotangent
+            flat = ids.reshape(-1)
+            gm = g.reshape(flat.shape[0], -1)
+
+            def cb(ids_np, g_np):
+                client.push(np.asarray(ids_np), np.asarray(g_np))
+                return np.zeros((), np.bool_)
+
+            io_callback(cb, jax.ShapeDtypeStruct((), jnp.bool_), flat,
+                        gm, ordered=True)
+        outs = {}
+        if "W" in ins:
+            outs["W@GRAD"] = [jnp.zeros_like(ins["W"][0])]
+        return outs
+    w = ins["W"][0]
+    wg = jnp.zeros_like(w)
+    for ids, g in zip(ins["Ids"], grads):
+        if g is None:
+            continue
+        flat = ids.reshape(-1) % w.shape[0]
+        wg = wg.at[flat].add(g.reshape(flat.shape[0], -1)
+                             .astype(w.dtype))
+    return {"W@GRAD": [wg]}
+
+
+@register_op("distributed_lookup_table", nondiff_inputs=("Ids",),
+             manual_grad=_distributed_lookup_grad)
 def _distributed_lookup_table(ctx, ins, attrs):
+    """Two modes (distributed_lookup_table_op,
+    parameter_prefetch.cc): with `endpoints` attrs, rows are PULLED from
+    host-sharded pserver tables (SURVEY §7.10 — vocab never materializes
+    on device; only the touched rows cross the wire); otherwise a local
+    dense W lookup. PS mode still wants a small trainable anchor var in
+    the W slot: backward only emits this op's grad (which performs the
+    sparse PUSH) while some differentiable input needs a gradient."""
+    if attrs.get("endpoints"):
+        client = _ps_sparse_client(attrs)
+        dim = int(attrs["emb_dim"])
+        outs = []
+        for ids in ins["Ids"]:
+            flat = ids.reshape(-1)
+
+            def cb(ids_np):
+                return client.pull(np.asarray(ids_np)).astype(np.float32)
+
+            rows = io_callback(
+                cb, jax.ShapeDtypeStruct((flat.shape[0], dim),
+                                         jnp.float32),
+                flat, ordered=True)
+            outs.append(rows.reshape(tuple(ids.shape) + (dim,)))
+        return {"Outputs": outs}
     w = ins["W"][0]
     outs = []
     for ids in ins["Ids"]:
