@@ -46,14 +46,41 @@ def model_flops_per_token(cfg, seq_len):
 
 
 def _timed_steps(exe, prog, feed, loss, steps):
-    # compile + warmup
+    """Device step time with host/transport latency amortized out.
+
+    The chip may sit behind a remote tunnel where every device→host
+    sync costs a full round trip (measured ~70-110 ms here — 2-5x a
+    whole training step). Fetching the loss to numpy every iteration
+    (the naive loop) therefore measures the network, not the TPU.
+    Instead: enqueue `steps` async steps (they serialize on-device via
+    the donated state dict), sync ONCE at the end, and subtract one
+    measured sync RTT. On a locally attached device rtt ~= 0 and this
+    degrades to plain wall-clock timing.
+    """
+    import jax.numpy as jnp
+
+    # compile + warmup (synced)
     exe.run(prog, feed=feed, fetch_list=[loss])
-    exe.run(prog, feed=feed, fetch_list=[loss])
+    x, = exe.run(prog, feed=feed, fetch_list=[loss], return_numpy=False)
+    np.asarray(x)  # drain the queue
+    np.asarray(jnp.zeros(()) + 1)  # compile the probe expression
     t0 = time.perf_counter()
-    lv = None
+    # fresh tiny device value: queue is empty and the probe is already
+    # compiled, so fetching it is one pure host<->device round trip
+    # (np.asarray on an already-fetched array would hit the cached host
+    # copy and measure ~0)
+    np.asarray(jnp.zeros(()) + 1)
+    rtt = time.perf_counter() - t0
+    t0 = time.perf_counter()
     for _ in range(steps):
-        lv, = exe.run(prog, feed=feed, fetch_list=[loss])
-    dt = (time.perf_counter() - t0) / steps
+        x, = exe.run(prog, feed=feed, fetch_list=[loss],
+                     return_numpy=False)
+    lv = np.asarray(x)
+    elapsed = time.perf_counter() - t0
+    # never let the RTT subtraction zero out (or flip the sign of) the
+    # measurement — a tiny model behind a slow tunnel could otherwise
+    # print negative tokens/s
+    dt = max(elapsed - rtt, 0.05 * elapsed) / steps
     return dt, lv
 
 
