@@ -188,6 +188,15 @@ DEFINE_int32(
     "is one (program fingerprint, feed shapes, fetches) specialization. "
     "Reference analogue: the per-program Prepare cache in executor.py.")
 
+DEFINE_string(
+    "prng_impl", "",
+    "PRNG implementation for stateful ops (dropout etc.): '' = jax "
+    "default (threefry2x32, splittable, slowest), 'rbg' = XLA "
+    "RngBitGenerator backed by the TPU hardware RNG (much faster mask "
+    "generation, still reproducible per (seed, step, op)), 'unsafe_rbg' "
+    "= fastest, weakest folding. Reference analogue: the cuRAND-backed "
+    "dropout kernels vs the CPU Philox path.", traced=True)
+
 DEFINE_int32(
     "reader_queue_depth", 2,
     "Default host infeed queue capacity for DataLoader/PyReader when the "

@@ -187,11 +187,21 @@ class Executor:
         mesh = compiled.mesh() if compiled is not None and \
             compiled._is_data_parallel else None
 
+        from .core.flags import FLAGS
+        prng_impl = FLAGS.prng_impl
+        if prng_impl not in ("", "threefry2x32", "rbg", "unsafe_rbg"):
+            raise ValueError(
+                f"FLAGS_prng_impl={prng_impl!r}: expected '', "
+                f"'threefry2x32', 'rbg' or 'unsafe_rbg'")
+
         def step(state, feeds, step_idx):
             env = dict(state)
             env.update(feeds)
-            base_key = jax.random.fold_in(
-                jax.random.PRNGKey(seed), step_idx)
+            if prng_impl:
+                root = jax.random.key(seed, impl=prng_impl)
+            else:
+                root = jax.random.PRNGKey(seed)
+            base_key = jax.random.fold_in(root, step_idx)
             ctx = LowerCtx(base_key, mesh=mesh)
             lower_block(block, env, ctx)
             fetches = [env[n] for n in fetch_names]

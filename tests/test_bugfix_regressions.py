@@ -195,3 +195,41 @@ def test_conditional_block_skipped_output_is_loud():
         assert any("no value" in str(x.message) for x in w)
     # x < x is always false -> branch skipped -> loud NaN, not zeros
     assert np.isnan(out).all()
+
+
+def test_amp_backward_dots_stay_bf16():
+    """Round-3 MFU fix: preferred_element_type=f32 on the matmul
+    lowerings forced an f32 primal, so jax's dot transpose emitted every
+    BACKWARD dot as f32 x f32 (2/3 of training FLOPs off the bf16 MXU
+    path). The AMP-rewritten program must lower with zero f32 dots."""
+    import re
+
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    from paddle_tpu.contrib import mixed_precision as mp
+
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        x = layers.data("ampx", shape=[16], dtype="float32")
+        h = layers.fc(x, size=32, act="relu")
+        y = layers.matmul(h, h, transpose_y=True)
+        loss = layers.mean(y)
+        mp.decorate(fluid.optimizer.SGD(learning_rate=0.1)).minimize(loss)
+        exe = fluid.Executor()
+        exe.run(startup)
+        feed = {"ampx": np.ones((8, 16), np.float32)}
+        exe.run(main, feed=feed, fetch_list=[loss])
+        step_fn = list(exe._cache.values())[-1]
+        state = {n: jnp.asarray(scope.find_var(n))
+                 for n in step_fn.state_in_names}
+        fa = exe._prepare_feed(main.current_block(), feed, None)
+        txt = jax.jit(step_fn.fn).lower(state, fa, jnp.uint32(0)).as_text()
+    dots = re.findall(r"stablehlo\.dot_general[^\n]*->\s*tensor<([0-9x]*)"
+                      r"(\w+)>", txt)
+    assert dots, "expected dot_generals in the lowered step"
+    f32_dots = [s for s, dt in dots if dt == "f32"]
+    assert not f32_dots, f"f32 dots leaked into the AMP step: {f32_dots}"
