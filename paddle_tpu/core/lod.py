@@ -57,13 +57,19 @@ class LoDTensor:
         return self._data if dtype is None else self._data.astype(dtype)
 
     # Pack ragged rows into (padded, lengths) for sequence ops.
-    def to_padded(self, pad_value=0.0):
+    def to_padded(self, pad_value=0.0, multiple=1):
+        """multiple > 1 rounds the pad target up (e.g. to 8): sequence
+        ops mask by lengths so extra padding is correctness-neutral, and
+        bucketing keeps per-shape executable-cache churn bounded for
+        ragged batches whose max length varies step to step."""
         if not self._lod:
             return self._data, None
         level = self._lod[-1]
         lengths = np.asarray([level[i + 1] - level[i]
                               for i in range(len(level) - 1)])
         maxlen = int(lengths.max()) if len(lengths) else 0
+        if multiple > 1 and maxlen % multiple:
+            maxlen += multiple - maxlen % multiple
         feat = self._data.shape[1:]
         out = np.full((len(lengths), maxlen) + feat, pad_value,
                       self._data.dtype)

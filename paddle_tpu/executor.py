@@ -140,13 +140,45 @@ class Executor:
         out = {}
         for name, val in feed.items():
             if hasattr(val, "numpy_value"):  # LoDTensor wrapper
-                val = val.numpy_value()
+                if getattr(val, "lod", lambda: None)():
+                    # ragged feed -> (padded, lengths): the TPU layout
+                    # for LoD data (reference lod_tensor.h offsets).
+                    # The companion lengths var (layers.data lod_level>0
+                    # / program.lod_link) is auto-fed alongside. Pad to
+                    # a multiple of 8 so varying batch max-lengths don't
+                    # churn the per-shape executable cache.
+                    padded, lengths = val.to_padded(multiple=8)
+                    ln = block.program.lod_link.get(name)
+                    if ln and block.has_var(ln) and ln not in feed:
+                        out[ln] = np.asarray(lengths, np.int64)
+                    elif not ln:
+                        import warnings
+                        warnings.warn(
+                            f"feed {name!r} carries LoD but the program "
+                            f"declares no lengths var for it (was it "
+                            f"created with lod_level=0?); sequence ops "
+                            f"will treat padding as real data")
+                    val = padded
+                else:
+                    val = val.numpy_value()
             arr = np.asarray(val)
             if block.has_var(name):
                 want = as_np_dtype(block.var(name).dtype)
                 if arr.dtype != want:
                     arr = arr.astype(want)
             out[name] = arr
+        # Dense-feed fallback for ragged-declared vars: a lod_level>0
+        # program hard-wires Lengths inputs at build time, but a user may
+        # feed an already-padded plain ndarray. Synthesize full-length
+        # lengths (= padded T) so those programs run maskless instead of
+        # crashing on the unfed companion var.
+        for name, ln in block.program.lod_link.items():
+            if (ln not in out and name in out and block.has_var(ln)
+                    and getattr(block.var(ln), "is_data", False)):
+                arr = out[name]
+                if arr.ndim >= 2:
+                    out[ln] = np.full((arr.shape[0],), arr.shape[1],
+                                      np.int64)
         return out
 
     def _cache_key(self, program, feed_arrays, fetch_names, compiled):

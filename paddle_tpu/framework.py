@@ -320,6 +320,14 @@ class Program:
         self._op_counter = 0
         self._version = 1
         self._fp_cache: Optional[str] = None
+        # LoD bookkeeping: var name -> name of its companion sequence-
+        # lengths var. The TPU representation of a ragged (LoD) tensor is
+        # (padded [B, T, ...], lengths [B]) — reference lod_tensor.h:104
+        # carries offsets on the tensor itself; here the link is program
+        # metadata so it survives serialization and build-time layer
+        # propagation (layer_helper.py) keeps it attached to downstream
+        # activations.
+        self.lod_link: Dict[str, str] = {}
 
     def _next_op_id(self):
         self._op_counter += 1
@@ -394,9 +402,12 @@ class Program:
 
     # -- serialization ---------------------------------------------------
     def to_dict(self):
-        return {"version": self._version, "random_seed": self.random_seed,
-                "op_versions": op_version_map(self),
-                "blocks": [b.to_dict() for b in self.blocks]}
+        d = {"version": self._version, "random_seed": self.random_seed,
+             "op_versions": op_version_map(self),
+             "blocks": [b.to_dict() for b in self.blocks]}
+        if self.lod_link:
+            d["lod_link"] = dict(self.lod_link)
+        return d
 
     def to_json(self) -> str:
         return json.dumps(self.to_dict())
@@ -429,6 +440,7 @@ class Program:
             p.blocks.append(blk)
         p._op_counter = max(
             (op.id for b in p.blocks for op in b.ops), default=0)
+        p.lod_link = dict(d.get("lod_link", {}))
         return p
 
     @staticmethod

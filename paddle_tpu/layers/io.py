@@ -17,10 +17,31 @@ def data(name, shape, append_batch_size=True, dtype="float32", lod_level=0,
     shape = list(shape)
     if append_batch_size:
         shape = [-1] + shape
-    for prog in (default_main_program(),):
-        blk = prog.global_block()
-        if blk.has_var(name):
-            return blk.var(name)
-    return default_main_program().global_block().create_var(
+    prog = default_main_program()
+    blk = prog.global_block()
+    if blk.has_var(name):
+        v = blk.var(name)
+        if lod_level > 0 and name not in prog.lod_link:
+            _attach_lengths(prog, name)
+        return v
+    v = prog.global_block().create_var(
         name=name, shape=shape, dtype=dtype, lod_level=lod_level,
         stop_gradient=stop_gradient, is_data=True)
+    if lod_level > 0:
+        _attach_lengths(prog, name)
+    return v
+
+
+def _attach_lengths(prog, name):
+    """Ragged input: the device-side layout is (padded, lengths). A
+    companion lengths var is declared here and auto-fed when the user
+    feeds a LoDTensor (executor._prepare_feed); sequence layers find it
+    through program.lod_link so reference-style programs that never
+    mention lengths stay correct on ragged batches (reference
+    lod_tensor.h LoD offsets, re-expressed)."""
+    ln = f"{name}.lengths"
+    if not prog.global_block().has_var(ln):
+        prog.global_block().create_var(
+            name=ln, shape=[-1], dtype="int64", lod_level=0,
+            stop_gradient=True, is_data=True)
+    prog.lod_link[name] = ln

@@ -14,6 +14,19 @@ __all__ = ["sequence_mask", "sequence_pool", "sequence_softmax",
            "sequence_reverse", "sequence_expand", "sequence_concat"]
 
 
+def _default_lengths(helper, input):
+    """Resolve the ragged input's lengths var through program.lod_link
+    (populated by layers.data(lod_level>0) and propagated across
+    length-preserving ops by LayerHelper). Returns a Variable or None."""
+    name = getattr(input, "name", None)
+    if name is None:
+        return None
+    ln = helper.block.program.lod_link.get(name)
+    if ln is None:
+        return None
+    return helper.block._find_var_recursive(ln)
+
+
 def sequence_mask(x, maxlen=None, dtype="int64"):
     helper = LayerHelper("sequence_mask")
     out = helper.create_variable_for_type_inference(dtype, True)
@@ -28,6 +41,8 @@ def sequence_pool(input, pool_type, lengths=None):
     helper = LayerHelper("sequence_pool")
     out = helper.create_variable_for_type_inference(input.dtype)
     idx = helper.create_variable_for_type_inference("int32", True)
+    if lengths is None:
+        lengths = _default_lengths(helper, input)
     inputs = {"X": [input.name]}
     if lengths is not None:
         inputs["Lengths"] = [lengths.name]
@@ -40,6 +55,8 @@ def sequence_pool(input, pool_type, lengths=None):
 def sequence_softmax(input, lengths=None, name=None):
     helper = LayerHelper("sequence_softmax", name=name)
     out = helper.create_variable_for_type_inference(input.dtype)
+    if lengths is None:
+        lengths = _default_lengths(helper, input)
     inputs = {"X": [input.name]}
     if lengths is not None:
         inputs["Lengths"] = [lengths.name]
@@ -51,6 +68,8 @@ def sequence_softmax(input, lengths=None, name=None):
 def sequence_reverse(x, lengths=None, name=None):
     helper = LayerHelper("sequence_reverse", name=name)
     out = helper.create_variable_for_type_inference(x.dtype)
+    if lengths is None:
+        lengths = _default_lengths(helper, x)
     inputs = {"X": [x.name]}
     if lengths is not None:
         inputs["Lengths"] = [lengths.name]

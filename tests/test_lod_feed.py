@@ -1,0 +1,116 @@
+"""Ragged (LoD) feed path: reference-style sequence programs that never
+mention lengths must stay correct on ragged batches.
+
+Reference semantics: LoDTensor offsets flow through ops and
+sequence_pool reduces each real sequence only (lod_tensor.h:104,
+sequence_pool_op.cc). TPU layout: (padded [B, T, ...], lengths [B]) with
+the lengths var auto-created by layers.data(lod_level>0), auto-fed from
+a LoDTensor by the Executor, and found by sequence layers through
+program.lod_link (propagated across length-preserving ops at build
+time).
+"""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.core.lod import LoDTensor
+from paddle_tpu.data_feeder import DataFeeder
+
+
+def _ragged_batch():
+    rows = [[1, 2, 3], [4, 5], [6, 7, 8, 9], [3]]
+    return rows
+
+
+def test_lod_feed_sequence_pool_sum():
+    vocab, emb_d = 16, 8
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        # reference-style: no lengths anywhere in user code
+        word = layers.data("word", shape=[1], dtype="int64", lod_level=1)
+        emb = layers.embedding(word, size=[vocab, emb_d])
+        pooled = layers.sequence_pool(emb, "sum")
+        exe = fluid.Executor()
+        exe.run(startup)
+
+        rows = _ragged_batch()
+        feeder = DataFeeder(feed_list=[word], program=main)
+        feed = feeder.feed([(r,) for r in rows])
+        assert isinstance(feed["word"], LoDTensor)
+        out, = exe.run(main, feed=feed, fetch_list=[pooled])
+
+        wname = main.all_parameters()[0].name
+        w = np.asarray(scope.find_var(wname))
+        expect = np.stack([w[np.asarray(r)].sum(0) for r in rows])
+        np.testing.assert_allclose(out, expect, rtol=1e-5)
+
+
+def test_lod_feed_max_pool_ignores_padding():
+    vocab, emb_d = 16, 4
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        word = layers.data("word", shape=[1], dtype="int64", lod_level=1)
+        emb = layers.embedding(word, size=[vocab, emb_d])
+        # scale is length-preserving: the link must survive it
+        emb2 = layers.scale(emb, scale=-1.0)
+        pooled = layers.sequence_pool(emb2, "max")
+        exe = fluid.Executor()
+        exe.run(startup)
+        rows = _ragged_batch()
+        t = LoDTensor.from_ragged(rows, "int64")
+        out, = exe.run(main, feed={"word": t}, fetch_list=[pooled])
+        wname = main.all_parameters()[0].name
+        w = np.asarray(scope.find_var(wname))
+        expect = np.stack([(-w[np.asarray(r)]).max(0) for r in rows])
+        # padding is zeros; if max pooling saw the padded rows the result
+        # would be wrong wherever all real values are negative
+        np.testing.assert_allclose(out, expect, rtol=1e-5)
+
+
+def test_lod_link_roundtrips_serialization():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        word = layers.data("word", shape=[1], dtype="int64", lod_level=1)
+        layers.embedding(word, size=[8, 4])
+    clone = fluid.Program.from_json(main.to_json())
+    assert clone.lod_link.get("word") == "word.lengths"
+    # the embedding output is linked too (propagated)
+    assert any(k.startswith("embedding") for k in clone.lod_link)
+
+
+def test_lod_program_accepts_dense_prepadded_feed():
+    """A lod_level>0 program fed a plain pre-padded ndarray must run
+    maskless (full lengths synthesized), not crash on the unfed
+    companion lengths var."""
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        word = layers.data("word", shape=[1], dtype="int64", lod_level=1)
+        emb = layers.embedding(word, size=[16, 4])
+        pooled = layers.sequence_pool(emb, "sum")
+        exe = fluid.Executor()
+        exe.run(startup)
+        dense = np.ones((3, 5, 1), np.int64)
+        out, = exe.run(main, feed={"word": dense}, fetch_list=[pooled])
+        wname = main.all_parameters()[0].name
+        w = np.asarray(scope.find_var(wname))
+        np.testing.assert_allclose(out, np.tile(w[1] * 5, (3, 1)),
+                                   rtol=1e-5)
+
+
+def test_ragged_feed_without_link_warns():
+    import warnings as _w
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        x = layers.data("xr", shape=[2], dtype="float32")  # lod_level=0
+        y = layers.scale(x, scale=2.0)
+        exe = fluid.Executor()
+        exe.run(startup)
+        t = LoDTensor.from_ragged([[[1.0, 2.0]], [[3.0, 4.0]]], "float32")
+        with _w.catch_warnings(record=True) as rec:
+            _w.simplefilter("always")
+            exe.run(main, feed={"xr": t}, fetch_list=[y])
+        assert any("no lengths var" in str(r.message) for r in rec)
