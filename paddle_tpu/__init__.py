@@ -18,8 +18,8 @@ changing the import.
 from . import ops  # noqa: F401  — registers all op lowerings
 from .framework import (Program, program_guard, default_main_program,  # noqa: F401
                         default_startup_program, name_scope, unique_name,
-                        ParamAttr, Variable, in_dygraph_mode, cpu_places,
-                        load_op_library)
+                        ParamAttr, WeightNormParamAttr, Variable,
+                        in_dygraph_mode, cpu_places, load_op_library)
 from .core.place import (CPUPlace, XLAPlace, TPUPlace, CUDAPlace,  # noqa: F401
                          CUDAPinnedPlace)
 from .core.scope import Scope, global_scope, scope_guard  # noqa: F401

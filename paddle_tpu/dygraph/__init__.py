@@ -319,7 +319,9 @@ def _run_backward(loss: VarBase):
 from .layers import Layer  # noqa: E402,F401
 from .checkpoint import save_dygraph, load_dygraph  # noqa: E402,F401
 from .nn import (Conv2D, Pool2D, FC, Linear, BatchNorm, Embedding,  # noqa: E402,F401
-                 LayerNorm, Dropout)
+                 LayerNorm, Dropout, GroupNorm, PRelu, Conv3D,
+                 Conv2DTranspose, Conv3DTranspose, GRUUnit, NCE,
+                 BilinearTensorProduct, SpectralNorm, TreeConv)
 from .parallel import DataParallel, prepare_context  # noqa: E402,F401
 from .base import grad  # noqa: E402,F401
 from . import jit  # noqa: E402,F401

@@ -10,7 +10,9 @@ from .layers import Layer
 from ..initializer import Constant, Normal
 
 __all__ = ["Conv2D", "Pool2D", "FC", "Linear", "BatchNorm", "Embedding",
-           "LayerNorm", "Dropout", "GroupNorm", "PRelu"]
+           "LayerNorm", "Dropout", "GroupNorm", "PRelu", "Conv3D",
+           "Conv2DTranspose", "Conv3DTranspose", "GRUUnit", "NCE",
+           "BilinearTensorProduct", "SpectralNorm", "TreeConv"]
 
 
 class Conv2D(Layer):
@@ -255,3 +257,258 @@ class PRelu(Layer):
     def forward(self, x):
         return trace_op("prelu", {"X": [x], "Alpha": [self.weight]},
                         {"mode": self._mode})["Out"][0]
+
+
+class Conv3D(Layer):
+    def __init__(self, name_scope=None, num_channels=None, num_filters=None,
+                 filter_size=None, stride=1, padding=0, dilation=1,
+                 groups=None, param_attr=None, bias_attr=None,
+                 use_cudnn=True, act=None, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._groups = groups or 1
+        def _3(v):
+            return [v] * 3 if isinstance(v, int) else list(v)
+        self._stride = _3(stride)
+        self._padding = _3(padding)
+        self._dilation = _3(dilation)
+        self._act = act
+        fs = _3(filter_size)
+        fan = int(np.prod(fs)) * num_channels
+        self.weight = self.create_parameter(
+            [num_filters, num_channels // self._groups] + fs, dtype,
+            initializer=Normal(0.0, (2.0 / fan) ** 0.5))
+        self.bias = (None if bias_attr is False else
+                     self.create_parameter([num_filters], dtype,
+                                           is_bias=True))
+
+    def forward(self, x):
+        out = trace_op("conv3d", {"Input": [x], "Filter": [self.weight]},
+                       {"strides": self._stride, "paddings": self._padding,
+                        "dilations": self._dilation,
+                        "groups": self._groups})["Output"][0]
+        if self.bias is not None:
+            out = trace_op("elementwise_add",
+                           {"X": [out], "Y": [self.bias]},
+                           {"axis": 1})["Out"][0]
+        if self._act:
+            out = trace_op(self._act, {"X": [out]}, {})["Out"][0]
+        return out
+
+
+class Conv2DTranspose(Layer):
+    def __init__(self, name_scope=None, num_channels=None, num_filters=None,
+                 filter_size=None, output_size=None, padding=0, stride=1,
+                 dilation=1, groups=None, param_attr=None, bias_attr=None,
+                 use_cudnn=True, act=None, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._groups = groups or 1
+        def _2(v):
+            return [v] * 2 if isinstance(v, int) else list(v)
+        self._stride = _2(stride)
+        self._padding = _2(padding)
+        self._dilation = _2(dilation)
+        self._output_size = output_size
+        self._act = act
+        fs = _2(filter_size)
+        self.weight = self.create_parameter(
+            [num_channels, num_filters // self._groups] + fs, dtype,
+            initializer=Normal(0.0, 0.02))
+        self.bias = (None if bias_attr is False else
+                     self.create_parameter([num_filters], dtype,
+                                           is_bias=True))
+
+    def forward(self, x):
+        if self._output_size is not None:
+            fs = self.weight.shape[-2:]
+            got = [(int(x.shape[2 + i]) - 1) * self._stride[i]
+                   - 2 * self._padding[i]
+                   + self._dilation[i] * (fs[i] - 1) + 1 for i in range(2)]
+            want = list(self._output_size)
+            if got != want:
+                raise ValueError(
+                    f"Conv2DTranspose: output_size {want} unreachable "
+                    f"with stride/padding/filter (natural output {got}); "
+                    f"adjust padding or filter_size")
+        out = trace_op("conv2d_transpose",
+                       {"Input": [x], "Filter": [self.weight]},
+                       {"strides": self._stride, "paddings": self._padding,
+                        "dilations": self._dilation,
+                        "groups": self._groups})["Output"][0]
+        if self.bias is not None:
+            out = trace_op("elementwise_add",
+                           {"X": [out], "Y": [self.bias]},
+                           {"axis": 1})["Out"][0]
+        if self._act:
+            out = trace_op(self._act, {"X": [out]}, {})["Out"][0]
+        return out
+
+
+class Conv3DTranspose(Layer):
+    def __init__(self, name_scope=None, num_channels=None, num_filters=None,
+                 filter_size=None, output_size=None, padding=0, stride=1,
+                 dilation=1, groups=None, param_attr=None, bias_attr=None,
+                 use_cudnn=True, act=None, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._groups = groups or 1
+        def _3(v):
+            return [v] * 3 if isinstance(v, int) else list(v)
+        self._stride = _3(stride)
+        self._padding = _3(padding)
+        self._dilation = _3(dilation)
+        self._output_size = output_size
+        self._act = act
+        fs = _3(filter_size)
+        self.weight = self.create_parameter(
+            [num_channels, num_filters // self._groups] + fs, dtype,
+            initializer=Normal(0.0, 0.02))
+        self.bias = (None if bias_attr is False else
+                     self.create_parameter([num_filters], dtype,
+                                           is_bias=True))
+
+    def forward(self, x):
+        if self._output_size is not None:
+            fs = self.weight.shape[-3:]
+            got = [(int(x.shape[2 + i]) - 1) * self._stride[i]
+                   - 2 * self._padding[i]
+                   + self._dilation[i] * (fs[i] - 1) + 1 for i in range(3)]
+            want = list(self._output_size)
+            if got != want:
+                raise ValueError(
+                    f"Conv3DTranspose: output_size {want} unreachable "
+                    f"with stride/padding/filter (natural output {got}); "
+                    f"adjust padding or filter_size")
+        out = trace_op("conv3d_transpose",
+                       {"Input": [x], "Filter": [self.weight]},
+                       {"strides": self._stride, "paddings": self._padding,
+                        "dilations": self._dilation,
+                        "groups": self._groups})["Output"][0]
+        if self.bias is not None:
+            out = trace_op("elementwise_add",
+                           {"X": [out], "Y": [self.bias]},
+                           {"axis": 1})["Out"][0]
+        if self._act:
+            out = trace_op(self._act, {"X": [out]}, {})["Out"][0]
+        return out
+
+
+class GRUUnit(Layer):
+    def __init__(self, name_scope=None, size=None, param_attr=None,
+                 bias_attr=None, activation="tanh",
+                 gate_activation="sigmoid", origin_mode=False,
+                 dtype="float32"):
+        super().__init__(name_scope, dtype)
+        d = size // 3
+        self._attrs = {"activation": activation,
+                       "gate_activation": gate_activation,
+                       "origin_mode": origin_mode}
+        self.weight = self.create_parameter([d, d * 3], dtype)
+        self.bias = (None if bias_attr is False else
+                     self.create_parameter([1, d * 3], dtype,
+                                           is_bias=True))
+
+    def forward(self, input, hidden):
+        ins = {"Input": [input], "HiddenPrev": [hidden],
+               "Weight": [self.weight]}
+        if self.bias is not None:
+            ins["Bias"] = [self.bias]
+        out = trace_op("gru_unit", ins, self._attrs)
+        return (out["Hidden"][0], out["ResetHiddenPrev"][0],
+                out["Gate"][0])
+
+
+class NCE(Layer):
+    def __init__(self, name_scope=None, num_total_classes=None, dim=None,
+                 sample_weight=None, param_attr=None, bias_attr=None,
+                 num_neg_samples=None, sampler="uniform", custom_dist=None,
+                 seed=0, is_sparse=False, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._attrs = {"num_total_classes": num_total_classes,
+                       "num_neg_samples": num_neg_samples or 10,
+                       "seed": seed}
+        self.weight = self.create_parameter([num_total_classes, dim],
+                                            dtype)
+        self.bias = (None if bias_attr is False else
+                     self.create_parameter([num_total_classes], dtype,
+                                           is_bias=True))
+
+    def forward(self, input, label, sample_weight=None):
+        ins = {"Input": [input], "Label": [label],
+               "Weight": [self.weight]}
+        if self.bias is not None:
+            ins["Bias"] = [self.bias]
+        return trace_op("nce", ins, self._attrs)["Cost"][0]
+
+
+class BilinearTensorProduct(Layer):
+    def __init__(self, name_scope=None, size=None, x_dim=None, y_dim=None,
+                 name=None, act=None, param_attr=None, bias_attr=None,
+                 dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._act = act
+        self.weight = self.create_parameter([size, x_dim, y_dim], dtype)
+        self.bias = (None if bias_attr is False else
+                     self.create_parameter([1, size], dtype, is_bias=True))
+
+    def forward(self, x, y):
+        ins = {"X": [x], "Y": [y], "Weight": [self.weight]}
+        if self.bias is not None:
+            ins["Bias"] = [self.bias]
+        out = trace_op("bilinear_tensor_product", ins, {})["Out"][0]
+        if self._act:
+            out = trace_op(self._act, {"X": [out]}, {})["Out"][0]
+        return out
+
+
+class SpectralNorm(Layer):
+    def __init__(self, name_scope=None, weight_shape=None, dim=0,
+                 power_iters=1, eps=1e-12, name=None, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._attrs = {"dim": dim, "power_iters": power_iters, "eps": eps}
+        h = weight_shape[dim]
+        w = int(np.prod(weight_shape)) // h
+        self.weight_u = self.create_parameter(
+            [h], dtype, initializer=Normal(0.0, 1.0))
+        self.weight_v = self.create_parameter(
+            [w], dtype, initializer=Normal(0.0, 1.0))
+
+    def forward(self, weight):
+        return trace_op("spectral_norm",
+                        {"Weight": [weight], "U": [self.weight_u],
+                         "V": [self.weight_v]},
+                        self._attrs)["Out"][0]
+
+
+class TreeConv(Layer):
+    def __init__(self, name_scope=None, output_size=None, num_filters=1,
+                 max_depth=8, act="tanh", param_attr=None, bias_attr=None,
+                 name=None, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._act = act
+        self._feature_size = None
+        self._output_size = output_size
+        self._num_filters = num_filters
+        self._max_depth = max_depth
+        self.weight = None
+        self.bias = None
+        self._bias_attr = bias_attr
+
+    def forward(self, nodes_vector, edge_set):
+        if self.weight is None:
+            feature = int(nodes_vector.shape[-1])
+            self.weight = self.create_parameter(
+                [feature, 3, self._output_size, self._num_filters],
+                self._dtype)
+            if self._bias_attr is not False:
+                self.bias = self.create_parameter(
+                    [self._num_filters], self._dtype, is_bias=True)
+        out = trace_op("tree_conv",
+                       {"NodesVector": [nodes_vector],
+                        "EdgeSet": [edge_set], "Filter": [self.weight]},
+                       {"max_depth": self._max_depth})["Out"][0]
+        if self.bias is not None:
+            out = trace_op("elementwise_add",
+                           {"X": [out], "Y": [self.bias]},
+                           {"axis": -1})["Out"][0]
+        if self._act:
+            out = trace_op(self._act, {"X": [out]}, {})["Out"][0]
+        return out

@@ -559,6 +559,20 @@ class ParamAttr:
         raise TypeError(f"bad ParamAttr spec {arg!r}")
 
 
+class WeightNormParamAttr(ParamAttr):
+    """Weight-normalized parameter attribute (reference: param_attr.py
+    WeightNormParamAttr — reparameterizes w = g * v / ||v||). The `dim`
+    is recorded; LayerHelper treats it as a plain ParamAttr (the
+    normalization itself is an optimizer/graph rewrite concern)."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, gradient_clip=None):
+        super().__init__(name, initializer, learning_rate, regularizer,
+                         trainable, do_model_average, gradient_clip)
+        self.dim = dim
+
+
 def load_op_library(lib_path):
     """Load user-defined ops into the registry.
 

@@ -24,3 +24,7 @@ from .rnn import rnn as rnn_fn  # noqa: F401  (module name shadows the fn)
 from . import sequence  # noqa: F401
 from .sequence import *  # noqa: F401,F403
 from .dist import *  # noqa: F401,F403
+from .detection import *  # noqa: F401,F403
+from .parity import *  # noqa: F401,F403
+from .distributions import (Uniform, Normal, Categorical,  # noqa: F401
+                            MultivariateNormalDiag)

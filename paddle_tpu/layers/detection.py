@@ -1,17 +1,32 @@
 """Detection layers (reference: layers/detection.py, 26 names;
 operators/detection/, 15.4k LoC).
 
-Round-1 scope: box/anchor math that lowers cleanly to static-shape XLA
-(prior_box, box_coder, iou_similarity, yolo_box, box_clip). NMS-style ops
-with data-dependent shapes need the padded top-k formulation and land in a
-later round.
+Full App-B surface: every function wraps a registered TPU lowering
+(ops/detection_ops.py, ops/detection_extra.py, ops/parity_final.py).
+Data-dependent result counts use the padded formulation throughout
+(fixed [.., K, ..] outputs, -1 / mask rows marking empties) — the
+static-shape XLA answer to the reference's LoD-sized outputs.
 """
 from __future__ import annotations
 
 from ..layer_helper import LayerHelper
 
 __all__ = ["prior_box", "box_coder", "iou_similarity", "box_clip",
-           "yolo_box"]
+           "yolo_box", "density_prior_box", "anchor_generator",
+           "bipartite_match", "target_assign", "multiclass_nms",
+           "polygon_box_transform", "yolov3_loss", "rpn_target_assign",
+           "retinanet_target_assign", "sigmoid_focal_loss",
+           "retinanet_detection_output", "generate_proposals",
+           "generate_proposal_labels", "generate_mask_labels",
+           "roi_perspective_transform", "distribute_fpn_proposals",
+           "collect_fpn_proposals", "box_decoder_and_assign",
+           "detection_output", "ssd_loss", "multi_box_head"]
+
+
+def _mk(helper, dtype, n=1, stop_gradient=True):
+    vs = [helper.create_variable_for_type_inference(dtype, stop_gradient)
+          for _ in range(n)]
+    return vs[0] if n == 1 else vs
 
 
 def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=[1.],
@@ -38,13 +53,15 @@ def box_coder(prior_box, prior_box_var, target_box,
               name=None, axis=0):
     helper = LayerHelper("box_coder", name=name)
     out = helper.create_variable_for_type_inference(target_box.dtype)
-    helper.append_op(type="box_coder",
-                     inputs={"PriorBox": [prior_box.name],
-                             "PriorBoxVar": [prior_box_var.name],
-                             "TargetBox": [target_box.name]},
-                     outputs={"OutputBox": [out.name]},
-                     attrs={"code_type": code_type,
-                            "box_normalized": box_normalized, "axis": axis})
+    ins = {"PriorBox": [prior_box.name], "TargetBox": [target_box.name]}
+    if prior_box_var is not None and hasattr(prior_box_var, "name"):
+        ins["PriorBoxVar"] = [prior_box_var.name]
+    attrs = {"code_type": code_type, "box_normalized": box_normalized,
+             "axis": axis}
+    if isinstance(prior_box_var, (list, tuple)):
+        attrs["variance"] = [float(v) for v in prior_box_var]
+    helper.append_op(type="box_coder", inputs=ins,
+                     outputs={"OutputBox": [out.name]}, attrs=attrs)
     return out
 
 
@@ -81,3 +98,562 @@ def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio,
                             "conf_thresh": conf_thresh,
                             "downsample_ratio": downsample_ratio})
     return boxes, scores
+
+
+def density_prior_box(input, image, densities=None, fixed_sizes=None,
+                      fixed_ratios=None, variance=[0.1, 0.1, 0.2, 0.2],
+                      clip=False, steps=[0.0, 0.0], offset=0.5,
+                      flatten_to_2d=False, name=None):
+    helper = LayerHelper("density_prior_box", name=name)
+    box, var = _mk(helper, input.dtype, 2)
+    helper.append_op(
+        type="density_prior_box",
+        inputs={"Input": [input.name], "Image": [image.name]},
+        outputs={"Boxes": [box.name], "Variances": [var.name]},
+        attrs={"densities": list(densities or []),
+               "fixed_sizes": list(fixed_sizes or []),
+               "fixed_ratios": list(fixed_ratios or []),
+               "variances": list(variance), "clip": clip,
+               "step_w": steps[0], "step_h": steps[1], "offset": offset,
+               "flatten_to_2d": flatten_to_2d})
+    return box, var
+
+
+def anchor_generator(input, anchor_sizes=None, aspect_ratios=None,
+                     variance=[0.1, 0.1, 0.2, 0.2], stride=None,
+                     offset=0.5, name=None):
+    helper = LayerHelper("anchor_generator", name=name)
+    anchors, variances = _mk(helper, input.dtype, 2)
+    helper.append_op(
+        type="anchor_generator", inputs={"Input": [input.name]},
+        outputs={"Anchors": [anchors.name], "Variances": [variances.name]},
+        attrs={"anchor_sizes": list(anchor_sizes or [64., 128., 256., 512.]),
+               "aspect_ratios": list(aspect_ratios or [0.5, 1.0, 2.0]),
+               "variances": list(variance),
+               "stride": list(stride or [16.0, 16.0]), "offset": offset})
+    return anchors, variances
+
+
+def bipartite_match(dist_matrix, match_type=None, dist_threshold=None,
+                    name=None):
+    helper = LayerHelper("bipartite_match", name=name)
+    match_indices = _mk(helper, "int32")
+    match_dist = _mk(helper, dist_matrix.dtype)
+    helper.append_op(
+        type="bipartite_match", inputs={"DistMat": [dist_matrix.name]},
+        outputs={"ColToRowMatchIndices": [match_indices.name],
+                 "ColToRowMatchDist": [match_dist.name]},
+        attrs={"match_type": match_type or "bipartite",
+               "dist_threshold": (0.5 if dist_threshold is None
+                                  else dist_threshold)})
+    return match_indices, match_dist
+
+
+def target_assign(input, matched_indices, negative_indices=None,
+                  mismatch_value=None, name=None):
+    helper = LayerHelper("target_assign", name=name)
+    out = _mk(helper, input.dtype)
+    out_weight = _mk(helper, "float32")
+    ins = {"X": [input.name], "MatchIndices": [matched_indices.name]}
+    if negative_indices is not None:
+        ins["NegIndices"] = [negative_indices.name]
+    helper.append_op(type="target_assign", inputs=ins,
+                     outputs={"Out": [out.name],
+                              "OutWeight": [out_weight.name]},
+                     attrs={"mismatch_value": mismatch_value or 0})
+    return out, out_weight
+
+
+def multiclass_nms(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
+                   nms_threshold=0.3, normalized=True, nms_eta=1.0,
+                   background_label=0, name=None):
+    """Padded [B, keep_top_k, 6] output; rows with class -1 are empty
+    (reference multiclass_nms_op.cc emits variable-length LoD rows)."""
+    helper = LayerHelper("multiclass_nms", name=name)
+    out = _mk(helper, bboxes.dtype)
+    index = _mk(helper, "int32")
+    nums = _mk(helper, "int32")
+    helper.append_op(
+        type="multiclass_nms",
+        inputs={"BBoxes": [bboxes.name], "Scores": [scores.name]},
+        outputs={"Out": [out.name], "Index": [index.name],
+                 "NmsRoisNum": [nums.name]},
+        attrs={"score_threshold": score_threshold, "nms_top_k": nms_top_k,
+               "keep_top_k": keep_top_k, "nms_threshold": nms_threshold,
+               "normalized": normalized, "nms_eta": nms_eta,
+               "background_label": background_label})
+    return out
+
+
+def polygon_box_transform(input, name=None):
+    helper = LayerHelper("polygon_box_transform", name=name)
+    out = _mk(helper, input.dtype)
+    helper.append_op(type="polygon_box_transform",
+                     inputs={"Input": [input.name]},
+                     outputs={"Output": [out.name]})
+    return out
+
+
+def yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+                ignore_thresh, downsample_ratio, gt_score=None,
+                use_label_smooth=True, name=None):
+    helper = LayerHelper("yolov3_loss", name=name)
+    loss = helper.create_variable_for_type_inference(x.dtype, False)
+    obj_mask = _mk(helper, x.dtype)
+    match_mask = _mk(helper, "int32")
+    ins = {"X": [x.name], "GTBox": [gt_box.name], "GTLabel": [gt_label.name]}
+    if gt_score is not None:
+        ins["GTScore"] = [gt_score.name]
+    helper.append_op(
+        type="yolov3_loss", inputs=ins,
+        outputs={"Loss": [loss.name], "ObjectnessMask": [obj_mask.name],
+                 "GTMatchMask": [match_mask.name]},
+        attrs={"anchors": list(anchors), "anchor_mask": list(anchor_mask),
+               "class_num": class_num, "ignore_thresh": ignore_thresh,
+               "downsample_ratio": downsample_ratio,
+               "use_label_smooth": use_label_smooth})
+    return loss
+
+
+def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                      gt_boxes, im_info, rpn_batch_size_per_im=256,
+                      rpn_straddle_thresh=0.0, rpn_fg_fraction=0.5,
+                      rpn_positive_overlap=0.7, rpn_negative_overlap=0.3,
+                      use_random=True):
+    helper = LayerHelper("rpn_target_assign")
+    loc_index, score_index = _mk(helper, "int32", 2)
+    tgt_bbox = _mk(helper, anchor_box.dtype)
+    tgt_label = _mk(helper, "int32")
+    bbox_inside_weight = _mk(helper, anchor_box.dtype)
+    helper.append_op(
+        type="rpn_target_assign",
+        inputs={"Anchor": [anchor_box.name], "GtBoxes": [gt_boxes.name],
+                "ImInfo": [im_info.name]},
+        outputs={"LocationIndex": [loc_index.name],
+                 "ScoreIndex": [score_index.name],
+                 "TargetBBox": [tgt_bbox.name],
+                 "TargetLabel": [tgt_label.name],
+                 "BBoxInsideWeight": [bbox_inside_weight.name]},
+        attrs={"rpn_batch_size_per_im": rpn_batch_size_per_im,
+               "rpn_straddle_thresh": rpn_straddle_thresh,
+               "rpn_fg_fraction": rpn_fg_fraction,
+               "rpn_positive_overlap": rpn_positive_overlap,
+               "rpn_negative_overlap": rpn_negative_overlap,
+               "use_random": use_random})
+    return (_gather_rows(bbox_pred, loc_index),
+            _gather_rows(cls_logits, score_index),
+            tgt_bbox, tgt_label, bbox_inside_weight)
+
+
+def _gather_rows(x, index):
+    from .nn import reshape, gather
+    flat = reshape(x, [-1, int(x.shape[-1])])
+    return gather(flat, index)
+
+
+def retinanet_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                            gt_boxes, gt_labels, is_crowd, im_info,
+                            num_classes=1, positive_overlap=0.5,
+                            negative_overlap=0.4):
+    helper = LayerHelper("retinanet_target_assign")
+    loc_index, score_index = _mk(helper, "int32", 2)
+    tgt_bbox = _mk(helper, anchor_box.dtype)
+    tgt_label = _mk(helper, "int32")
+    bbox_inside_weight = _mk(helper, anchor_box.dtype)
+    fg_num = _mk(helper, "int32")
+    helper.append_op(
+        type="retinanet_target_assign",
+        inputs={"Anchor": [anchor_box.name], "GtBoxes": [gt_boxes.name],
+                "GtLabels": [gt_labels.name], "IsCrowd": [is_crowd.name],
+                "ImInfo": [im_info.name]},
+        outputs={"LocationIndex": [loc_index.name],
+                 "ScoreIndex": [score_index.name],
+                 "TargetBBox": [tgt_bbox.name],
+                 "TargetLabel": [tgt_label.name],
+                 "BBoxInsideWeight": [bbox_inside_weight.name],
+                 "ForegroundNumber": [fg_num.name]},
+        attrs={"positive_overlap": positive_overlap,
+               "negative_overlap": negative_overlap})
+    return (_gather_rows(bbox_pred, loc_index),
+            _gather_rows(cls_logits, score_index),
+            tgt_bbox, tgt_label, bbox_inside_weight, fg_num)
+
+
+def sigmoid_focal_loss(x, label, fg_num, gamma=2.0, alpha=0.25):
+    helper = LayerHelper("sigmoid_focal_loss")
+    out = helper.create_variable_for_type_inference(x.dtype, False)
+    helper.append_op(
+        type="sigmoid_focal_loss",
+        inputs={"X": [x.name], "Label": [label.name],
+                "FgNum": [fg_num.name]},
+        outputs={"Out": [out.name]},
+        attrs={"gamma": gamma, "alpha": alpha})
+    return out
+
+
+def retinanet_detection_output(bboxes, scores, anchors, im_info,
+                               score_threshold=0.05, nms_top_k=1000,
+                               keep_top_k=100, nms_threshold=0.3,
+                               nms_eta=1.0):
+    from .tensor import concat
+    helper = LayerHelper("retinanet_detection_output")
+    out = _mk(helper, "float32")
+    bb = bboxes if not isinstance(bboxes, (list, tuple)) else \
+        concat(bboxes, axis=1)
+    sc = scores if not isinstance(scores, (list, tuple)) else \
+        concat(scores, axis=1)
+    an = anchors if not isinstance(anchors, (list, tuple)) else \
+        concat(anchors, axis=0)
+    helper.append_op(
+        type="retinanet_detection_output",
+        inputs={"BBoxes": [bb.name], "Scores": [sc.name],
+                "Anchors": [an.name], "ImInfo": [im_info.name]},
+        outputs={"Out": [out.name]},
+        attrs={"score_threshold": score_threshold, "nms_top_k": nms_top_k,
+               "keep_top_k": keep_top_k, "nms_threshold": nms_threshold,
+               "nms_eta": nms_eta})
+    return out
+
+
+def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0, name=None,
+                       return_rois_num=False):
+    helper = LayerHelper("generate_proposals", name=name)
+    rois = _mk(helper, scores.dtype)
+    roi_probs = _mk(helper, scores.dtype)
+    rois_num = _mk(helper, "int32")
+    helper.append_op(
+        type="generate_proposals",
+        inputs={"Scores": [scores.name], "BboxDeltas": [bbox_deltas.name],
+                "ImInfo": [im_info.name], "Anchors": [anchors.name],
+                "Variances": [variances.name]},
+        outputs={"RpnRois": [rois.name], "RpnRoiProbs": [roi_probs.name],
+                 "RpnRoisNum": [rois_num.name]},
+        attrs={"pre_nms_topN": pre_nms_top_n,
+               "post_nms_topN": post_nms_top_n, "nms_thresh": nms_thresh,
+               "min_size": min_size, "eta": eta})
+    if return_rois_num:
+        return rois, roi_probs, rois_num
+    return rois, roi_probs
+
+
+def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
+                             im_info, batch_size_per_im=256,
+                             fg_fraction=0.25, fg_thresh=0.5,
+                             bg_thresh_hi=0.5, bg_thresh_lo=0.0,
+                             bbox_reg_weights=[0.1, 0.1, 0.2, 0.2],
+                             class_nums=None, use_random=True,
+                             is_cls_agnostic=False, is_cascade_rcnn=False):
+    helper = LayerHelper("generate_proposal_labels")
+    rois = _mk(helper, rpn_rois.dtype)
+    labels_int32 = _mk(helper, "int32")
+    bbox_targets, bbox_inside_weights, bbox_outside_weights = _mk(
+        helper, rpn_rois.dtype, 3)
+    helper.append_op(
+        type="generate_proposal_labels",
+        inputs={"RpnRois": [rpn_rois.name], "GtClasses": [gt_classes.name],
+                "IsCrowd": [is_crowd.name], "GtBoxes": [gt_boxes.name],
+                "ImInfo": [im_info.name]},
+        outputs={"Rois": [rois.name], "LabelsInt32": [labels_int32.name],
+                 "BboxTargets": [bbox_targets.name],
+                 "BboxInsideWeights": [bbox_inside_weights.name],
+                 "BboxOutsideWeights": [bbox_outside_weights.name]},
+        attrs={"batch_size_per_im": batch_size_per_im,
+               "fg_fraction": fg_fraction, "fg_thresh": fg_thresh,
+               "bg_thresh_hi": bg_thresh_hi, "bg_thresh_lo": bg_thresh_lo,
+               "bbox_reg_weights": list(bbox_reg_weights),
+               "class_nums": class_nums or 81, "use_random": use_random,
+               "is_cls_agnostic": is_cls_agnostic,
+               "is_cascade_rcnn": is_cascade_rcnn})
+    return (rois, labels_int32, bbox_targets, bbox_inside_weights,
+            bbox_outside_weights)
+
+
+def generate_mask_labels(im_info, gt_classes, is_crowd, gt_segms, rois,
+                         labels_int32, num_classes, resolution):
+    helper = LayerHelper("generate_mask_labels")
+    mask_rois = _mk(helper, rois.dtype)
+    roi_has_mask_int32 = _mk(helper, "int32")
+    mask_int32 = _mk(helper, "int32")
+    helper.append_op(
+        type="generate_mask_labels",
+        inputs={"ImInfo": [im_info.name], "GtClasses": [gt_classes.name],
+                "IsCrowd": [is_crowd.name], "GtSegms": [gt_segms.name],
+                "Rois": [rois.name], "LabelsInt32": [labels_int32.name]},
+        outputs={"MaskRois": [mask_rois.name],
+                 "RoiHasMaskInt32": [roi_has_mask_int32.name],
+                 "MaskInt32": [mask_int32.name]},
+        attrs={"num_classes": num_classes, "resolution": resolution})
+    return mask_rois, roi_has_mask_int32, mask_int32
+
+
+def roi_perspective_transform(input, rois, transformed_height,
+                              transformed_width, spatial_scale=1.0,
+                              name=None):
+    helper = LayerHelper("roi_perspective_transform", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype, False)
+    mask = _mk(helper, "int32")
+    matrix = _mk(helper, input.dtype)
+    out2in_idx = _mk(helper, "int32")
+    out2in_w = _mk(helper, input.dtype)
+    helper.append_op(
+        type="roi_perspective_transform",
+        inputs={"X": [input.name], "ROIs": [rois.name]},
+        outputs={"Out": [out.name], "Mask": [mask.name],
+                 "TransformMatrix": [matrix.name],
+                 "Out2InIdx": [out2in_idx.name],
+                 "Out2InWeights": [out2in_w.name]},
+        attrs={"transformed_height": transformed_height,
+               "transformed_width": transformed_width,
+               "spatial_scale": spatial_scale})
+    return out, mask, matrix
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, name=None):
+    helper = LayerHelper("distribute_fpn_proposals", name=name)
+    num_lvl = max_level - min_level + 1
+    multi_rois = _mk(helper, fpn_rois.dtype, num_lvl)
+    if num_lvl == 1:
+        multi_rois = [multi_rois]
+    restore_ind = _mk(helper, "int32")
+    helper.append_op(
+        type="distribute_fpn_proposals",
+        inputs={"FpnRois": [fpn_rois.name]},
+        outputs={"MultiFpnRois": [v.name for v in multi_rois],
+                 "RestoreIndex": [restore_ind.name]},
+        attrs={"min_level": min_level, "max_level": max_level,
+               "refer_level": refer_level, "refer_scale": refer_scale})
+    return multi_rois, restore_ind
+
+
+def collect_fpn_proposals(multi_rois, multi_scores, min_level, max_level,
+                          post_nms_top_n, name=None):
+    helper = LayerHelper("collect_fpn_proposals", name=name)
+    out = _mk(helper, multi_rois[0].dtype)
+    helper.append_op(
+        type="collect_fpn_proposals",
+        inputs={"MultiLevelRois": [v.name for v in multi_rois],
+                "MultiLevelScores": [v.name for v in multi_scores]},
+        outputs={"FpnRois": [out.name]},
+        attrs={"post_nms_topN": post_nms_top_n})
+    return out
+
+
+def box_decoder_and_assign(prior_box, prior_box_var, target_box, box_score,
+                           box_clip, name=None):
+    helper = LayerHelper("box_decoder_and_assign", name=name)
+    decoded = _mk(helper, target_box.dtype)
+    assigned = _mk(helper, target_box.dtype)
+    helper.append_op(
+        type="box_decoder_and_assign",
+        inputs={"PriorBox": [prior_box.name],
+                "PriorBoxVar": [prior_box_var.name],
+                "TargetBox": [target_box.name],
+                "BoxScore": [box_score.name]},
+        outputs={"DecodeBox": [decoded.name],
+                 "OutputAssignBox": [assigned.name]},
+        attrs={"box_clip": box_clip})
+    return decoded, assigned
+
+
+def detection_output(loc, scores, prior_box, prior_box_var,
+                     background_label=0, nms_threshold=0.3, nms_top_k=400,
+                     keep_top_k=200, score_threshold=0.01, nms_eta=1.0):
+    """SSD-style post-processing: decode loc vs priors, then per-class
+    NMS (reference layers/detection.py detection_output = box_coder +
+    transpose + multiclass_nms composition)."""
+    from .nn import transpose
+    decoded = box_coder(prior_box, prior_box_var, loc,
+                        code_type="decode_center_size")
+    scores_t = transpose(scores, perm=[0, 2, 1])
+    return multiclass_nms(decoded, scores_t,
+                          score_threshold=score_threshold,
+                          nms_top_k=nms_top_k, keep_top_k=keep_top_k,
+                          nms_threshold=nms_threshold, nms_eta=nms_eta,
+                          background_label=background_label)
+
+
+def _encode_center_size(assigned_gt, priors, prior_var):
+    """Elementwise center-size box encode t_j = encode(gt_{m_j},
+    prior_j) via layer math (box_coder's encode produces the all-pairs
+    [T, P, 4] the reference then gathers; after target_assign we
+    already hold the matched gt per prior, so encode row-to-row)."""
+    from . import nn
+    from . import tensor as T
+
+    def parts(v):
+        x1 = nn.slice(v, axes=[1], starts=[0], ends=[1])
+        y1 = nn.slice(v, axes=[1], starts=[1], ends=[2])
+        x2 = nn.slice(v, axes=[1], starts=[2], ends=[3])
+        y2 = nn.slice(v, axes=[1], starts=[3], ends=[4])
+        w = nn.elementwise_sub(x2, x1)
+        h = nn.elementwise_sub(y2, y1)
+        cx = nn.elementwise_add(x1, nn.scale(w, scale=0.5))
+        cy = nn.elementwise_add(y1, nn.scale(h, scale=0.5))
+        return cx, cy, w, h
+
+    pcx, pcy, pw, ph = parts(priors)
+    gcx, gcy, gw, gh = parts(assigned_gt)
+    eps = 1e-9
+    tx = nn.elementwise_div(nn.elementwise_sub(gcx, pcx),
+                            nn.scale(pw, scale=1.0, bias=eps))
+    ty = nn.elementwise_div(nn.elementwise_sub(gcy, pcy),
+                            nn.scale(ph, scale=1.0, bias=eps))
+    tw = nn.log(nn.clip(nn.elementwise_div(gw, pw), eps, 1e9))
+    th = nn.log(nn.clip(nn.elementwise_div(gh, ph), eps, 1e9))
+    enc = T.concat([tx, ty, tw, th], axis=1)
+    if prior_var is not None:
+        enc = nn.elementwise_div(enc, prior_var)
+    return enc
+
+
+def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
+             prior_box_var=None, background_label=0, overlap_threshold=0.5,
+             neg_pos_ratio=3.0, neg_overlap=0.5, loc_loss_weight=1.0,
+             conf_loss_weight=1.0, match_type="per_prediction",
+             mining_type="max_negative", normalize=True,
+             sample_size=None):
+    """MultiBox SSD loss for one image (reference
+    layers/detection.py:ssd_loss; the reference batches ragged gt via
+    LoD — feed per-image here, or vmap at the model level):
+
+      1. IoU match priors -> gt (bipartite + per-prediction extras)
+      2. localization: smooth-l1 on center-size-encoded matched gt,
+         positives only
+      3. confidence: softmax CE with max_negative hard mining at
+         neg_pos_ratio
+      4. optional normalization by the positive count
+
+    location [P, 4], confidence [P, C], gt_box [G, 4], gt_label [G, 1].
+    Returns the combined per-prior loss [P, 1] (reference returns the
+    same elementwise shape)."""
+    from . import nn
+    from . import tensor as T
+    if mining_type != "max_negative":
+        raise NotImplementedError("ssd_loss: only max_negative mining")
+    iou = iou_similarity(gt_box, prior_box)            # [G, P]
+    matched, match_dist = bipartite_match(iou, match_type,
+                                          overlap_threshold)  # [1, P]
+    # gather matched gt per prior (raw boxes), then encode vs priors
+    gt3 = nn.reshape(gt_box, [1, -1, 4])
+    assigned_gt, loc_w = target_assign(gt3, matched)   # [1, P, 4/1]
+    assigned_gt = nn.reshape(assigned_gt, [-1, 4])
+    pos = nn.reshape(loc_w, [-1, 1])                   # [P, 1] 1=matched
+    loc_tgt = _encode_center_size(assigned_gt, prior_box, prior_box_var)
+    loc_tgt.stop_gradient = True
+    # localization loss over positives only (inside weight masks both
+    # the prediction diff and the target, reference InsideWeight)
+    loc_loss = nn.smooth_l1(location, loc_tgt, inside_weight=pos,
+                            outside_weight=pos)        # [P, 1]
+    # confidence targets: matched class, background where unmatched
+    lab3 = nn.reshape(cast_int64(gt_label), [1, -1, 1])
+    cls_tgt, _ = target_assign(lab3, matched,
+                               mismatch_value=background_label)
+    cls_tgt = nn.reshape(cls_tgt, [-1, 1])
+    cls_tgt.stop_gradient = True
+    conf_loss = nn.softmax_with_cross_entropy(
+        confidence, cast_int64(cls_tgt))               # [P, 1]
+    # max_negative mining: keep all positives + the top
+    # neg_pos_ratio * num_pos hardest negatives
+    neg = nn.scale(pos, scale=-1.0, bias=1.0)          # 1 - pos
+    neg_score = nn.elementwise_mul(conf_loss, neg)
+    _, order = nn.argsort(nn.reshape(neg_score, [1, -1]), axis=1,
+                          descending=True)
+    _, rank = nn.argsort(T.cast(order, "float32"), axis=1)  # invert perm
+    num_pos = nn.reduce_sum(pos)                       # scalar
+    k = nn.scale(num_pos, scale=float(neg_pos_ratio))
+    from .control_flow import less_than
+    keep_neg = T.cast(
+        less_than(T.cast(nn.reshape(rank, [-1, 1]), "float32"),
+                  nn.expand_as(nn.reshape(k, [1, 1]),
+                               nn.reshape(rank, [-1, 1]))),
+        "float32")
+    keep_neg = nn.elementwise_mul(keep_neg, neg)
+    conf_keep = nn.elementwise_add(pos, keep_neg)
+    conf_loss = nn.elementwise_mul(conf_loss, conf_keep)
+    loss = nn.elementwise_add(nn.scale(loc_loss, scale=loc_loss_weight),
+                              nn.scale(conf_loss, scale=conf_loss_weight))
+    if normalize:
+        denom = nn.clip(num_pos, 1.0, 1e9)
+        loss = nn.elementwise_div(loss, nn.expand_as(
+            nn.reshape(denom, [1, 1]), loss))
+    return loss
+
+
+def cast_int64(v):
+    from . import tensor as T
+    return T.cast(v, "int64") if str(v.dtype) != "int64" else v
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, step_w=None, step_h=None,
+                   offset=0.5, variance=[0.1, 0.1, 0.2, 0.2], flip=True,
+                   clip=False, kernel_size=1, pad=0, stride=1, name=None,
+                   min_max_aspect_ratios_order=False):
+    """SSD detection head over multiple feature maps: per-map prior
+    boxes + conv loc/conf predictors, concatenated
+    (reference layers/detection.py:multi_box_head)."""
+    from . import nn
+    from . import tensor as T
+    if min_sizes is None:
+        # reference ratio schedule: evenly spaced between min/max ratio
+        num_layer = len(inputs)
+        min_sizes, max_sizes = [], []
+        step = int((max_ratio - min_ratio) / (num_layer - 2)) \
+            if num_layer > 2 else 0
+        min_sizes.append(base_size * 0.1)
+        max_sizes.append(base_size * 0.2)
+        for ratio in range(min_ratio, max_ratio + 1, max(step, 1)):
+            min_sizes.append(base_size * ratio / 100.0)
+            max_sizes.append(base_size * (ratio + step) / 100.0)
+        min_sizes = min_sizes[:num_layer]
+        max_sizes = max_sizes[:num_layer]
+    locs, confs, boxes, vars_ = [], [], [], []
+    for i, x in enumerate(inputs):
+        mins = min_sizes[i]
+        maxs = max_sizes[i] if max_sizes else None
+        ar = aspect_ratios[i] if isinstance(aspect_ratios[i],
+                                            (list, tuple)) \
+            else [aspect_ratios[i]]
+        st = steps[i] if steps else [step_w or 0.0, step_h or 0.0]
+        if not isinstance(st, (list, tuple)):
+            st = [st, st]
+        mins_list = list(mins) if isinstance(mins, (list, tuple)) \
+            else [mins]
+        maxs_list = ([maxs] if maxs and not isinstance(
+            maxs, (list, tuple)) else (maxs or []))
+        box, var = prior_box(x, image, mins_list, maxs_list,
+                             ar, variance, flip, clip, st, offset)
+        # prior count must mirror the prior_box lowering exactly
+        # (ops/detection_ops.py): implicit leading 1.0, dedup, flip
+        # reciprocals for non-1 ratios, +1 box per min_size when a
+        # max_size is present
+        ars_eff = [1.0]
+        for a in ar:
+            if not any(abs(a - e) < 1e-6 for e in ars_eff):
+                ars_eff.append(a)
+                if flip:
+                    ars_eff.append(1.0 / a)
+        num_priors = len(mins_list) * len(ars_eff) + \
+            (len(mins_list) if maxs_list else 0)
+        loc = nn.conv2d(x, num_priors * 4, kernel_size, stride=stride,
+                        padding=pad)
+        conf = nn.conv2d(x, num_priors * num_classes, kernel_size,
+                         stride=stride, padding=pad)
+        # NCHW -> [B, HW*priors, 4 / C]
+        loc = nn.reshape(nn.transpose(loc, perm=[0, 2, 3, 1]),
+                         [0, -1, 4])
+        conf = nn.reshape(nn.transpose(conf, perm=[0, 2, 3, 1]),
+                          [0, -1, num_classes])
+        locs.append(loc)
+        confs.append(conf)
+        boxes.append(nn.reshape(box, [-1, 4]))
+        vars_.append(nn.reshape(var, [-1, 4]))
+    mbox_locs = T.concat(locs, axis=1)
+    mbox_confs = T.concat(confs, axis=1)
+    box = T.concat(boxes, axis=0)
+    var = T.concat(vars_, axis=0)
+    return mbox_locs, mbox_confs, box, var

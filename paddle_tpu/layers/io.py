@@ -45,3 +45,67 @@ def _attach_lengths(prog, name):
             name=ln, shape=[-1], dtype="int64", lod_level=0,
             stop_gradient=True, is_data=True)
     prog.lod_link[name] = ln
+
+
+__all__ += ["read_file", "double_buffer", "py_reader",
+            "create_py_reader_by_data", "load"]
+
+
+def py_reader(capacity, shapes, dtypes, lod_levels=None, name=None,
+              use_double_buffer=True):
+    """reference layers/io.py:py_reader — declares feed vars + a host
+    infeed queue. Returns a PyReader whose data vars are retrieved with
+    read_file(reader); feeding happens through the reader's
+    decorate_* generators (reader.py queue + double buffering)."""
+    from ..reader import PyReader
+    from ..framework import unique_name
+    lod_levels = lod_levels or [0] * len(shapes)
+    feed_vars = []
+    for i, (shp, dt, ll) in enumerate(zip(shapes, dtypes, lod_levels)):
+        feed_vars.append(data(
+            unique_name.generate(f"{name or 'py_reader'}_slot{i}"),
+            shape=list(shp), dtype=dt, lod_level=ll,
+            append_batch_size=False))
+    r = PyReader(feed_list=feed_vars, capacity=capacity,
+                 use_double_buffer=use_double_buffer)
+    r._data_vars = feed_vars
+    return r
+
+
+def create_py_reader_by_data(capacity, feed_list, name=None,
+                             use_double_buffer=True):
+    from ..reader import PyReader
+    r = PyReader(feed_list=list(feed_list), capacity=capacity,
+                 use_double_buffer=use_double_buffer)
+    r._data_vars = list(feed_list)
+    return r
+
+
+def read_file(reader):
+    """Returns the reader's declared data vars (reference read_file
+    pops one batch from the file/queue reader into new vars; here the
+    infeed queue feeds the same declared vars each step)."""
+    vs = getattr(reader, "_data_vars", None) or \
+        getattr(reader, "feed_list", None)
+    if not vs:
+        raise ValueError("read_file: reader has no data vars")
+    return vs if len(vs) > 1 else vs[0]
+
+
+def double_buffer(reader, place=None, name=None):
+    """Double buffering is built into the infeed queue
+    (FLAGS_reader_queue_depth / reader.py); identity here."""
+    return reader
+
+
+def load(out, file_path, load_as_fp16=False):
+    """reference load_op: read one serialized tensor from disk into a
+    var (ops/misc_ops.py 'load' lowering reads the .npy)."""
+    from ..layer_helper import LayerHelper
+    helper = LayerHelper("load")
+    helper.append_op(type="load", inputs={},
+                     outputs={"Out": [out.name]},
+                     attrs={"file_path": file_path,
+                            "shape": [int(s) for s in (out.shape or [])],
+                            "dtype": out.dtype})
+    return out

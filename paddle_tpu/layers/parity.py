@@ -1,0 +1,740 @@
+"""App-B parity layers: the remaining fluid.layers surface, each a thin
+builder over an already-registered TPU lowering (reference:
+python/paddle/fluid/layers/nn.py signatures; op slot names per the
+corresponding ops/*.py lowering docstrings).
+
+Grouped here rather than scattered across nn.py to keep the round-1
+core file readable; `layers/__init__.py` flattens everything into the
+fluid.layers namespace exactly like the reference does.
+"""
+from __future__ import annotations
+
+from ..layer_helper import LayerHelper
+
+__all__ = [
+    "linear_chain_crf", "crf_decoding", "chunk_eval", "pool3d",
+    "adaptive_pool3d", "data_norm", "beam_search_decode",
+    "conv3d_transpose", "edit_distance", "im2sequence", "nce",
+    "sampled_softmax_with_cross_entropy", "hsigmoid", "beam_search",
+    "row_conv", "multiplex", "spectral_norm", "lod_reset", "lod_append",
+    "pad_constant_like", "roi_pool", "roi_align", "psroi_pool",
+    "prroi_pool", "random_crop", "mean_iou", "crop", "crop_tensor",
+    "sequence_enumerate", "unique_with_counts",
+    "uniform_random_batch_size_like", "gaussian_random_batch_size_like",
+    "sum", "affine_grid", "similarity_focus", "merge_selected_rows",
+    "get_tensor_from_selected_rows", "py_func", "gather_tree",
+    "teacher_student_sigmoid_loss", "continuous_value_model",
+    "deformable_conv", "deformable_roi_pooling", "filter_by_instag",
+    "tensor_array_to_tensor", "reorder_lod_tensor_by_rank",
+    "ctc_greedy_decoder", "image_resize_short", "resize_trilinear",
+    "scatter_nd",
+]
+
+
+def _one_out(op_type, inputs, attrs=None, dtype=None, ref=None, name=None,
+             out_slot="Out", stop_gradient=False):
+    helper = LayerHelper(op_type, name=name)
+    out = helper.create_variable_for_type_inference(
+        dtype or ref.dtype, stop_gradient)
+    helper.append_op(type=op_type, inputs=inputs,
+                     outputs={out_slot: [out.name]}, attrs=attrs or {})
+    return out
+
+
+def linear_chain_crf(input, label, param_attr=None, length=None):
+    helper = LayerHelper("linear_chain_crf", param_attr=param_attr)
+    n_tags = int(input.shape[-1])
+    transition = helper.create_parameter(helper.param_attr,
+                                         [n_tags + 2, n_tags],
+                                         input.dtype)
+    alpha = helper.create_variable_for_type_inference(input.dtype, True)
+    e_exps = helper.create_variable_for_type_inference(input.dtype, True)
+    t_exps = helper.create_variable_for_type_inference(input.dtype, True)
+    ll = helper.create_variable_for_type_inference(input.dtype)
+    ins = {"Emission": [input.name], "Transition": [transition.name],
+           "Label": [label.name]}
+    if length is not None:
+        ins["Length"] = [length.name]
+    helper.append_op(type="linear_chain_crf", inputs=ins,
+                     outputs={"Alpha": [alpha.name],
+                              "EmissionExps": [e_exps.name],
+                              "TransitionExps": [t_exps.name],
+                              "LogLikelihood": [ll.name]})
+    return ll
+
+
+def crf_decoding(input, param_attr, label=None, length=None):
+    helper = LayerHelper("crf_decoding")
+    transition = helper.kwargs.get("param_attr")
+    # reference passes the SAME ParamAttr used for linear_chain_crf; the
+    # parameter already exists, so resolve it by name
+    from ..framework import ParamAttr, default_main_program
+    attr = ParamAttr._to_attr(param_attr)
+    trans_var = default_main_program().global_block().var(attr.name)
+    out = helper.create_variable_for_type_inference("int64", True)
+    ins = {"Emission": [input.name], "Transition": [trans_var.name]}
+    if label is not None:
+        ins["Label"] = [label.name]
+    if length is not None:
+        ins["Length"] = [length.name]
+    helper.append_op(type="crf_decoding", inputs=ins,
+                     outputs={"ViterbiPath": [out.name]})
+    return out
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types,
+               excluded_chunk_types=None, seq_length=None):
+    helper = LayerHelper("chunk_eval")
+    precision = helper.create_variable_for_type_inference("float32", True)
+    recall = helper.create_variable_for_type_inference("float32", True)
+    f1 = helper.create_variable_for_type_inference("float32", True)
+    n_infer = helper.create_variable_for_type_inference("int64", True)
+    n_label = helper.create_variable_for_type_inference("int64", True)
+    n_correct = helper.create_variable_for_type_inference("int64", True)
+    ins = {"Inference": [input.name], "Label": [label.name]}
+    if seq_length is not None:
+        ins["SeqLength"] = [seq_length.name]
+    helper.append_op(
+        type="chunk_eval", inputs=ins,
+        outputs={"Precision": [precision.name], "Recall": [recall.name],
+                 "F1-Score": [f1.name], "NumInferChunks": [n_infer.name],
+                 "NumLabelChunks": [n_label.name],
+                 "NumCorrectChunks": [n_correct.name]},
+        attrs={"chunk_scheme": chunk_scheme,
+               "num_chunk_types": num_chunk_types,
+               "excluded_chunk_types": excluded_chunk_types or []})
+    return precision, recall, f1, n_infer, n_label, n_correct
+
+
+def pool3d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, ceil_mode=False,
+           exclusive=True, name=None):
+    def _3(v):
+        return [v, v, v] if isinstance(v, int) else list(v)
+    return _one_out("pool3d", {"X": [input.name]},
+                    {"ksize": _3(pool_size), "pooling_type": pool_type,
+                     "strides": _3(pool_stride),
+                     "paddings": _3(pool_padding),
+                     "global_pooling": global_pooling,
+                     "ceil_mode": ceil_mode, "exclusive": exclusive},
+                    ref=input, name=name)
+
+
+def adaptive_pool3d(input, pool_size, pool_type="max", require_index=False,
+                    name=None):
+    def _3(v):
+        return [v, v, v] if isinstance(v, int) else list(v)
+    return _one_out("pool3d", {"X": [input.name]},
+                    {"ksize": _3(pool_size), "pooling_type": pool_type,
+                     "adaptive": True, "strides": [1, 1, 1],
+                     "paddings": [0, 0, 0]},
+                    ref=input, name=name)
+
+
+def data_norm(input, act=None, epsilon=1e-05, param_attr=None,
+              data_layout="NCHW", in_place=False, name=None,
+              moving_mean_name=None, moving_variance_name=None,
+              do_model_average_for_mean_and_var=True, slot_dim=-1):
+    from .nn import _create_persistable_stat
+    helper = LayerHelper("data_norm", name=name)
+    c = int(input.shape[1])
+    batch_size = _create_persistable_stat(helper, "data_norm_size", [c],
+                                          "float32", 1e4)
+    batch_sum = _create_persistable_stat(helper, "data_norm_sum", [c],
+                                         "float32", 0.0)
+    batch_square = _create_persistable_stat(helper, "data_norm_sq", [c],
+                                            "float32", 1e4)
+    y = helper.create_variable_for_type_inference(input.dtype)
+    means = helper.create_variable_for_type_inference(input.dtype, True)
+    scales = helper.create_variable_for_type_inference(input.dtype, True)
+    helper.append_op(type="data_norm",
+                     inputs={"X": [input.name],
+                             "BatchSize": [batch_size.name],
+                             "BatchSum": [batch_sum.name],
+                             "BatchSquareSum": [batch_square.name]},
+                     outputs={"Y": [y.name], "Means": [means.name],
+                              "Scales": [scales.name]},
+                     attrs={"epsilon": epsilon})
+    return helper.append_activation(y)
+
+
+def beam_search(pre_ids, pre_scores, ids, scores, beam_size, end_id,
+                level=0, is_accumulated=True, name=None,
+                return_parent_idx=False):
+    helper = LayerHelper("beam_search", name=name)
+    selected_ids = helper.create_variable_for_type_inference("int64", True)
+    selected_scores = helper.create_variable_for_type_inference(
+        scores.dtype, True)
+    parent_idx = helper.create_variable_for_type_inference("int32", True)
+    ins = {"pre_ids": [pre_ids.name], "pre_scores": [pre_scores.name],
+           "scores": [scores.name]}
+    if ids is not None:
+        ins["ids"] = [ids.name]
+    helper.append_op(
+        type="beam_search", inputs=ins,
+        outputs={"selected_ids": [selected_ids.name],
+                 "selected_scores": [selected_scores.name],
+                 "parent_idx": [parent_idx.name]},
+        attrs={"beam_size": beam_size, "end_id": end_id, "level": level,
+               "is_accumulated": is_accumulated})
+    if return_parent_idx:
+        return selected_ids, selected_scores, parent_idx
+    return selected_ids, selected_scores
+
+
+def beam_search_decode(ids, scores, beam_size, end_id, name=None):
+    helper = LayerHelper("beam_search_decode", name=name)
+    sentence_ids = helper.create_variable_for_type_inference("int64", True)
+    sentence_scores = helper.create_variable_for_type_inference(
+        scores.dtype, True)
+    helper.append_op(type="beam_search_decode",
+                     inputs={"Ids": [ids.name], "Scores": [scores.name]},
+                     outputs={"SentenceIds": [sentence_ids.name],
+                              "SentenceScores": [sentence_scores.name]},
+                     attrs={"beam_size": beam_size, "end_id": end_id})
+    return sentence_ids, sentence_scores
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None):
+    def _3(v):
+        return [v, v, v] if isinstance(v, int) else list(v)
+    helper = LayerHelper("conv3d_transpose", name=name,
+                         param_attr=param_attr, bias_attr=bias_attr,
+                         act=act)
+    c_in = int(input.shape[1])
+    fs = _3(filter_size or 1)
+    filt = helper.create_parameter(
+        helper.param_attr, [c_in, num_filters // groups] + fs, input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="conv3d_transpose",
+                     inputs={"Input": [input.name], "Filter": [filt.name]},
+                     outputs={"Output": [out.name]},
+                     attrs={"strides": _3(stride), "paddings": _3(padding),
+                            "dilations": _3(dilation), "groups": groups})
+    out = helper.append_bias_op(out)
+    return helper.append_activation(out)
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None,
+                  input_length=None, label_length=None):
+    helper = LayerHelper("edit_distance")
+    out = helper.create_variable_for_type_inference("float32", True)
+    seq_num = helper.create_variable_for_type_inference("int64", True)
+    ins = {"Hyps": [input.name], "Refs": [label.name]}
+    if input_length is not None:
+        ins["HypsLength"] = [input_length.name]
+    if label_length is not None:
+        ins["RefsLength"] = [label_length.name]
+    helper.append_op(type="edit_distance", inputs=ins,
+                     outputs={"Out": [out.name],
+                              "SequenceNum": [seq_num.name]},
+                     attrs={"normalized": normalized})
+    return out, seq_num
+
+
+def im2sequence(input, filter_size=1, stride=1, padding=0, input_image_size=None,
+                out_stride=1, name=None):
+    def _2(v):
+        return [v, v] if isinstance(v, int) else list(v)
+    pad = _2(padding)
+    if len(pad) == 2:
+        pad = pad * 2
+    return _one_out("im2sequence", {"X": [input.name]},
+                    {"kernels": _2(filter_size), "strides": _2(stride),
+                     "paddings": pad},
+                    ref=input, name=name)
+
+
+def nce(input, label, num_total_classes, sample_weight=None,
+        param_attr=None, bias_attr=None, num_neg_samples=None, name=None,
+        sampler="uniform", custom_dist=None, seed=0, is_sparse=False):
+    helper = LayerHelper("nce", name=name, param_attr=param_attr,
+                         bias_attr=bias_attr)
+    d = int(input.shape[-1])
+    w = helper.create_parameter(helper.param_attr,
+                                [num_total_classes, d], input.dtype)
+    b = helper.create_parameter(helper.bias_attr, [num_total_classes],
+                                input.dtype, is_bias=True)
+    cost = helper.create_variable_for_type_inference(input.dtype)
+    sample_logits = helper.create_variable_for_type_inference(
+        input.dtype, True)
+    sample_labels = helper.create_variable_for_type_inference(
+        "int64", True)
+    ins = {"Input": [input.name], "Label": [label.name],
+           "Weight": [w.name]}
+    if b is not None:
+        ins["Bias"] = [b.name]
+    helper.append_op(
+        type="nce", inputs=ins,
+        outputs={"Cost": [cost.name], "SampleLogits": [sample_logits.name],
+                 "SampleLabels": [sample_labels.name]},
+        attrs={"num_total_classes": num_total_classes,
+               "num_neg_samples": num_neg_samples or 10, "seed": seed})
+    return cost
+
+
+def sampled_softmax_with_cross_entropy(logits, label, num_samples,
+                                       num_true=1,
+                                       remove_accidental_hits=True,
+                                       use_customized_samples=False,
+                                       customized_samples=None,
+                                       customized_probabilities=None,
+                                       seed=0):
+    """reference nn.py: sample_logits op + softmax CE over the sampled
+    slice."""
+    from .nn import softmax_with_cross_entropy
+    helper = LayerHelper("sample_logits")
+    samples = helper.create_variable_for_type_inference("int64", True)
+    probabilities = helper.create_variable_for_type_inference(
+        logits.dtype, True)
+    sampled_logits = helper.create_variable_for_type_inference(logits.dtype)
+    sampled_label = helper.create_variable_for_type_inference("int64", True)
+    logits_dim = helper.create_variable_for_type_inference(
+        logits.dtype, True)
+    labels_dim = helper.create_variable_for_type_inference("int64", True)
+    helper.append_op(
+        type="sample_logits",
+        inputs={"Logits": [logits.name], "Labels": [label.name]},
+        outputs={"Samples": [samples.name],
+                 "Probabilities": [probabilities.name],
+                 "SampledLogits": [sampled_logits.name],
+                 "SampledLabels": [sampled_label.name],
+                 "LogitsDim": [logits_dim.name],
+                 "LabelsDim": [labels_dim.name]},
+        attrs={"num_samples": num_samples,
+               "remove_accidental_hits": remove_accidental_hits,
+               "seed": seed})
+    return softmax_with_cross_entropy(sampled_logits, sampled_label)
+
+
+def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
+             name=None, path_table=None, path_code=None, is_custom=False,
+             is_sparse=False):
+    helper = LayerHelper("hsigmoid", name=name, param_attr=param_attr,
+                         bias_attr=bias_attr)
+    d = int(input.shape[-1])
+    w = helper.create_parameter(helper.param_attr, [num_classes - 1, d],
+                                input.dtype)
+    b = helper.create_parameter(helper.bias_attr, [num_classes - 1],
+                                input.dtype, is_bias=True)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    pre_out = helper.create_variable_for_type_inference(input.dtype, True)
+    ins = {"X": [input.name], "Label": [label.name], "W": [w.name]}
+    if b is not None:
+        ins["Bias"] = [b.name]
+    helper.append_op(type="hierarchical_sigmoid", inputs=ins,
+                     outputs={"Out": [out.name], "PreOut": [pre_out.name]},
+                     attrs={"num_classes": num_classes})
+    return out
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    helper = LayerHelper("row_conv", param_attr=param_attr, act=act)
+    d = int(input.shape[-1])
+    filt = helper.create_parameter(helper.param_attr,
+                                   [future_context_size + 1, d],
+                                   input.dtype)
+    out = _one_out("row_conv", {"X": [input.name], "Filter": [filt.name]},
+                   ref=input)
+    return helper.append_activation(out)
+
+
+def multiplex(inputs, index):
+    helper = LayerHelper("multiplex")
+    out = helper.create_variable_for_type_inference(inputs[0].dtype)
+    helper.append_op(type="multiplex",
+                     inputs={"X": [v.name for v in inputs],
+                             "Ids": [index.name]},
+                     outputs={"Out": [out.name]})
+    return out
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    from ..initializer import Normal, Constant
+    helper = LayerHelper("spectral_norm", name=name)
+    import numpy as np
+    shape = [int(s) for s in weight.shape]
+    h = shape[dim]
+    w = int(np.prod(shape)) // h
+    from ..framework import ParamAttr
+    u = helper.create_parameter(ParamAttr(initializer=Normal(0.0, 1.0),
+                                          trainable=False), [h],
+                                weight.dtype)
+    v = helper.create_parameter(ParamAttr(initializer=Normal(0.0, 1.0),
+                                          trainable=False), [w],
+                                weight.dtype)
+    return _one_out("spectral_norm",
+                    {"Weight": [weight.name], "U": [u.name],
+                     "V": [v.name]},
+                    {"dim": dim, "power_iters": power_iters, "eps": eps},
+                    ref=weight, name=name)
+
+
+def lod_reset(x, y=None, target_lod=None):
+    ins = {"X": [x.name]}
+    if y is not None:
+        ins["Y"] = [y.name]
+    return _one_out("lod_reset", ins, {"target_lod": target_lod or []},
+                    ref=x)
+
+
+def lod_append(x, level):
+    """LoD is host-side metadata here (core/lod.py); on-device the
+    tensor is unchanged (reference lod_append returns x with one more
+    LoD level)."""
+    return lod_reset(x)
+
+
+def pad_constant_like(x, y, pad_value=0.0, name=None):
+    return _one_out("pad_constant_like", {"X": [x.name], "Y": [y.name]},
+                    {"pad_value": pad_value}, ref=y, name=name)
+
+
+def roi_pool(input, rois, pooled_height=1, pooled_width=1,
+             spatial_scale=1.0, rois_lod=None):
+    helper = LayerHelper("roi_pool")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    argmax = helper.create_variable_for_type_inference("int32", True)
+    ins = {"X": [input.name], "ROIs": [rois.name]}
+    if rois_lod is not None:
+        ins["RoisLod"] = [rois_lod.name]
+    helper.append_op(type="roi_pool", inputs=ins,
+                     outputs={"Out": [out.name], "Argmax": [argmax.name]},
+                     attrs={"pooled_height": pooled_height,
+                            "pooled_width": pooled_width,
+                            "spatial_scale": spatial_scale})
+    return out
+
+
+def roi_align(input, rois, pooled_height=1, pooled_width=1,
+              spatial_scale=1.0, sampling_ratio=-1, name=None,
+              rois_lod=None):
+    ins = {"X": [input.name], "ROIs": [rois.name]}
+    if rois_lod is not None:
+        ins["RoisLod"] = [rois_lod.name]
+    return _one_out("roi_align", ins,
+                    {"pooled_height": pooled_height,
+                     "pooled_width": pooled_width,
+                     "spatial_scale": spatial_scale,
+                     "sampling_ratio": sampling_ratio},
+                    ref=input, name=name)
+
+
+def psroi_pool(input, rois, output_channels, spatial_scale, pooled_height,
+               pooled_width, name=None):
+    return _one_out("psroi_pool",
+                    {"X": [input.name], "ROIs": [rois.name]},
+                    {"output_channels": output_channels,
+                     "spatial_scale": spatial_scale,
+                     "pooled_height": pooled_height,
+                     "pooled_width": pooled_width},
+                    ref=input, name=name)
+
+
+def prroi_pool(input, rois, output_channels=None, spatial_scale=1.0,
+               pooled_height=1, pooled_width=1, batch_roi_nums=None,
+               name=None):
+    ins = {"X": [input.name], "ROIs": [rois.name]}
+    if batch_roi_nums is not None:
+        ins["BatchRoINums"] = [batch_roi_nums.name]
+    return _one_out("prroi_pool", ins,
+                    {"spatial_scale": spatial_scale,
+                     "pooled_height": pooled_height,
+                     "pooled_width": pooled_width},
+                    ref=input, name=name)
+
+
+def random_crop(x, shape, seed=None):
+    helper = LayerHelper("random_crop")
+    from .tensor import fill_constant
+    if seed is None:
+        seed_var = fill_constant([1], "int64", 0)
+    elif isinstance(seed, int):
+        seed_var = fill_constant([1], "int64", seed)
+    else:
+        seed_var = seed
+    return _one_out("random_crop",
+                    {"X": [x.name], "Seed": [seed_var.name]},
+                    {"shape": list(shape)}, ref=x)
+
+
+def mean_iou(input, label, num_classes):
+    helper = LayerHelper("mean_iou")
+    miou = helper.create_variable_for_type_inference("float32", True)
+    wrong = helper.create_variable_for_type_inference("int32", True)
+    correct = helper.create_variable_for_type_inference("int32", True)
+    helper.append_op(type="mean_iou",
+                     inputs={"Predictions": [input.name],
+                             "Labels": [label.name]},
+                     outputs={"OutMeanIou": [miou.name],
+                              "OutWrong": [wrong.name],
+                              "OutCorrect": [correct.name]},
+                     attrs={"num_classes": num_classes})
+    return miou, wrong, correct
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    ins = {"X": [x.name]}
+    attrs = {}
+    if hasattr(shape, "name"):
+        ins["Y"] = [shape.name]
+    else:
+        attrs["shape"] = list(shape or [])
+    if hasattr(offsets, "name"):
+        ins["Offsets"] = [offsets.name]
+    elif offsets is not None:
+        attrs["offsets"] = list(offsets)
+    return _one_out("crop", ins, attrs, ref=x, name=name)
+
+
+def crop_tensor(x, shape=None, offsets=None, name=None):
+    ins = {"X": [x.name]}
+    attrs = {}
+    if hasattr(shape, "name"):
+        ins["Shape"] = [shape.name]
+    else:
+        attrs["shape"] = list(shape or [])
+    if hasattr(offsets, "name"):
+        ins["Offsets"] = [offsets.name]
+    elif offsets is not None:
+        attrs["offsets"] = list(offsets)
+    return _one_out("crop_tensor", ins, attrs, ref=x, name=name)
+
+
+def sequence_enumerate(input, win_size, pad_value=0, name=None):
+    from .sequence import sequence_enumerate as _se
+    return _se(input, win_size, pad_value, name)
+
+
+def unique_with_counts(x, dtype="int32"):
+    helper = LayerHelper("unique_with_counts")
+    out = helper.create_variable_for_type_inference(x.dtype, True)
+    index = helper.create_variable_for_type_inference(dtype, True)
+    count = helper.create_variable_for_type_inference(dtype, True)
+    helper.append_op(type="unique_with_counts", inputs={"X": [x.name]},
+                     outputs={"Out": [out.name], "Index": [index.name],
+                              "Count": [count.name]})
+    return out, index, count
+
+
+def uniform_random_batch_size_like(input, shape, dtype="float32",
+                                   input_dim_idx=0, output_dim_idx=0,
+                                   min=-1.0, max=1.0, seed=0):
+    return _one_out("uniform_random_batch_size_like",
+                    {"Input": [input.name]},
+                    {"shape": list(shape), "input_dim_idx": input_dim_idx,
+                     "output_dim_idx": output_dim_idx, "min": min,
+                     "max": max, "seed": seed, "dtype": dtype},
+                    dtype=dtype, ref=input, stop_gradient=True)
+
+
+def gaussian_random_batch_size_like(input, shape, input_dim_idx=0,
+                                    output_dim_idx=0, mean=0.0, std=1.0,
+                                    seed=0, dtype="float32"):
+    return _one_out("gaussian_random_batch_size_like",
+                    {"Input": [input.name]},
+                    {"shape": list(shape), "input_dim_idx": input_dim_idx,
+                     "output_dim_idx": output_dim_idx, "mean": mean,
+                     "std": std, "seed": seed, "dtype": dtype},
+                    dtype=dtype, ref=input, stop_gradient=True)
+
+
+def sum(x):
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    helper = LayerHelper("sum")
+    out = helper.create_variable_for_type_inference(xs[0].dtype)
+    helper.append_op(type="sum", inputs={"X": [v.name for v in xs]},
+                     outputs={"Out": [out.name]})
+    return out
+
+
+def affine_grid(theta, out_shape, name=None):
+    ins = {"Theta": [theta.name]}
+    attrs = {}
+    if hasattr(out_shape, "name"):
+        ins["OutputShape"] = [out_shape.name]
+    else:
+        attrs["output_shape"] = [int(s) for s in out_shape]
+    return _one_out("affine_grid", ins, attrs, ref=theta, name=name,
+                    out_slot="Output")
+
+
+def similarity_focus(input, axis, indexes, name=None):
+    return _one_out("similarity_focus", {"X": [input.name]},
+                    {"axis": axis, "indexes": list(indexes)},
+                    ref=input, name=name)
+
+
+def merge_selected_rows(x, name=None):
+    return _one_out("merge_selected_rows", {"X": [x.name]}, ref=x,
+                    name=name)
+
+
+def get_tensor_from_selected_rows(x, name=None):
+    return _one_out("get_tensor_from_selected_rows", {"X": [x.name]},
+                    ref=x, name=name)
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    from ..ops.misc_ops import register_py_func
+    helper = LayerHelper("py_func")
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    func_id = register_py_func(func)
+    helper.append_op(
+        type="py_func", inputs={"X": [v.name for v in xs]},
+        outputs={"Out": [v.name for v in outs]},
+        attrs={"func_id": func_id,
+               "out_dtypes": [str(v.dtype) for v in outs],
+               "out_shapes": [[int(s) for s in (v.shape or [])]
+                              for v in outs]})
+    return outs if isinstance(out, (list, tuple)) else outs[0]
+
+
+def gather_tree(ids, parents):
+    return _one_out("gather_tree",
+                    {"Ids": [ids.name], "Parents": [parents.name]},
+                    ref=ids, stop_gradient=True)
+
+
+def teacher_student_sigmoid_loss(input, label, soft_max_up_bound=15.0,
+                                 soft_max_lower_bound=-15.0):
+    return _one_out("teacher_student_sigmoid_loss",
+                    {"X": [input.name], "Label": [label.name]},
+                    {"soft_max_up_bound": soft_max_up_bound,
+                     "soft_max_lower_bound": soft_max_lower_bound},
+                    ref=input, out_slot="Y")
+
+
+def continuous_value_model(input, cvm, use_cvm=True):
+    return _one_out("cvm", {"X": [input.name], "CVM": [cvm.name]},
+                    {"use_cvm": use_cvm}, ref=input, out_slot="Y")
+
+
+def deformable_conv(input, offset, mask, num_filters, filter_size,
+                    stride=1, padding=0, dilation=1, groups=1,
+                    deformable_groups=1, im2col_step=1, param_attr=None,
+                    bias_attr=None, modulated=True, name=None):
+    def _2(v):
+        return [v, v] if isinstance(v, int) else list(v)
+    helper = LayerHelper("deformable_conv", name=name,
+                         param_attr=param_attr, bias_attr=bias_attr)
+    c_in = int(input.shape[1])
+    fs = _2(filter_size)
+    filt = helper.create_parameter(
+        helper.param_attr, [num_filters, c_in // groups] + fs, input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    ins = {"Input": [input.name], "Offset": [offset.name],
+           "Filter": [filt.name]}
+    if modulated and mask is not None:
+        ins["Mask"] = [mask.name]
+    helper.append_op(
+        type="deformable_conv" if modulated else "deformable_conv_v1",
+        inputs=ins, outputs={"Output": [out.name]},
+        attrs={"strides": _2(stride), "paddings": _2(padding),
+               "dilations": _2(dilation), "groups": groups,
+               "deformable_groups": deformable_groups,
+               "im2col_step": im2col_step})
+    out = helper.append_bias_op(out)
+    return out
+
+
+def deformable_roi_pooling(input, rois, trans, no_trans=False,
+                           spatial_scale=1.0, group_size=[1, 1],
+                           pooled_height=1, pooled_width=1, part_size=None,
+                           sample_per_part=1, trans_std=0.1,
+                           position_sensitive=False, name=None):
+    return _one_out(
+        "deformable_psroi_pooling",
+        {"Input": [input.name], "ROIs": [rois.name],
+         "Trans": [trans.name]},
+        {"no_trans": no_trans, "spatial_scale": spatial_scale,
+         "output_dim": int(input.shape[1]),
+         "group_size": list(group_size), "pooled_height": pooled_height,
+         "pooled_width": pooled_width,
+         "part_size": list(part_size or [pooled_height, pooled_width]),
+         "sample_per_part": sample_per_part, "trans_std": trans_std},
+        ref=input, name=name, out_slot="Output")
+
+
+def filter_by_instag(ins, ins_tag, filter_tag, is_lod, out_val_if_empty=0):
+    helper = LayerHelper("filter_by_instag")
+    out = helper.create_variable_for_type_inference(ins.dtype)
+    loss_weight = helper.create_variable_for_type_inference("float32", True)
+    index_map = helper.create_variable_for_type_inference("int64", True)
+    helper.append_op(type="filter_by_instag",
+                     inputs={"Ins": [ins.name], "Ins_tag": [ins_tag.name],
+                             "Filter_tag": [filter_tag.name]},
+                     outputs={"Out": [out.name],
+                              "LossWeight": [loss_weight.name],
+                              "IndexMap": [index_map.name]},
+                     attrs={"is_lod": is_lod})
+    return out, loss_weight
+
+
+def tensor_array_to_tensor(input, axis=1, name=None, use_stack=False):
+    helper = LayerHelper("tensor_array_to_tensor", name=name)
+    out = helper.create_variable_for_type_inference("float32")
+    out_index = helper.create_variable_for_type_inference("int32", True)
+    helper.append_op(type="tensor_array_to_tensor",
+                     inputs={"X": [input.name]},
+                     outputs={"Out": [out.name],
+                              "OutIndex": [out_index.name]},
+                     attrs={"axis": axis, "use_stack": use_stack})
+    return out, out_index
+
+
+def reorder_lod_tensor_by_rank(x, rank_table):
+    return _one_out("reorder_lod_tensor_by_rank",
+                    {"X": [x.name], "RankTable": [rank_table.name]},
+                    ref=x)
+
+
+def ctc_greedy_decoder(input, blank, name=None):
+    """argmax per step -> collapse repeats -> strip blanks
+    (reference nn.py composes topk + ctc_align the same way)."""
+    from .nn import topk, squeeze
+    _, ids = topk(input, k=1)
+    ids2 = squeeze(ids, axes=[-1])
+    return _one_out("ctc_align", {"Input": [ids2.name]}, {"blank": blank},
+                    dtype="int64", ref=input, name=name,
+                    out_slot="Output", stop_gradient=True)
+
+
+def image_resize_short(input, out_short_len, resample="BILINEAR"):
+    """Resize so the SHORT side equals out_short_len, preserving aspect
+    (reference nn.py:image_resize_short). Static shapes: computed from
+    the declared input H/W at build time."""
+    from .nn import image_resize
+    h, w = int(input.shape[2]), int(input.shape[3])
+    short, long_ = (h, w) if h < w else (w, h)
+    scale = out_short_len / float(short)
+    out_h = int(round(h * scale))
+    out_w = int(round(w * scale))
+    return image_resize(input, out_shape=[out_h, out_w], resample=resample)
+
+
+def resize_trilinear(input, out_shape=None, scale=None, name=None,
+                     align_corners=True, align_mode=1):
+    attrs = {"align_corners": align_corners, "align_mode": align_mode}
+    if out_shape is not None:
+        attrs["out_d"], attrs["out_h"], attrs["out_w"] = [
+            int(s) for s in out_shape]
+    if scale is not None:
+        attrs["scale"] = float(scale)
+    return _one_out("trilinear_interp", {"X": [input.name]}, attrs,
+                    ref=input, name=name)
+
+
+def scatter_nd(index, updates, shape, name=None):
+    """scatter into zeros (reference nn.py: scatter_nd = scatter_nd_add
+    on a zero tensor)."""
+    from .tensor import zeros
+    z = zeros(list(shape), updates.dtype)
+    return _one_out("scatter_nd_add",
+                    {"X": [z.name], "Index": [index.name],
+                     "Updates": [updates.name]},
+                    ref=updates, name=name)

@@ -11,7 +11,11 @@ from __future__ import annotations
 from ..layer_helper import LayerHelper
 
 __all__ = ["sequence_mask", "sequence_pool", "sequence_softmax",
-           "sequence_reverse", "sequence_expand", "sequence_concat"]
+           "sequence_reverse", "sequence_expand", "sequence_concat",
+           "sequence_first_step", "sequence_last_step",
+           "sequence_conv", "sequence_expand_as", "sequence_pad",
+           "sequence_unpad", "sequence_slice", "sequence_reshape",
+           "sequence_scatter", "sequence_enumerate"]
 
 
 def _default_lengths(helper, input):
@@ -78,11 +82,124 @@ def sequence_reverse(x, lengths=None, name=None):
     return out
 
 
-def sequence_expand(x, y, ref_level=-1, name=None):
-    raise NotImplementedError(
-        "sequence_expand needs LoD; use expand/tile on padded-dense")
-
-
 def sequence_concat(input, name=None):
     from .tensor import concat
     return concat(input, axis=1, name=name)
+
+
+def sequence_first_step(input):
+    """reference: layers/nn.py sequence_first_step = pool FIRST."""
+    return sequence_pool(input, "first")
+
+
+def sequence_last_step(input):
+    """reference: layers/nn.py sequence_last_step = pool LAST."""
+    return sequence_pool(input, "last")
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=True, bias_attr=None, param_attr=None, act=None,
+                  name=None):
+    helper = LayerHelper("sequence_conv", name=name, param_attr=param_attr,
+                         bias_attr=bias_attr, act=act)
+    d = int(input.shape[-1])
+    filt = helper.create_parameter(helper.param_attr,
+                                   [filter_size * d, num_filters],
+                                   input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    ins = {"X": [input.name], "Filter": [filt.name]}
+    lengths = _default_lengths(helper, input)
+    if lengths is not None:
+        ins["Lengths"] = [lengths.name]
+    helper.append_op(type="sequence_conv", inputs=ins,
+                     outputs={"Out": [out.name]},
+                     attrs={"contextLength": filter_size,
+                            "contextStride": filter_stride,
+                            "contextStart": -(filter_size // 2)})
+    out = helper.append_bias_op(out, dim_start=2)
+    return helper.append_activation(out)
+
+
+def sequence_expand(x, y, ref_level=-1, name=None):
+    helper = LayerHelper("sequence_expand", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="sequence_expand",
+                     inputs={"X": [x.name], "Y": [y.name]},
+                     outputs={"Out": [out.name]},
+                     attrs={"ref_level": ref_level})
+    return out
+
+
+def sequence_expand_as(x, y, name=None):
+    helper = LayerHelper("sequence_expand_as", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="sequence_expand_as",
+                     inputs={"X": [x.name], "Y": [y.name]},
+                     outputs={"Out": [out.name]})
+    return out
+
+
+def sequence_pad(x, pad_value, maxlen=None, name=None):
+    """Identity in the padded-dense representation; returns
+    (padded, lengths) like the reference (sequence_pad_op.cc)."""
+    helper = LayerHelper("sequence_pad", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    length = helper.create_variable_for_type_inference("int64", True)
+    ins = {"X": [x.name], "PadValue": [pad_value.name]}
+    lengths = _default_lengths(helper, x)
+    if lengths is not None:
+        ins["Lengths"] = [lengths.name]
+    helper.append_op(type="sequence_pad", inputs=ins,
+                     outputs={"Out": [out.name], "Length": [length.name]},
+                     attrs={"padded_length": maxlen or -1})
+    return out, length
+
+
+def sequence_unpad(x, length, name=None):
+    helper = LayerHelper("sequence_unpad", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="sequence_unpad",
+                     inputs={"X": [x.name], "Length": [length.name]},
+                     outputs={"Out": [out.name]})
+    # the unpadded tensor stays padded-dense on device; keep the lengths
+    # link so downstream sequence ops mask correctly
+    helper.block.program.lod_link[out.name] = length.name
+    return out
+
+
+def sequence_slice(input, offset, length, name=None):
+    helper = LayerHelper("sequence_slice", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="sequence_slice",
+                     inputs={"X": [input.name], "Offset": [offset.name],
+                             "Length": [length.name]},
+                     outputs={"Out": [out.name]})
+    return out
+
+
+def sequence_reshape(input, new_dim, name=None):
+    helper = LayerHelper("sequence_reshape", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="sequence_reshape", inputs={"X": [input.name]},
+                     outputs={"Out": [out.name]},
+                     attrs={"new_dim": new_dim})
+    return out
+
+
+def sequence_scatter(input, index, updates, name=None):
+    helper = LayerHelper("sequence_scatter", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="sequence_scatter",
+                     inputs={"X": [input.name], "Ids": [index.name],
+                             "Updates": [updates.name]},
+                     outputs={"Out": [out.name]})
+    return out
+
+
+def sequence_enumerate(input, win_size, pad_value=0, name=None):
+    helper = LayerHelper("sequence_enumerate", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype, True)
+    helper.append_op(type="sequence_enumerate", inputs={"X": [input.name]},
+                     outputs={"Out": [out.name]},
+                     attrs={"win_size": win_size, "pad_value": pad_value})
+    return out
