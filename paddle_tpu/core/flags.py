@@ -223,23 +223,124 @@ DEFINE_string(
     "When set, fluid.profiler writes chrome-trace/XPlane dumps here by "
     "default. Reference: FLAGS profile_path (flags.cc).")
 
-# --- compatibility tier: accepted + stored, no effect on TPU ------------
+# ---------------------------------------------------------------------------
+# Reference-flag compat surface (App. C parity target:
+# platform/flags.cc:33-449 + the read_env_flags whitelist in
+# python/paddle/fluid/__init__.py:165). Reference programs call
+# fluid.set_flags / export FLAGS_* freely; every name in the inventory
+# is accepted here. Flags marked no-op describe CUDA/CPU-runtime
+# machinery that XLA/TPU absorbs (allocator strategies, cuDNN
+# autotuning, NCCL dirs, eager deletion GC, ...) — they are settable,
+# readable, and ignored, with the TPU-native equivalent named in the
+# help text where one exists.
+# ---------------------------------------------------------------------------
+
+def _compat(name, default, help_=""):
+    ftype = type(default)
+    _define(name, default,
+            ftype if ftype in (bool, int, float) else str,
+            help_, noop=True)
+
+
 for _name, _default, _help in [
-    ("eager_delete_tensor_gb", 0.0,
-     "no-op: XLA buffer assignment owns device memory lifetime"),
-    ("fraction_of_gpu_memory_to_use", 0.92,
-     "no-op: no CUDA allocator in this runtime"),
-    ("cudnn_deterministic", False,
-     "no-op: XLA:TPU compilation is deterministic"),
-    ("allocator_strategy", "auto_growth",
-     "no-op: kept for reference-script compatibility"),
     ("cpu_deterministic", False,
      "no-op: single jitted computation is deterministic"),
-    ("local_exe_sub_scope_limit", 0.5,
-     "no-op: no per-device sub-scopes; XLA owns live-range memory"),
+    ("allocator_strategy", "naive_best_fit",
+     "no-op: device memory is XLA buffer assignment; host pool is "
+     "native/src/allocator.h"),
+    ("fast_check_nan_inf", False,
+     "no-op: FLAGS_check_nan_inf covers both modes here"),
+    ("collective_get_thread_num", 16, "no-op: XLA collectives"),
+    ("communicator_fake_rpc", False, "no-op: test hook of the ref"),
+    ("communicator_independent_recv_thread", True,
+     "no-op: PS communicator threading (distributed/ps_server.py)"),
+    ("communicator_is_sgd_optimizer", True, "no-op"),
+    ("communicator_max_merge_var_num", 20, "no-op"),
+    ("communicator_merge_sparse_bucket", 2000, "no-op"),
+    ("communicator_merge_sparse_grad", True, "no-op"),
+    ("communicator_min_send_grad_num_before_recv", 20, "no-op"),
+    ("communicator_send_queue_size", 20, "no-op"),
+    ("communicator_send_wait_times", 5, "no-op"),
+    ("communicator_thread_pool_size", 5, "no-op"),
+    ("conv_workspace_size_limit", 512,
+     "no-op: XLA picks conv algorithms; no cuDNN workspace"),
+    ("cudnn_batchnorm_spatial_persistent", False, "no-op: CUDA-only"),
+    ("cudnn_deterministic", False,
+     "no-op: XLA TPU executables are deterministic by construction"),
+    ("cudnn_exhaustive_search", False, "no-op: CUDA-only"),
+    ("cudnn_exhaustive_search_times", -1, "no-op: CUDA-only"),
+    ("dist_threadpool_size", 0,
+     "no-op: RPC concurrency is distributed/rpc.py thread-per-conn"),
+    ("dygraph_debug", False, "no-op: use check_nan_inf / jax debug"),
+    ("enable_parallel_graph", False,
+     "no-op: multi-device execution is GSPMD, not graph replication"),
+    ("fast_eager_deletion_mode", True,
+     "no-op: buffer lifetime is XLA's; donation frees inputs"),
+    ("fraction_of_cpu_memory_to_use", 1.0, "no-op"),
+    ("fraction_of_gpu_memory_to_use", 0.92,
+     "no-op: HBM budgeting is core/memory.py assert_hbm_within"),
+    ("init_allocated_mem", False, "no-op"),
+    ("initial_cpu_memory_in_mb", 500, "no-op"),
+    ("inner_op_parallelism", 0, "no-op: XLA schedules ops"),
+    ("io_threadpool_size", 100,
+     "no-op: reader threads are reader.py + native data_feed.cc"),
+    ("local_exe_sub_scope_limit", 256.0,
+     "no-op: no per-device scopes (reference: double, MBytes)"),
+    ("eager_delete_scope", True, "no-op: Scope GC is Python's"),
+    ("enable_cublas_tensor_op_math", False, "no-op: CUDA-only"),
+    ("fuse_parameter_groups_size", 3,
+     "no-op: gradient fusion is XLA's; GradientMergeOptimizer covers "
+     "the accumulation use case"),
+    ("fuse_parameter_memory_size", -1, "no-op: same as groups_size"),
+    ("gpu_allocator_retry_time", 2000, "no-op"),
+    ("initial_gpu_memory_in_mb", 0, "no-op"),
+    ("max_body_size", 2147483647,
+     "no-op: distributed/rpc.py frames are length-prefixed without a "
+     "hard cap"),
+    ("print_sub_graph_dir", "",
+     "no-op: graph dumps via debugger.draw_block_graphviz"),
+    ("reader_queue_speed_test_mode", False,
+     "no-op: test hook of the reference reader queue"),
+    ("rpc_get_thread_num", 12, "no-op: thread-per-connection server"),
+    ("rpc_prefetch_thread_num", 12, "no-op"),
+    ("rpc_send_thread_num", 12, "no-op"),
+    ("sync_nccl_allreduce", True,
+     "no-op: XLA collectives are synchronous in-program ops"),
+    ("free_idle_memory", False, "no-op"),
+    ("limit_of_tmp_allocation", -1, "no-op"),
+    ("memory_optimize_debug", "", "no-op: no memory-reuse pass to log"),
+    ("times_excess_than_required_tmp_allocation", 2, "no-op"),
+    ("memory_fraction_of_eager_deletion", 1.0, "no-op"),
+    ("paddle_num_threads", 1, "no-op: host math is jax CPU"),
+    ("pe_profile_fname", "", "no-op: use profiler.py traces"),
+    ("reallocate_gpu_memory_in_mb", 0, "no-op"),
+    ("rpc_deadline", 180000,
+     "no-op: distributed/rpc.py uses socket timeouts"),
+    ("rpc_disable_reuse_port", False, "no-op"),
+    ("rpc_retry_bind_port", 3, "no-op"),
+    ("rpc_retry_times", 3, "no-op"),
+    ("rpc_server_profile_path", "./profile_ps", "no-op"),
+    ("selected_gpus", "",
+     "no-op: device selection is JAX_PLATFORMS / jax.devices()"),
+    ("skip_fused_all_reduce_check", False, "no-op"),
+    ("use_mkldnn", False, "no-op: CPU fallback is XLA:CPU"),
+    ("use_ngraph", False, "no-op"),
+    ("worker_update_interval_secs", 900, "no-op: PS heartbeat knob"),
+    ("benchmark", False,
+     "no-op: bench.py + profiler.py are the benchmark path"),
+    ("eager_delete_tensor_gb", 0.0,
+     "no-op: XLA buffer assignment frees dead buffers at compile "
+     "time; donation covers step state"),
+    ("enable_rpc_profiler", False, "no-op"),
+    ("multiple_of_cupti_buffer_size", 1, "no-op: CUPTI is CUDA-only"),
+    ("init_p2p", True, "no-op: ICI needs no P2P init"),
+    ("cuda_dir", "", "no-op: dynload search path, CUDA-only"),
+    ("cudnn_dir", "", "no-op"),
+    ("nccl_dir", "", "no-op: collectives ride XLA/ICI"),
+    ("mklml_dir", "", "no-op"),
+    ("cupti_dir", "", "no-op"),
+    ("use_pinned_memory", True, "no-op"),
+    ("tracer_profile_fname", "", "no-op: dygraph tracing uses "
+     "profiler.py"),
 ]:
-    f = _define(_name, _default,
-                bool if isinstance(_default, bool)
-                else float if isinstance(_default, float)
-                else str if isinstance(_default, str) else int,
-                _help, noop=True)
+    _compat(_name, _default, _help)

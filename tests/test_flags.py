@@ -125,3 +125,30 @@ def test_executor_cache_evicts_lru():
         assert len(exe._cache) <= 2
     finally:
         fluid.set_flags({"FLAGS_executor_cache_capacity": 64})
+
+
+def test_reference_flag_inventory_accepted():
+    """App. C parity: every flags.cc name a reference program might set
+    is accepted (live knob or documented no-op)."""
+    import paddle_tpu as fluid
+    names = ["allocator_strategy", "check_nan_inf", "fast_check_nan_inf",
+             "cudnn_deterministic", "cudnn_exhaustive_search",
+             "fraction_of_gpu_memory_to_use", "eager_delete_tensor_gb",
+             "inner_op_parallelism", "paddle_num_threads", "use_mkldnn",
+             "rpc_deadline", "communicator_send_queue_size",
+             "selected_gpus", "init_p2p", "use_pinned_memory",
+             "benchmark", "tracer_profile_fname"]
+    names += ["sync_nccl_allreduce", "eager_delete_scope",
+              "fuse_parameter_groups_size", "fuse_parameter_memory_size",
+              "reader_queue_speed_test_mode", "max_body_size",
+              "rpc_get_thread_num", "local_exe_sub_scope_limit"]
+    vals = fluid.get_flags([f"FLAGS_{n}" for n in names])
+    assert len(vals) == len(names)
+    # reference type fidelity: double flag stays float
+    assert isinstance(vals["FLAGS_local_exe_sub_scope_limit"], float)
+    try:
+        fluid.set_flags({"FLAGS_cudnn_deterministic": True})
+        assert fluid.get_flags(["FLAGS_cudnn_deterministic"])[
+            "FLAGS_cudnn_deterministic"] is True
+    finally:
+        fluid.set_flags({"FLAGS_cudnn_deterministic": False})
