@@ -1,0 +1,162 @@
+// C-ABI inference entry points: load a saved inference model and run
+// predictions from pure C/C++ — the counterpart of the reference's
+// inference/capi/ (PD_NewAnalysisConfig, PD_PredictorRun,
+// PD_GetOutputTensor). Same embedding strategy as trainer.cc: the XLA
+// compute path is driven through an embedded (or hosted) CPython via
+// paddle_tpu.native_predictor; buffers cross the ABI raw.
+#include "py_embed.h"
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+
+namespace {
+
+using ptn_embed::Gil;
+using ptn_embed::capture_py_error;
+
+struct Predictor {
+  PyObject* obj;  // paddle_tpu.native_predictor.NativePredictor
+};
+
+constexpr int kMaxRank = 8;  // dims_out contract in output_meta
+
+}  // namespace
+
+extern "C" {
+
+const char* ptn_predictor_last_error() {
+  return ptn_embed::last_error().c_str();
+}
+
+// Interpreter bootstrap. Identical contract to ptn_trainer_init.
+int ptn_predictor_init(const char* repo_root) {
+  return ptn_embed::bootstrap(repo_root, "paddle_tpu.native_predictor");
+}
+
+// Load a model dir written by fluid.io.save_inference_model. Returns a
+// handle or NULL (see ptn_predictor_last_error).
+void* ptn_predictor_load(const char* model_dir) {
+  Gil gil;
+  PyObject* mod = PyImport_ImportModule("paddle_tpu.native_predictor");
+  if (!mod) {
+    capture_py_error("import");
+    return nullptr;
+  }
+  PyObject* obj = PyObject_CallMethod(mod, "load_predictor", "s", model_dir);
+  Py_DECREF(mod);
+  if (!obj) {
+    capture_py_error("load_predictor");
+    return nullptr;
+  }
+  return new Predictor{obj};
+}
+
+// Run one prediction. Feed ABI matches ptn_trainer_run_step. Returns
+// the number of outputs (cached on the handle), or -1 on failure.
+int ptn_predictor_run(void* handle, int n, const char** names,
+                      const void** bufs, const uint64_t* nbytes,
+                      const char** dtypes, const int64_t* shapes,
+                      const int* ranks) {
+  Gil gil;
+  Predictor* p = static_cast<Predictor*>(handle);
+  PyObject* feed = PyList_New(n);
+  const int64_t* sp = shapes;
+  for (int i = 0; i < n; ++i) {
+    PyObject* shape = PyTuple_New(ranks[i]);
+    for (int d = 0; d < ranks[i]; ++d)
+      PyTuple_SET_ITEM(shape, d, PyLong_FromLongLong(sp[d]));
+    sp += ranks[i];
+    PyObject* entry = Py_BuildValue(
+        "(sy#sO)", names[i], static_cast<const char*>(bufs[i]),
+        static_cast<Py_ssize_t>(nbytes[i]), dtypes[i], shape);
+    Py_DECREF(shape);
+    if (!entry) {
+      capture_py_error("build feed entry");
+      Py_DECREF(feed);
+      return -1;
+    }
+    PyList_SET_ITEM(feed, i, entry);
+  }
+  PyObject* r = PyObject_CallMethod(p->obj, "run_raw", "O", feed);
+  Py_DECREF(feed);
+  if (!r) {
+    capture_py_error("run_raw");
+    return -1;
+  }
+  long count = PyLong_AsLong(r);
+  Py_DECREF(r);
+  return static_cast<int>(count);
+}
+
+// Metadata of output i from the last run: dtype string (copied into
+// dtype_buf, NUL-terminated), rank + dims (dims_out must hold >= 8),
+// and total byte size. Returns 0 / -1 (rank > 8 is an error — the
+// caller's dims buffer contract is 8).
+int ptn_predictor_output_meta(void* handle, int i, char* dtype_buf,
+                              int dtype_cap, int* rank_out,
+                              int64_t* dims_out, uint64_t* nbytes_out) {
+  Gil gil;
+  Predictor* p = static_cast<Predictor*>(handle);
+  PyObject* r = PyObject_CallMethod(p->obj, "output_meta", "i", i);
+  if (!r) {
+    capture_py_error("output_meta");
+    return -1;
+  }
+  const char* dt = nullptr;
+  PyObject* shape = nullptr;
+  long long nb = 0;
+  if (!PyArg_ParseTuple(r, "sOL", &dt, &shape, &nb)) {
+    capture_py_error("parse output_meta");
+    Py_DECREF(r);
+    return -1;
+  }
+  Py_ssize_t rank = PyList_Size(shape);
+  if (rank > kMaxRank) {
+    ptn_embed::last_error() = "output_meta: rank exceeds the 8-dim ABI";
+    Py_DECREF(r);
+    return -1;
+  }
+  std::snprintf(dtype_buf, dtype_cap, "%s", dt);
+  *rank_out = static_cast<int>(rank);
+  for (Py_ssize_t d = 0; d < rank; ++d)
+    dims_out[d] = PyLong_AsLongLong(PyList_GetItem(shape, d));
+  *nbytes_out = static_cast<uint64_t>(nb);
+  Py_DECREF(r);
+  return 0;
+}
+
+// Copy output i's bytes into dst (cap bytes). Returns bytes written or
+// -1.
+int64_t ptn_predictor_output_data(void* handle, int i, void* dst,
+                                  uint64_t cap) {
+  Gil gil;
+  Predictor* p = static_cast<Predictor*>(handle);
+  PyObject* r = PyObject_CallMethod(p->obj, "output_bytes", "i", i);
+  if (!r) {
+    capture_py_error("output_bytes");
+    return -1;
+  }
+  char* buf = nullptr;
+  Py_ssize_t len = 0;
+  if (PyBytes_AsStringAndSize(r, &buf, &len) != 0) {
+    capture_py_error("output bytes access");
+    Py_DECREF(r);
+    return -1;
+  }
+  if (static_cast<uint64_t>(len) > cap) len = static_cast<Py_ssize_t>(cap);
+  std::memcpy(dst, buf, len);
+  Py_DECREF(r);
+  return static_cast<int64_t>(len);
+}
+
+void ptn_predictor_destroy(void* handle) {
+  Predictor* p = static_cast<Predictor*>(handle);
+  if (p) {
+    Gil gil;
+    Py_XDECREF(p->obj);
+    delete p;
+  }
+}
+
+}  // extern "C"

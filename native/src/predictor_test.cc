@@ -1,0 +1,103 @@
+// Pure-C++ inference entry test: build+save an inference model via
+// embedded setup, then LOAD and PREDICT entirely through the C ABI —
+// the counterpart of the reference's inference/capi tests
+// (pd_config/pd_predict test suite).
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <vector>
+
+extern "C" {
+int ptn_predictor_init(const char* repo_root);
+void* ptn_predictor_load(const char* model_dir);
+int ptn_predictor_run(void* handle, int n, const char** names,
+                      const void** bufs, const uint64_t* nbytes,
+                      const char** dtypes, const int64_t* shapes,
+                      const int* ranks);
+int ptn_predictor_output_meta(void* handle, int i, char* dtype_buf,
+                              int dtype_cap, int* rank_out,
+                              int64_t* dims_out, uint64_t* nbytes_out);
+int64_t ptn_predictor_output_data(void* handle, int i, void* dst,
+                                  uint64_t cap);
+void ptn_predictor_destroy(void* handle);
+const char* ptn_predictor_last_error();
+// from trainer.cc (linked together): arbitrary setup python
+int ptn_trainer_exec(const char* code);
+}
+
+#define CHECK(cond)                                                   \
+  do {                                                                \
+    if (!(cond)) {                                                    \
+      std::fprintf(stderr, "FAILED: %s (line %d): %s\n", #cond,       \
+                   __LINE__, ptn_predictor_last_error());             \
+      std::exit(1);                                                   \
+    }                                                                 \
+  } while (0)
+
+int main(int argc, char** argv) {
+  const char* repo = argc > 1 ? argv[1] : "..";
+  CHECK(ptn_predictor_init(repo) == 0);
+
+  const char* setup = R"PY(
+import numpy as np
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+main, startup = fluid.Program(), fluid.Program()
+scope = fluid.Scope()
+with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+    x = layers.data("x", shape=[8], dtype="float32")
+    y = layers.fc(x, size=4, act="relu")
+    z = layers.fc(y, size=2)
+    exe = fluid.Executor()
+    exe.run(startup)
+    fluid.io.save_inference_model("/tmp/ptn_pred_model", ["x"], [z], exe,
+                                  main_program=main)
+)PY";
+  CHECK(ptn_trainer_exec(setup) == 0);
+
+  void* pred = ptn_predictor_load("/tmp/ptn_pred_model");
+  CHECK(pred != nullptr);
+
+  std::vector<float> x(6 * 8);
+  for (size_t i = 0; i < x.size(); ++i) x[i] = 0.01f * (float)i - 0.2f;
+  const char* names[] = {"x"};
+  const void* bufs[] = {x.data()};
+  const uint64_t nbytes[] = {x.size() * sizeof(float)};
+  const char* dtypes[] = {"float32"};
+  const int64_t shapes[] = {6, 8};
+  const int ranks[] = {2};
+
+  int n_out = ptn_predictor_run(pred, 1, names, bufs, nbytes, dtypes,
+                                shapes, ranks);
+  CHECK(n_out == 1);
+
+  char dtype[16];
+  int rank = 0;
+  int64_t dims[8];
+  uint64_t out_bytes = 0;
+  CHECK(ptn_predictor_output_meta(pred, 0, dtype, sizeof(dtype), &rank,
+                                  dims, &out_bytes) == 0);
+  CHECK(std::strcmp(dtype, "float32") == 0);
+  CHECK(rank == 2 && dims[0] == 6 && dims[1] == 2);
+  CHECK(out_bytes == 6 * 2 * sizeof(float));
+
+  std::vector<float> out(6 * 2);
+  CHECK(ptn_predictor_output_data(pred, 0, out.data(),
+                                  out_bytes) == (int64_t)out_bytes);
+  for (float v : out) CHECK(std::isfinite(v));
+
+  // run twice: same input -> identical output (deterministic inference)
+  std::vector<float> out2(6 * 2);
+  CHECK(ptn_predictor_run(pred, 1, names, bufs, nbytes, dtypes, shapes,
+                          ranks) == 1);
+  CHECK(ptn_predictor_output_data(pred, 0, out2.data(),
+                                  out_bytes) == (int64_t)out_bytes);
+  for (size_t i = 0; i < out.size(); ++i) CHECK(out[i] == out2[i]);
+
+  ptn_predictor_destroy(pred);
+  std::printf("predictor_test OK\n");
+  return 0;
+}

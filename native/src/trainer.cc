@@ -8,8 +8,7 @@
 // direction; the CPython C API is the sanctioned binding layer here) and
 // drives paddle_tpu.native_trainer. C callers never touch Python types:
 // feeds cross the ABI as raw buffers + shape/dtype strings.
-#define PY_SSIZE_T_CLEAN
-#include <Python.h>
+#include "py_embed.h"
 
 #include <cstdint>
 #include <cstring>
@@ -17,75 +16,25 @@
 
 namespace {
 
+using ptn_embed::Gil;
+using ptn_embed::capture_py_error;
+
 struct Trainer {
   PyObject* obj;  // paddle_tpu.native_trainer.NativeTrainer
 };
-
-// GIL helper working both embedded (we own the interpreter) and hosted
-// (this .so was ctypes-loaded inside a running Python).
-class Gil {
- public:
-  Gil() : state_(PyGILState_Ensure()) {}
-  ~Gil() { PyGILState_Release(state_); }
-
- private:
-  PyGILState_STATE state_;
-};
-
-thread_local std::string g_last_error;
-
-void capture_py_error(const char* where) {
-  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
-  PyErr_Fetch(&type, &value, &tb);
-  PyObject* s = value ? PyObject_Str(value) : nullptr;
-  g_last_error = std::string(where) + ": " +
-                 (s ? PyUnicode_AsUTF8(s) : "unknown python error");
-  Py_XDECREF(s);
-  Py_XDECREF(type);
-  Py_XDECREF(value);
-  Py_XDECREF(tb);
-}
 
 }  // namespace
 
 extern "C" {
 
-const char* ptn_trainer_last_error() { return g_last_error.c_str(); }
+const char* ptn_trainer_last_error() {
+  return ptn_embed::last_error().c_str();
+}
 
 // Initialize the embedded interpreter (no-op when already hosted inside
-// Python). repo_root is prepended to sys.path; jax is pinned to the CPU
-// backend unless PTN_TRAINER_KEEP_PLATFORM is set (the TPU-tunnel
-// backend must not be claimed by a side process).
+// Python); see py_embed.h bootstrap for the JAX_PLATFORMS pinning.
 int ptn_trainer_init(const char* repo_root) {
-  bool embedded = false;
-  if (!Py_IsInitialized()) {
-    if (!getenv("PTN_TRAINER_KEEP_PLATFORM")) setenv("JAX_PLATFORMS", "cpu", 1);
-    Py_InitializeEx(0);
-    embedded = true;
-  }
-  int rc = 0;
-  {
-    Gil gil;
-    PyObject* sys_path = PySys_GetObject("path");  // borrowed
-    if (repo_root && *repo_root) {
-      PyObject* p = PyUnicode_FromString(repo_root);
-      PyList_Insert(sys_path, 0, p);
-      Py_DECREF(p);
-    }
-    PyObject* mod = PyImport_ImportModule("paddle_tpu.native_trainer");
-    if (!mod) {
-      capture_py_error("import paddle_tpu.native_trainer");
-      rc = -1;
-    } else {
-      Py_DECREF(mod);
-    }
-  }
-  if (embedded) {
-    // Release the GIL the init thread acquired with Py_InitializeEx so
-    // other C threads can enter via PyGILState_Ensure.
-    PyEval_SaveThread();
-  }
-  return rc;
+  return ptn_embed::bootstrap(repo_root, "paddle_tpu.native_trainer");
 }
 
 // Load a model directory saved by
@@ -177,7 +126,7 @@ void ptn_trainer_destroy(void* handle) {
 int ptn_trainer_exec(const char* code) {
   Gil gil;
   if (PyRun_SimpleString(code) != 0) {
-    g_last_error = "ptn_trainer_exec: python raised";
+    ptn_embed::last_error() = "ptn_trainer_exec: python raised";
     return -1;
   }
   return 0;

@@ -1,0 +1,53 @@
+"""Python half of the C-ABI inference API (native/src/predictor.cc).
+
+Reference: paddle/fluid/inference/capi/ — PD_NewAnalysisConfig /
+PD_PredictorRun etc. give C callers a stable inference entry. Here the
+saved artifact is the inference model written by
+fluid.io.save_inference_model; the C side feeds raw buffers and reads
+raw buffers back, never touching Python types.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["NativePredictor", "load_predictor"]
+
+
+class NativePredictor:
+    def __init__(self, model_dir):
+        import paddle_tpu as fluid
+        self._fluid = fluid
+        self.scope = fluid.Scope()
+        self.exe = fluid.Executor()
+        with fluid.scope_guard(self.scope):
+            prog, feeds, fetches = fluid.io.load_inference_model(
+                model_dir, self.exe)
+        self.program = prog
+        self.feed_names = list(feeds)
+        self.fetch_vars = fetches
+        self._outputs = []
+
+    def run_raw(self, feed_entries):
+        """feed_entries: [(name, raw_bytes, dtype_str, shape_tuple)].
+        Executes and caches outputs; returns the output count. The C
+        side then reads each output via output_meta/output_bytes."""
+        feed = {name: np.frombuffer(buf, dtype=np.dtype(dtype))
+                .reshape(shape)
+                for name, buf, dtype, shape in feed_entries}
+        with self._fluid.scope_guard(self.scope):
+            outs = self.exe.run(self.program, feed=feed,
+                                fetch_list=self.fetch_vars)
+        self._outputs = [np.ascontiguousarray(np.asarray(o))
+                         for o in outs]
+        return len(self._outputs)
+
+    def output_meta(self, i):
+        o = self._outputs[i]
+        return (str(o.dtype), list(o.shape), int(o.nbytes))
+
+    def output_bytes(self, i):
+        return self._outputs[i].tobytes()
+
+
+def load_predictor(model_dir) -> NativePredictor:
+    return NativePredictor(model_dir)

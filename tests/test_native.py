@@ -150,3 +150,47 @@ def test_native_trainer_python_surface(tmp_path):
     assert last < first
     tr.save(str(tmp_path / "out"))
     assert (tmp_path / "out" / "main_program.json").exists()
+
+
+def test_c_abi_predictor_predicts():
+    """Pure-C inference entry (native/src/predictor.cc +
+    predictor_test.cc): the reference inference/capi analogue — save an
+    inference model, load + run it from C, read raw outputs back.
+    Skipped when no C++ toolchain/libpython is present."""
+    import shutil
+    if shutil.which("g++") is None or \
+            shutil.which("python3-config") is None:
+        pytest.skip("no C++ toolchain / python3-dev")
+    r = subprocess.run(["make", "-s", "predictor-test"],
+                       cwd=os.path.join(REPO, "native"),
+                       capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "predictor_test OK" in r.stdout
+
+
+def test_native_predictor_python_surface(tmp_path):
+    """NativePredictor drives the same artifact fluid C API consumes."""
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    from paddle_tpu.native_predictor import load_predictor
+
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    d = str(tmp_path / "pred_model")
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        x = layers.data("x", shape=[8], dtype="float32")
+        z = layers.fc(x, size=2)
+        exe = fluid.Executor()
+        exe.run(startup)
+        fluid.io.save_inference_model(d, ["x"], [z], exe,
+                                      main_program=main)
+    p = load_predictor(d)
+    xv = np.ones((3, 8), np.float32)
+    n = p.run_raw([("x", xv.tobytes(), "float32", (3, 8))])
+    assert n == 1
+    dtype, shape, nbytes = p.output_meta(0)
+    assert dtype == "float32" and shape == [3, 2]
+    out = np.frombuffer(p.output_bytes(0), np.float32).reshape(shape)
+    assert np.isfinite(out).all()
