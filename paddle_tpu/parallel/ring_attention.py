@@ -27,16 +27,24 @@ from jax.sharding import PartitionSpec as P
 NEG_INF = -1e30
 
 
-def _block_attn(q, k, v, sm_scale, q_off, k_off, causal):
-    """Attention of local q against one k/v block, returning (o, lse).
-    q: [b, h, tq, d]; k/v: [b, h, tk, d]."""
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+def _masked_scores(q, k_blk, sm_scale, q_off, k_off, causal):
+    """Scaled qk^T scores with the causal mask applied — shared by the
+    forward block attention and the blockwise ring backward so the two
+    can never desynchronize."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk,
                    preferred_element_type=jnp.float32) * sm_scale
     if causal:
-        tq, tk = q.shape[2], k.shape[2]
+        tq, tk = q.shape[2], k_blk.shape[2]
         qpos = q_off + jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 0)
         kpos = k_off + jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 1)
         s = jnp.where((qpos >= kpos)[None, None], s, NEG_INF)
+    return s
+
+
+def _block_attn(q, k, v, sm_scale, q_off, k_off, causal):
+    """Attention of local q against one k/v block, returning (o, lse).
+    q: [b, h, tq, d]; k/v: [b, h, tk, d]."""
+    s = _masked_scores(q, k, sm_scale, q_off, k_off, causal)
     m = jnp.max(s, axis=-1, keepdims=True)
     m = jnp.maximum(m, NEG_INF)  # avoid -inf - -inf
     p = jnp.exp(s - m)
@@ -47,16 +55,11 @@ def _block_attn(q, k, v, sm_scale, q_off, k_off, causal):
     return o, lse  # o normalised within the block; merge by lse weights
 
 
-def ring_attention(q, k, v, axis_name, causal=False, sm_scale=None):
-    """Inside shard_map: q,k,v are the LOCAL sequence chunks
-    [b, h, t_local, d]. Returns local attention output chunk."""
-    if sm_scale is None:
-        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+def _ring_fwd_loop(q, k, v, axis_name, causal, sm_scale):
     n = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     t_local = q.shape[2]
     q_off = idx * t_local
-
     perm = [(j, (j + 1) % n) for j in range(n)]
 
     def step(i, carry):
@@ -77,7 +80,85 @@ def ring_attention(q, k, v, axis_name, causal=False, sm_scale=None):
     o0 = jnp.zeros((b, h, t, d), jnp.float32)
     lse0 = jnp.full((b, h, t, 1), NEG_INF, jnp.float32)
     o, lse, _ = jax.lax.fori_loop(0, n, step, (o0, lse0, (k, v)))
-    return o.astype(q.dtype)
+    return o.astype(q.dtype), lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _ring(q, k, v, axis_name, causal, sm_scale):
+    o, _ = _ring_fwd_loop(q, k, v, axis_name, causal, sm_scale)
+    return o
+
+
+def _ring_vjp_fwd(q, k, v, axis_name, causal, sm_scale):
+    o, lse = _ring_fwd_loop(q, k, v, axis_name, causal, sm_scale)
+    # after n rotations k/v are home again: residuals are the originals
+    return o, (q, k, v, o, lse)
+
+
+def _ring_vjp_bwd(axis_name, causal, sm_scale, res, do):
+    """FlashAttention-2-style blockwise backward around the ring: each
+    step recomputes p = exp(s - lse) for the currently-held k/v chunk,
+    accumulates dq locally, and accumulates dk/dv into buffers that
+    ROTATE WITH the chunk — after the full ring the buffers land back on
+    the chunk's owner. All dots take bf16 operands with f32 accumulation
+    (a custom-vjp backward is safe from jax's dot-transpose f32
+    poisoning; see ops/math.py:_mul)."""
+    q, k, v, o, lse = res
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    t_local = q.shape[2]
+    q_off = idx * t_local
+    perm = [(j, (j + 1) % n) for j in range(n)]
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1, keepdims=True)  # [b, h, tq, 1]
+
+    def step(i, carry):
+        dq, kv, dkv = carry
+        k_blk, v_blk = kv
+        dk_acc, dv_acc = dkv
+        src = (idx - i) % n
+        k_off = src * t_local
+        s = _masked_scores(q, k_blk, sm_scale, q_off, k_off, causal)
+        p = jnp.exp(s - lse)                       # [b, h, tq, tk] f32
+        dp = jnp.einsum("bhqd,bhkd->bhqk", do, v_blk,
+                        preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        ds_l = ds.astype(q.dtype)
+        p_l = p.astype(q.dtype)
+        dq = dq + jnp.einsum("bhqk,bhkd->bhqd", ds_l, k_blk,
+                             preferred_element_type=jnp.float32)
+        dk_acc = dk_acc + jnp.einsum("bhqk,bhqd->bhkd", ds_l, q,
+                                     preferred_element_type=jnp.float32)
+        dv_acc = dv_acc + jnp.einsum("bhqk,bhqd->bhkd", p_l, do,
+                                     preferred_element_type=jnp.float32)
+        kv, dkv = jax.lax.ppermute(
+            ((k_blk, v_blk), (dk_acc, dv_acc)), axis_name, perm)
+        return dq, kv, dkv
+
+    b, h, t, d = q.shape
+    zeros = jnp.zeros((b, h, t, d), jnp.float32)
+    dq, _, (dk, dv) = jax.lax.fori_loop(
+        0, n, step, (zeros, (k, v), (zeros, zeros)))
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_ring.defvjp(_ring_vjp_fwd, _ring_vjp_bwd)
+
+
+def ring_attention(q, k, v, axis_name, causal=False, sm_scale=None):
+    """Inside shard_map: q,k,v are the LOCAL sequence chunks
+    [b, h, t_local, d]. Returns local attention output chunk.
+    Differentiable via a blockwise ring backward (custom vjp)."""
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    if not isinstance(sm_scale, (int, float)):
+        # custom_vjp nondiff args must be static; fail with the contract
+        # spelled out instead of a ConcretizationTypeError deep inside
+        raise TypeError(
+            "ring_attention: sm_scale must be a static python number "
+            f"(got {type(sm_scale).__name__}); close over the value "
+            "instead of passing it as a traced array")
+    return _ring(q, k, v, axis_name, causal, float(sm_scale))
 
 
 def ring_attention_sharded(q, k, v, mesh, seq_axis, causal=False,
