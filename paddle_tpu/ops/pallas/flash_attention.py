@@ -39,94 +39,137 @@ NEG_INF = -1e30
 
 
 # ---------------------------------------------------------------------------
-# forward kernel: grid = (batch*heads, num_q_blocks)
+# forward kernel: grid = (batch*heads, num_q_blocks, num_k_blocks) — the
+# k dimension is a GRID dimension (ARBITRARY semantics) rather than an
+# in-kernel fori_loop, so Pallas streams k/v blocks through VMEM with
+# automatic double buffering (DMA of block j+1 overlaps compute on j);
+# the (m, l, acc) softmax state lives in VMEM scratch, which persists
+# across the sequentially-executed innermost grid dimension.
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
-                block_k, kv_len):
+def _mask_block(s, qi, kb, block_q, block_k, causal, kv_len, t):
+    if causal or kv_len < t:
+        qpos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        kpos = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        keep = kpos < kv_len
+        if causal:
+            keep = jnp.logical_and(keep, qpos >= kpos)
+        s = jnp.where(keep, s, NEG_INF)
+    return s
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *,
+                sm_scale, causal, kv_len, t):
     # block shapes carry a leading singleton (bh) dim: q_ref[0] = [bq, d],
-    # k_ref[0]/v_ref[0] = [T, d] (full K/V for this head).
-    # Operands stay in their input dtype (bf16 under AMP) so the MXU runs
-    # its fast path; every accumulation is f32 via preferred_element_type.
-    q = q_ref[0]
-    block_q, d = q.shape
-    t = k_ref.shape[1]
+    # k_ref[0]/v_ref[0] = [bk, d]. Operands stay in their input dtype
+    # (bf16 under AMP) so the MXU runs its fast path; accumulation is f32.
     qi = pl.program_id(1)
+    kb = pl.program_id(2)
+    nkb = pl.num_programs(2)
+    block_q = q_ref.shape[1]
+    block_k = k_ref.shape[1]
 
-    m = jnp.full((block_q, 1), NEG_INF, jnp.float32)
-    l = jnp.zeros((block_q, 1), jnp.float32)
-    acc = jnp.zeros((block_q, d), jnp.float32)
+    @pl.when(kb == 0)
+    def _init():
+        m_s[...] = jnp.full(m_s.shape, NEG_INF, jnp.float32)
+        l_s[...] = jnp.zeros(l_s.shape, jnp.float32)
+        acc_s[...] = jnp.zeros(acc_s.shape, jnp.float32)
 
-    num_kb = t // block_k
-
-    def body(kb, carry):
-        m, l, acc = carry
-        k = k_ref[0, pl.ds(kb * block_k, block_k), :]
-        v = v_ref[0, pl.ds(kb * block_k, block_k), :]
+    def body():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         s = s * sm_scale
-        if causal or kv_len < t:
-            qpos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            kpos = kb * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            keep = kpos < kv_len
-            if causal:
-                keep = jnp.logical_and(keep, qpos >= kpos)
-            s = jnp.where(keep, s, NEG_INF)
+        s = _mask_block(s, qi, kb, block_q, block_k, causal, kv_len, t)
+        m = m_s[...]
         m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
-        l_new = alpha * l + jnp.sum(p, axis=1, keepdims=True)
-        acc_new = alpha * acc + jax.lax.dot_general(
+        l_s[...] = alpha * l_s[...] + jnp.sum(p, axis=1, keepdims=True)
+        acc_s[...] = alpha * acc_s[...] + jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        return m_new, l_new, acc_new
+        m_s[...] = m_new
 
     if causal:
-        # skip k blocks entirely past the diagonal:
-        # need ceil(((qi+1)*block_q) / block_k) blocks
-        need = ((qi + 1) * block_q + block_k - 1) // block_k
-        num_iters = jnp.minimum(num_kb, need)
-        m, l, acc = jax.lax.fori_loop(0, num_iters, body, (m, l, acc))
+        # blocks entirely above the diagonal contribute nothing
+        pl.when(kb * block_k <= (qi + 1) * block_q - 1)(body)
     else:
-        m, l, acc = jax.lax.fori_loop(0, num_kb, body, (m, l, acc))
+        body()
 
-    l_safe = jnp.maximum(l, 1e-20)
-    o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
-    # lse is carried as [bh, 8, T] — replicated across an 8-sublane dim so
-    # its blocks satisfy the TPU (8, 128) tile constraint.
-    lse_ref[0] = jnp.broadcast_to((m + jnp.log(l_safe)).reshape(1, block_q),
-                                  (8, block_q))
+    @pl.when(kb == nkb - 1)
+    def _finish():
+        l_safe = jnp.maximum(l_s[...], 1e-20)
+        o_ref[0] = (acc_s[...] / l_safe).astype(o_ref.dtype)
+        # lse is carried as [bh, 8, T] — replicated across an 8-sublane
+        # dim so its blocks satisfy the TPU (8, 128) tile constraint.
+        lse_ref[0] = jnp.broadcast_to(
+            (m_s[...] + jnp.log(l_safe)).reshape(1, block_q),
+            (8, block_q))
+
+
+def _grid_kw():
+    """compiler_params kwargs: bh/q dims parallel, the streamed dim
+    arbitrary (sequential — scratch state persists across it)."""
+    params = pltpu.CompilerParams(dimension_semantics=(
+        pltpu.GridDimensionSemantics.PARALLEL,
+        pltpu.GridDimensionSemantics.PARALLEL,
+        pltpu.GridDimensionSemantics.ARBITRARY))
+    return {"compiler_params": params}
+
+
+def _scratch(shape):
+    return pltpu.VMEM(shape, jnp.float32)
+
+
+def _kv_index(causal, block_q, block_k):
+    """k/v BlockSpec index for the (bh, q, k) grids. Causal: clamp j to
+    the diagonal block — consecutive skipped grid steps then map to the
+    SAME block index, so Pallas performs no new DMA for them (the
+    in-kernel pl.when already skips their compute)."""
+    if not causal:
+        return lambda b, i, j: (b, j, 0)
+
+    def index(b, i, j):
+        jmax = ((i + 1) * block_q - 1) // block_k
+        return (b, jnp.minimum(j, jmax), 0)
+    return index
 
 
 def _fwd(q, k, v, sm_scale, causal, block_q, block_k, kv_len):
     bh, t, d = q.shape
-    grid = (bh, t // block_q)
+    grid = (bh, t // block_q, t // block_k)
     kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale,
-                               causal=causal, block_k=block_k,
-                               kv_len=kv_len)
+                               causal=causal, kv_len=kv_len, t=t)
     kw = {}
     if _VMEM is not None:
         kw = {"memory_space": _VMEM}
+    extra = _grid_kw()
+    kv_idx = _kv_index(causal, block_q, block_k)
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0), **kw),
-            pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0), **kw),
-            pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0), **kw),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0), **kw),
+            pl.BlockSpec((1, block_k, d), kv_idx, **kw),
+            pl.BlockSpec((1, block_k, d), kv_idx, **kw),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0), **kw),
-            pl.BlockSpec((1, 8, block_q), lambda b, i: (b, 0, i), **kw),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0), **kw),
+            pl.BlockSpec((1, 8, block_q), lambda b, i, j: (b, 0, i), **kw),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, t, d), q.dtype),
             jax.ShapeDtypeStruct((bh, 8, t), jnp.float32),
         ],
+        scratch_shapes=[_scratch((block_q, 1)), _scratch((block_q, 1)),
+                        _scratch((block_q, d))],
         interpret=_interpret(),
+        **extra,
     )(q, k, v)
     return o, lse
 
@@ -140,98 +183,97 @@ def _fwd(q, k, v, sm_scale, causal, block_q, block_k, kv_len):
 # ---------------------------------------------------------------------------
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, delta_ref, lse_ref, do_ref, dq_ref,
-                   *, sm_scale, causal, block_k, kv_len):
-    q = q_ref[0]
-    do = do_ref[0]
-    lse = lse_ref[0, 0, :].astype(jnp.float32)
-    block_q, d = q.shape
-    t = k_ref.shape[1]
+                   dq_s, *, sm_scale, causal, kv_len, t):
+    # grid (bh, q_blocks, k_blocks): k/v stream through the innermost
+    # dim; dq accumulates in VMEM scratch and is flushed once.
     qi = pl.program_id(1)
-    delta = delta_ref[0, 0, :].astype(jnp.float32)[:, None]
-    num_kb = t // block_k
+    kb = pl.program_id(2)
+    nkb = pl.num_programs(2)
+    block_q = q_ref.shape[1]
+    block_k = k_ref.shape[1]
 
-    def body(kb, dq):
-        k = k_ref[0, pl.ds(kb * block_k, block_k), :]
-        v = v_ref[0, pl.ds(kb * block_k, block_k), :]
+    @pl.when(kb == 0)
+    def _init():
+        dq_s[...] = jnp.zeros(dq_s.shape, jnp.float32)
+
+    def body():
+        q = q_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0, 0, :].astype(jnp.float32)
+        delta = delta_ref[0, 0, :].astype(jnp.float32)[:, None]
+        k = k_ref[0]
+        v = v_ref[0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         s = s * sm_scale
-        if causal or kv_len < t:
-            qpos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            kpos = kb * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            keep = kpos < kv_len
-            if causal:
-                keep = jnp.logical_and(keep, qpos >= kpos)
-            s = jnp.where(keep, s, NEG_INF)
+        s = _mask_block(s, qi, kb, block_q, block_k, causal, kv_len, t)
         p = jnp.exp(s - lse[:, None])
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * sm_scale
-        return dq + jax.lax.dot_general(
+        dq_s[...] = dq_s[...] + jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     if causal:
-        need = ((qi + 1) * block_q + block_k - 1) // block_k
-        iters = jnp.minimum(num_kb, need)
+        pl.when(kb * block_k <= (qi + 1) * block_q - 1)(body)
     else:
-        iters = num_kb
-    dq = jax.lax.fori_loop(0, iters, body,
-                           jnp.zeros((block_q, d), jnp.float32))
-    dq_ref[0] = dq.astype(dq_ref.dtype)
+        body()
+
+    @pl.when(kb == nkb - 1)
+    def _finish():
+        dq_ref[0] = dq_s[...].astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, delta_ref, lse_ref, do_ref,
-                    dk_ref, dv_ref, *, sm_scale, causal, block_q, kv_len):
-    k = k_ref[0]
-    v = v_ref[0]
-    block_k, d = k.shape
-    t = q_ref.shape[1]
+                    dk_ref, dv_ref, dk_s, dv_s, *, sm_scale, causal,
+                    kv_len, t):
+    # grid (bh, k_blocks, q_blocks): q/do stream through the innermost
+    # dim; dk/dv accumulate in VMEM scratch.
     ki = pl.program_id(1)
-    num_qb = t // block_q
+    qb = pl.program_id(2)
+    nqb = pl.num_programs(2)
+    block_k = k_ref.shape[1]
+    block_q = q_ref.shape[1]
 
-    def body(qb, carry):
-        dk, dv = carry
-        q = q_ref[0, pl.ds(qb * block_q, block_q), :]
-        do = do_ref[0, pl.ds(qb * block_q, block_q), :]
-        lse = lse_ref[0, 0, pl.ds(qb * block_q, block_q)].astype(jnp.float32)
-        delta = delta_ref[0, 0, pl.ds(qb * block_q, block_q)].astype(
-            jnp.float32)[:, None]
+    @pl.when(qb == 0)
+    def _init():
+        dk_s[...] = jnp.zeros(dk_s.shape, jnp.float32)
+        dv_s[...] = jnp.zeros(dv_s.shape, jnp.float32)
+
+    def body():
+        k = k_ref[0]
+        v = v_ref[0]
+        q = q_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0, 0, :].astype(jnp.float32)
+        delta = delta_ref[0, 0, :].astype(jnp.float32)[:, None]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         s = s * sm_scale
-        if causal or kv_len < t:
-            qpos = qb * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            kpos = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            keep = kpos < kv_len
-            if causal:
-                keep = jnp.logical_and(keep, qpos >= kpos)
-            s = jnp.where(keep, s, NEG_INF)
+        s = _mask_block(s, qb, ki, block_q, block_k, causal, kv_len, t)
         p = jnp.exp(s - lse[:, None])
-        dv = dv + jax.lax.dot_general(p.astype(do.dtype), do,
-                                      (((0,), (0,)), ((), ())),
-                                      preferred_element_type=jnp.float32)
+        dv_s[...] = dv_s[...] + jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * sm_scale
-        dk = dk + jax.lax.dot_general(ds.astype(q.dtype), q,
-                                      (((0,), (0,)), ((), ())),
-                                      preferred_element_type=jnp.float32)
-        return dk, dv
+        dk_s[...] = dk_s[...] + jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
     if causal:
-        # q blocks before the diagonal contribute nothing to this k block
-        start = (ki * block_k) // block_q
+        # q blocks strictly before the diagonal see no keys of this
+        # k block
+        pl.when((qb + 1) * block_q - 1 >= ki * block_k)(body)
     else:
-        start = 0
-    zeros = jnp.zeros((block_k, d), jnp.float32)
-    dk, dv = jax.lax.fori_loop(start, num_qb, body, (zeros, zeros))
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+        body()
+
+    @pl.when(qb == nqb - 1)
+    def _finish():
+        dk_ref[0] = dk_s[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_s[...].astype(dv_ref.dtype)
 
 
 def _bwd(sm_scale, causal, block_q, block_k, kv_len, res, do):
@@ -245,32 +287,61 @@ def _bwd(sm_scale, causal, block_q, block_k, kv_len, res, do):
     kw = {}
     if _VMEM is not None:
         kw = {"memory_space": _VMEM}
-    spec_full = pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0), **kw)
-    spec_lse_full = pl.BlockSpec((1, 8, t), lambda b, i: (b, 0, 0), **kw)
-    spec_qb = pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0), **kw)
-    spec_lse_qb = pl.BlockSpec((1, 8, block_q), lambda b, i: (b, 0, i), **kw)
-    spec_kb = pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0), **kw)
+    extra = _grid_kw()
 
+    # dq pass: (bh, q, k) — fix q block on the middle dim
+    spec_q_qk = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
+                             **kw)
+    spec_k_qk = pl.BlockSpec((1, block_k, d),
+                             _kv_index(causal, block_q, block_k), **kw)
+    spec_lse_qk = pl.BlockSpec((1, 8, block_q), lambda b, i, j: (b, 0, i),
+                               **kw)
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
-                          block_k=block_k, kv_len=kv_len),
-        grid=(bh, t // block_q),
-        in_specs=[spec_qb, spec_full, spec_full, spec_lse_qb, spec_lse_qb,
-                  spec_qb],
-        out_specs=spec_qb,
+                          kv_len=kv_len, t=t),
+        grid=(bh, t // block_q, t // block_k),
+        in_specs=[spec_q_qk, spec_k_qk, spec_k_qk, spec_lse_qk,
+                  spec_lse_qk, spec_q_qk],
+        out_specs=spec_q_qk,
         out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+        scratch_shapes=[_scratch((block_q, d))],
         interpret=_interpret(),
+        **extra,
     )(q, k, v, delta, lse, do)
 
+    # dk/dv pass: (bh, k, q) — fix k block on the middle dim. Causal:
+    # q blocks strictly before this k block contribute nothing; clamp
+    # their index up to the diagonal so skipped steps re-map to an
+    # already-fetched block (no DMA), mirroring _kv_index.
+    if causal:
+        def q_idx(b, i, j):
+            jmin = (i * block_k) // block_q
+            return (b, jnp.maximum(j, jmin), 0)
+
+        def lse_idx(b, i, j):
+            jmin = (i * block_k) // block_q
+            return (b, 0, jnp.maximum(j, jmin))
+    else:
+        def q_idx(b, i, j):
+            return (b, j, 0)
+
+        def lse_idx(b, i, j):
+            return (b, 0, j)
+    spec_q_kq = pl.BlockSpec((1, block_q, d), q_idx, **kw)
+    spec_k_kq = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0),
+                             **kw)
+    spec_lse_kq = pl.BlockSpec((1, 8, block_q), lse_idx, **kw)
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale,
-                          causal=causal, block_q=block_q, kv_len=kv_len),
-        grid=(bh, t // block_k),
-        in_specs=[spec_full, spec_kb, spec_kb, spec_lse_full, spec_lse_full,
-                  spec_full],
-        out_specs=[spec_kb, spec_kb],
+                          causal=causal, kv_len=kv_len, t=t),
+        grid=(bh, t // block_k, t // block_q),
+        in_specs=[spec_q_kq, spec_k_kq, spec_k_kq, spec_lse_kq,
+                  spec_lse_kq, spec_q_kq],
+        out_specs=[spec_k_kq, spec_k_kq],
         out_shape=[jax.ShapeDtypeStruct((bh, t, d), q.dtype)] * 2,
+        scratch_shapes=[_scratch((block_k, d)), _scratch((block_k, d))],
         interpret=_interpret(),
+        **extra,
     )(q, k, v, delta, lse, do)
     return dq, dk, dv
 
@@ -336,8 +407,9 @@ def flash_attention(q, k, v, causal=False, sm_scale=None, block_q=128,
     t, d = q.shape[1], q.shape[2]
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(d)
-    if t < 128:
-        # short sequences: exact path is cheaper than kernel padding
+    if t < 128 or pltpu is None:
+        # short sequences: exact path is cheaper than kernel padding;
+        # builds without pallas-TPU (no pltpu.VMEM scratch) also take it
         out = reference_attention(q, k, v, causal=causal, sm_scale=sm_scale)
         return out.reshape(orig_shape)
     # Pad T to a 128-multiple so every length stays on the flash path; the

@@ -141,3 +141,25 @@ def test_ring_attention_gradients_match_naive():
         for gr, gn, name in zip(g_ring, g_ref, "qkv"):
             np.testing.assert_allclose(gr, gn, atol=3e-4,
                                        err_msg=f"d{name} causal={causal}")
+
+
+def test_flash_attention_kernel_path_t256():
+    """Exercises the real tiled kernel path (t >= 128: grid-streamed
+    k/v + VMEM scratch + causal index-map clamping), not the small-t
+    exact fallback."""
+    from paddle_tpu.ops.pallas.flash_attention import (flash_attention,
+                                                       reference_attention)
+    k0 = jax.random.PRNGKey(0)
+    q = jax.random.normal(k0, (2, 256, 32), jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(1), (2, 256, 32), jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(2), (2, 256, 32), jnp.float32)
+    for causal in (False, True):
+        o = flash_attention(q, k, v, causal=causal, block_q=128,
+                            block_k=128)
+        r = reference_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(o, r, atol=3e-5)
+        gk = jax.grad(lambda k_: flash_attention(
+            q, k_, v, causal=causal).sum())(k)
+        gkr = jax.grad(lambda k_: reference_attention(
+            q, k_, v, causal=causal).sum())(k)
+        np.testing.assert_allclose(gk, gkr, atol=3e-4)
