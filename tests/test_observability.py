@@ -138,3 +138,27 @@ def test_train_from_dataset_via_trainer_factory(tmp_path):
                                       fetch_list=[loss],
                                       print_period=1000)
     assert np.isfinite(np.asarray(last[0])).all()
+
+
+def test_xplane_summary(tmp_path):
+    """profiler.summarize_xplane aggregates the captured trace by
+    category (reference print_profiler table, XPlane-based)."""
+    import paddle_tpu as fluid
+    from paddle_tpu import layers, profiler
+
+    main, startup = fluid.Program(), fluid.Program()
+    sc = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(sc):
+        x = layers.data("xps", shape=[32], dtype="float32")
+        loss = layers.mean(layers.fc(x, size=32))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        exe = fluid.Executor()
+        exe.run(startup)
+        feed = {"xps": np.ones((8, 32), np.float32)}
+        exe.run(main, feed=feed, fetch_list=[loss])
+        d = str(tmp_path / "trace")
+        profiler.start_profiler(output_dir=d)
+        exe.run(main, feed=feed, fetch_list=[loss])
+        profiler.stop_profiler()
+    s = profiler.summarize_xplane(d)
+    assert s["total_us"] > 0 and s["by_category"] and s["top_ops"]

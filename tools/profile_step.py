@@ -1,0 +1,90 @@
+"""Profile one benchmark training step on the attached device and print
+a device-time breakdown.
+
+Usage (on TPU; also runs on CPU for plumbing checks):
+    python tools/profile_step.py [bert|resnet50]
+
+Captures a jax.profiler trace around a handful of steps (enqueued
+async, single end sync — see bench.py on tunnel RTT) and aggregates the
+XPlane device events by category via fluid.profiler.summarize_xplane:
+the per-op cost discipline of the reference's
+operators/benchmark/op_tester.cc applied to the whole step.
+"""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+import numpy as np  # noqa: E402
+
+
+def main():
+    model = sys.argv[1] if len(sys.argv) > 1 else "bert"
+    import paddle_tpu as fluid
+    from paddle_tpu import profiler
+
+    trace_dir = "/tmp/paddle_tpu_profile_step"
+    if model == "resnet50":
+        from paddle_tpu.models import resnet
+        batch = int(os.environ.get("BENCH_BATCH", "64"))
+        main_prog, startup = fluid.Program(), fluid.Program()
+        scope = fluid.Scope()
+        with fluid.program_guard(main_prog, startup), \
+                fluid.scope_guard(scope):
+            loss, acc, _ = resnet.build_train(amp=True)
+            exe = fluid.Executor()
+            exe.run(startup)
+            rng = np.random.RandomState(0)
+            feed = {"image": rng.randn(batch, 3, 224, 224)
+                    .astype(np.float32),
+                    "label": rng.randint(0, 1000, (batch, 1))
+                    .astype(np.int64)}
+            _profile(exe, main_prog, feed, loss, trace_dir, profiler)
+    else:
+        from paddle_tpu.models import transformer
+        batch = int(os.environ.get("BENCH_BATCH", "32"))
+        seq = int(os.environ.get("BENCH_SEQ", "512"))
+        cfg = transformer.bert_base(
+            dropout=0.1, attn_dropout=0.0,
+            use_flash=os.environ.get("BENCH_FLASH", "1") == "1")
+        main_prog, startup = fluid.Program(), fluid.Program()
+        scope = fluid.Scope()
+        with fluid.program_guard(main_prog, startup), \
+                fluid.scope_guard(scope):
+            loss, _ = transformer.build_train(cfg, batch, seq, lr=1e-4,
+                                              amp=True)
+            exe = fluid.Executor()
+            exe.run(startup)
+            rng = np.random.RandomState(0)
+            toks = rng.randint(0, cfg.vocab_size, (batch, seq)) \
+                .astype(np.int64)
+            feed = {"tokens": toks, "labels": toks}
+            _profile(exe, main_prog, feed, loss, trace_dir, profiler)
+
+
+def _profile(exe, prog, feed, loss, trace_dir, profiler, steps=5):
+    # warm up + compile outside the trace
+    exe.run(prog, feed=feed, fetch_list=[loss])
+    x, = exe.run(prog, feed=feed, fetch_list=[loss], return_numpy=False)
+    np.asarray(x)
+    profiler.start_profiler(output_dir=trace_dir)
+    for _ in range(steps):
+        x, = exe.run(prog, feed=feed, fetch_list=[loss],
+                     return_numpy=False)
+    np.asarray(x)  # drain before stopping the trace
+    profiler.stop_profiler()
+    summary = profiler.summarize_xplane(trace_dir)
+    summary["per_step_us"] = summary["total_us"] / steps
+    print(json.dumps({
+        "per_step_us": round(summary["per_step_us"], 1),
+        "by_category_us": {k: round(v, 1)
+                           for k, v in summary["by_category"].items()},
+        "top_ops_us": [(n, round(v, 1))
+                       for n, v in summary["top_ops"][:15]],
+    }, indent=1))
+
+
+if __name__ == "__main__":
+    main()
