@@ -84,16 +84,18 @@ def _timed_steps(exe, prog, feed, loss, steps):
     return dt, lv
 
 
-def bench_bert():
+def build_bert_bench(batch=None, seq_len=None):
+    """Build the BERT pretraining step per the BENCH_* env config.
+    Returns (exe, program, scope, feed, loss, cfg) — shared by bench.py
+    and tools/profile_step.py so the profiled program is exactly the
+    benchmarked one."""
     import paddle_tpu as fluid
     from paddle_tpu.models import transformer
 
-    batch = int(os.environ.get("BENCH_BATCH", "32"))
-    seq_len = int(os.environ.get("BENCH_SEQ", "512"))
-    steps = int(os.environ.get("BENCH_STEPS", "20"))
+    batch = batch or int(os.environ.get("BENCH_BATCH", "32"))
+    seq_len = seq_len or int(os.environ.get("BENCH_SEQ", "512"))
     amp = os.environ.get("BENCH_AMP", "1") == "1"
     use_flash = os.environ.get("BENCH_FLASH", "1") == "1"
-
     cfg = transformer.bert_base(dropout=0.1, attn_dropout=0.0,
                                 use_flash=use_flash)
     main_prog, startup = fluid.Program(), fluid.Program()
@@ -103,10 +105,39 @@ def bench_bert():
                                               amp=amp)
         exe = fluid.Executor()
         exe.run(startup)
-        rng = np.random.RandomState(0)
-        toks = rng.randint(0, cfg.vocab_size,
-                           (batch, seq_len)).astype(np.int64)
-        feed = {"tokens": toks, "labels": toks}
+    rng = np.random.RandomState(0)
+    toks = rng.randint(0, cfg.vocab_size, (batch, seq_len)).astype(np.int64)
+    feed = {"tokens": toks, "labels": toks}
+    return exe, main_prog, scope, feed, loss, cfg
+
+
+def build_resnet50_bench(batch=None):
+    """ResNet-50 ImageNet step per the BENCH_* env config; same return
+    contract as build_bert_bench (cfg slot is None)."""
+    import paddle_tpu as fluid
+    from paddle_tpu.models import resnet
+
+    batch = batch or int(os.environ.get("BENCH_BATCH", "64"))
+    amp = os.environ.get("BENCH_AMP", "1") == "1"
+    main_prog, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main_prog, startup), fluid.scope_guard(scope):
+        loss, acc, feeds = resnet.build_train(amp=amp)
+        exe = fluid.Executor()
+        exe.run(startup)
+    rng = np.random.RandomState(0)
+    feed = {"image": rng.randn(batch, 3, 224, 224).astype(np.float32),
+            "label": rng.randint(0, 1000, (batch, 1)).astype(np.int64)}
+    return exe, main_prog, scope, feed, loss, None
+
+
+def bench_bert():
+    import paddle_tpu as fluid
+
+    steps = int(os.environ.get("BENCH_STEPS", "20"))
+    exe, main_prog, scope, feed, loss, cfg = build_bert_bench()
+    batch, seq_len = feed["tokens"].shape
+    with fluid.scope_guard(scope):
         dt, lv = _timed_steps(exe, main_prog, feed, loss, steps)
 
     tokens_per_sec = batch * seq_len / dt
@@ -127,20 +158,10 @@ def bench_resnet50():
     import paddle_tpu as fluid
     from paddle_tpu.models import resnet
 
-    batch = int(os.environ.get("BENCH_BATCH", "64"))
     steps = int(os.environ.get("BENCH_STEPS", "20"))
-    amp = os.environ.get("BENCH_AMP", "1") == "1"
-
-    main_prog, startup = fluid.Program(), fluid.Program()
-    scope = fluid.Scope()
-    with fluid.program_guard(main_prog, startup), fluid.scope_guard(scope):
-        loss, acc, feeds = resnet.build_train(amp=amp)
-        exe = fluid.Executor()
-        exe.run(startup)
-        rng = np.random.RandomState(0)
-        img = rng.randn(batch, 3, 224, 224).astype(np.float32)
-        lbl = rng.randint(0, 1000, (batch, 1)).astype(np.int64)
-        feed = {"image": img, "label": lbl}
+    exe, main_prog, scope, feed, loss, _ = build_resnet50_bench()
+    batch = feed["image"].shape[0]
+    with fluid.scope_guard(scope):
         dt, lv = _timed_steps(exe, main_prog, feed, loss, steps)
 
     images_per_sec = batch / dt

@@ -8,3 +8,5 @@ instead (SURVEY.md §2.8).
 """
 from .ps_server import HeartBeatMonitor, PServerRuntime, run_pserver  # noqa: F401
 from .rpc import RPCClient, RPCServer  # noqa: F401
+from .env import (init_parallel_env, global_mesh,  # noqa: F401
+                  parallel_env_rank, parallel_env_world_size)

@@ -1,0 +1,106 @@
+"""Multi-host runtime bootstrap: PADDLE_* env contract -> JAX
+distributed runtime.
+
+Reference: the NCCL/gRPC bootstrap in operators/distributed +
+ParallelExecutor's multi-node graph (SURVEY.md §2.8). TPU-native
+equivalent: one process per HOST (the launcher's worker = host model),
+`jax.distributed.initialize` wires every host's chips into one global
+device set, and GSPMD then lays collectives over ICI within a slice and
+DCN across slices — no NCCL ring construction, no send/recv ops.
+
+Typical use, mirroring fleet collective training:
+
+    import paddle_tpu.distributed as dist
+    dist.init_parallel_env()          # reads PADDLE_TRAINER_* env
+    mesh = dist.global_mesh({"dp": -1, "tp": 8})
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+__all__ = ["init_parallel_env", "global_mesh", "parallel_env_rank",
+           "parallel_env_world_size"]
+
+_init_args = None  # (coordinator, num_processes, process_id) after init
+
+
+def parallel_env_rank() -> int:
+    if _init_args is not None:
+        return _init_args[2]
+    return int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+
+
+def parallel_env_world_size() -> int:
+    if _init_args is not None:
+        return _init_args[1]
+    return int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+
+
+def init_parallel_env(coordinator_address=None, num_processes=None,
+                      process_id=None):
+    """Connect this process to the job's global JAX runtime.
+
+    Defaults come from the launcher's env contract
+    (PADDLE_TRAINER_ENDPOINTS / PADDLE_TRAINERS_NUM /
+    PADDLE_TRAINER_ID): the coordinator is trainer 0's endpoint.
+    Single-process jobs (world size 1) skip the distributed runtime
+    entirely — jax.devices() is already correct.
+    """
+    global _init_args
+    import jax
+    n = num_processes if num_processes is not None else \
+        int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    if n <= 1:
+        # single-process: jax.devices() is already the whole job. Not
+        # recorded as initialized — a later call with real multi-process
+        # arguments must still work.
+        return
+    pid = process_id if process_id is not None else \
+        int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    if coordinator_address is None:
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        if not eps:
+            raise RuntimeError(
+                "init_parallel_env: PADDLE_TRAINER_ENDPOINTS is not set "
+                "and no coordinator_address was given — run under "
+                "python -m paddle_tpu.distributed.launch or pass the "
+                "coordinator explicitly")
+        coordinator_address = eps.split(",")[0]
+    if _init_args is not None:
+        if _init_args != (coordinator_address, n, pid):
+            raise RuntimeError(
+                f"init_parallel_env: runtime already initialized as "
+                f"{_init_args}, cannot re-initialize as "
+                f"{(coordinator_address, n, pid)}")
+        return
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=n, process_id=pid)
+    _init_args = (coordinator_address, n, pid)
+
+
+def global_mesh(axes, devices=None):
+    """Build a jax.sharding.Mesh over ALL job devices (every host's
+    chips after init_parallel_env). `axes` is an ordered {name: size}
+    dict; one size may be -1 (inferred). Axis order should put the
+    fastest-communicating axes last so they map to ICI neighbors."""
+    import jax
+    from jax.sharding import Mesh
+    devs = np.asarray(devices if devices is not None else jax.devices())
+    sizes = list(axes.values())
+    n_infer = sum(1 for s in sizes if s == -1)
+    if n_infer > 1:
+        raise ValueError("global_mesh: at most one axis size may be -1")
+    known = int(np.prod([s for s in sizes if s != -1])) or 1
+    if n_infer:
+        if devs.size % known:
+            raise ValueError(
+                f"global_mesh: {devs.size} devices not divisible by "
+                f"{known}")
+        sizes = [devs.size // known if s == -1 else s for s in sizes]
+    if int(np.prod(sizes)) != devs.size:
+        raise ValueError(
+            f"global_mesh: axes {dict(zip(axes, sizes))} need "
+            f"{int(np.prod(sizes))} devices, job has {devs.size}")
+    return Mesh(devs.reshape(sizes), tuple(axes.keys()))

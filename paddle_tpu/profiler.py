@@ -84,8 +84,8 @@ def summarize_xplane(trace_dir=None, top=25):
     print_profiler table, re-expressed for XPlane). Returns a dict:
     {"total_us", "by_category": {cat: us}, "top_ops": [(name, us)]}.
 
-    Categories: dot/conv (MXU), pallas/custom-call, rng, collective,
-    infeed/outfeed, copy/transpose, other-fusion.
+    Categories: mxu-fusion, dot/conv, pallas/custom-call, rng,
+    collective, infeed/host, copy/layout, fusion, other.
     """
     import glob
     import os
@@ -136,7 +136,7 @@ def summarize_xplane(trace_dir=None, top=25):
             meta = plane.event_metadata.get(ev.metadata_id)
             name = meta.name if meta else "?"
             low = name.lower()
-            if any(low.startswith(s) or s in low for s in _SKIP):
+            if any(s in low for s in _SKIP):
                 continue
             us = ev.duration_ps / 1e6
             by_op[name] += us

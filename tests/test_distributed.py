@@ -401,3 +401,25 @@ def test_distributed_lookup_table_ps_mode_trains():
         for s in servers:
             s.stop()
         RPCClient.reset_all()
+
+
+def test_init_parallel_env_and_global_mesh():
+    """Multi-host bootstrap glue: single-process init is a no-op, and
+    global_mesh builds meshes over the job's devices with one inferred
+    axis (SURVEY.md §2.8 comm-backend mapping)."""
+    import jax
+
+    from paddle_tpu.distributed import env as dist_env
+
+    dist_env.init_parallel_env()  # world size 1: must not require env
+    assert dist_env.parallel_env_rank() == 0
+
+    mesh = dist_env.global_mesh({"dp": -1, "tp": 2})
+    assert mesh.shape == {"dp": 4, "tp": 2}
+    mesh2 = dist_env.global_mesh({"sp": 8})
+    assert mesh2.shape == {"sp": 8}
+    try:
+        dist_env.global_mesh({"dp": 3, "tp": 2})
+        assert False, "expected size mismatch error"
+    except ValueError as e:
+        assert "devices" in str(e)

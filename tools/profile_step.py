@@ -4,11 +4,13 @@ a device-time breakdown.
 Usage (on TPU; also runs on CPU for plumbing checks):
     python tools/profile_step.py [bert|resnet50]
 
-Captures a jax.profiler trace around a handful of steps (enqueued
-async, single end sync — see bench.py on tunnel RTT) and aggregates the
-XPlane device events by category via fluid.profiler.summarize_xplane:
-the per-op cost discipline of the reference's
-operators/benchmark/op_tester.cc applied to the whole step.
+Uses bench.py's model builders, so the profiled program is EXACTLY the
+benchmarked one (same BENCH_BATCH/BENCH_SEQ/BENCH_AMP/BENCH_FLASH env
+config). Captures a jax.profiler trace around a handful of steps
+(enqueued async, single end sync — see bench.py on tunnel RTT) and
+aggregates the XPlane device events by category via
+fluid.profiler.summarize_xplane: the per-op cost discipline of the
+reference's operators/benchmark/op_tester.cc applied to the whole step.
 """
 import json
 import os
@@ -22,46 +24,16 @@ import numpy as np  # noqa: E402
 
 def main():
     model = sys.argv[1] if len(sys.argv) > 1 else "bert"
+    import bench
     import paddle_tpu as fluid
     from paddle_tpu import profiler
 
+    build = bench.build_resnet50_bench if model == "resnet50" \
+        else bench.build_bert_bench
+    exe, prog, scope, feed, loss, _ = build()
     trace_dir = "/tmp/paddle_tpu_profile_step"
-    if model == "resnet50":
-        from paddle_tpu.models import resnet
-        batch = int(os.environ.get("BENCH_BATCH", "64"))
-        main_prog, startup = fluid.Program(), fluid.Program()
-        scope = fluid.Scope()
-        with fluid.program_guard(main_prog, startup), \
-                fluid.scope_guard(scope):
-            loss, acc, _ = resnet.build_train(amp=True)
-            exe = fluid.Executor()
-            exe.run(startup)
-            rng = np.random.RandomState(0)
-            feed = {"image": rng.randn(batch, 3, 224, 224)
-                    .astype(np.float32),
-                    "label": rng.randint(0, 1000, (batch, 1))
-                    .astype(np.int64)}
-            _profile(exe, main_prog, feed, loss, trace_dir, profiler)
-    else:
-        from paddle_tpu.models import transformer
-        batch = int(os.environ.get("BENCH_BATCH", "32"))
-        seq = int(os.environ.get("BENCH_SEQ", "512"))
-        cfg = transformer.bert_base(
-            dropout=0.1, attn_dropout=0.0,
-            use_flash=os.environ.get("BENCH_FLASH", "1") == "1")
-        main_prog, startup = fluid.Program(), fluid.Program()
-        scope = fluid.Scope()
-        with fluid.program_guard(main_prog, startup), \
-                fluid.scope_guard(scope):
-            loss, _ = transformer.build_train(cfg, batch, seq, lr=1e-4,
-                                              amp=True)
-            exe = fluid.Executor()
-            exe.run(startup)
-            rng = np.random.RandomState(0)
-            toks = rng.randint(0, cfg.vocab_size, (batch, seq)) \
-                .astype(np.int64)
-            feed = {"tokens": toks, "labels": toks}
-            _profile(exe, main_prog, feed, loss, trace_dir, profiler)
+    with fluid.scope_guard(scope):
+        _profile(exe, prog, feed, loss, trace_dir, profiler)
 
 
 def _profile(exe, prog, feed, loss, trace_dir, profiler, steps=5):
