@@ -27,6 +27,9 @@ _LOD_PRESERVING = {
     "elementwise_div", "elementwise_max", "elementwise_min",
     "layer_norm", "softmax", "log_softmax",
     "sequence_softmax", "sequence_reverse", "emb_eltwise_layernorm",
+    # recurrent ops keep [batch, time] (their Lengths input already
+    # masks the padded tail); dynamic_lstmp also emits op type "lstm"
+    "lstm", "gru",
 }
 # aux output slots that never carry sequence data
 _LOD_AUX_SLOTS = {"Mask", "MaxIndex", "Mean", "Variance", "SavedMean",

@@ -17,6 +17,18 @@ def data(name, shape, append_batch_size=True, dtype="float32", lod_level=0,
     shape = list(shape)
     if append_batch_size:
         shape = [-1] + shape
+    if lod_level > 1:
+        raise NotImplementedError(
+            "data(lod_level>=2): nested ragged levels have no padded "
+            "feed path yet — only one variable-length (time) dimension "
+            "is supported")
+    if lod_level == 1:
+        # ragged data is padded-dense on device: [batch, T, *feature].
+        # The reference declares the FLAT LoD shape ([sum, d]); here
+        # the dynamic time dim joins the build-time shape so
+        # shape-dependent layers (fc weight sizing, rnn projections)
+        # see the runtime rank.
+        shape = shape[:1] + [-1] + shape[1:]
     prog = default_main_program()
     blk = prog.global_block()
     if blk.has_var(name):

@@ -15,6 +15,7 @@ import numpy as np
 
 from ..framework import default_main_program, unique_name
 from ..layer_helper import LayerHelper
+from .sequence import _default_lengths
 from . import nn as _nn
 from . import tensor as _tensor
 
@@ -31,6 +32,8 @@ def dynamic_gru(input, size, param_attr=None, bias_attr=None,
     dynamic_gru); returns hidden [B, T, size]."""
     helper = LayerHelper("dynamic_gru", param_attr=param_attr,
                          bias_attr=bias_attr, name=name)
+    if sequence_length is None:
+        sequence_length = _default_lengths(helper, input)
     w = helper.create_parameter(param_attr, [size, 3 * size], "float32")
     b = helper.create_parameter(bias_attr, [1, 3 * size], "float32",
                                 is_bias=True)
@@ -58,6 +61,8 @@ def dynamic_lstm(input, size, param_attr=None, bias_attr=None,
     d = size // 4
     helper = LayerHelper("dynamic_lstm", param_attr=param_attr,
                          bias_attr=bias_attr, name=name)
+    if sequence_length is None:
+        sequence_length = _default_lengths(helper, input)
     w = helper.create_parameter(param_attr, [d, 4 * d], "float32")
     bias_len = 7 * d if use_peepholes else 4 * d
     b = helper.create_parameter(bias_attr, [1, bias_len], "float32",
@@ -85,13 +90,15 @@ def dynamic_lstmp(input, size, proj_size, param_attr=None, bias_attr=None,
                   use_peepholes=True, is_reverse=False,
                   gate_activation="sigmoid", cell_activation="tanh",
                   candidate_activation="tanh", proj_activation="tanh",
-                  name=None):
+                  sequence_length=None, name=None):
     """LSTM with recurrent projection (lstmp_op): recurrent weight
     [proj_size, 4*hidden], projection [hidden, proj_size]; returns
     (projection [B,T,proj_size], cell [B,T,hidden])."""
     d = size // 4
     helper = LayerHelper("dynamic_lstmp", param_attr=param_attr,
                          bias_attr=bias_attr, name=name)
+    if sequence_length is None:
+        sequence_length = _default_lengths(helper, input)
     w = helper.create_parameter(param_attr, [proj_size, 4 * d], "float32")
     proj_w = helper.create_parameter(param_attr, [d, proj_size], "float32")
     bias_len = 7 * d if use_peepholes else 4 * d
@@ -99,10 +106,13 @@ def dynamic_lstmp(input, size, proj_size, param_attr=None, bias_attr=None,
                                 is_bias=True)
     hidden = helper.create_variable_for_type_inference()
     cell = helper.create_variable_for_type_inference()
+    ins = {"Input": [input.name], "Weight": [w.name],
+           "Bias": [b.name], "ProjWeight": [proj_w.name]}
+    if sequence_length is not None:
+        ins["Lengths"] = [sequence_length.name]
     helper.append_op(
         type="lstm",
-        inputs={"Input": [input.name], "Weight": [w.name],
-                "Bias": [b.name], "ProjWeight": [proj_w.name]},
+        inputs=ins,
         outputs={"Hidden": [hidden.name], "Cell": [cell.name]},
         attrs={"use_peepholes": use_peepholes, "is_reverse": is_reverse,
                "gate_activation": gate_activation,

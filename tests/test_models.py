@@ -231,3 +231,54 @@ def test_se_resnext_step():
                               np.int64)},
                       fetch_list=[loss])
     assert np.isfinite(np.asarray(lv)).all()
+
+
+def test_sentiment_lstm_ragged_trains():
+    """Book test understand_sentiment (reference
+    tests/book/test_understand_sentiment.py): embedding -> lstm ->
+    pooled features -> classifier, driven end to end through the ragged
+    LoD feed path — variable-length reviews, no lengths anywhere in the
+    model code (program.lod_link threads them through embedding, fc,
+    and the lstm to the pools)."""
+    from paddle_tpu.data_feeder import DataFeeder
+
+    vocab, emb_d, hid = 64, 16, 16
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        words = layers.data("sent_words", shape=[1], dtype="int64",
+                            lod_level=1)
+        label = layers.data("sent_label", shape=[1], dtype="int64")
+        emb = layers.embedding(words, size=[vocab, emb_d])
+        proj = layers.fc(emb, size=hid * 4, num_flatten_dims=2)
+        h, c = layers.dynamic_lstm(proj, size=hid * 4)
+        feat = layers.concat([layers.sequence_pool(h, "max"),
+                              layers.sequence_last_step(h)], axis=1)
+        logits = layers.fc(feat, size=2)
+        loss = layers.mean(
+            layers.softmax_with_cross_entropy(logits, label))
+        fluid.optimizer.Adam(learning_rate=5e-3).minimize(loss)
+
+        exe = fluid.Executor()
+        exe.run(startup)
+
+        rng = np.random.RandomState(7)
+        # class-separable toy reviews with ragged lengths 3..11: class 1
+        # uses the top half of the vocab
+        def batch(n=16):
+            rows = []
+            for _ in range(n):
+                y = rng.randint(0, 2)
+                ln = rng.randint(3, 12)
+                lo, hi = (vocab // 2, vocab) if y else (0, vocab // 2)
+                rows.append((rng.randint(lo, hi, (ln, 1)), [y]))
+            return rows
+
+        feeder = DataFeeder(feed_list=[words, label], program=main)
+        losses = []
+        data = batch(32)
+        for _ in range(30):
+            lv, = exe.run(main, feed=feeder.feed(data),
+                          fetch_list=[loss])
+            losses.append(float(lv))
+        assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
