@@ -18,8 +18,10 @@ import numpy as np
 
 from .core.scope import global_scope
 from .framework import Program, Variable
+from .reader import DataLoader, PyReader  # noqa: F401  (fluid.io.DataLoader)
 
-__all__ = ["save_vars", "save_params", "save_persistables", "load_vars",
+__all__ = ["DataLoader", "PyReader",
+           "save_vars", "save_params", "save_persistables", "load_vars",
            "load_params", "load_persistables", "save_inference_model",
            "load_inference_model", "save", "load", "batch"]
 

@@ -100,9 +100,24 @@ class DataLoader:
                                 return_list, use_double_buffer)
 
     @staticmethod
-    def from_dataset(dataset, places, drop_last=True):
-        raise NotImplementedError(
-            "Dataset loader lands with the fleet/data path")
+    def from_dataset(dataset, places=None, drop_last=True):
+        """Iterate a Dataset (QueueDataset / InMemoryDataset over the
+        native C++ data feed) as feed dicts — the reference's
+        DatasetLoader (reader.py:1355) without the per-place split:
+        one process drives all local chips, so each batch feeds the
+        whole (possibly sharded) step."""
+        return _DatasetLoader(dataset, drop_last)
+
+
+class _DatasetLoader(_GeneratorLoader):
+    """Stages Dataset batches through the same bounded prefetch queue
+    as the generator loader, so file read + MultiSlot parse overlap
+    with device compute instead of stalling the training thread."""
+
+    def __init__(self, dataset, drop_last):
+        super().__init__(feed_list=[], capacity=None, iterable=True,
+                         return_list=False)
+        self._gen = lambda: dataset.batches(drop_last=drop_last)
 
 
 class PyReader(_GeneratorLoader):
