@@ -326,3 +326,6 @@ from .parallel import DataParallel, prepare_context  # noqa: E402,F401
 from .base import grad  # noqa: E402,F401
 from . import jit  # noqa: E402,F401
 from .jit import TracedLayer  # noqa: E402,F401
+from .learning_rate_scheduler import (  # noqa: E402,F401
+    LearningRateDecay, PiecewiseDecay, NaturalExpDecay, ExponentialDecay,
+    InverseTimeDecay, PolynomialDecay, CosineDecay, NoamDecay)
