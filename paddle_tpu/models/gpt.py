@@ -1,0 +1,105 @@
+"""Decoder-only causal LM (GPT family) — the causal counterpart of the
+BERT flagship, built from the same transformer encoder stack with
+causal=True (the flash kernel then skips above-diagonal blocks
+entirely; ops/pallas/flash_attention.py).
+
+The 2019 reference predates GPT-style pretraining; its closest
+analogues are the language_model/seq2seq book models. This module gives
+the framework a modern autoregressive family: next-token training
+graph + greedy/temperature sampling by full-context re-forwarding
+(static shapes: the context window is fixed and left-padded)."""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import layers
+from . import transformer
+
+__all__ = ["gpt_small", "gpt_medium", "build_train", "greedy_generate"]
+
+
+def gpt_small(**kw):
+    kw.setdefault("vocab_size", 32000)
+    kw.setdefault("d_model", 768)
+    kw.setdefault("n_heads", 12)
+    kw.setdefault("n_layers", 12)
+    kw.setdefault("d_ff", 3072)
+    kw.setdefault("max_seq_len", 1024)
+    kw.setdefault("causal", True)
+    return transformer.TransformerConfig(**kw)
+
+
+def gpt_medium(**kw):
+    kw.setdefault("d_model", 1024)
+    kw.setdefault("n_heads", 16)
+    kw.setdefault("n_layers", 24)
+    kw.setdefault("d_ff", 4096)
+    return gpt_small(**kw)
+
+
+def build_train(cfg, batch, seq_len, lr=3e-4, amp=False,
+                optimizer_cls=None):
+    """Next-token LM training graph: predict tokens[1:] from
+    tokens[:-1] (the shift happens in-graph so the feed is just the
+    token stream, like the bench's BERT feed). Returns
+    (loss, logits, tokens) — generation runs a clone(for_test=True) of
+    this program fetching `logits` (positions 0..seq_len-2), so the
+    parameters are shared by construction."""
+    assert cfg.causal, "GPT training needs causal=True"
+    from .. import optimizer as opt
+    tokens = layers.data("tokens", shape=[batch, seq_len], dtype="int64",
+                         append_batch_size=False)
+    inp = layers.slice(tokens, axes=[1], starts=[0], ends=[seq_len - 1])
+    tgt = layers.slice(tokens, axes=[1], starts=[1], ends=[seq_len])
+    hidden = transformer.encoder(inp, cfg)
+    logits = transformer.lm_logits(hidden, cfg)
+    loss = transformer.lm_loss(hidden, tgt, cfg, logits=logits)
+    opt_inst = (optimizer_cls or opt.AdamW)(learning_rate=lr)
+    if amp:
+        from ..contrib import mixed_precision as mp
+        opt_inst = mp.decorate(opt_inst)
+    opt_inst.minimize(loss)
+    return loss, logits, tokens
+
+
+def greedy_generate(exe, program, tokens_var, logits_var, prompt,
+                    max_new_tokens, seq_len, temperature=0.0, seed=0):
+    """Autoregressive decode by re-forwarding the full (fixed-length)
+    context: right-pad the window to seq_len (harmless under the causal
+    mask — padded positions sit in the future), take the logits at the
+    last real position, append, repeat. O(T) forwards of an O(T)
+    context — the simple exact scheme; KV-cache incremental decoding is
+    a later optimization.
+
+    prompt: 1-D int array. Returns the generated continuation (list)."""
+    if not len(prompt):
+        raise ValueError("greedy_generate: prompt must be non-empty")
+    rng = np.random.RandomState(seed)
+    ctx = list(int(t) for t in prompt)
+    out = []
+    # the train graph consumes tokens[:-1]: logits cover positions
+    # 0..seq_len-2, so the usable context window is seq_len-1
+    win = seq_len - 1
+    # reshape attrs bake the build-time batch: tile the single prompt
+    # row up to it and read row 0
+    batch = int(tokens_var.shape[0])
+    for _ in range(max_new_tokens):
+        window = ctx[-win:]
+        pos = len(window) - 1
+        pad = [0] * (seq_len - len(window))
+        feed_tokens = np.tile(np.asarray([window + pad], np.int64),
+                              (batch, 1))
+        logits, = exe.run(program,
+                          feed={tokens_var.name: feed_tokens},
+                          fetch_list=[logits_var])
+        step_logits = np.asarray(logits)[0, pos]
+        if temperature and temperature > 0.0:
+            p = step_logits / temperature
+            p = np.exp(p - p.max())
+            p /= p.sum()
+            nxt = int(rng.choice(len(p), p=p))
+        else:
+            nxt = int(step_logits.argmax())
+        ctx.append(nxt)
+        out.append(nxt)
+    return out

@@ -154,15 +154,23 @@ def encoder(tokens, cfg: TransformerConfig):
     return x
 
 
-def lm_loss(hidden, labels, cfg: TransformerConfig):
-    """LM head tied projection + per-token softmax CE."""
-    logits = layers.fc(hidden, size=cfg.vocab_size, num_flatten_dims=2,
-                       param_attr=ParamAttr(name="lm_head.w",
-                                            initializer=Normal(0.0, 0.02)),
-                       bias_attr=False)
-    b, t = hidden.shape[0], hidden.shape[1]
-    logits2 = layers.reshape(logits, [b * t, cfg.vocab_size])
-    labels2 = layers.reshape(labels, [b * t, 1])
+def lm_logits(hidden, cfg: TransformerConfig):
+    """LM head projection to vocab logits."""
+    return layers.fc(hidden, size=cfg.vocab_size, num_flatten_dims=2,
+                     param_attr=ParamAttr(name="lm_head.w",
+                                          initializer=Normal(0.0, 0.02)),
+                     bias_attr=False)
+
+
+def lm_loss(hidden, labels, cfg: TransformerConfig, logits=None):
+    """LM head tied projection + per-token softmax CE. Pass precomputed
+    `logits` to avoid a second head projection when the caller also
+    exposes them (gpt.build_train)."""
+    if logits is None:
+        logits = lm_logits(hidden, cfg)
+    # single -1: robust to dynamic batch/time dims (sliced inputs)
+    logits2 = layers.reshape(logits, [-1, cfg.vocab_size])
+    labels2 = layers.reshape(labels, [-1, 1])
     loss = layers.softmax_with_cross_entropy(logits2, labels2)
     return layers.mean(loss)
 
