@@ -8,7 +8,8 @@ Executor. vs_baseline = measured MFU / 0.50 (the ">=50% MFU" north
 star; the reference publishes no numeric baseline — BASELINE.md).
 
 Prints ONE JSON line for the selected model (default: bert).
-BENCH_MODEL=both prints two lines (bert first).
+BENCH_MODEL selects bert | resnet50 | gpt (causal flash path) |
+both (bert + resnet50) | all (all three).
 """
 from __future__ import annotations
 
@@ -177,13 +178,70 @@ def bench_resnet50():
     }
 
 
+def build_gpt_bench(batch=None, seq_len=None):
+    """GPT-small causal-LM step per the BENCH_* env config (third
+    headline workload: exercises the causal flash-kernel path)."""
+    import paddle_tpu as fluid
+    from paddle_tpu.models import gpt
+
+    batch = batch or int(os.environ.get("BENCH_BATCH", "32"))
+    seq_len = seq_len or int(os.environ.get("BENCH_SEQ", "512"))
+    amp = os.environ.get("BENCH_AMP", "1") == "1"
+    use_flash = os.environ.get("BENCH_FLASH", "1") == "1"
+    cfg = gpt.gpt_small(dropout=0.1, attn_dropout=0.0,
+                        use_flash=use_flash, max_seq_len=seq_len)
+    main_prog, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main_prog, startup), fluid.scope_guard(scope):
+        loss, logits, tokens = gpt.build_train(cfg, batch, seq_len,
+                                               lr=3e-4, amp=amp)
+        exe = fluid.Executor()
+        exe.run(startup)
+    rng = np.random.RandomState(0)
+    toks = rng.randint(0, cfg.vocab_size, (batch, seq_len)).astype(np.int64)
+    return exe, main_prog, scope, {"tokens": toks}, loss, cfg
+
+
+def bench_gpt():
+    import paddle_tpu as fluid
+
+    steps = int(os.environ.get("BENCH_STEPS", "20"))
+    exe, main_prog, scope, feed, loss, cfg = build_gpt_bench()
+    batch, seq_len = feed["tokens"].shape
+    with fluid.scope_guard(scope):
+        dt, lv = _timed_steps(exe, main_prog, feed, loss, steps)
+    t_eff = seq_len - 1  # in-graph next-token shift
+    tokens_per_sec = batch * t_eff / dt
+    # causal attention does half the score/context flops: subtract half
+    # of the attention term from the shared full-attention accounting
+    flops_tok = model_flops_per_token(cfg, t_eff) \
+        - 6 * cfg.n_layers * t_eff * cfg.d_model
+    flops = flops_tok * batch * t_eff
+    mfu = flops / dt / peak_flops_per_chip()
+    return {
+        "metric": "gpt_small_pretrain_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.50, 4),
+        "extra": {"step_ms": round(dt * 1000, 2), "mfu": round(mfu, 4),
+                  "batch": int(batch), "seq_len": int(seq_len),
+                  "loss": float(np.asarray(lv))},
+    }
+
+
 def main():
     model = os.environ.get("BENCH_MODEL", "bert")
     if model == "both":
         print(json.dumps(bench_bert()))
         print(json.dumps(bench_resnet50()))
+    elif model == "all":
+        print(json.dumps(bench_bert()))
+        print(json.dumps(bench_resnet50()))
+        print(json.dumps(bench_gpt()))
     elif model == "resnet50":
         print(json.dumps(bench_resnet50()))
+    elif model == "gpt":
+        print(json.dumps(bench_gpt()))
     else:
         print(json.dumps(bench_bert()))
 

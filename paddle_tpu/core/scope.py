@@ -14,11 +14,40 @@ from typing import Dict, Optional
 import numpy as np
 
 
+
+# Scope pool (reference: framework/scope_pool.{h,cc} — tracks every
+# Python-created Scope so leaked ones can be cleared deterministically,
+# the notebook/REPL hygiene hook exposed as core._ScopePool in pybind).
+# Entries are weak: a Scope dies normally with its last reference; the
+# pool only lets you bulk-release the arrays of whatever is still alive.
+import weakref as _weakref
+
+_scope_pool = _weakref.WeakSet()
+
+
+def _pool_register(scope):
+    _scope_pool.add(scope)
+
+
+def scope_pool_size() -> int:
+    return len(_scope_pool)
+
+
+def clear_scope_pool():
+    """Drop every tracked scope's contents (device buffers become
+    collectable) — reference ScopePool::Clear. The global scope is
+    emptied but stays usable."""
+    for s in list(_scope_pool):
+        s._vars.clear()
+        s.drop_kids()
+
+
 class Scope:
     def __init__(self, parent: Optional["Scope"] = None):
         self._vars: Dict[str, object] = {}
         self.parent = parent
         self._kids = []
+        _pool_register(self)
 
     def var(self, name):
         """Create-if-missing (scope.h:62 Var)."""

@@ -93,3 +93,19 @@ def test_gradients_numeric_vs_analytic():
         down = f(pert.reshape(xv.shape))
         num.reshape(-1)[i] = (up - down) / (2 * eps)
     np.testing.assert_allclose(g, num, rtol=1e-2, atol=1e-3)
+
+
+def test_scope_pool_clear():
+    """App-D scope pool: leaked scopes can be bulk-released
+    (framework/scope_pool.h semantics) without breaking live ones."""
+    from paddle_tpu.core import scope as S
+
+    s = S.Scope()
+    s.set("leak", np.ones(4))
+    n = S.scope_pool_size()
+    assert n >= 1
+    S.clear_scope_pool()
+    assert s.find_var("leak") is None
+    # the global scope survives cleared-but-usable
+    S.global_scope().set("x", np.zeros(2))
+    assert S.global_scope().find_var("x") is not None
