@@ -15,7 +15,8 @@ import numpy as np
 from .. import layers
 from . import transformer
 
-__all__ = ["gpt_small", "gpt_medium", "build_train", "greedy_generate"]
+__all__ = ["gpt_small", "gpt_medium", "build_train", "greedy_generate",
+           "build_decode_step", "kv_generate"]
 
 
 def gpt_small(**kw):
@@ -35,6 +36,15 @@ def gpt_medium(**kw):
     kw.setdefault("n_layers", 24)
     kw.setdefault("d_ff", 4096)
     return gpt_small(**kw)
+
+
+def _sample(step_logits, temperature, rng):
+    if temperature and temperature > 0.0:
+        p = step_logits / temperature
+        p = np.exp(p - p.max())
+        p /= p.sum()
+        return int(rng.choice(len(p), p=p))
+    return int(step_logits.argmax())
 
 
 def build_train(cfg, batch, seq_len, lr=3e-4, amp=False,
@@ -93,13 +103,164 @@ def greedy_generate(exe, program, tokens_var, logits_var, prompt,
                           feed={tokens_var.name: feed_tokens},
                           fetch_list=[logits_var])
         step_logits = np.asarray(logits)[0, pos]
-        if temperature and temperature > 0.0:
-            p = step_logits / temperature
-            p = np.exp(p - p.max())
-            p /= p.sum()
-            nxt = int(rng.choice(len(p), p=p))
-        else:
-            nxt = int(step_logits.argmax())
+        nxt = _sample(step_logits, temperature, rng)
         ctx.append(nxt)
         out.append(nxt)
     return out
+
+
+def build_decode_step(cfg, batch, max_seq):
+    """Incremental decoding graph: ONE token in, next-token logits out,
+    per-layer K/V caches carried as persistable state (donated by the
+    Executor, so updates are in-place at the XLA buffer level). O(T)
+    per generated token instead of greedy_generate's O(T^2) full
+    re-forward.
+
+    Weight names match the training graph (layer_i.att.*, layer_i.ln*,
+    word_emb, lm_head.w), so running this program in the same scope as
+    a trained model shares parameters by construction.
+
+    Returns (token_var, logits_var, cache_names): feed `token_var`
+    [batch, 1] int64; `cache_names` lists every state var to zero when
+    starting a new sequence (kv_generate does this via the scope)."""
+    from ..framework import ParamAttr
+    from ..initializer import Normal
+    import math as _math
+
+    d, h = cfg.d_model, cfg.n_heads
+    hd = d // h
+    token = layers.data("step_token", shape=[batch, 1], dtype="int64",
+                        append_batch_size=False)
+    pos = layers.create_global_var([1], 0, "int64", persistable=True,
+                                   name="decode_pos")
+    cache_names = ["decode_pos"]
+
+    x = layers.embedding(token, size=[cfg.vocab_size, d],
+                         param_attr=ParamAttr(name="word_emb",
+                                              initializer=Normal(0.0,
+                                                                 0.02)))
+    # position encoding at the current position: build the full
+    # sinusoid table from a zero sequence, then gather row `pos`
+    zeros_seq = layers.fill_constant([1, max_seq, d], "float32", 0.0)
+    pe_table = layers.add_position_encoding(zeros_seq, alpha=1.0,
+                                            beta=1.0)
+    pe_row = layers.gather(layers.reshape(pe_table, [max_seq, d]), pos)
+    x = layers.elementwise_add(x, layers.reshape(pe_row, [1, 1, d]))
+
+    # masks over the cache length
+    steps_f = layers.cast(layers.range(0, max_seq, 1, "int64"), "float32")
+    pos_f = layers.cast(pos, "float32")
+    keep = layers.cast(
+        layers.less_equal(steps_f, layers.expand_as(pos_f, steps_f)),
+        "float32")                               # [max_seq] 1 for <= pos
+    neg = layers.scale(keep, scale=1e30, bias=-1e30)  # 0 keep, -1e30 drop
+    onehot = layers.reshape(
+        layers.one_hot(layers.reshape(pos, [1, 1]), max_seq),
+        [1, 1, max_seq, 1])
+    inv_onehot = layers.scale(onehot, scale=-1.0, bias=1.0)
+
+    def dense(z, size, name, act=None):
+        # transformer._dense is the single source of truth for the
+        # weight names/init the trained scope holds (cfg.tp is False
+        # here, so its tp annotation is a no-op)
+        return transformer._dense(z, size, name, cfg, act=act)
+
+    for i in range(cfg.n_layers):
+        pre = f"layer_{i}"
+        q = dense(x, d, f"{pre}.att.q")
+        k = dense(x, d, f"{pre}.att.k")
+        v = dense(x, d, f"{pre}.att.v")
+
+        def heads(z):
+            return layers.transpose(layers.reshape(z, [batch, 1, h, hd]),
+                                    [0, 2, 1, 3])   # [B, H, 1, hd]
+        q, k, v = heads(q), heads(k), heads(v)
+
+        ck = layers.create_global_var([batch, h, max_seq, hd], 0.0,
+                                      "float32", persistable=True,
+                                      name=f"{pre}.cache_k")
+        cv = layers.create_global_var([batch, h, max_seq, hd], 0.0,
+                                      "float32", persistable=True,
+                                      name=f"{pre}.cache_v")
+        cache_names += [ck.name, cv.name]
+        ck_new = layers.elementwise_add(
+            layers.elementwise_mul(ck, inv_onehot),
+            layers.elementwise_mul(k, onehot))
+        cv_new = layers.elementwise_add(
+            layers.elementwise_mul(cv, inv_onehot),
+            layers.elementwise_mul(v, onehot))
+        layers.assign(ck_new, output=ck)
+        layers.assign(cv_new, output=cv)
+
+        scores = layers.scale(
+            layers.matmul(q, ck_new, transpose_y=True),
+            scale=1.0 / _math.sqrt(hd))              # [B, H, 1, maxT]
+        scores = layers.elementwise_add(
+            scores, layers.reshape(neg, [1, 1, 1, max_seq]))
+        probs = layers.softmax(scores)
+        ctxv = layers.matmul(probs, cv_new)          # [B, H, 1, hd]
+        ctxv = layers.reshape(
+            layers.transpose(ctxv, [0, 2, 1, 3]), [batch, 1, d])
+        att = dense(ctxv, d, f"{pre}.att.proj")
+        x = layers.layer_norm(layers.elementwise_add(x, att),
+                              begin_norm_axis=2,
+                              param_attr=ParamAttr(name=f"{pre}.ln1.w"),
+                              bias_attr=ParamAttr(name=f"{pre}.ln1.b"))
+        ff = transformer._ffn(x, cfg, f"{pre}.ffn")
+        x = layers.layer_norm(layers.elementwise_add(x, ff),
+                              begin_norm_axis=2,
+                              param_attr=ParamAttr(name=f"{pre}.ln2.w"),
+                              bias_attr=ParamAttr(name=f"{pre}.ln2.b"))
+
+    logits = layers.fc(x, size=cfg.vocab_size, num_flatten_dims=2,
+                       param_attr=ParamAttr(name="lm_head.w",
+                                            initializer=Normal(0.0, 0.02)),
+                       bias_attr=False)
+    layers.increment(pos, value=1.0)
+    return token, logits, cache_names
+
+
+def kv_generate(exe, scope, decode_prog, token_var, logits_var,
+                cache_names, prompt, max_new_tokens, temperature=0.0,
+                seed=0):
+    """Autoregressive generation over the KV-cache decode step: feed
+    the prompt token by token (prefill), then sample/argmax the
+    continuation. Caches (and the position counter) are created/zeroed
+    directly in the scope — do NOT run the decode program's startup in
+    a trained scope, it would re-initialize the shared weights."""
+    import paddle_tpu as fluid
+    from ..core.dtypes import as_np_dtype
+
+    if not len(prompt):
+        raise ValueError("kv_generate: prompt must be non-empty")
+    rng = np.random.RandomState(seed)
+    batch = int(token_var.shape[0])
+    blk = decode_prog.global_block()
+    # any cache var carries [B, H, max_seq, hd]
+    max_seq = int(blk.var(cache_names[-1]).shape[2])
+    need = len(prompt) + max_new_tokens - 1
+    if need > max_seq:
+        raise ValueError(
+            f"kv_generate: prompt ({len(prompt)}) + max_new_tokens "
+            f"({max_new_tokens}) needs {need} cache slots but the decode "
+            f"graph was built with max_seq={max_seq}")
+    with fluid.scope_guard(scope):
+        for name in cache_names:
+            v = blk.var(name)
+            shape = [abs(int(s)) for s in v.shape]
+            scope.set(name, np.zeros(shape, as_np_dtype(v.dtype)))
+
+        def step(tok):
+            feed = {token_var.name: np.full((batch, 1), tok, np.int64)}
+            out, = exe.run(decode_prog, feed=feed,
+                           fetch_list=[logits_var])
+            return np.asarray(out)[0, 0]
+
+        for tok in prompt[:-1]:
+            step(int(tok))
+        out = []
+        cur = int(prompt[-1])
+        for _ in range(max_new_tokens):
+            cur = _sample(step(cur), temperature, rng)
+            out.append(cur)
+        return out

@@ -125,13 +125,19 @@ def _block(x, cfg, i):
     if cfg.dropout:
         att = layers.dropout(att, cfg.dropout,
                              dropout_implementation="upscale_in_train")
+    # explicit param names: cross-program weight sharing (decode-step
+    # graphs, checkpoint stability) must not depend on build order
     x = layers.layer_norm(layers.elementwise_add(x, att),
-                          begin_norm_axis=2)
+                          begin_norm_axis=2,
+                          param_attr=ParamAttr(name=f"layer_{i}.ln1.w"),
+                          bias_attr=ParamAttr(name=f"layer_{i}.ln1.b"))
     ff = _ffn(x, cfg, f"layer_{i}.ffn")
     if cfg.dropout:
         ff = layers.dropout(ff, cfg.dropout,
                             dropout_implementation="upscale_in_train")
-    x = layers.layer_norm(layers.elementwise_add(x, ff), begin_norm_axis=2)
+    x = layers.layer_norm(layers.elementwise_add(x, ff), begin_norm_axis=2,
+                          param_attr=ParamAttr(name=f"layer_{i}.ln2.w"),
+                          bias_attr=ParamAttr(name=f"layer_{i}.ln2.b"))
     if cfg.sp:
         x = layers.shard_hint(x, [cfg.dp_axis, cfg.sp_axis, None])
     return x

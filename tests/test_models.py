@@ -317,3 +317,44 @@ def test_gpt_causal_lm_trains_and_generates():
                                   prompt=[0, 1, 2, 3],
                                   max_new_tokens=4, seq_len=seq)
         assert out == [4, 5, 6, 7], out
+
+
+def test_gpt_kv_cache_decode_matches_full_reforward():
+    """Incremental (KV-cache) decoding must generate exactly what the
+    O(T^2) full-re-forward path generates from the same trained
+    weights."""
+    from paddle_tpu.models import gpt
+
+    vocab, seq = 16, 12
+    cfg = gpt.gpt_small(vocab_size=vocab, d_model=32, n_heads=4,
+                        n_layers=2, d_ff=64, max_seq_len=seq,
+                        dropout=0.0, use_flash=False)
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        loss, logits, tokens = gpt.build_train(cfg, batch=4, seq_len=seq,
+                                               lr=5e-3)
+        exe = fluid.Executor()
+        exe.run(startup)
+        base = np.arange(seq) % vocab
+        toks = np.stack([(base + i) % vocab for i in range(4)]) \
+            .astype(np.int64)
+        for _ in range(40):
+            exe.run(main, feed={"tokens": toks}, fetch_list=[loss])
+
+        infer = main.clone(for_test=True)
+        want = gpt.greedy_generate(exe, infer, tokens, logits,
+                                   prompt=[0, 1, 2],
+                                   max_new_tokens=5, seq_len=seq)
+
+        # decode-step program in a fresh program but the SAME scope:
+        # weights shared by name; kv_generate creates the caches (its
+        # startup must NOT run — it would re-init the trained weights)
+        dec_main, dec_start = fluid.Program(), fluid.Program()
+        with fluid.program_guard(dec_main, dec_start):
+            tok_var, dec_logits, cache_names = gpt.build_decode_step(
+                cfg, batch=1, max_seq=seq)
+    got = gpt.kv_generate(exe, scope, dec_main, tok_var, dec_logits,
+                          cache_names, prompt=[0, 1, 2],
+                          max_new_tokens=5)
+    assert got == want, (got, want)
