@@ -40,9 +40,33 @@ def _pool_nd(x, ksize, strides, paddings, pool_type, nd, global_pool,
 @register_op("pool3d")
 def _pool3d(ctx, ins, attrs):
     x = ins["X"][0]
+    ksize = list(attrs.get("ksize", [2, 2, 2]))
+    strides = list(attrs.get("strides", [2, 2, 2]))
+    paddings = list(attrs.get("paddings", [0, 0, 0]))
+    if attrs.get("ceil_mode", False):
+        # floor-mode reduce_window would silently shrink the output
+        for s, k, st, p in zip(x.shape[2:], ksize, strides, paddings):
+            if (s + 2 * p - k) % st:
+                raise NotImplementedError(
+                    "pool3d ceil_mode=True with non-exact division is "
+                    "not supported under static XLA shapes; pad the "
+                    "input or adjust ksize/strides")
+    if attrs.get("adaptive", False):
+        # ksize is the OUTPUT size (adaptive_pool3d); static XLA shapes
+        # need divisible inputs — same contract as the 2-D path
+        # (nn_ops.py pool2d)
+        spatial = x.shape[2:]
+        for s, o in zip(spatial, ksize):
+            if s % o:
+                raise NotImplementedError(
+                    "adaptive pool3d needs divisible sizes under static "
+                    f"XLA shapes (input {tuple(spatial)}, output "
+                    f"{tuple(ksize)})")
+        strides = [s // o for s, o in zip(spatial, ksize)]
+        ksize = strides
+        paddings = [0, 0, 0]
     return {"Out": [_pool_nd(
-        x, attrs.get("ksize", [2, 2, 2]), attrs.get("strides", [2, 2, 2]),
-        attrs.get("paddings", [0, 0, 0]), attrs.get("pooling_type", "max"),
+        x, ksize, strides, paddings, attrs.get("pooling_type", "max"),
         3, attrs.get("global_pooling", False),
         exclusive=attrs.get("exclusive", True))]}
 

@@ -250,3 +250,113 @@ def test_ssd_loss_mining_and_normalize():
     np.testing.assert_allclose(out_norm * 2.0, out_raw, rtol=1e-5)
     # unmatched, un-mined priors contribute zero loss rows
     assert (np.abs(out_raw) > 0).sum() < 6 * 1 + 1
+
+
+def test_crf_layers():
+    def build():
+        emission = layers.data("crf_e", shape=[5, 3], dtype="float32",
+                               append_batch_size=False)
+        label = layers.data("crf_l", shape=[5, 1], dtype="int64",
+                            append_batch_size=False)
+        ll = layers.linear_chain_crf(
+            layers.reshape(emission, [1, 5, 3]),
+            layers.reshape(label, [1, 5]),
+            param_attr=fluid.ParamAttr(name="crfw_t"))
+        path = layers.crf_decoding(
+            layers.reshape(emission, [1, 5, 3]),
+            param_attr=fluid.ParamAttr(name="crfw_t"))
+        return [ll, path]
+    rng = np.random.RandomState(0)
+    ll, path = _run(build, {
+        "crf_e": rng.randn(5, 3).astype(np.float32),
+        "crf_l": rng.randint(0, 3, (5, 1)).astype(np.int64)})
+    assert np.isfinite(ll).all()
+    assert path.shape[-1] == 5
+
+
+def test_edit_distance_and_gather_tree_layers():
+    def build():
+        h = layers.data("ed_h", shape=[4], dtype="int64")
+        r = layers.data("ed_r", shape=[4], dtype="int64")
+        dist, seq_num = layers.edit_distance(h, r, normalized=False)
+        # gather_tree takes [max_time, batch, beam]
+        ids = layers.data("gt_i", shape=[3, 1, 2], dtype="int64",
+                          append_batch_size=False)
+        parents = layers.data("gt_p", shape=[3, 1, 2], dtype="int64",
+                              append_batch_size=False)
+        tree = layers.gather_tree(ids, parents)
+        return [dist, tree]
+    dist, tree = _run(build, {
+        "ed_h": np.array([[1, 2, 3, 4]], np.int64),
+        "ed_r": np.array([[1, 2, 4, 4]], np.int64),
+        "gt_i": np.array([[[2, 2]], [[3, 4]], [[5, 6]]], np.int64),
+        "gt_p": np.array([[[0, 0]], [[0, 1]], [[1, 0]]], np.int64)})
+    assert float(dist.reshape(-1)[0]) >= 1.0
+    assert tree.shape == (3, 1, 2)
+
+
+def test_spectral_norm_and_row_conv_layers():
+    def build():
+        w = layers.data("sn_w", shape=[6, 4], dtype="float32",
+                        append_batch_size=False)
+        sn = layers.spectral_norm(w, power_iters=2)
+        x = layers.data("rc_x", shape=[5, 4], dtype="float32")
+        rc = layers.row_conv(x, future_context_size=2)
+        return [sn, rc]
+    rng = np.random.RandomState(0)
+    sn, rc = _run(build, {
+        "sn_w": rng.randn(6, 4).astype(np.float32),
+        "rc_x": rng.randn(2, 5, 4).astype(np.float32)})
+    assert sn.shape == (6, 4) and rc.shape == (2, 5, 4)
+
+
+def test_crop_pool3d_affine_grid_layers():
+    def build():
+        x = layers.data("cr_x", shape=[6, 6], dtype="float32")
+        c = layers.crop_tensor(x, shape=[2, 4, 4], offsets=[0, 1, 1])
+        v = layers.data("p3_x", shape=[2, 4, 4, 4], dtype="float32")
+        p3 = layers.pool3d(v, pool_size=2, pool_stride=2)
+        ap3 = layers.adaptive_pool3d(v, pool_size=2)
+        theta = layers.data("ag_t", shape=[2, 3], dtype="float32")
+        grid = layers.affine_grid(theta, out_shape=[2, 1, 4, 4])
+        return [c, p3, ap3, grid]
+    rng = np.random.RandomState(0)
+    c, p3, ap3, grid = _run(build, {
+        "cr_x": rng.randn(2, 6, 6).astype(np.float32),
+        "p3_x": rng.randn(2, 2, 4, 4, 4).astype(np.float32),
+        "ag_t": rng.randn(2, 2, 3).astype(np.float32)})
+    assert c.shape == (2, 4, 4)
+    assert p3.shape == (2, 2, 2, 2, 2)
+    assert ap3.shape == (2, 2, 2, 2, 2)
+    assert grid.shape == (2, 4, 4, 2)
+
+
+def test_im2sequence_and_similarity_focus_layers():
+    def build():
+        x = layers.data("i2s_x", shape=[1, 4, 4], dtype="float32")
+        seq = layers.im2sequence(x, filter_size=2, stride=2)
+        y = layers.data("sf_x", shape=[3, 2, 2], dtype="float32")
+        sf = layers.similarity_focus(y, axis=1, indexes=[0])
+        return [seq, sf]
+    rng = np.random.RandomState(0)
+    seq, sf = _run(build, {
+        "i2s_x": rng.randn(2, 1, 4, 4).astype(np.float32),
+        "sf_x": rng.randn(2, 3, 2, 2).astype(np.float32)})
+    assert seq.shape[-1] == 4
+    assert sf.shape == (2, 3, 2, 2)
+
+
+def test_random_ops_and_selected_rows_layers():
+    def build():
+        x = layers.data("rnd_x", shape=[4], dtype="float32")
+        u = layers.uniform_random_batch_size_like(x, shape=[-1, 6])
+        g = layers.gaussian_random_batch_size_like(x, shape=[-1, 3])
+        m = layers.merge_selected_rows(x)
+        t = layers.get_tensor_from_selected_rows(m)
+        s = layers.sum([x, x])
+        return [u, g, t, s]
+    xv = np.ones((5, 4), np.float32)
+    u, g, t, s = _run(build, {"rnd_x": xv})
+    assert u.shape == (5, 6) and g.shape == (5, 3)
+    np.testing.assert_allclose(t, xv)
+    np.testing.assert_allclose(s, 2 * xv)
