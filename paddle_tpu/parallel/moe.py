@@ -1,0 +1,107 @@
+"""Expert parallelism: a switch-style MoE FFN sharded over the `ep`
+mesh axis.
+
+Absent from the 2019 reference (its scale story was PS sharding +
+NCCL data parallelism); here expert parallelism is a first-class mesh
+axis alongside dp/tp/pp/sp. Expert weights live sharded over `ep`
+(each device holds E/ep experts); every device computes its local
+experts' contribution for all tokens and a psum over `ep` combines
+them — the dense-dispatch formulation, exact and static-shape. The
+capacity-based sparse all-to-all dispatch is the optimization on top;
+at equal expert count it changes cost, not numerics.
+
+Gating is top-1 (Switch Transformer): the selected expert's output is
+scaled by its softmax probability, so the router is trained through
+the prob factor while the hard selection is a stop-gradient mask.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["moe_ffn", "moe_ffn_sharded", "init_moe_params"]
+
+
+def init_moe_params(rng, n_experts, d_model, d_ff, dtype=jnp.float32):
+    """{gate_w [d, E], w1 [E, d, f], b1 [E, f], w2 [E, f, d], b2 [E, d]}"""
+    import numpy as np
+    r = np.random.RandomState(rng)
+    s1 = (2.0 / d_model) ** 0.5
+    s2 = (2.0 / d_ff) ** 0.5
+    return {
+        "gate_w": jnp.asarray(
+            r.randn(d_model, n_experts).astype(np.float32) * 0.02, dtype),
+        "w1": jnp.asarray(
+            r.randn(n_experts, d_model, d_ff).astype(np.float32) * s1,
+            dtype),
+        "b1": jnp.zeros((n_experts, d_ff), dtype),
+        "w2": jnp.asarray(
+            r.randn(n_experts, d_ff, d_model).astype(np.float32) * s2,
+            dtype),
+        "b2": jnp.zeros((n_experts, d_model), dtype),
+    }
+
+
+def moe_ffn(x, params, axis_name="ep", n_experts_global=None,
+            batch_axis=None):
+    """Inside shard_map: x [B, T, d] (replicated or dp-sharded on B);
+    params' expert arrays hold the LOCAL expert shard [E_local, ...];
+    gate_w is replicated [d, E_global]. Returns y [B, T, d] (summed
+    over the ep axis) and the router's mean top-1 prob (a load metric).
+    """
+    gate_w = params["gate_w"]
+    w1, b1 = params["w1"], params["b1"]
+    w2, b2 = params["w2"], params["b2"]
+    e_local = w1.shape[0]
+    e_global = n_experts_global or gate_w.shape[-1]
+    idx = jax.lax.axis_index(axis_name)
+
+    logits = jnp.einsum("btd,de->bte", x, gate_w)      # [B, T, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    top = jnp.argmax(probs, axis=-1)                   # [B, T]
+    # hard top-1 mask (stop-grad), scaled by the differentiable prob
+    mask = jax.nn.one_hot(top, e_global, dtype=probs.dtype)
+    coef = probs * jax.lax.stop_gradient(mask)         # [B, T, E]
+
+    # local slice of the combine coefficients
+    start = idx * e_local
+    coef_local = jax.lax.dynamic_slice_in_dim(coef, start, e_local,
+                                              axis=-1)  # [B, T, E_local]
+
+    # every local expert computes all tokens; combine weighted
+    h = jnp.einsum("btd,edf->betf", x, w1) + b1[None, :, None, :]
+    h = jax.nn.gelu(h)
+    out = jnp.einsum("betf,efd->betd", h, w2) + b2[None, :, None, :]
+    y = jnp.einsum("betd,bte->btd", out, coef_local)
+    y = jax.lax.psum(y, axis_name)
+    load = jax.lax.pmean(jnp.mean(jnp.max(probs, axis=-1)), axis_name)
+    if batch_axis is not None:
+        # the metric is declared replicated (out_specs P()): reduce over
+        # the batch axis too so every shard returns the GLOBAL mean
+        load = jax.lax.pmean(load, batch_axis)
+    return y, load
+
+
+def moe_ffn_sharded(x, params, mesh, ep_axis="ep", batch_axis=None):
+    """Global arrays -> shard_map over the mesh: expert arrays sharded
+    on dim 0 over `ep_axis`, x replicated (or batch-sharded over
+    `batch_axis`), output matching x."""
+    from jax.experimental.shard_map import shard_map
+
+    x_spec = P(batch_axis, None, None)
+    param_specs = {"gate_w": P(None, None),
+                   "w1": P(ep_axis, None, None), "b1": P(ep_axis, None),
+                   "w2": P(ep_axis, None, None), "b2": P(ep_axis, None)}
+    n_global = params["gate_w"].shape[-1]
+
+    fn = functools.partial(moe_ffn, axis_name=ep_axis,
+                           n_experts_global=n_global,
+                           batch_axis=batch_axis)
+    sm = shard_map(fn, mesh=mesh,
+                   in_specs=(x_spec, param_specs),
+                   out_specs=(x_spec, P()),
+                   check_rep=False)
+    return sm(x, params)
