@@ -136,3 +136,22 @@ def _shard_hint(ctx, ins, attrs):
                            for s in attrs.get("spec", [])])
     return {"Out": [jax.lax.with_sharding_constraint(
         x, NamedSharding(ctx.mesh, spec))]}
+
+
+@register_op("c_alltoall")
+def _c_alltoall(ctx, ins, attrs):
+    """All-to-all over the ring's mesh axis: splits dim `split_axis`
+    across the group and concatenates the received pieces on
+    `concat_axis` (XLA AllToAll over ICI) — the Program-IR face of the
+    exchange that Ulysses-style sequence parallelism and sparse MoE
+    dispatch perform (parallel/ulysses.py uses jax.lax.all_to_all
+    directly; this op serves reference-style programs)."""
+    x = ins["X"][0]
+    axis = _axis_name(attrs)
+    if _in_shard_map(axis):
+        out = jax.lax.all_to_all(
+            x, axis, split_axis=attrs.get("split_axis", 0),
+            concat_axis=attrs.get("concat_axis", 0), tiled=True)
+    else:
+        out = x  # GSPMD mode: resharding constraints do the exchange
+    return {"Out": [out]}

@@ -10,3 +10,4 @@ from . import ring_attention  # noqa: F401  (registers the op)
 from . import recompute  # noqa: F401  (registers recompute_segment)
 from .pipeline import gpipe, stack_stage_params, SectionPipeline  # noqa: F401
 from .moe import moe_ffn, moe_ffn_sharded, init_moe_params  # noqa: F401
+from .ulysses import ulysses_attention, ulysses_attention_sharded  # noqa: F401

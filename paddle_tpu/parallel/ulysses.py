@@ -1,0 +1,75 @@
+"""Ulysses-style all-to-all sequence parallelism.
+
+The second long-context scheme next to [[ring attention]]
+(parallel/ring_attention.py): instead of rotating K/V blocks around a
+ring, TWO all-to-alls re-partition the work — the first trades the
+sequence sharding for a HEAD sharding (each device receives the full
+sequence for h/sp of the heads), exact local attention runs per head
+group, and the second all-to-all restores the sequence sharding.
+
+Communication is 2 x all-to-all of the activations (O(b·t·d/sp) per
+device over ICI) vs the ring's (sp-1) k/v ppermutes; attention math is
+exact in both. Requires sp | n_heads.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["ulysses_attention", "ulysses_attention_sharded"]
+
+
+def ulysses_attention(q, k, v, axis_name="sp", causal=False,
+                      sm_scale=None):
+    """Inside shard_map: q/k/v are LOCAL sequence chunks
+    [b, h, t_local, d] with h divisible by the axis size. Returns the
+    local output chunk [b, h, t_local, d]."""
+    n = jax.lax.axis_size(axis_name)
+    h = q.shape[1]
+    if h % n:
+        raise ValueError(
+            f"ulysses_attention: heads ({h}) must divide by the "
+            f"sequence-parallel degree ({n}); use ring attention for "
+            f"head counts below the mesh axis size")
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+
+    def scatter_heads(x):
+        # [b, h, t/n, d] -> [b, h/n, t, d]
+        return jax.lax.all_to_all(x, axis_name, split_axis=1,
+                                  concat_axis=2, tiled=True)
+
+    def gather_heads(x):
+        # [b, h/n, t, d] -> [b, h, t/n, d]
+        return jax.lax.all_to_all(x, axis_name, split_axis=2,
+                                  concat_axis=1, tiled=True)
+
+    qf, kf, vf = scatter_heads(q), scatter_heads(k), scatter_heads(v)
+    # exact attention over the full sequence for the local head group;
+    # score+mask math shared with the ring scheme (positions are global
+    # after the scatter, so offsets are 0)
+    from .ring_attention import _masked_scores
+    s = _masked_scores(qf, kf, sm_scale, 0, 0, causal)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p.astype(vf.dtype), vf,
+                   preferred_element_type=jnp.float32).astype(q.dtype)
+    return gather_heads(o)
+
+
+def ulysses_attention_sharded(q, k, v, mesh, seq_axis, causal=False,
+                              sm_scale=None, batch_axis=None):
+    """Global [b, h, T, d] arrays -> shard_map over the mesh seq axis
+    (same contract as ring_attention_sharded)."""
+    shard_map = jax.shard_map  # non-deprecated home since jax 0.8
+
+    spec = P(batch_axis, None, seq_axis, None)
+    fn = functools.partial(ulysses_attention, axis_name=seq_axis,
+                           causal=causal, sm_scale=sm_scale)
+    sm = shard_map(lambda q_, k_, v_: fn(q_, k_, v_), mesh=mesh,
+                   in_specs=(spec, spec, spec), out_specs=spec,
+                   check_rep=False)
+    return sm(q, k, v)
