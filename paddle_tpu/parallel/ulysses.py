@@ -64,12 +64,11 @@ def ulysses_attention_sharded(q, k, v, mesh, seq_axis, causal=False,
                               sm_scale=None, batch_axis=None):
     """Global [b, h, T, d] arrays -> shard_map over the mesh seq axis
     (same contract as ring_attention_sharded)."""
-    shard_map = jax.shard_map  # non-deprecated home since jax 0.8
-
     spec = P(batch_axis, None, seq_axis, None)
     fn = functools.partial(ulysses_attention, axis_name=seq_axis,
                            causal=causal, sm_scale=sm_scale)
-    sm = shard_map(lambda q_, k_, v_: fn(q_, k_, v_), mesh=mesh,
-                   in_specs=(spec, spec, spec), out_specs=spec,
-                   check_rep=False)
+    # jax.shard_map (non-deprecated home): check_rep became check_vma
+    sm = jax.shard_map(lambda q_, k_, v_: fn(q_, k_, v_), mesh=mesh,
+                       in_specs=(spec, spec, spec), out_specs=spec,
+                       check_vma=False)
     return sm(q, k, v)
