@@ -11,7 +11,7 @@ white_list = {
     "conv2d_transpose",
     # fused attention kernels: bf16 operands hit the MXU fast path, all
     # softmax/accumulation math stays f32 inside the kernel
-    "flash_attention", "ring_attention",
+    "flash_attention", "ring_attention", "ulysses_attention",
 }
 
 # Ops that must stay fp32 for numerics: reductions into losses, norms.

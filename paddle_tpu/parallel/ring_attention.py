@@ -180,22 +180,33 @@ def ring_attention_sharded(q, k, v, mesh, seq_axis, causal=False,
 # Program-IR op
 # ---------------------------------------------------------------------------
 
-def _ring_attention_op(ctx, ins, attrs):
-    q, k, v = ins["Q"][0], ins["K"][0], ins["V"][0]
-    if ctx.mesh is None:
-        # single-device fallback: exact attention via the flash kernel path
-        from ..ops.pallas.flash_attention import flash_attention
-        return {"Out": [flash_attention(q, k, v,
-                                        causal=attrs.get("causal", False),
-                                        sm_scale=attrs.get("sm_scale"))]}
-    seq_axis = attrs.get("seq_axis", "sp")
-    batch_axis = attrs.get("batch_axis", "dp")
-    if batch_axis not in ctx.mesh.axis_names:
-        batch_axis = None
-    out = ring_attention_sharded(
-        q, k, v, ctx.mesh, seq_axis, causal=attrs.get("causal", False),
-        sm_scale=attrs.get("sm_scale"), batch_axis=batch_axis)
-    return {"Out": [out]}
+def seq_parallel_attention_op(sharded_fn):
+    """Shared Program-IR op body for the sequence-parallel attention
+    schemes (ring / Ulysses): attrs parsing, single-device flash
+    fallback (also used when the mesh lacks the seq axis — the inputs
+    are then unsharded on it, so exact attention is the same math),
+    and graceful batch-axis degradation."""
+
+    def _op(ctx, ins, attrs):
+        q, k, v = ins["Q"][0], ins["K"][0], ins["V"][0]
+        seq_axis = attrs.get("seq_axis", "sp")
+        if ctx.mesh is None or seq_axis not in ctx.mesh.axis_names:
+            from ..ops.pallas.flash_attention import flash_attention
+            return {"Out": [flash_attention(
+                q, k, v, causal=attrs.get("causal", False),
+                sm_scale=attrs.get("sm_scale"))]}
+        batch_axis = attrs.get("batch_axis", "dp")
+        if batch_axis not in ctx.mesh.axis_names:
+            batch_axis = None
+        out = sharded_fn(
+            q, k, v, ctx.mesh, seq_axis,
+            causal=attrs.get("causal", False),
+            sm_scale=attrs.get("sm_scale"), batch_axis=batch_axis)
+        return {"Out": [out]}
+    return _op
+
+
+_ring_attention_op = seq_parallel_attention_op(ring_attention_sharded)
 
 
 def _register():

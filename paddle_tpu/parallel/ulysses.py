@@ -72,3 +72,17 @@ def ulysses_attention_sharded(q, k, v, mesh, seq_axis, causal=False,
                        in_specs=(spec, spec, spec), out_specs=spec,
                        check_vma=False)
     return sm(q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# Program-IR op (same contract as the ring_attention op)
+# ---------------------------------------------------------------------------
+
+def _register():
+    from ..core.registry import register_op
+    from .ring_attention import seq_parallel_attention_op
+    register_op("ulysses_attention")(
+        seq_parallel_attention_op(ulysses_attention_sharded))
+
+
+_register()
