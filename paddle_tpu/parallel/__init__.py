@@ -9,5 +9,6 @@ from .mesh import get_mesh, set_mesh, mesh_context  # noqa: F401
 from . import ring_attention  # noqa: F401  (registers the op)
 from . import recompute  # noqa: F401  (registers recompute_segment)
 from .pipeline import gpipe, stack_stage_params, SectionPipeline  # noqa: F401
-from .moe import moe_ffn, moe_ffn_sharded, init_moe_params  # noqa: F401
+from .moe import (moe_ffn, moe_ffn_sharded, moe_ffn_sparse,  # noqa: F401
+                  moe_ffn_sparse_sharded, init_moe_params)
 from .ulysses import ulysses_attention, ulysses_attention_sharded  # noqa: F401

@@ -22,7 +22,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-__all__ = ["moe_ffn", "moe_ffn_sharded", "init_moe_params"]
+__all__ = ["moe_ffn", "moe_ffn_sharded", "moe_ffn_sparse",
+           "moe_ffn_sparse_sharded", "init_moe_params"]
 
 
 def init_moe_params(rng, n_experts, d_model, d_ff, dtype=jnp.float32):
@@ -85,23 +86,99 @@ def moe_ffn(x, params, axis_name="ep", n_experts_global=None,
     return y, load
 
 
-def moe_ffn_sharded(x, params, mesh, ep_axis="ep", batch_axis=None):
-    """Global arrays -> shard_map over the mesh: expert arrays sharded
-    on dim 0 over `ep_axis`, x replicated (or batch-sharded over
-    `batch_axis`), output matching x."""
-    from jax.experimental.shard_map import shard_map
-
+def _moe_shard_map(inner, x, params, mesh, ep_axis, batch_axis, **kw):
+    """Shared shard_map wrapper for the dense and sparse formulations:
+    one place owns the spec layout (expert arrays sharded on dim 0 over
+    ep, gate replicated, x optionally batch-sharded)."""
     x_spec = P(batch_axis, None, None)
     param_specs = {"gate_w": P(None, None),
                    "w1": P(ep_axis, None, None), "b1": P(ep_axis, None),
                    "w2": P(ep_axis, None, None), "b2": P(ep_axis, None)}
-    n_global = params["gate_w"].shape[-1]
-
-    fn = functools.partial(moe_ffn, axis_name=ep_axis,
-                           n_experts_global=n_global,
-                           batch_axis=batch_axis)
-    sm = shard_map(fn, mesh=mesh,
-                   in_specs=(x_spec, param_specs),
-                   out_specs=(x_spec, P()),
-                   check_rep=False)
+    fn = functools.partial(inner, axis_name=ep_axis,
+                           n_experts_global=params["gate_w"].shape[-1],
+                           batch_axis=batch_axis, **kw)
+    sm = jax.shard_map(fn, mesh=mesh, in_specs=(x_spec, param_specs),
+                       out_specs=(x_spec, P()), check_vma=False)
     return sm(x, params)
+
+
+def moe_ffn_sharded(x, params, mesh, ep_axis="ep", batch_axis=None):
+    """Global arrays -> shard_map over the mesh: expert arrays sharded
+    on dim 0 over `ep_axis`, x replicated (or batch-sharded over
+    `batch_axis`), output matching x."""
+    return _moe_shard_map(moe_ffn, x, params, mesh, ep_axis, batch_axis)
+
+
+def moe_ffn_sparse(x, params, axis_name="ep", capacity=None,
+                   n_experts_global=None, batch_axis=None):
+    """Capacity-based sparse dispatch (the performance formulation):
+    instead of every expert computing every token, tokens are packed
+    into per-expert capacity buffers and exchanged with two all-to-alls
+    over `ep`, so each expert computes only (up to) ep * capacity
+    tokens. Tokens beyond an expert's capacity are DROPPED (output 0 +
+    residual upstream), the standard Switch trade; capacity defaults to
+    2x the even-load share. Numerics match moe_ffn exactly whenever no
+    token is dropped (capacity >= tokens routed per expert).
+
+    x [B, T, d] local; expert params local shards as in moe_ffn.
+    Returns (y [B, T, d], load metric)."""
+    gate_w = params["gate_w"]
+    w1, b1 = params["w1"], params["b1"]
+    w2, b2 = params["w2"], params["b2"]
+    e_local = w1.shape[0]
+    e_global = n_experts_global or gate_w.shape[-1]
+    n_shards = jax.lax.axis_size(axis_name)
+    b, t, d = x.shape
+    n = b * t
+    if capacity is None:
+        capacity = max(1, (2 * n + e_global - 1) // e_global)
+
+    xt = x.reshape(n, d)
+    logits = xt @ gate_w                                # [N, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    top = jnp.argmax(probs, axis=-1)                    # [N]
+    coef = jnp.take_along_axis(probs, top[:, None], axis=-1)[:, 0]
+
+    onehot = jax.nn.one_hot(top, e_global, dtype=jnp.int32)  # [N, E]
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1       # [N, E]
+    pos = jnp.max(pos, axis=-1)                         # [N] slot in expert
+    keep = pos < capacity
+
+    # dispatch buffers [E, C, d]: scatter kept tokens
+    disp = jnp.zeros((e_global, capacity, d), x.dtype)
+    safe_e = jnp.where(keep, top, 0)
+    safe_p = jnp.where(keep, pos, 0)
+    contrib = jnp.where(keep[:, None], xt, 0.0)
+    disp = disp.at[safe_e, safe_p].add(contrib)
+
+    # exchange: [ep, E_local, C, d] -> each shard holds its experts'
+    # buffers from EVERY shard: [E_local, ep*C, d]
+    disp = disp.reshape(n_shards, e_local, capacity, d)
+    recv = jax.lax.all_to_all(disp, axis_name, split_axis=0,
+                              concat_axis=2, tiled=True)
+    recv = recv.reshape(e_local, n_shards * capacity, d)
+
+    h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", recv, w1)
+                    + b1[:, None, :])
+    out = jnp.einsum("ecf,efd->ecd", h, w2) + b2[:, None, :]
+
+    # exchange back: [E_local, ep, C, d] -> [E(=ep*E_local), C, d]
+    out = out.reshape(e_local, n_shards, capacity, d)
+    back = jax.lax.all_to_all(out, axis_name, split_axis=1,
+                              concat_axis=0, tiled=True)
+    back = back.reshape(e_global, capacity, d)
+
+    y = back[safe_e, safe_p] * coef[:, None]
+    y = jnp.where(keep[:, None], y, 0.0)
+    load = jax.lax.pmean(jnp.mean(jnp.max(probs, axis=-1)), axis_name)
+    if batch_axis is not None:
+        load = jax.lax.pmean(load, batch_axis)
+    return y.reshape(b, t, d), load
+
+
+def moe_ffn_sparse_sharded(x, params, mesh, ep_axis="ep", capacity=None,
+                           batch_axis=None):
+    """Global-array wrapper for moe_ffn_sparse (same specs as
+    moe_ffn_sharded)."""
+    return _moe_shard_map(moe_ffn_sparse, x, params, mesh, ep_axis,
+                          batch_axis, capacity=capacity)
