@@ -16,7 +16,7 @@ from .. import layers
 from . import transformer
 
 __all__ = ["gpt_small", "gpt_medium", "build_train", "greedy_generate",
-           "build_decode_step", "kv_generate"]
+           "build_decode_step", "kv_generate", "beam_generate"]
 
 
 def gpt_small(**kw):
@@ -264,3 +264,64 @@ def kv_generate(exe, scope, decode_prog, token_var, logits_var,
             cur = _sample(step(cur), temperature, rng)
             out.append(cur)
         return out
+
+
+def beam_generate(exe, program, tokens_var, logits_var, prompt,
+                  max_new_tokens, seq_len, beam_size=4,
+                  length_penalty=0.0, eos_id=None):
+    """Host-driven beam search over the full-re-forward graph (the
+    reference's beam_search decoding style, driven from Python): all
+    live beams ride one batched forward per step (beams pad up to the
+    program's build-time batch), log-prob scores accumulate. A beam
+    that emits `eos_id` is finished and stops extending; with
+    hypotheses of different lengths in play, `length_penalty` > 0
+    applies the GNMT-style normalization score/len^p (without an
+    eos_id all hypotheses share one length, so the penalty cannot
+    change the ranking). Returns the best continuation (list,
+    including the eos token if one was produced).
+
+    Requires beam_size <= the program's batch."""
+    if not len(prompt):
+        raise ValueError("beam_generate: prompt must be non-empty")
+    batch = int(tokens_var.shape[0])
+    if beam_size > batch:
+        raise ValueError(
+            f"beam_generate: beam_size ({beam_size}) exceeds the "
+            f"program's batch ({batch}); rebuild with a larger batch")
+    win = seq_len - 1
+
+    def key(cs):
+        ctx, score, _ = cs
+        gen_len = max(len(ctx) - len(prompt), 1)
+        return -score / (gen_len ** length_penalty
+                         if length_penalty else 1.0)
+
+    beams = [(list(int(t) for t in prompt), 0.0, False)]
+    for _ in range(max_new_tokens):
+        live = [(i, b) for i, b in enumerate(beams) if not b[2]]
+        if not live:
+            break
+        rows = []
+        for _, (ctx, _, _) in live:
+            window = ctx[-win:]
+            rows.append(window + [0] * (seq_len - len(window)))
+        while len(rows) < batch:
+            rows.append([0] * seq_len)
+        feed = np.asarray(rows, np.int64)
+        logits, = exe.run(program, feed={tokens_var.name: feed},
+                          fetch_list=[logits_var])
+        logits = np.asarray(logits)
+        cand = [b for b in beams if b[2]]  # finished pass through
+        for ri, (_, (ctx, score, _)) in enumerate(live):
+            pos = min(len(ctx), win) - 1
+            lp = logits[ri, pos]
+            lp = lp - lp.max()
+            logp = lp - np.log(np.exp(lp).sum())
+            for tok in np.argsort(-logp)[:beam_size]:
+                tok = int(tok)
+                cand.append((ctx + [tok], score + float(logp[tok]),
+                             eos_id is not None and tok == eos_id))
+        cand.sort(key=key)
+        beams = cand[:beam_size]
+    best = beams[0][0]
+    return best[len(prompt):]

@@ -358,3 +358,32 @@ def test_gpt_kv_cache_decode_matches_full_reforward():
                           cache_names, prompt=[0, 1, 2],
                           max_new_tokens=5)
     assert got == want, (got, want)
+
+
+def test_gpt_beam_generate():
+    """Beam search over the trained cyclic model: beam=3 must find the
+    same (deterministic) continuation greedy does, with a higher-
+    is-better score ordering."""
+    from paddle_tpu.models import gpt
+
+    vocab, seq = 16, 12
+    cfg = gpt.gpt_small(vocab_size=vocab, d_model=32, n_heads=4,
+                        n_layers=2, d_ff=64, max_seq_len=seq,
+                        dropout=0.0, use_flash=False)
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        loss, logits, tokens = gpt.build_train(cfg, batch=4, seq_len=seq,
+                                               lr=5e-3)
+        exe = fluid.Executor()
+        exe.run(startup)
+        base = np.arange(seq) % vocab
+        toks = np.stack([(base + i) % vocab for i in range(4)]) \
+            .astype(np.int64)
+        for _ in range(40):
+            exe.run(main, feed={"tokens": toks}, fetch_list=[loss])
+        infer = main.clone(for_test=True)
+        out = gpt.beam_generate(exe, infer, tokens, logits,
+                                prompt=[0, 1, 2], max_new_tokens=4,
+                                seq_len=seq, beam_size=3)
+        assert out == [3, 4, 5, 6], out
