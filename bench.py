@@ -229,7 +229,28 @@ def bench_gpt():
     }
 
 
+def _wait_for_backend():
+    """The TPU tunnel can be transiently wedged (UNAVAILABLE backend
+    init). Retry for up to BENCH_WAIT_TPU_S seconds (default 600)
+    before measuring; on exhaustion proceed and let the real error
+    surface."""
+    deadline = time.time() + float(os.environ.get("BENCH_WAIT_TPU_S",
+                                                  "600"))
+    while True:
+        try:
+            import jax
+            jax.devices()
+            return
+        except RuntimeError as e:
+            if time.time() >= deadline:
+                print(f"# backend still unavailable after retries: {e}",
+                      file=sys.stderr)
+                return
+            time.sleep(30)
+
+
 def main():
+    _wait_for_backend()
     model = os.environ.get("BENCH_MODEL", "bert")
     if model == "both":
         print(json.dumps(bench_bert()))

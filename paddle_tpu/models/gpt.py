@@ -72,6 +72,14 @@ def build_train(cfg, batch, seq_len, lr=3e-4, amp=False,
     return loss, logits, tokens
 
 
+def _window_row(ctx, win, seq_len):
+    """Context window + zero pad for the full-re-forward decoders: the
+    usable window is seq_len-1 because the train graph consumes
+    tokens[:-1]; returns (row list of len seq_len, last real pos)."""
+    window = ctx[-win:]
+    return window + [0] * (seq_len - len(window)), len(window) - 1
+
+
 def greedy_generate(exe, program, tokens_var, logits_var, prompt,
                     max_new_tokens, seq_len, temperature=0.0, seed=0):
     """Autoregressive decode by re-forwarding the full (fixed-length)
@@ -94,11 +102,8 @@ def greedy_generate(exe, program, tokens_var, logits_var, prompt,
     # row up to it and read row 0
     batch = int(tokens_var.shape[0])
     for _ in range(max_new_tokens):
-        window = ctx[-win:]
-        pos = len(window) - 1
-        pad = [0] * (seq_len - len(window))
-        feed_tokens = np.tile(np.asarray([window + pad], np.int64),
-                              (batch, 1))
+        row, pos = _window_row(ctx, win, seq_len)
+        feed_tokens = np.tile(np.asarray([row], np.int64), (batch, 1))
         logits, = exe.run(program,
                           feed={tokens_var.name: feed_tokens},
                           fetch_list=[logits_var])
@@ -298,13 +303,10 @@ def beam_generate(exe, program, tokens_var, logits_var, prompt,
 
     beams = [(list(int(t) for t in prompt), 0.0, False)]
     for _ in range(max_new_tokens):
-        live = [(i, b) for i, b in enumerate(beams) if not b[2]]
+        live = [b for b in beams if not b[2]]
         if not live:
             break
-        rows = []
-        for _, (ctx, _, _) in live:
-            window = ctx[-win:]
-            rows.append(window + [0] * (seq_len - len(window)))
+        rows = [_window_row(ctx, win, seq_len)[0] for ctx, _, _ in live]
         while len(rows) < batch:
             rows.append([0] * seq_len)
         feed = np.asarray(rows, np.int64)
@@ -312,12 +314,13 @@ def beam_generate(exe, program, tokens_var, logits_var, prompt,
                           fetch_list=[logits_var])
         logits = np.asarray(logits)
         cand = [b for b in beams if b[2]]  # finished pass through
-        for ri, (_, (ctx, score, _)) in enumerate(live):
-            pos = min(len(ctx), win) - 1
+        for ri, (ctx, score, _) in enumerate(live):
+            pos = _window_row(ctx, win, seq_len)[1]
             lp = logits[ri, pos]
             lp = lp - lp.max()
             logp = lp - np.log(np.exp(lp).sum())
-            for tok in np.argsort(-logp)[:beam_size]:
+            topk = np.argpartition(-logp, beam_size)[:beam_size]
+            for tok in topk[np.argsort(-logp[topk])]:
                 tok = int(tok)
                 cand.append((ctx + [tok], score + float(logp[tok]),
                              eos_id is not None and tok == eos_id))
