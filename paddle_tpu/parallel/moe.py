@@ -46,6 +46,28 @@ def init_moe_params(rng, n_experts, d_model, d_ff, dtype=jnp.float32):
     }
 
 
+def _route_top1(x, gate_w, e_global):
+    """Top-1 switch routing, shared by every formulation: returns
+    (probs [.., E], coef [.., E] = prob on the selected expert under a
+    stop-grad mask, load = mean top-1 prob)."""
+    logits = jnp.einsum("btd,de->bte", x, gate_w)
+    probs = jax.nn.softmax(logits, axis=-1)
+    mask = jax.nn.one_hot(jnp.argmax(probs, -1), e_global,
+                          dtype=probs.dtype)
+    coef = probs * jax.lax.stop_gradient(mask)
+    return probs, coef, jnp.mean(jnp.max(probs, axis=-1))
+
+
+def _expert_eval_all(x, params):
+    """Every expert over every token: [B, E, T, d] outputs (the dense
+    formulation's compute; also the exact single-device evaluation)."""
+    h = jnp.einsum("btd,edf->betf", x, params["w1"]) \
+        + params["b1"][None, :, None, :]
+    h = jax.nn.gelu(h)
+    return jnp.einsum("betf,efd->betd", h, params["w2"]) \
+        + params["b2"][None, :, None, :]
+
+
 def moe_ffn(x, params, axis_name="ep", n_experts_global=None,
             batch_axis=None):
     """Inside shard_map: x [B, T, d] (replicated or dp-sharded on B);
@@ -60,12 +82,7 @@ def moe_ffn(x, params, axis_name="ep", n_experts_global=None,
     e_global = n_experts_global or gate_w.shape[-1]
     idx = jax.lax.axis_index(axis_name)
 
-    logits = jnp.einsum("btd,de->bte", x, gate_w)      # [B, T, E]
-    probs = jax.nn.softmax(logits, axis=-1)
-    top = jnp.argmax(probs, axis=-1)                   # [B, T]
-    # hard top-1 mask (stop-grad), scaled by the differentiable prob
-    mask = jax.nn.one_hot(top, e_global, dtype=probs.dtype)
-    coef = probs * jax.lax.stop_gradient(mask)         # [B, T, E]
+    probs, coef, local_load = _route_top1(x, gate_w, e_global)
 
     # local slice of the combine coefficients
     start = idx * e_local
@@ -73,12 +90,11 @@ def moe_ffn(x, params, axis_name="ep", n_experts_global=None,
                                               axis=-1)  # [B, T, E_local]
 
     # every local expert computes all tokens; combine weighted
-    h = jnp.einsum("btd,edf->betf", x, w1) + b1[None, :, None, :]
-    h = jax.nn.gelu(h)
-    out = jnp.einsum("betf,efd->betd", h, w2) + b2[None, :, None, :]
+    out = _expert_eval_all(
+        x, {"w1": w1, "b1": b1, "w2": w2, "b2": b2})
     y = jnp.einsum("betd,bte->btd", out, coef_local)
     y = jax.lax.psum(y, axis_name)
-    load = jax.lax.pmean(jnp.mean(jnp.max(probs, axis=-1)), axis_name)
+    load = jax.lax.pmean(local_load, axis_name)
     if batch_axis is not None:
         # the metric is declared replicated (out_specs P()): reduce over
         # the batch axis too so every shard returns the GLOBAL mean
@@ -182,3 +198,46 @@ def moe_ffn_sparse_sharded(x, params, mesh, ep_axis="ep", capacity=None,
     moe_ffn_sharded)."""
     return _moe_shard_map(moe_ffn_sparse, x, params, mesh, ep_axis,
                           batch_axis, capacity=capacity)
+
+
+# ---------------------------------------------------------------------------
+# Program-IR op + fluid.layers front-end
+# ---------------------------------------------------------------------------
+
+def _moe_ffn_op(ctx, ins, attrs):
+    """Program-IR face: inputs X [B,T,d], GateW [d,E], W1 [E,d,f],
+    B1 [E,f], W2 [E,f,d], B2 [E,d]. With a mesh carrying the `ep` axis
+    the sharded (dense or capacity-sparse) formulation runs; otherwise
+    a single-device dense evaluation with identical routing math."""
+    x = ins["X"][0]
+    params = {"gate_w": ins["GateW"][0], "w1": ins["W1"][0],
+              "b1": ins["B1"][0], "w2": ins["W2"][0], "b2": ins["B2"][0]}
+    ep_axis = attrs.get("ep_axis", "ep")
+    if ctx.mesh is not None and ep_axis in ctx.mesh.axis_names:
+        batch_axis = attrs.get("batch_axis", "dp")
+        if batch_axis not in ctx.mesh.axis_names:
+            batch_axis = None
+        if attrs.get("capacity"):
+            y, load = moe_ffn_sparse_sharded(
+                x, params, ctx.mesh, ep_axis=ep_axis,
+                capacity=attrs["capacity"], batch_axis=batch_axis)
+        else:
+            y, load = moe_ffn_sharded(x, params, ctx.mesh,
+                                      ep_axis=ep_axis,
+                                      batch_axis=batch_axis)
+        return {"Out": [y], "Load": [load]}
+    # single-device exact evaluation: the SAME routing/expert helpers
+    # the sharded formulations use
+    e = params["gate_w"].shape[-1]
+    _, coef, load = _route_top1(x, params["gate_w"], e)
+    out = _expert_eval_all(x, params)
+    y = jnp.einsum("betd,bte->btd", out, coef)
+    return {"Out": [y], "Load": [load]}
+
+
+def _register():
+    from ..core.registry import register_op
+    register_op("moe_ffn", nondiff_outputs=("Load",))(_moe_ffn_op)
+
+
+_register()

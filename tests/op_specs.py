@@ -443,7 +443,7 @@ for _op in ["c_allreduce_sum", "c_allreduce_max", "c_allreduce_min",
             "c_allreduce_prod", "c_allgather", "c_reducescatter",
             "c_broadcast", "c_sync_calc_stream", "c_sync_comm_stream",
             "allreduce", "broadcast", "shard_hint", "ring_attention",
-            "ulysses_attention", "c_alltoall",  # op bodies exercised in
+            "ulysses_attention", "c_alltoall", "moe_ffn",  # op bodies exercised in
             # tests/test_parallel.py (c_alltoall, seq-parallel op) and
             # tests/test_kernels.py (sharded fns)
             "sync_batch_norm"]:
