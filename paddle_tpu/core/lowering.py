@@ -103,7 +103,18 @@ def run_op(op, env, ctx):
     # live view of already-materialised vars — lets keep-previous-value
     # semantics (conditional_block false branch) read carried state
     opctx.env = env
-    outs = opdef.lower(opctx, ins, op.attrs)
+    try:
+        outs = opdef.lower(opctx, ins, op.attrs)
+    except Exception as e:
+        # operator attribution on failures (reference op_call_stack.cc:
+        # PADDLE_ENFORCE appends the Python-level op that emitted the
+        # kernel): name the op, its input slots/shapes, and attrs so
+        # users see WHICH Program op died, not just a jnp traceback
+        shapes = {s: [getattr(v, "shape", "?") for v in vs]
+                  for s, vs in ins.items()}
+        e.add_note(f"[operator {op.type!r}] inputs {shapes} -> outputs "
+                   f"{dict(op.outputs)}, attrs {op.attrs}")
+        raise
     check = FLAGS.check_nan_inf
     for slot, names in op.outputs.items():
         if slot not in outs:

@@ -751,6 +751,10 @@ def moe_ffn(input, num_experts, d_ff, ep_axis="ep", capacity=None,
     Returns (out, router_load)."""
     from ..framework import ParamAttr
     from ..initializer import Normal
+    if param_attr is False:
+        raise TypeError(
+            "moe_ffn: param_attr=False is not meaningful — the expert "
+            "weights ARE the layer; pass a ParamAttr or None")
     helper = LayerHelper("moe_ffn", name=name, param_attr=param_attr)
     d = int(input.shape[-1])
     pfx = helper.name

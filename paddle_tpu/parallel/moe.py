@@ -82,7 +82,7 @@ def moe_ffn(x, params, axis_name="ep", n_experts_global=None,
     e_global = n_experts_global or gate_w.shape[-1]
     idx = jax.lax.axis_index(axis_name)
 
-    probs, coef, local_load = _route_top1(x, gate_w, e_global)
+    _, coef, local_load = _route_top1(x, gate_w, e_global)
 
     # local slice of the combine coefficients
     start = idx * e_local
@@ -90,8 +90,7 @@ def moe_ffn(x, params, axis_name="ep", n_experts_global=None,
                                               axis=-1)  # [B, T, E_local]
 
     # every local expert computes all tokens; combine weighted
-    out = _expert_eval_all(
-        x, {"w1": w1, "b1": b1, "w2": w2, "b2": b2})
+    out = _expert_eval_all(x, params)  # extra gate_w key is unused
     y = jnp.einsum("betd,bte->btd", out, coef_local)
     y = jax.lax.psum(y, axis_name)
     load = jax.lax.pmean(local_load, axis_name)

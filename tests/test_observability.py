@@ -162,3 +162,26 @@ def test_xplane_summary(tmp_path):
         profiler.stop_profiler()
     s = profiler.summarize_xplane(d)
     assert s["total_us"] > 0 and s["by_category"] and s["top_ops"]
+
+
+def test_op_error_attribution():
+    """A failing lowering names the Program op, input shapes, and attrs
+    (reference op_call_stack.cc PADDLE_ENFORCE attribution) instead of
+    surfacing only the raw jnp traceback."""
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+
+    main, startup = fluid.Program(), fluid.Program()
+    sc = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(sc):
+        x = layers.data("att_x", shape=[4], dtype="float32")
+        y = layers.fc(x, size=2)
+        exe = fluid.Executor()
+        exe.run(startup)
+        try:
+            exe.run(main, feed={"att_x": np.zeros((2, 5), np.float32)},
+                    fetch_list=[y])
+            assert False, "expected a shape error"
+        except Exception as e:
+            notes = " ".join(getattr(e, "__notes__", []))
+            assert "operator 'mul'" in notes and "(2, 5)" in notes, notes
