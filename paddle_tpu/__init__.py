@@ -16,6 +16,7 @@ changing the import.
 """
 
 from . import ops  # noqa: F401  — registers all op lowerings
+from . import average  # noqa: F401
 from .framework import (Program, program_guard, default_main_program,  # noqa: F401
                         default_startup_program, name_scope, unique_name,
                         ParamAttr, WeightNormParamAttr, Variable,

@@ -1,0 +1,31 @@
+"""fluid.average — WeightedAverage (reference: python/paddle/fluid/
+average.py): host-side running weighted mean over fetched numpy values,
+used by the book tutorials for epoch-level loss/accuracy reporting."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["WeightedAverage"]
+
+
+class WeightedAverage:
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.numerator = 0.0
+        self.denominator = 0.0
+
+    def add(self, value, weight):
+        # elementwise like the reference: an ndarray value accumulates
+        # per element (epoch-averaging a fetched per-sample vector)
+        arr = np.asarray(value, dtype=np.float64)
+        self.numerator = self.numerator + arr * weight
+        self.denominator += weight
+
+    def eval(self):
+        if self.denominator == 0.0:
+            raise ValueError(
+                "There is no data to be averaged in WeightedAverage.")
+        out = self.numerator / self.denominator
+        return float(out) if np.ndim(out) == 0 else out

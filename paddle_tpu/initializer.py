@@ -153,3 +153,28 @@ TruncatedNormal = TruncatedNormalInitializer
 Xavier = XavierInitializer
 MSRA = MSRAInitializer
 Bilinear = BilinearInitializer
+
+
+# -- CPU-init shims (reference initializer.py:30-60) ----------------------
+# On TPU the startup program compiles to XLA wherever the Executor
+# targets; there is no separate "init on CPU then copy" path to select,
+# so the context manager is accepted and ignored (weights land on the
+# device that runs startup).
+import contextlib as _contextlib
+
+_force_init_on_cpu_flag = False
+
+
+def force_init_on_cpu():
+    return _force_init_on_cpu_flag
+
+
+@_contextlib.contextmanager
+def init_on_cpu():
+    global _force_init_on_cpu_flag
+    prev = _force_init_on_cpu_flag
+    _force_init_on_cpu_flag = True
+    try:
+        yield
+    finally:
+        _force_init_on_cpu_flag = prev

@@ -62,7 +62,8 @@ SURFACE = {
  "metrics": "MetricBase CompositeMetric Precision Recall Accuracy "
             "ChunkEvaluator EditDistance DetectionMAP Auc",
  "initializer": "Constant Uniform Normal TruncatedNormal Xavier Bilinear "
-                "MSRA NumpyArrayInitializer",
+                "MSRA NumpyArrayInitializer force_init_on_cpu "
+                "init_on_cpu",
  "optimizer": "SGD Momentum Adagrad Adam Adamax Dpsgd DecayedAdagrad Ftrl "
               "RMSProp Adadelta LarsMomentum DGCMomentum Lamb ModelAverage "
               "ExponentialMovingAverage PipelineOptimizer "
@@ -80,7 +81,8 @@ SURFACE = {
      "Executor ParallelExecutor CompiledProgram BuildStrategy "
      "ExecutionStrategy CPUPlace Scope global_scope scope_guard LoDTensor "
      "LoDTensorArray DataFeeder WeightNormParamAttr ParamAttr name_scope "
-     "unique_name gradients profiler install_check data embedding one_hot",
+     "unique_name gradients profiler install_check data embedding one_hot "
+     "average",
 }
 
 
