@@ -579,15 +579,27 @@ def get_tensor_from_selected_rows(x, name=None):
 
 
 def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Host python op (reference layers/nn.py py_func). When
+    backward_func is given the op is differentiable: backward_func
+    receives (inputs..., outputs..., out_grads...) as numpy arrays —
+    minus vars listed in skip_vars_in_backward_input — and returns the
+    input gradients in input order. With backward_func set, `func`
+    must be pure (it may execute more than once per step; the
+    non-differentiable form stays ordered and single-execution)."""
     from ..ops.misc_ops import register_py_func
     helper = LayerHelper("py_func")
     xs = x if isinstance(x, (list, tuple)) else [x]
     outs = out if isinstance(out, (list, tuple)) else [out]
     func_id = register_py_func(func)
+    bid = register_py_func(backward_func) if backward_func else -1
+    skip_names = {getattr(v, "name", v)
+                  for v in (skip_vars_in_backward_input or [])}
+    skip_mask = [v.name in skip_names for v in list(xs) + list(outs)]
     helper.append_op(
         type="py_func", inputs={"X": [v.name for v in xs]},
         outputs={"Out": [v.name for v in outs]},
-        attrs={"func_id": func_id,
+        attrs={"func_id": func_id, "backward_func_id": bid,
+               "bwd_skip_mask": skip_mask,
                "out_dtypes": [str(v.dtype) for v in outs],
                "out_shapes": [[int(s) for s in (v.shape or [])]
                               for v in outs]})

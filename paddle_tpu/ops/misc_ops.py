@@ -307,10 +307,26 @@ def register_py_func(fn) -> int:
 @register_op("py_func")
 def _py_func(ctx, ins, attrs):
     fn = _PY_FUNCS[attrs["func_id"]]
-    shapes = attrs["out_shapes"]
+    xs = tuple(ins.get("X", []))
     dtypes = [as_np_dtype(d) for d in attrs["out_dtypes"]]
-    structs = tuple(jax.ShapeDtypeStruct(tuple(s), d)
-                    for s, d in zip(shapes, dtypes))
+
+    def concretize(shape):
+        # declared var shapes carry -1 dynamic dims; resolve them from
+        # the first runtime input (the batch dim in practice)
+        out = []
+        for i, s in enumerate(shape):
+            if s >= 0:
+                out.append(int(s))
+            elif xs and i < len(xs[0].shape):
+                out.append(int(xs[0].shape[i]))
+            else:
+                raise ValueError(
+                    f"py_func: cannot resolve dynamic dim {i} of "
+                    f"declared output shape {shape}")
+        return tuple(out)
+
+    structs = tuple(jax.ShapeDtypeStruct(concretize(s), d)
+                    for s, d in zip(attrs["out_shapes"], dtypes))
 
     def cb(*arrs):
         out = fn(*[np.asarray(a) for a in arrs])
@@ -318,7 +334,50 @@ def _py_func(ctx, ins, attrs):
         return tuple(np.asarray(o).astype(d)
                      for o, d in zip(out, dtypes))
 
-    out = io_callback(cb, structs, *ins.get("X", []), ordered=True)
+    bid = attrs.get("backward_func_id", -1)
+    if bid < 0:
+        # non-differentiable host op: ordered callback, exactly one
+        # execution per step — safe for stateful readers/loggers
+        out = io_callback(cb, structs, *xs, ordered=True)
+        return {"Out": list(out)}
+
+    # Differentiable host function (reference py_func backward_func).
+    # CONTRACT: with backward_func set, `func` must be PURE — the
+    # generic grad path re-lowers the forward under jax.vjp, so the
+    # host function can run more than once per step (pure_callback is
+    # used precisely so XLA may dedupe the copies). The bwd host call
+    # receives (inputs..., outputs..., out_grads...) minus any
+    # positions masked by skip_vars_in_backward_input, and returns the
+    # input gradients in input order.
+    bfn = _PY_FUNCS[bid]
+    x_structs = tuple(jax.ShapeDtypeStruct(tuple(x.shape),
+                                           np.dtype(x.dtype)) for x in xs)
+    skip = attrs.get("bwd_skip_mask") or []
+
+    @jax.custom_vjp
+    def host_fn(*xs_):
+        return jax.pure_callback(cb, structs, *xs_)
+
+    def host_fwd(*xs_):
+        out = jax.pure_callback(cb, structs, *xs_)
+        return out, (xs_, out)
+
+    def host_bwd(res, gs):
+        xs_, out = res
+        bwd_ins = [v for i, v in enumerate(tuple(xs_) + tuple(out))
+                   if i >= len(skip) or not skip[i]] + list(gs)
+
+        def bcb(*arrs):
+            dxs = bfn(*[np.asarray(a) for a in arrs])
+            dxs = dxs if isinstance(dxs, (list, tuple)) else [dxs]
+            return tuple(np.asarray(dx).astype(s.dtype)
+                         for dx, s in zip(dxs, x_structs))
+
+        dxs = jax.pure_callback(bcb, x_structs, *bwd_ins)
+        return tuple(dxs)
+
+    host_fn.defvjp(host_fwd, host_bwd)
+    out = host_fn(*xs)
     return {"Out": list(out)}
 
 
