@@ -1134,16 +1134,35 @@ logical_xor = _logical("logical_xor")
 
 
 def where(condition):
-    raise NotImplementedError(
-        "fluid.layers.where returns a data-dependent-shape index tensor; "
-        "XLA requires static shapes — use masked computation instead "
-        "(SURVEY.md §7 hard parts (a))")
+    """Indices of true elements (reference where_index_op). The
+    reference emits a [num_true, rank] tensor; static XLA shapes make
+    this [condition.size, rank] with -1 rows past the true count —
+    mask on row >= 0 (or pair with the ops' padded conventions)."""
+    helper = LayerHelper("where")
+    out = helper.create_variable_for_type_inference("int64", True)
+    helper.append_op(type="where_index",
+                     inputs={"Condition": [condition.name]},
+                     outputs={"Out": [out.name]})
+    return out
 
 
 def unique(x, dtype="int32"):
-    raise NotImplementedError(
-        "unique has data-dependent output shape; use static-shape "
-        "alternatives (segment ops) on TPU")
+    """Unique values + inverse index (reference unique_op). Static
+    shapes: Out is padded to x.size with a sentinel (+inf for floats,
+    dtype max for ints) past the real unique count (valid count =
+    max(Index) + 1); Index maps each x element to its slot in Out.
+    Index is emitted as the widest available int (int64, truncated to
+    int32 when jax x64 mode is off); cast afterwards if the reference's
+    `dtype` argument matters downstream."""
+    helper = LayerHelper("unique")
+    out = helper.create_variable_for_type_inference(x.dtype, True)
+    index = helper.create_variable_for_type_inference("int64", True)
+    helper.append_op(type="unique", inputs={"X": [x.name]},
+                     outputs={"Out": [out.name], "Index": [index.name]})
+    if dtype and dtype not in ("int64",):
+        from .tensor import cast
+        index = cast(index, dtype)
+    return out, index
 
 
 def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):

@@ -360,3 +360,27 @@ def test_random_ops_and_selected_rows_layers():
     assert u.shape == (5, 6) and g.shape == (5, 3)
     np.testing.assert_allclose(t, xv)
     np.testing.assert_allclose(s, 2 * xv)
+
+
+def test_where_and_unique_layers_padded():
+    """layers.where / layers.unique wrap the padded static-shape ops
+    instead of raising (reference where_index_op / unique_op)."""
+    def build():
+        c = layers.data("wuc", shape=[6], dtype="float32",
+                        append_batch_size=False)
+        cond = layers.cast(layers.less_than(
+            layers.fill_constant([6], "float32", 2.0), c), "bool")
+        idx = layers.where(cond)
+        x = layers.data("wux", shape=[5], dtype="int64",
+                        append_batch_size=False)
+        u, inv = layers.unique(x, dtype="int64")
+        return [idx, u, inv]
+    idx, u, inv = _run(build, {
+        "wuc": np.array([1.0, 3.0, 0.0, 5.0, 2.0, 9.0], np.float32),
+        "wux": np.array([7, 2, 7, 4, 2], np.int64)})
+    real = idx[idx[:, 0] >= 0, 0] if idx.ndim == 2 else idx[idx >= 0]
+    np.testing.assert_array_equal(np.sort(real), [1, 3, 5])
+    # first 3 slots are the real uniques; padding is int-max sentinel
+    assert set(int(v) for v in u[:3]) == {2, 4, 7}
+    assert (u[3:] == np.iinfo(u.dtype).max).all()  # sentinel padding
+    np.testing.assert_array_equal(u[inv], [7, 2, 7, 4, 2])
