@@ -104,9 +104,17 @@ def polynomial_decay(learning_rate, decay_steps, end_learning_rate=0.0001,
                      power=1.0, cycle=False):
     step = _fstep()
     if cycle:
-        raise NotImplementedError("cycle=True polynomial decay")
-    clipped = _unary_attr(step, "clip", min=0.0, max=float(decay_steps))
-    frac = clipped * (1.0 / decay_steps)
+        # reference learning_rate_scheduler.py polynomial_decay: the
+        # horizon stretches to decay_steps * ceil(step / decay_steps)
+        # (>= 1 cycle) so the rate saw-tooths instead of flat-lining
+        from .math_ops import elementwise_div
+        mult = _unary_attr(step * (1.0 / float(decay_steps)), "ceil")
+        mult = _unary_attr(mult, "clip", min=1.0, max=1e30)
+        frac = elementwise_div(step, mult * float(decay_steps))
+    else:
+        clipped = _unary_attr(step, "clip", min=0.0,
+                              max=float(decay_steps))
+        frac = clipped * (1.0 / decay_steps)
     one_minus = frac * -1.0 + 1.0
     poly = _unary_attr(one_minus, "pow", factor=float(power))
     return poly * float(learning_rate - end_learning_rate) + \

@@ -170,3 +170,39 @@ def test_huber_kldiv_losses():
     t = make_op_test("huber_loss", {"X": x, "Y": y},
                      {"Out": hub, "Residual": r}, {"delta": d})
     t.check_output(no_check_set=("Residual",))
+
+
+def test_polynomial_decay_cycle():
+    """cycle=True polynomial decay: horizon stretches to
+    decay_steps * ceil(step/decay_steps), so the rate saw-tooths
+    (reference learning_rate_scheduler.py). Step counter ticks once
+    per run (module convention: first run sees step=1)."""
+    import math
+
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+
+    main, startup = fluid.Program(), fluid.Program()
+    sc = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(sc):
+        lr = layers.polynomial_decay(0.1, decay_steps=4,
+                                     end_learning_rate=0.01,
+                                     power=1.0, cycle=True)
+        x = layers.data("pcx", shape=[1], dtype="float32")
+        loss = layers.mean(x)
+        fluid.optimizer.SGD(learning_rate=lr).minimize(loss)
+        exe = fluid.Executor()
+        exe.run(startup)
+        vals = []
+        for _ in range(8):
+            out, = exe.run(main,
+                           feed={"pcx": np.ones((1, 1), np.float32)},
+                           fetch_list=[lr])
+            vals.append(round(np.asarray(out).item(), 5))
+
+    def ref(step):
+        mult = max(1.0, math.ceil(step / 4))
+        frac = step / (4 * mult)
+        return round((0.1 - 0.01) * (1 - frac) + 0.01, 5)
+
+    assert vals == [ref(s) for s in range(1, 9)], vals
