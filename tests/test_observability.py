@@ -185,3 +185,22 @@ def test_op_error_attribution():
         except Exception as e:
             notes = " ".join(getattr(e, "__notes__", []))
             assert "operator 'mul'" in notes and "(2, 5)" in notes, notes
+
+
+def test_tools_cli_smoke(tmp_path):
+    """tools/op_bench.py runs end to end on CPU (plumbing guard for
+    the perf tooling; profile_step.py's summarizer is covered by
+    test_xplane_summary — its full bench model is too heavy to compile
+    on CPU in a unit test, and the sitecustomize pins JAX_PLATFORMS in
+    subprocesses so only the tool's own --cpu flag can force CPU)."""
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "op_bench.py"),
+         "matmul", "--shape", "64x64x64", "--cpu", "--steps", "3"],
+        capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "TFLOP/s" in r.stdout
