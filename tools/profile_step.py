@@ -23,7 +23,11 @@ import numpy as np  # noqa: E402
 
 
 def main():
-    model = sys.argv[1] if len(sys.argv) > 1 else "bert"
+    args = [a for a in sys.argv[1:] if a != "--cpu"]
+    if "--cpu" in sys.argv:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    model = args[0] if args else "bert"
     import bench
     import paddle_tpu as fluid
     from paddle_tpu import profiler
