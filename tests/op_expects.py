@@ -1,0 +1,2342 @@
+"""Independent numpy references + extra grad slots for the op sweep.
+
+VERDICT r3 #3: ~180 swept ops were verified only as self-consistent
+(IR path vs the same lowering) — no independent witness. Each entry
+here computes the REFERENCE-defined output in pure numpy, written from
+the reference op kernels (cited per family as
+/root/reference/paddle/fluid/operators/<file>), independent of the jax
+lowerings. op_specs.py merges EXPECTS into SPECS at import; a lowering
+bug now fails against this witness, not against itself.
+
+EXTRA_GRADS adds numeric-gradient slots to every differentiable op the
+sweep previously left unchecked (op_test.py:47 discipline).
+
+For ops where this framework's contract deliberately diverges from the
+reference (padded sequence/detection outputs instead of LoD), the
+reference MATH is reproduced on the padded layout the SURVEY sanctions.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+EXPECTS = {}
+EXTRA_GRADS = {}
+
+
+def exp_(op, fn):
+    assert op not in EXPECTS, op
+    EXPECTS[op] = fn
+
+
+def grads(op, *slots):
+    EXTRA_GRADS.setdefault(op, []).extend(slots)
+
+
+def _sig(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def _softmax(x, axis=-1):
+    e = np.exp(x - x.max(axis=axis, keepdims=True))
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+def _erf(x):
+    from scipy.special import erf as _e  # scipy ships with the image
+    return _e(x)
+
+
+# ---------------------------------------------------------------------------
+# activations (activation_op.cc — formulas from each OpMaker's AddComment,
+# defaults from SetDefault calls at activation_op.cc:360-620)
+# ---------------------------------------------------------------------------
+_ACT = {
+    "exp": lambda x, a: np.exp(x),
+    "tanh": lambda x, a: np.tanh(x),
+    "sigmoid": lambda x, a: _sig(x),
+    "sin": lambda x, a: np.sin(x),
+    "cos": lambda x, a: np.cos(x),
+    "atan": lambda x, a: np.arctan(x),
+    "erf": lambda x, a: _erf(x),
+    "softplus": lambda x, a: np.log1p(np.exp(x)),
+    "softsign": lambda x, a: x / (1 + np.abs(x)),
+    "gelu": lambda x, a: 0.5 * x * (1 + _erf(x / np.sqrt(2.0))),
+    "logsigmoid": lambda x, a: np.log(_sig(x)),
+    "stanh": lambda x, a: a.get("scale_b", 1.7159) * np.tanh(
+        a.get("scale_a", 0.67) * x),
+    "square": lambda x, a: x * x,
+    "swish": lambda x, a: x * _sig(a.get("beta", 1.0) * x),
+    "hard_sigmoid": lambda x, a: np.clip(
+        a.get("slope", 0.2) * x + a.get("offset", 0.5), 0.0, 1.0),
+    "hard_swish": lambda x, a: x * np.clip(
+        x + a.get("offset", 3.0), 0, a.get("threshold", 6.0)
+    ) / a.get("scale", 6.0),
+    "elu": lambda x, a: np.where(
+        x > 0, x, a.get("alpha", 1.0) * (np.exp(np.minimum(x, 0)) - 1)),
+    "selu": lambda x, a: a.get("scale", 1.0507009873554805) * np.where(
+        x > 0, x, a.get("alpha", 1.6732632423543772)
+        * (np.exp(np.minimum(x, 0)) - 1)),
+    "soft_relu": lambda x, a: np.log1p(np.exp(np.clip(
+        x, -a.get("threshold", 40.0), a.get("threshold", 40.0)))),
+    "tanh_shrink": lambda x, a: x - np.tanh(x),
+    "log": lambda x, a: np.log(x),
+    "sqrt": lambda x, a: np.sqrt(x),
+    "rsqrt": lambda x, a: 1.0 / np.sqrt(x),
+    "reciprocal": lambda x, a: 1.0 / x,
+    "asin": lambda x, a: np.arcsin(x),
+    "acos": lambda x, a: np.arccos(x),
+    "abs": lambda x, a: np.abs(x),
+    "relu": lambda x, a: np.maximum(x, 0),
+    "relu6": lambda x, a: np.clip(x, 0, a.get("threshold", 6.0)),
+    "leaky_relu": lambda x, a: np.maximum(x, a.get("alpha", 0.02) * x),
+    "brelu": lambda x, a: np.clip(x, a.get("t_min", 0.0),
+                                  a.get("t_max", 24.0)),
+    "hard_shrink": lambda x, a: np.where(
+        np.abs(x) > a.get("threshold", 0.5), x, 0.0),
+    "softshrink": lambda x, a: np.where(
+        x > a.get("lambda", 0.5), x - a.get("lambda", 0.5),
+        np.where(x < -a.get("lambda", 0.5), x + a.get("lambda", 0.5), 0.0)),
+    "thresholded_relu": lambda x, a: np.where(
+        x > a.get("threshold", 1.0), x, 0.0),
+    "ceil": lambda x, a: np.ceil(x),
+    "floor": lambda x, a: np.floor(x),
+    "round": lambda x, a: np.round(x),
+    "sign": lambda x, a: np.sign(x),
+    "pow": lambda x, a: np.power(x, a.get("factor", 1.0)),
+}
+for _op, _fn in _ACT.items():
+    exp_(_op, (lambda f: lambda i, a: {"Out": [f(i["X"], a)]})(_fn))
+exp_("prelu", lambda i, a: {"Out": [np.where(i["X"] > 0, i["X"],
+                                             i["Alpha"][0] * i["X"])]})
+grads("prelu", "Alpha")
+for _op in ["ceil", "floor", "round", "sign"]:
+    grads(_op, "X")        # zero-gradient contract, witnessed numerically
+
+# ---------------------------------------------------------------------------
+# binary elementwise / comparisons / logical (elementwise_*_op.h)
+# ---------------------------------------------------------------------------
+_BIN = {
+    "elementwise_add": np.add, "elementwise_sub": np.subtract,
+    "elementwise_mul": np.multiply, "elementwise_div": np.divide,
+    "elementwise_max": np.maximum, "elementwise_min": np.minimum,
+    "elementwise_pow": np.power,
+    "elementwise_mod": np.mod, "elementwise_floordiv": np.floor_divide,
+}
+for _op, _fn in _BIN.items():
+    exp_(_op, (lambda f: lambda i, a: {"Out": [f(i["X"], i["Y"])]})(_fn))
+grads("elementwise_pow", "Y")
+_CMP = {"equal": np.equal, "not_equal": np.not_equal,
+        "less_than": np.less, "less_equal": np.less_equal,
+        "greater_than": np.greater, "greater_equal": np.greater_equal,
+        "logical_and": np.logical_and, "logical_or": np.logical_or,
+        "logical_xor": np.logical_xor}
+for _op, _fn in _CMP.items():
+    exp_(_op, (lambda f: lambda i, a: {"Out": [f(i["X"], i["Y"])]})(_fn))
+exp_("logical_not", lambda i, a: {"Out": [np.logical_not(i["X"])]})
+
+# ---------------------------------------------------------------------------
+# reductions (reduce_op.h)
+# ---------------------------------------------------------------------------
+def _red(fn):
+    def r(i, a):
+        dim = tuple(a["dim"])
+        return {"Out": [fn(i["X"], axis=dim,
+                           keepdims=a.get("keep_dim", False))]}
+    return r
+
+
+exp_("reduce_sum", _red(np.sum))
+exp_("reduce_mean", _red(np.mean))
+exp_("reduce_max", _red(np.max))
+exp_("reduce_min", _red(np.min))
+exp_("reduce_prod", _red(np.prod))
+exp_("reduce_all", _red(np.all))
+exp_("reduce_any", _red(np.any))
+exp_("l2_normalize", lambda i, a: {"Out": [i["X"] / np.sqrt(
+    np.sum(i["X"] ** 2, axis=a.get("axis", 1), keepdims=True)
+    + a.get("epsilon", 1e-10))]})
+exp_("clip_by_norm", lambda i, a: {"Out": [
+    i["X"] * np.minimum(1.0, a["max_norm"]
+                        / max(np.sqrt((i["X"] ** 2).sum()), 1e-12))]})
+exp_("norm", lambda i, a: {"Out": [i["X"] / np.sqrt(
+    np.sum(i["X"] ** 2, axis=a.get("axis", 1), keepdims=True)
+    + a.get("epsilon", 1e-10))]})
+
+# ---------------------------------------------------------------------------
+# matmul family (mul_op.h, fc_op.cc, bilinear_tensor_product_op.h,
+# cos_sim_op.h, fsp_op.h)
+# ---------------------------------------------------------------------------
+exp_("matmul_v2", lambda i, a: {"Out": [i["X"] @ i["Y"]]})
+exp_("fc", lambda i, a: {"Out": [i["Input"] @ i["W"] + i["Bias"]]})
+grads("fc", "Bias")
+
+
+def _btp(i, a):
+    # out[b, k] = x[b] @ W[k] @ y[b] + bias[k]
+    x, y, w = i["X"], i["Y"], i["Weight"]
+    out = np.einsum("bi,kij,bj->bk", x, w, y) + i["Bias"]
+    return {"Out": [out]}
+
+
+exp_("bilinear_tensor_product", _btp)
+grads("bilinear_tensor_product", "Weight")
+
+
+def _cos_sim(i, a):
+    x, y = i["X"], i["Y"]
+    xn = np.sqrt((x * x).sum(1, keepdims=True))
+    yn = np.sqrt((y * y).sum(1, keepdims=True))
+    return {"Out": [(x * y).sum(1, keepdims=True) / (xn * yn)]}
+
+
+exp_("cos_sim", _cos_sim)
+
+
+def _fsp(i, a):
+    x, y = i["X"], i["Y"]  # (b, c1, h, w), (b, c2, h, w)
+    b, c1, h, w = x.shape
+    out = np.einsum("bihw,bjhw->bij", x, y) / (h * w)
+    return {"Out": [out]}
+
+
+exp_("fsp", _fsp)
+
+
+def _conv_shift(i, a):
+    # conv_shift_op.h: out[b, j] = sum_k x[b, (j + k - m/2) % n] * y[b, k]
+    x, y = i["X"], i["Y"]
+    b, n = x.shape
+    m = y.shape[1]
+    out = np.zeros_like(x)
+    for bi in range(b):
+        for j in range(n):
+            for k in range(m):
+                out[bi, j] += x[bi, (j + k - m // 2) % n] * y[bi, k]
+    return {"Out": [out]}
+
+
+exp_("conv_shift", _conv_shift)
+
+# ---------------------------------------------------------------------------
+# shape / tensor manipulation
+# ---------------------------------------------------------------------------
+exp_("reshape", lambda i, a: {"Out": [i["X"].reshape(a["shape"])]})
+exp_("reshape2", lambda i, a: {"Out": [i["X"].reshape(a["shape"])]})
+exp_("flatten", lambda i, a: {"Out": [i["X"].reshape(
+    int(np.prod(i["X"].shape[:a["axis"]])), -1)]})
+exp_("flatten2", lambda i, a: {"Out": [i["X"].reshape(
+    int(np.prod(i["X"].shape[:a["axis"]])), -1)]})
+exp_("squeeze", lambda i, a: {"Out": [np.squeeze(i["X"],
+                                                 tuple(a["axes"]))]})
+exp_("squeeze2", lambda i, a: {"Out": [np.squeeze(i["X"],
+                                                  tuple(a["axes"]))]})
+exp_("unsqueeze", lambda i, a: {"Out": [np.expand_dims(i["X"],
+                                                       a["axes"][0])]})
+exp_("unsqueeze2", lambda i, a: {"Out": [np.expand_dims(i["X"],
+                                                        a["axes"][0])]})
+for _op in ["flatten", "flatten2", "squeeze", "squeeze2", "unsqueeze",
+            "unsqueeze2", "unstack", "expand_as", "multiplex"]:
+    grads(_op, "X")
+exp_("stack", lambda i, a: {"Y": [np.stack([i["stk_a"], i["stk_b"]],
+                                           axis=a.get("axis", 0))]})
+exp_("transpose", lambda i, a: {"Out": [np.transpose(i["X"], a["axis"])]})
+exp_("transpose2", lambda i, a: {"Out": [np.transpose(i["X"],
+                                                      a["axis"])]})
+
+
+def _slice(i, a):
+    x = i["Input"]
+    idx = [slice(None)] * x.ndim
+    for ax, st, en in zip(a["axes"], a["starts"], a["ends"]):
+        idx[ax] = slice(st, en)
+    return {"Out": [x[tuple(idx)]]}
+
+
+exp_("slice", _slice)
+
+
+def _strided_slice(i, a):
+    x = i["Input"]
+    idx = [slice(None)] * x.ndim
+    for ax, st, en, sd in zip(a["axes"], a["starts"], a["ends"],
+                              a["strides"]):
+        idx[ax] = slice(st, en, sd)
+    return {"Out": [x[tuple(idx)]]}
+
+
+exp_("strided_slice", _strided_slice)
+exp_("expand", lambda i, a: {"Out": [np.tile(i["X"],
+                                             a["expand_times"])]})
+exp_("expand_as", lambda i, a: {"Out": [np.tile(
+    i["X"], [t // s for t, s in zip(i["target_tensor"].shape,
+                                    i["X"].shape)])]})
+
+
+def _pad(i, a):
+    x = i["X"]
+    p = a["paddings"]
+    pads = [(p[2 * d], p[2 * d + 1]) for d in range(x.ndim)]
+    return {"Out": [np.pad(x, pads, constant_values=a.get("pad_value",
+                                                          0.0))]}
+
+
+exp_("pad", _pad)
+
+
+def _pad2d(i, a):
+    x = i["X"]  # NCHW
+    p = a["paddings"]  # [top, bottom, left, right]
+    mode = a.get("mode", "constant")
+    pads = [(0, 0), (0, 0), (p[0], p[1]), (p[2], p[3])]
+    if mode == "constant":
+        return {"Out": [np.pad(x, pads,
+                               constant_values=a.get("pad_value", 0.0))]}
+    np_mode = {"reflect": "reflect", "edge": "edge"}[mode]
+    return {"Out": [np.pad(x, pads, mode=np_mode)]}
+
+
+exp_("pad2d", _pad2d)
+
+
+def _pad_constant_like(i, a):
+    x, y = i["X"], i["Y"]
+    pads = [(0, xs - ys) for xs, ys in zip(x.shape, y.shape)]
+    return {"Out": [np.pad(y, pads,
+                           constant_values=a.get("pad_value", 0.0))]}
+
+
+exp_("pad_constant_like", _pad_constant_like)
+exp_("reverse", lambda i, a: {"Out": [np.flip(i["X"],
+                                              tuple(a["axis"]))]})
+exp_("gather", lambda i, a: {"Out": [i["X"][i["Index"]]]})
+
+
+def _gather_nd(i, a):
+    x, idx = i["X"], i["Index"]
+    return {"Out": [x[tuple(idx[..., k] for k in range(idx.shape[-1]))]]}
+
+
+exp_("gather_nd", _gather_nd)
+
+
+def _scatter(i, a):
+    out = i["X"].copy()
+    if a.get("overwrite", True):
+        out[i["Ids"]] = i["Updates"]
+    else:
+        out[i["Ids"]] = 0
+        np.add.at(out, i["Ids"], i["Updates"])
+    return {"Out": [out]}
+
+
+exp_("scatter", _scatter)
+grads("scatter", "Updates")
+
+
+def _scatter_nd_add(i, a):
+    out = i["X"].copy()
+    idx = i["Index"]
+    np.add.at(out, tuple(idx[..., k] for k in range(idx.shape[-1])),
+              i["Updates"])
+    return {"Out": [out]}
+
+
+exp_("scatter_nd_add", _scatter_nd_add)
+exp_("cast", lambda i, a: {"Out": [i["X"].astype(a["out_dtype"])]})
+exp_("assign", lambda i, a: {"Out": [i["X"]]})
+exp_("shape", lambda i, a: {"Out": [np.array(i["Input"].shape,
+                                             np.int32)]})
+exp_("size", lambda i, a: {"Out": [np.array(i["Input"].size)]})
+exp_("diag", lambda i, a: {"Out": [np.diag(i["Diagonal"])]})
+exp_("eye", lambda i, a: {"Out": [np.eye(a["num_rows"],
+                                         a["num_columns"],
+                                         dtype=np.float32)]})
+exp_("linspace", lambda i, a: {"Out": [np.linspace(
+    i["Start"][0], i["Stop"][0], a["num"], dtype=np.float32)]})
+exp_("range", lambda i, a: {"Out": [np.arange(
+    i["Start"][0], i["End"][0], i["Step"][0], dtype=np.float32)]})
+exp_("fill_any_like", lambda i, a: {"Out": [np.full_like(i["X"],
+                                                         a["value"])]})
+exp_("fill", lambda i, a: {"Out": [np.array(a["value"], np.float32)
+                                   .reshape(a["shape"])]})
+exp_("fill_constant_batch_size_like", lambda i, a: {"Out": [np.full(
+    [i["Input"].shape[0] if s == -1 else s for s in a["shape"]],
+    a["value"], np.float32)]})
+
+
+def _one_hot(i, a):
+    ids = i["X"].reshape(-1).astype(np.int64)
+    out = np.zeros((ids.size, a["depth"]), np.float32)
+    out[np.arange(ids.size), ids] = 1.0
+    return {"Out": [out]}
+
+
+exp_("one_hot", _one_hot)
+exp_("one_hot_v2", _one_hot)
+
+
+def _shard_index(i, a):
+    # shard_index_op.h: shard_size = index_num / nshards;
+    # out = id/shard_size == shard_id ? id % shard_size : ignore_value
+    ids = i["X"]
+    shard_size = a["index_num"] // a["nshards"]
+    return {"Out": [np.where(ids // shard_size == a["shard_id"],
+                             ids % shard_size, a["ignore_value"])]}
+
+
+exp_("shard_index", _shard_index)
+
+
+def _top_k(i, a):
+    x, k = i["X"], a["k"]
+    idx = np.argsort(-x, axis=-1, kind="stable")[..., :k]
+    return {"Out": [np.take_along_axis(x, idx, -1)],
+            "Indices": [idx.astype(np.int64)]}
+
+
+exp_("top_k", _top_k)
+exp_("arg_max", lambda i, a: {"Out": [np.argmax(i["X"],
+                                                a.get("axis", -1))]})
+exp_("arg_min", lambda i, a: {"Out": [np.argmin(i["X"],
+                                                a.get("axis", -1))]})
+exp_("argsort", lambda i, a: {"Out": [np.sort(i["X"],
+                                              axis=a.get("axis", -1))],
+                              "Indices": [np.argsort(
+                                  i["X"], axis=a.get("axis", -1),
+                                  kind="stable").astype(np.int64)]})
+exp_("isfinite", lambda i, a: {"Out": [np.array(
+    np.isfinite(i["X"]).all())]})
+exp_("has_inf", lambda i, a: {"Out": [np.array(np.isinf(i["X"]).any())]})
+exp_("has_nan", lambda i, a: {"Out": [np.array(np.isnan(i["X"]).any())]})
+exp_("is_empty", lambda i, a: {"Out": [np.array(i["X"].size == 0)]})
+
+
+def _multiplex(i, a):
+    rows = [i["mpx_a"], i["mpx_b"]]
+    ids = i["Ids"].reshape(-1)
+    out = np.stack([rows[ids[r]][r] for r in range(len(ids))])
+    return {"Out": [out]}
+
+
+exp_("multiplex", _multiplex)
+exp_("assign_value", lambda i, a: {"Out": [np.array(
+    a["values"], np.float32).reshape(a["shape"])]})
+
+
+def _sequence_mask(i, a):
+    lens = i["X"]
+    m = a["maxlen"]
+    return {"Y": [(np.arange(m)[None, :] < lens[:, None])]}
+
+
+exp_("sequence_mask", _sequence_mask)
+
+
+def _space_to_depth(i, a):
+    x, bs = i["X"], a["blocksize"]
+    n, c, h, w = x.shape
+    y = x.reshape(n, c, h // bs, bs, w // bs, bs)
+    y = y.transpose(0, 3, 5, 1, 2, 4).reshape(n, c * bs * bs, h // bs,
+                                              w // bs)
+    return {"Out": [y]}
+
+
+exp_("space_to_depth", _space_to_depth)
+
+
+def _pixel_shuffle(i, a):
+    x, r = i["X"], a["upscale_factor"]
+    n, c, h, w = x.shape
+    y = x.reshape(n, c // (r * r), r, r, h, w)
+    y = y.transpose(0, 1, 4, 2, 5, 3).reshape(n, c // (r * r), h * r,
+                                              w * r)
+    return {"Out": [y]}
+
+
+exp_("pixel_shuffle", _pixel_shuffle)
+
+
+def _shuffle_channel(i, a):
+    x, g = i["X"], a["group"]
+    n, c, h, w = x.shape
+    y = x.reshape(n, g, c // g, h, w).transpose(0, 2, 1, 3, 4)
+    return {"Out": [y.reshape(n, c, h, w)]}
+
+
+exp_("shuffle_channel", _shuffle_channel)
+
+# ---------------------------------------------------------------------------
+# embedding (lookup_table_op.h)
+# ---------------------------------------------------------------------------
+exp_("lookup_table", lambda i, a: {"Out": [
+    i["W"][i["Ids"].reshape(-1)].reshape(
+        i["Ids"].shape[:-1] + (i["W"].shape[1],))]})
+exp_("lookup_table_v2", lambda i, a: {"Out": [i["W"][i["Ids"]]]})
+
+# ---------------------------------------------------------------------------
+# losses (cross_entropy_op.h, bpr_loss_op.h:62-77, hinge_loss_op.h:36-39,
+# rank_loss_op.h:39-40, huber_loss_op.h:29-41, smooth_l1_loss_op.h:32-45,
+# modified_huber_loss_op.h:40-51, log_loss_op.h:43-45, kldiv_loss_op.h:29-38,
+# teacher_student_sigmoid_loss_op.h:34-55)
+# ---------------------------------------------------------------------------
+def _xent(i, a):
+    x, lbl = i["X"], i["Label"].reshape(-1)
+    return {"Y": [-np.log(x[np.arange(x.shape[0]), lbl])
+                  .reshape(-1, 1)]}
+
+
+exp_("cross_entropy", _xent)
+exp_("cross_entropy2",
+     lambda i, a: {"Y": [-np.log(i["X"][np.arange(i["X"].shape[0]),
+                                        i["Label"].reshape(-1)])
+                         .reshape(-1, 1)]})
+
+
+def _bpr(i, a):
+    x, lbl = i["X"], i["Label"].reshape(-1)
+    n, c = x.shape
+    out = np.zeros((n, 1), np.float64)
+    for r in range(n):
+        p = x[r, lbl[r]]
+        s = sum(-np.log(1.0 + np.exp(x[r, j] - p))
+                for j in range(c) if j != lbl[r])
+        out[r, 0] = -s / (c - 1)
+    return {"Y": [out.astype(np.float32)]}
+
+
+exp_("bpr_loss", _bpr)
+
+
+def _softmax_xent(i, a):
+    sm = _softmax(i["Logits"], -1)
+    lbl = i["Label"].reshape(-1)
+    loss = -np.log(sm[np.arange(sm.shape[0]), lbl]).reshape(-1, 1)
+    return {"Softmax": [sm], "Loss": [loss]}
+
+
+exp_("softmax_with_cross_entropy", _softmax_xent)
+exp_("sigmoid_cross_entropy_with_logits", lambda i, a: {"Out": [
+    np.maximum(i["X"], 0) - i["X"] * i["Label"]
+    + np.log1p(np.exp(-np.abs(i["X"])))]})
+exp_("hinge_loss", lambda i, a: {"Loss": [np.maximum(
+    0.0, 1.0 - i["Logits"] * (2.0 * i["Labels"] - 1.0))]})
+
+
+def _huber(i, a):
+    d = a["delta"]
+    r = i["Y"] - i["X"]
+    ab = np.abs(r)
+    return {"Out": [np.where(ab <= d, 0.5 * r * r,
+                             d * (ab - 0.5 * d))]}
+
+
+exp_("huber_loss", _huber)
+grads("huber_loss", "Y")
+
+
+def _kldiv(i, a):
+    t, x = i["Target"], i["X"]
+    ele = np.where(t > 0, t * (np.log(np.maximum(t, 1e-30)) - x), 0.0)
+    red = a.get("reduction", "mean")
+    if red == "none":
+        return {"Loss": [ele]}
+    if red == "batchmean":
+        return {"Loss": [np.array(ele.sum() / x.shape[0], np.float32)]}
+    if red == "sum":
+        return {"Loss": [np.array(ele.sum(), np.float32)]}
+    return {"Loss": [np.array(ele.mean(), np.float32)]}
+
+
+exp_("kldiv_loss", _kldiv)
+exp_("log_loss", lambda i, a: {"Loss": [
+    -i["Labels"] * np.log(i["Predicted"] + a["epsilon"])
+    - (1 - i["Labels"]) * np.log(1 - i["Predicted"] + a["epsilon"])]})
+exp_("mse_loss", lambda i, a: {"Out": [np.array(
+    ((i["X"] - i["Y"]) ** 2).mean(), np.float32)]})
+grads("mse_loss", "Y")
+exp_("rank_loss", lambda i, a: {"Out": [
+    np.log1p(np.exp(i["Left"] - i["Right"]))
+    - i["Label"] * (i["Left"] - i["Right"])]})
+exp_("margin_rank_loss", lambda i, a: {"Out": [np.maximum(
+    0.0, -i["Label"] * (i["X1"] - i["X2"]) + a.get("margin", 0.0))]})
+
+
+def _smooth_l1(i, a):
+    sigma2 = a.get("sigma", 1.0) ** 2
+    d = i["X"] - i["Y"]
+    ab = np.abs(d)
+    ele = np.where(ab < 1.0 / sigma2, 0.5 * d * d * sigma2,
+                   ab - 0.5 / sigma2)
+    return {"Out": [ele.sum(axis=tuple(range(1, d.ndim)))
+                    .reshape(-1, 1)]}
+
+
+exp_("smooth_l1_loss", _smooth_l1)
+grads("smooth_l1_loss", "Y")
+
+
+def _mod_huber(i, a):
+    # modified_huber_loss_op.h:40-51 on val = y_hat * x, y_hat = 2y - 1
+    val = (2.0 * i["Y"] - 1.0) * i["X"]
+    out = np.where(val < -1, -4.0 * val,
+                   np.where(val < 1, (1 - val) ** 2, 0.0))
+    return {"Out": [out]}
+
+
+exp_("modified_huber_loss", _mod_huber)
+exp_("squared_l2_distance", lambda i, a: {"Out": [
+    ((i["X"] - i["Y"]) ** 2).sum(1, keepdims=True)]})
+grads("squared_l2_distance", "Y")
+
+
+def _ts_sigmoid(i, a):
+    # teacher_student_sigmoid_loss_op.h:43-62; both label>=0 branches
+    # reduce to 2·softplus(x) − x·label
+    x, lbl = i["X"], i["Label"]
+    base = np.maximum(x, 0) + np.log1p(np.exp(-np.abs(x)))
+    out = np.where(lbl < -1.0, base,
+                   np.where(lbl < 0.0, base - x,
+                            2.0 * base - x * lbl))
+    return {"Y": [out]}
+
+
+exp_("teacher_student_sigmoid_loss", _ts_sigmoid)
+exp_("label_smooth", lambda i, a: {"Out": [
+    (1 - a["epsilon"]) * i["X"] + a["epsilon"] / i["X"].shape[-1]]})
+exp_("log_softmax", lambda i, a: {"Out": [np.log(_softmax(i["X"]))]})
+exp_("softmax", lambda i, a: {"Out": [_softmax(i["X"])]})
+grads("dice_loss", "X")
+grads("dropout", "X")
+
+# ---------------------------------------------------------------------------
+# optimizer update rules (sgd_op.h, momentum_op.h, adam_op.h, ...)
+# ---------------------------------------------------------------------------
+def _momentum(i, a):
+    v = a["mu"] * i["Velocity"] + i["Grad"]
+    return {"ParamOut": [i["Param"] - i["LearningRate"][0] * v],
+            "VelocityOut": [v]}
+
+
+exp_("momentum", _momentum)
+
+
+def _adam(i, a):
+    lr = i["LearningRate"][0]
+    b1, b2, eps = a["beta1"], a["beta2"], a["epsilon"]
+    m = b1 * i["Moment1"] + (1 - b1) * i["Grad"]
+    v = b2 * i["Moment2"] + (1 - b2) * i["Grad"] ** 2
+    lr_t = lr * np.sqrt(1 - i["Beta2Pow"][0]) / (1 - i["Beta1Pow"][0])
+    p = i["Param"] - lr_t * m / (np.sqrt(v) + eps)
+    return {"ParamOut": [p], "Moment1Out": [m], "Moment2Out": [v]}
+
+
+exp_("adam", _adam)
+
+
+def _adamw(i, a):
+    base = _adam(i, a)
+    lr = i["LearningRate"][0]
+    p = base["ParamOut"][0] - lr * a.get("coeff", 0.01) * i["Param"]
+    return {"ParamOut": [p], "Moment1Out": base["Moment1Out"],
+            "Moment2Out": base["Moment2Out"]}
+
+
+exp_("adamw", _adamw)
+
+
+def _adagrad(i, a):
+    mom = i["Moment"] + i["Grad"] ** 2
+    p = i["Param"] - i["LearningRate"][0] * i["Grad"] / (
+        np.sqrt(mom) + a["epsilon"])
+    return {"ParamOut": [p], "MomentOut": [mom]}
+
+
+exp_("adagrad", _adagrad)
+
+
+def _adamax(i, a):
+    lr = i["LearningRate"][0]
+    b1, b2, eps = a["beta1"], a["beta2"], a["epsilon"]
+    m = b1 * i["Moment"] + (1 - b1) * i["Grad"]
+    inf = np.maximum(b2 * i["InfNorm"], np.abs(i["Grad"]))
+    lr_t = lr / (1 - i["Beta1Pow"][0])
+    p = i["Param"] - lr_t * m / (inf + eps)
+    return {"ParamOut": [p], "MomentOut": [m], "InfNormOut": [inf]}
+
+
+exp_("adamax", _adamax)
+
+
+def _adadelta(i, a):
+    rho, eps = a["rho"], a["epsilon"]
+    g2 = rho * i["AvgSquaredGrad"] + (1 - rho) * i["Grad"] ** 2
+    upd = -np.sqrt((i["AvgSquaredUpdate"] + eps) / (g2 + eps)) * i["Grad"]
+    u2 = rho * i["AvgSquaredUpdate"] + (1 - rho) * upd ** 2
+    return {"ParamOut": [i["Param"] + upd], "AvgSquaredGradOut": [g2],
+            "AvgSquaredUpdateOut": [u2]}
+
+
+exp_("adadelta", _adadelta)
+
+
+def _decayed_adagrad(i, a):
+    mom = a["decay"] * i["Moment"] + (1 - a["decay"]) * i["Grad"] ** 2
+    p = i["Param"] - i["LearningRate"][0] * i["Grad"] / (
+        np.sqrt(mom) + a["epsilon"])
+    return {"ParamOut": [p], "MomentOut": [mom]}
+
+
+exp_("decayed_adagrad", _decayed_adagrad)
+
+
+def _rmsprop(i, a):
+    rho, eps, mu = a["decay"], a["epsilon"], a["momentum"]
+    lr = i["LearningRate"][0]
+    ms = rho * i["MeanSquare"] + (1 - rho) * i["Grad"] ** 2
+    mom = mu * i["Moment"] + lr * i["Grad"] / np.sqrt(ms + eps)
+    return {"ParamOut": [i["Param"] - mom], "MomentOut": [mom],
+            "MeanSquareOut": [ms]}
+
+
+exp_("rmsprop", _rmsprop)
+
+
+def _proximal_gd(i, a):
+    lr = i["LearningRate"][0]
+    l1, l2 = a["l1"], a["l2"]
+    prox = i["Param"] - lr * i["Grad"]
+    p = (np.sign(prox) * np.maximum(np.abs(prox) - lr * l1, 0)
+         / (1.0 + lr * l2))
+    return {"ParamOut": [p]}
+
+
+exp_("proximal_gd", _proximal_gd)
+
+
+def _proximal_adagrad(i, a):
+    mom = i["Moment"] + i["Grad"] ** 2
+    lr = i["LearningRate"][0] / (np.sqrt(mom) + a["epsilon"])
+    prox = i["Param"] - lr * i["Grad"]
+    p = (np.sign(prox) * np.maximum(np.abs(prox) - lr * a["l1"], 0)
+         / (1.0 + lr * a["l2"]))
+    return {"ParamOut": [p], "MomentOut": [mom]}
+
+
+exp_("proximal_adagrad", _proximal_adagrad)
+
+
+def _lars(i, a):
+    lr = i["LearningRate"][0]
+    pn = np.sqrt((i["Param"] ** 2).sum())
+    gn = np.sqrt((i["Grad"] ** 2).sum())
+    local_lr = (lr * a["lars_coeff"] * pn
+                / (gn + a["lars_weight_decay"] * pn))
+    v = a["mu"] * i["Velocity"] + local_lr * (
+        i["Grad"] + a["lars_weight_decay"] * i["Param"])
+    return {"ParamOut": [i["Param"] - v], "VelocityOut": [v]}
+
+
+exp_("lars_momentum", _lars)
+
+
+def _ftrl(i, a):
+    # ftrl_op.h:58-100, lr_power = -0.5 path
+    g, p = i["Grad"], i["Param"]
+    sq, lin = i["SquaredAccumulator"], i["LinearAccumulator"]
+    lr = i["LearningRate"][0]
+    l1, l2 = a["l1"], a["l2"]
+    new_acc = sq + g * g
+    lin_out = lin + g - ((np.sqrt(new_acc) - np.sqrt(sq)) / lr) * p
+    x = l1 * np.sign(lin_out) - lin_out
+    y = np.sqrt(new_acc) / lr + 2 * l2
+    p_out = np.where(np.abs(lin_out) > l1, x / y, 0.0)
+    return {"ParamOut": [p_out], "SquaredAccumOut": [new_acc],
+            "LinearAccumOut": [lin_out]}
+
+
+exp_("ftrl", _ftrl)
+
+
+def _lamb(i, a):
+    # lamb_op.h:65-73 moment update + :280-300 trust-ratio param update
+    g, p = i["Grad"], i["Param"]
+    b1, b2 = a["beta1"], a["beta2"]
+    eps, wd = a["epsilon"], a["weight_decay"]
+    lr = i["LearningRate"][0]
+    m1 = b1 * i["Moment1"] + (1 - b1) * g
+    m2 = b2 * i["Moment2"] + (1 - b2) * g * g
+    trd = m1 / (np.sqrt(m2) + eps) + wd * p
+    pn = np.sqrt((p ** 2).sum())
+    tn = np.sqrt((trd ** 2).sum())
+    p_out = p - lr * (pn / tn) * trd
+    return {"ParamOut": [p_out], "Moment1Out": [m1], "Moment2Out": [m2]}
+
+
+exp_("lamb", _lamb)
+
+
+def _dgc_momentum(i, a):
+    # dgc_momentum_op.h: plain momentum while current_step <
+    # rampup_begin_step (the spec drives step 0 < 100)
+    assert float(i["current_step"][0]) < a["rampup_begin_step"]
+    return _momentum(i, a)
+
+
+exp_("dgc_momentum", _dgc_momentum)
+
+# ---------------------------------------------------------------------------
+# norms (batch_norm_op.cc, layer_norm_op.h, group_norm_op.h,
+# instance_norm via batch-norm-per-instance, affine_channel_op.cc)
+# ---------------------------------------------------------------------------
+def _bn_infer(i, a):
+    x = i["X"]
+    eps = a["epsilon"]
+    mean = i["Mean"].reshape(1, -1, 1, 1)
+    var = i["Variance"].reshape(1, -1, 1, 1)
+    s = i["Scale"].reshape(1, -1, 1, 1)
+    b = i["Bias"].reshape(1, -1, 1, 1)
+    return {"Y": [(x - mean) / np.sqrt(var + eps) * s + b]}
+
+
+exp_("batch_norm", _bn_infer)
+grads("batch_norm", "X", "Scale", "Bias")
+
+
+def _layer_norm(i, a):
+    x = i["X"]
+    ax = tuple(range(a["begin_norm_axis"], x.ndim))
+    mu = x.mean(ax, keepdims=True)
+    var = x.var(ax, keepdims=True)
+    y = (x - mu) / np.sqrt(var + a["epsilon"])
+    return {"Y": [y * i["Scale"] + i["Bias"]]}
+
+
+exp_("layer_norm", _layer_norm)
+
+
+def _instance_norm(i, a):
+    x = i["X"]
+    mu = x.mean((2, 3), keepdims=True)
+    var = x.var((2, 3), keepdims=True)
+    y = (x - mu) / np.sqrt(var + a["epsilon"])
+    return {"Y": [y * i["Scale"].reshape(1, -1, 1, 1)
+                  + i["Bias"].reshape(1, -1, 1, 1)]}
+
+
+exp_("instance_norm", _instance_norm)
+grads("instance_norm", "Scale", "Bias")
+
+
+def _group_norm(i, a):
+    x, g = i["X"], a["groups"]
+    n, c, h, w = x.shape
+    xg = x.reshape(n, g, c // g, h, w)
+    mu = xg.mean((2, 3, 4), keepdims=True)
+    var = xg.var((2, 3, 4), keepdims=True)
+    y = ((xg - mu) / np.sqrt(var + a["epsilon"])).reshape(n, c, h, w)
+    return {"Y": [y * i["Scale"].reshape(1, -1, 1, 1)
+                  + i["Bias"].reshape(1, -1, 1, 1)]}
+
+
+exp_("group_norm", _group_norm)
+grads("group_norm", "Scale", "Bias")
+
+
+def _lrn(i, a):
+    # lrn_op.cc: out = x / (k + alpha * sum_local(x^2))^beta
+    x = i["X"]
+    n_, c, h, w = x.shape
+    nsz, k, al, be = a["n"], a["k"], a["alpha"], a["beta"]
+    sq = np.zeros_like(x)
+    for ci in range(c):
+        lo = max(0, ci - (nsz - 1) // 2)
+        hi = min(c, ci + (nsz - 1) // 2 + 1)
+        sq[:, ci] = (x[:, lo:hi] ** 2).sum(1)
+    return {"Out": [x / (k + al * sq) ** be]}
+
+
+exp_("lrn", _lrn)
+exp_("affine_channel", lambda i, a: {"Out": [
+    i["X"] * i["Scale"].reshape(1, -1, 1, 1)
+    + i["Bias"].reshape(1, -1, 1, 1)]})
+grads("affine_channel", "Scale", "Bias")
+
+
+def _add_pos_enc(i, a):
+    x = i["X"]
+    b, t, d = x.shape
+    half = d // 2
+    pos = np.arange(t, dtype=np.float64)[:, None]
+    div = np.power(10000.0, np.arange(half, dtype=np.float64) / half)
+    enc = np.zeros((t, d))
+    enc[:, :half] = np.sin(pos / div)
+    enc[:, half:] = np.cos(pos / div)
+    return {"Out": [(a["alpha"] * x + a["beta"]
+                     * enc[None]).astype(np.float32)]}
+
+
+exp_("add_position_encoding", _add_pos_enc)
+
+
+def _temporal_shift(i, a):
+    x = i["X"]
+    seg, ratio = a["seg_num"], a["shift_ratio"]
+    nt, c, h, w = x.shape
+    n = nt // seg
+    xr = x.reshape(n, seg, c, h, w)
+    c1 = int(c * ratio)
+    c2 = int(c * 2 * ratio)
+    out = np.zeros_like(xr)
+    out[:, :-1, :c1] = xr[:, 1:, :c1]            # shift left
+    out[:, 1:, c1:c2] = xr[:, :-1, c1:c2]        # shift right
+    out[:, :, c2:] = xr[:, :, c2:]
+    return {"Out": [out.reshape(nt, c, h, w)]}
+
+
+exp_("temporal_shift", _temporal_shift)
+grads("data_norm", "X")
+
+# ---------------------------------------------------------------------------
+# quantization (fake_quantize_op.cc:31-80, fake_dequantize_op.cc)
+# ---------------------------------------------------------------------------
+def _fq_absmax(i, a):
+    x = i["X"]
+    bins = (1 << (a["bit_length"] - 1)) - 1
+    s = np.abs(x).max()
+    return {"Out": [np.round(np.clip(x, -s, s) * bins / s)],
+            "OutScale": [np.array([s], np.float32)]}
+
+
+exp_("fake_quantize_abs_max", _fq_absmax)
+
+
+def _fq_ch_absmax(i, a):
+    x = i["X"]
+    bins = (1 << (a["bit_length"] - 1)) - 1
+    s = np.abs(x).max(axis=tuple(range(1, x.ndim)))
+    out = np.round(x * (bins / s.reshape(-1, *([1] * (x.ndim - 1)))))
+    return {"Out": [out], "OutScale": [s.astype(np.float32)]}
+
+
+exp_("fake_channel_wise_quantize_abs_max", _fq_ch_absmax)
+exp_("fake_dequantize_max_abs", lambda i, a: {"Out": [
+    i["X"] * i["Scale"][0] / a["max_range"]]})
+
+
+def _fq_dq_moving(i, a):
+    # is_test: scale = InScale; quantize then dequantize
+    x, s = i["X"], i["InScale"][0]
+    bins = (1 << (a["bit_length"] - 1)) - 1
+    return {"Out": [np.round(np.clip(x, -s, s) * bins / s) * s / bins]}
+
+
+exp_("fake_quantize_dequantize_moving_average_abs_max", _fq_dq_moving)
+
+
+def _fq_moving(i, a):
+    x, s = i["X"], i["InScale"][0]
+    bins = (1 << (a["bit_length"] - 1)) - 1
+    return {"Out": [np.round(np.clip(x, -s, s) * bins / s)]}
+
+
+exp_("fake_quantize_moving_average_abs_max", _fq_moving)
+exp_("fake_quantize_range_abs_max", _fq_moving)
+exp_("moving_average_abs_max_scale", lambda i, a: {"Out": [i["X"]]})
+
+# ---------------------------------------------------------------------------
+# metrics (accuracy_op.h, edit_distance_op.h, ctc_align_op.h, mean_iou_op.h)
+# ---------------------------------------------------------------------------
+def _accuracy(i, a):
+    idx, lbl = i["Indices"], i["Label"]
+    correct = (idx[:, :1] == lbl).sum()
+    n = lbl.shape[0]
+    return {"Accuracy": [np.array(correct / n, np.float32)]}
+
+
+exp_("accuracy", _accuracy)
+
+
+def _edit_distance(i, a):
+    def lev(h, r):
+        h = [v for v in h if v >= 0]
+        r = [v for v in r if v >= 0]
+        d = np.zeros((len(h) + 1, len(r) + 1))
+        d[:, 0] = np.arange(len(h) + 1)
+        d[0, :] = np.arange(len(r) + 1)
+        for x in range(1, len(h) + 1):
+            for y in range(1, len(r) + 1):
+                d[x, y] = min(d[x - 1, y] + 1, d[x, y - 1] + 1,
+                              d[x - 1, y - 1] + (h[x - 1] != r[y - 1]))
+        return d[len(h), len(r)]
+
+    out = np.array([[lev(hh, rr)] for hh, rr in zip(i["Hyps"],
+                                                    i["Refs"])],
+                   np.float32)
+    return {"Out": [out]}
+
+
+exp_("edit_distance", _edit_distance)
+
+
+def _ctc_align(i, a):
+    # ctc_align_op.h merge-repeated + drop-blank; padded contract keeps
+    # the static input width, -1 past the kept tokens
+    blank = a["blank"]
+    x = i["Input"]
+    out = np.full_like(x, -1)
+    for r, row in enumerate(x):
+        prev = None
+        n = 0
+        for v in row:
+            if v != prev and v != blank:
+                out[r, n] = v
+                n += 1
+            prev = v
+    return {"Output": [out]}
+
+
+exp_("ctc_align", _ctc_align)
+
+
+def _mean_iou(i, a):
+    p, l_ = i["Predictions"].reshape(-1), i["Labels"].reshape(-1)
+    n = a["num_classes"]
+    ious = []
+    for c in range(n):
+        inter = ((p == c) & (l_ == c)).sum()
+        union = ((p == c) | (l_ == c)).sum()
+        if union > 0:
+            ious.append(inter / union)
+    return {"OutMeanIou": [np.array(np.mean(ious), np.float32)]}
+
+
+exp_("mean_iou", _mean_iou)
+
+# ---------------------------------------------------------------------------
+# conv / pool (conv_op.h im2col+gemm semantics, pool_op.h)
+# ---------------------------------------------------------------------------
+def _conv2d_np(x, w, strides, pads, dilations=(1, 1), groups=1):
+    n, cin, h, wid = x.shape
+    cout, cing, kh, kw = w.shape
+    sh, sw = strides
+    ph, pw = pads
+    dh, dw = dilations
+    oh = (h + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    ow = (wid + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+    xp = np.pad(x, [(0, 0), (0, 0), (ph, ph), (pw, pw)])
+    out = np.zeros((n, cout, oh, ow), np.float64)
+    cpg = cin // groups
+    opg = cout // groups
+    for b in range(n):
+        for oc in range(cout):
+            g = oc // opg
+            for i_ in range(oh):
+                for j in range(ow):
+                    acc = 0.0
+                    for ic in range(cpg):
+                        for r in range(kh):
+                            for c in range(kw):
+                                acc += (xp[b, g * cpg + ic,
+                                           i_ * sh + r * dh,
+                                           j * sw + c * dw]
+                                        * w[oc, ic, r, c])
+                    out[b, oc, i_, j] = acc
+    return out.astype(np.float32)
+
+
+exp_("conv2d", lambda i, a: {"Output": [_conv2d_np(
+    i["Input"], i["Filter"], a["strides"], a["paddings"],
+    a.get("dilations", [1, 1]), a.get("groups", 1))]})
+exp_("depthwise_conv2d", lambda i, a: {"Output": [_conv2d_np(
+    i["Input"], i["Filter"], a["strides"], a["paddings"],
+    a.get("dilations", [1, 1]), a.get("groups", 1))]})
+
+
+def _conv2d_transpose_np(x, w, strides, pads, groups=1):
+    n, cin, h, wid = x.shape
+    cing, copg, kh, kw = w.shape
+    sh, sw = strides
+    ph, pw = pads
+    cout = copg * groups
+    oh = (h - 1) * sh - 2 * ph + kh
+    ow = (wid - 1) * sw - 2 * pw + kw
+    out = np.zeros((n, cout, oh + 2 * ph, ow + 2 * pw), np.float64)
+    cpg = cin // groups
+    for b in range(n):
+        for g in range(groups):
+            for ic in range(cpg):
+                for oc in range(copg):
+                    for i_ in range(h):
+                        for j in range(wid):
+                            out[b, g * copg + oc,
+                                i_ * sh:i_ * sh + kh,
+                                j * sw:j * sw + kw] += (
+                                x[b, g * cpg + ic, i_, j]
+                                * w[g * cpg + ic, oc])
+    out = out[:, :, ph:ph + oh, pw:pw + ow]
+    return out.astype(np.float32)
+
+
+exp_("conv2d_transpose", lambda i, a: {"Output": [_conv2d_transpose_np(
+    i["Input"], i["Filter"], a["strides"], a["paddings"],
+    a.get("groups", 1))]})
+exp_("depthwise_conv2d_transpose",
+     lambda i, a: {"Output": [_conv2d_transpose_np(
+         i["Input"], i["Filter"], a["strides"], a["paddings"],
+         a.get("groups", 1))]})
+
+
+def _pool2d(i, a):
+    x = i["X"]
+    kh, kw = a["ksize"]
+    sh, sw = a["strides"]
+    ph, pw = a["paddings"]
+    n, c, h, w = x.shape
+    oh = (h + 2 * ph - kh) // sh + 1
+    ow = (w + 2 * pw - kw) // sw + 1
+    fill = -np.inf if a["pooling_type"] == "max" else 0.0
+    xp = np.pad(x, [(0, 0), (0, 0), (ph, ph), (pw, pw)],
+                constant_values=fill)
+    out = np.zeros((n, c, oh, ow), np.float32)
+    for i_ in range(oh):
+        for j in range(ow):
+            win = xp[:, :, i_ * sh:i_ * sh + kh, j * sw:j * sw + kw]
+            out[:, :, i_, j] = (win.max((2, 3))
+                                if a["pooling_type"] == "max"
+                                else win.mean((2, 3)))
+    return {"Out": [out]}
+
+
+exp_("pool2d", _pool2d)
+
+
+def _maxout(i, a):
+    x, g = i["X"], a["groups"]
+    n, c, h, w = x.shape
+    return {"Out": [x.reshape(n, c // g, g, h, w).max(2)]}
+
+
+exp_("maxout", _maxout)
+
+
+def _unfold(i, a):
+    x = i["X"]
+    kh, kw = a["kernel_sizes"]
+    sh, sw = a["strides"]
+    p = a["paddings"]
+    dh, dw = a["dilations"]
+    n, c, h, w = x.shape
+    xp = np.pad(x, [(0, 0), (0, 0), (p[0], p[2]), (p[1], p[3])])
+    oh = (h + p[0] + p[2] - (dh * (kh - 1) + 1)) // sh + 1
+    ow = (w + p[1] + p[3] - (dw * (kw - 1) + 1)) // sw + 1
+    cols = np.zeros((n, c * kh * kw, oh * ow), np.float32)
+    for i_ in range(oh):
+        for j in range(ow):
+            patch = xp[:, :, i_ * sh:i_ * sh + dh * (kh - 1) + 1:dh,
+                       j * sw:j * sw + dw * (kw - 1) + 1:dw]
+            cols[:, :, i_ * ow + j] = patch.reshape(n, -1)
+    return {"Y": [cols]}
+
+
+exp_("unfold", _unfold)
+
+
+def _crop(i, a):
+    x = i["X"]
+    off, shp = a["offsets"], a["shape"]
+    idx = tuple(slice(o, o + s) for o, s in zip(off, shp))
+    return {"Out": [x[idx]]}
+
+
+exp_("crop", _crop)
+exp_("crop_tensor", _crop)
+
+# ---------------------------------------------------------------------------
+# interpolation (interpolate_op.h; default align_mode=1 → src = dst*scale
+# when align_corners=False)
+# ---------------------------------------------------------------------------
+def _nearest_interp(i, a):
+    x = i["X"]
+    n, c, h, w = x.shape
+    oh, ow = a["out_h"], a["out_w"]
+    if a.get("align_corners", True):
+        ri = np.round(np.arange(oh) * (h - 1) / max(oh - 1, 1))
+        rj = np.round(np.arange(ow) * (w - 1) / max(ow - 1, 1))
+    else:
+        ri = np.floor(np.arange(oh) * h / oh)
+        rj = np.floor(np.arange(ow) * w / ow)
+    return {"Out": [x[:, :, ri.astype(int)][:, :, :, rj.astype(int)]]}
+
+
+exp_("nearest_interp", _nearest_interp)
+grads("nearest_interp", "X")
+grads("trilinear_interp", "X")
+
+
+def _bilinear_interp(i, a):
+    x = i["X"].astype(np.float64)
+    n, c, h, w = x.shape
+    oh, ow = a["out_h"], a["out_w"]
+    align = a.get("align_corners", True)
+    mode = a.get("align_mode", 1)
+    out = np.zeros((n, c, oh, ow))
+    for oi in range(oh):
+        for oj in range(ow):
+            if align:
+                fi = oi * (h - 1) / max(oh - 1, 1)
+                fj = oj * (w - 1) / max(ow - 1, 1)
+            elif mode == 0:
+                fi = max((oi + 0.5) * h / oh - 0.5, 0.0)
+                fj = max((oj + 0.5) * w / ow - 0.5, 0.0)
+            else:
+                fi = oi * h / oh
+                fj = oj * w / ow
+            i0, j0 = int(fi), int(fj)
+            i1, j1 = min(i0 + 1, h - 1), min(j0 + 1, w - 1)
+            di, dj = fi - i0, fj - j0
+            out[:, :, oi, oj] = (
+                x[:, :, i0, j0] * (1 - di) * (1 - dj)
+                + x[:, :, i1, j0] * di * (1 - dj)
+                + x[:, :, i0, j1] * (1 - di) * dj
+                + x[:, :, i1, j1] * di * dj)
+    return {"Out": [out.astype(np.float32)]}
+
+
+exp_("bilinear_interp", _bilinear_interp)
+
+# ---------------------------------------------------------------------------
+# sequence family — padded+lengths contract (SURVEY §2.1 redesign); the
+# math matches sequence_pool_op.h etc. applied per-row up to Lengths[i]
+# ---------------------------------------------------------------------------
+def _seq_mask3(x, lens):
+    t = x.shape[1]
+    return (np.arange(t)[None, :] < lens[:, None])
+
+
+def _sequence_pool(i, a):
+    x, lens = i["X"], i["Lengths"]
+    m = _seq_mask3(x, lens)[..., None]
+    xm = np.where(m, x, 0.0)
+    pt = a["pooltype"]
+    if pt == "SUM":
+        out = xm.sum(1)
+    elif pt == "AVERAGE":
+        out = xm.sum(1) / lens[:, None]
+    elif pt == "SQRT":
+        out = xm.sum(1) / np.sqrt(lens[:, None].astype(np.float64))
+    elif pt == "MAX":
+        out = np.where(m, x, -np.inf).max(1)
+    elif pt == "FIRST":
+        out = x[:, 0]
+    elif pt == "LAST":
+        out = x[np.arange(x.shape[0]), lens - 1]
+    return {"Out": [out.astype(np.float32)]}
+
+
+exp_("sequence_pool", _sequence_pool)
+
+
+def _sequence_softmax(i, a):
+    x, lens = i["X"], i["Lengths"]
+    m = _seq_mask3(x, lens)
+    e = np.where(m, np.exp(x - x.max(1, keepdims=True)), 0.0)
+    return {"Out": [(e / e.sum(1, keepdims=True)) * m]}
+
+
+exp_("sequence_softmax", _sequence_softmax)
+
+
+def _sequence_reverse(i, a):
+    x, lens = i["X"], i["Lengths"]
+    out = x.copy()
+    for r, ln in enumerate(lens):
+        out[r, :ln] = x[r, :ln][::-1]
+    return {"Y": [out]}
+
+
+exp_("sequence_reverse", _sequence_reverse)
+
+
+def _sequence_pad(i, a):
+    x = i["X"]
+    pl = a["padded_length"]
+    pv = i["PadValue"].reshape(-1)[0]
+    b, t = x.shape[0], x.shape[1]
+    out = np.full((b, pl) + x.shape[2:], pv, x.dtype)
+    out[:, :t] = x
+    return {"Out": [out]}
+
+
+exp_("sequence_pad", _sequence_pad)
+grads("sequence_pad", "X")
+
+
+def _sequence_unpad(i, a):
+    x, lens = i["X"], i["Length"]
+    m = _seq_mask3(x, lens)[..., None]
+    return {"Out": [np.where(m, x, 0.0)]}
+
+
+exp_("sequence_unpad", _sequence_unpad)
+grads("sequence_unpad", "X")
+
+
+def _sequence_expand_as(i, a):
+    # each X row expands to Y's (padded) time width (sequence_expand_as_op)
+    reps = i["Y"].shape[1] if i["Y"].ndim > 1 else 1
+    return {"Out": [np.repeat(i["X"], reps, axis=0)]}
+
+
+exp_("sequence_expand_as", _sequence_expand_as)
+
+
+def _sequence_reshape(i, a):
+    x = i["X"]
+    nd = a["new_dim"]
+    return {"Out": [x.reshape(x.shape[0], -1, nd)]}
+
+
+exp_("sequence_reshape", _sequence_reshape)
+grads("sequence_reshape", "X")
+
+
+def _sequence_enumerate(i, a):
+    x = i["X"]
+    win, pad = a["win_size"], a["pad_value"]
+    b, t = x.shape
+    out = np.full((b, t, win), pad, x.dtype)
+    for r in range(b):
+        for c in range(t):
+            for k in range(win):
+                if c + k < t:
+                    out[r, c, k] = x[r, c + k]
+    return {"Out": [out]}
+
+
+exp_("sequence_enumerate", _sequence_enumerate)
+
+
+def _sequence_erase(i, a):
+    # padded contract: erased positions compact left, tail -1-padded
+    x = i["X"]
+    toks = set(a["tokens"])
+    out = np.full_like(x, -1)
+    for r in range(x.shape[0]):
+        keep = [v for v in x[r] if v not in toks]
+        out[r, :len(keep)] = keep
+    return {"Out": [out]}
+
+
+exp_("sequence_erase", _sequence_erase)
+
+
+def _sequence_slice(i, a):
+    # padded contract: static input width kept, slice left-aligned,
+    # tail zero-padded
+    x = i["X"]
+    off = i["Offset"].reshape(-1)
+    ln = i["Length"].reshape(-1)
+    out = np.zeros_like(x)
+    for r in range(x.shape[0]):
+        out[r, :ln[r]] = x[r, off[r]:off[r] + ln[r]]
+    return {"Out": [out]}
+
+
+exp_("sequence_slice", _sequence_slice)
+
+
+grads("sequence_slice", "X")
+grads("sequence_expand", "X")
+grads("sequence_expand_as", "X")
+grads("sequence_scatter", "X", "Updates")
+grads("im2sequence", "X")
+
+
+def _cvm(i, a):
+    # cvm_op.h: use_cvm=True → passthrough with first two cols
+    # log-transformed: show=log(show+1), clk=log(clk+1)-log(show+1)
+    x = i["X"].copy()
+    if a.get("use_cvm", True):
+        out = x.copy()
+        out[:, 0] = np.log(x[:, 0] + 1)
+        out[:, 1] = np.log(x[:, 1] + 1) - np.log(x[:, 0] + 1)
+        return {"Y": [out]}
+    return {"Y": [x[:, 2:]]}
+
+
+exp_("cvm", _cvm)
+
+
+# ---------------------------------------------------------------------------
+# detection (iou_similarity_op.h, box_clip_op.h, box_coder_op.h,
+# target_assign_op.h, bipartite_match_op.cc, polygon_box_transform_op.cc,
+# roi_align_op.h, roi_pool_op.h, psroi_pool_op.h)
+# ---------------------------------------------------------------------------
+def _iou(b1, b2, normalized=False):
+    off = 0.0 if normalized else 1.0
+    a1 = np.maximum(b1[:, None, 0], b2[None, :, 0])
+    a2 = np.maximum(b1[:, None, 1], b2[None, :, 1])
+    b1x = np.minimum(b1[:, None, 2], b2[None, :, 2])
+    b2y = np.minimum(b1[:, None, 3], b2[None, :, 3])
+    iw = np.maximum(b1x - a1 + off, 0)
+    ih = np.maximum(b2y - a2 + off, 0)
+    inter = iw * ih
+    ar1 = ((b1[:, 2] - b1[:, 0] + off)
+           * (b1[:, 3] - b1[:, 1] + off))[:, None]
+    ar2 = ((b2[:, 2] - b2[:, 0] + off)
+           * (b2[:, 3] - b2[:, 1] + off))[None, :]
+    return inter / (ar1 + ar2 - inter)
+
+
+exp_("iou_similarity", lambda i, a: {"Out": [
+    _iou(i["X"], i["Y"], a.get("box_normalized", True))
+    .astype(np.float32)]})
+
+
+def _box_clip(i, a):
+    b = i["Input"].copy()
+    h, w = i["ImInfo"][0, 0], i["ImInfo"][0, 1]
+    b[:, 0::2] = np.clip(b[:, 0::2], 0, w - 1)
+    b[:, 1::2] = np.clip(b[:, 1::2], 0, h - 1)
+    return {"Output": [b]}
+
+
+exp_("box_clip", _box_clip)
+
+
+def _box_coder_encode(i, a):
+    p, t = i["PriorBox"], i["TargetBox"]
+    pv = i.get("PriorBoxVar")
+    off = 0.0 if a.get("box_normalized", True) else 1.0
+    pw = p[:, 2] - p[:, 0] + off
+    ph = p[:, 3] - p[:, 1] + off
+    px = p[:, 0] + pw / 2
+    py = p[:, 1] + ph / 2
+    tw = t[:, 2] - t[:, 0] + off
+    th = t[:, 3] - t[:, 1] + off
+    tx = t[:, 0] + tw / 2
+    ty = t[:, 1] + th / 2
+    out = np.zeros((t.shape[0], p.shape[0], 4), np.float64)
+    out[..., 0] = (tx[:, None] - px[None]) / pw[None]
+    out[..., 1] = (ty[:, None] - py[None]) / ph[None]
+    out[..., 2] = np.log(tw[:, None] / pw[None])
+    out[..., 3] = np.log(th[:, None] / ph[None])
+    if pv is not None:
+        out /= pv[None]
+    return {"OutputBox": [out.astype(np.float32)]}
+
+
+exp_("box_coder", _box_coder_encode)
+
+
+def _target_assign(i, a):
+    x, mi = i["X"], i["MatchIndices"]
+    n, m = mi.shape
+    k = x.shape[2]
+    out = np.full((n, m, k), a["mismatch_value"], np.float32)
+    wt = np.zeros((n, m, 1), np.float32)
+    for b in range(n):
+        for j in range(m):
+            if mi[b, j] >= 0:
+                out[b, j] = x[b % x.shape[0], mi[b, j]]
+                wt[b, j] = 1.0
+    return {"Out": [out], "OutWeight": [wt]}
+
+
+exp_("target_assign", _target_assign)
+
+
+def _bipartite_match(i, a):
+    d = i["DistMat"].copy()
+    n, m = d.shape
+    match = np.full(m, -1, np.int32)
+    dist = np.zeros(m, np.float32)
+    used_r = set()
+    used_c = set()
+    while len(used_c) < min(n, m):
+        best = (-1, -1, -1.0)
+        for r in range(n):
+            if r in used_r:
+                continue
+            for c in range(m):
+                if c in used_c:
+                    continue
+                if d[r, c] > best[2]:
+                    best = (r, c, d[r, c])
+        if best[0] < 0:
+            break
+        match[best[1]] = best[0]
+        dist[best[1]] = best[2]
+        used_r.add(best[0])
+        used_c.add(best[1])
+    return {"ColToRowMatchIndices": [match.reshape(1, -1)],
+            "ColToRowMatchDist": [dist.reshape(1, -1)]}
+
+
+exp_("bipartite_match", _bipartite_match)
+
+
+def _polygon_box_transform(i, a):
+    x = i["Input"]
+    n, c, h, w = x.shape
+    out = x.copy()
+    for id_h in range(h):
+        for id_w in range(w):
+            for id_c in range(c):
+                if id_c % 2 == 0:
+                    out[:, id_c, id_h, id_w] = (
+                        id_w * 4 - x[:, id_c, id_h, id_w])
+                else:
+                    out[:, id_c, id_h, id_w] = (
+                        id_h * 4 - x[:, id_c, id_h, id_w])
+    return {"Output": [out]}
+
+
+exp_("polygon_box_transform", _polygon_box_transform)
+
+
+def _roi_align(i, a):
+    x, rois = i["X"], i["ROIs"]
+    ph, pw = a["pooled_height"], a["pooled_width"]
+    scale = a["spatial_scale"]
+    sr = a.get("sampling_ratio", -1)
+    n, c, h, w = x.shape
+    out = np.zeros((rois.shape[0], c, ph, pw), np.float64)
+    for r, roi in enumerate(rois):
+        x1, y1, x2, y2 = roi * scale
+        rw = max(x2 - x1, 1.0)
+        rh = max(y2 - y1, 1.0)
+        bw, bh = rw / pw, rh / ph
+        gw = sr if sr > 0 else int(np.ceil(rw / pw))
+        gh = sr if sr > 0 else int(np.ceil(rh / ph))
+        for pi in range(ph):
+            for pj in range(pw):
+                acc = np.zeros(c)
+                for iy in range(gh):
+                    for ix in range(gw):
+                        yy = y1 + pi * bh + (iy + 0.5) * bh / gh
+                        xx = x1 + pj * bw + (ix + 0.5) * bw / gw
+                        if yy < -1 or yy > h or xx < -1 or xx > w:
+                            continue
+                        yy = min(max(yy, 0), h - 1)
+                        xx = min(max(xx, 0), w - 1)
+                        y0, x0 = int(yy), int(xx)
+                        y1_, x1_ = min(y0 + 1, h - 1), min(x0 + 1,
+                                                           w - 1)
+                        ly, lx = yy - y0, xx - x0
+                        acc += (x[0, :, y0, x0] * (1 - ly) * (1 - lx)
+                                + x[0, :, y0, x1_] * (1 - ly) * lx
+                                + x[0, :, y1_, x0] * ly * (1 - lx)
+                                + x[0, :, y1_, x1_] * ly * lx)
+                out[r, :, pi, pj] = acc / (gh * gw)
+    return {"Out": [out.astype(np.float32)]}
+
+
+exp_("roi_align", _roi_align)
+
+
+def _roi_pool(i, a):
+    x, rois = i["X"], i["ROIs"]
+    ph, pw = a["pooled_height"], a["pooled_width"]
+    scale = a["spatial_scale"]
+    n, c, h, w = x.shape
+    out = np.zeros((rois.shape[0], c, ph, pw), np.float32)
+    for r, roi in enumerate(rois):
+        x1 = int(round(roi[0] * scale))
+        y1 = int(round(roi[1] * scale))
+        x2 = int(round(roi[2] * scale))
+        y2 = int(round(roi[3] * scale))
+        rh = max(y2 - y1 + 1, 1)
+        rw = max(x2 - x1 + 1, 1)
+        for pi in range(ph):
+            for pj in range(pw):
+                hs = y1 + int(np.floor(pi * rh / ph))
+                he = y1 + int(np.ceil((pi + 1) * rh / ph))
+                ws = x1 + int(np.floor(pj * rw / pw))
+                we = x1 + int(np.ceil((pj + 1) * rw / pw))
+                hs, he = np.clip([hs, he], 0, h)
+                ws, we = np.clip([ws, we], 0, w)
+                if he > hs and we > ws:
+                    out[r, :, pi, pj] = x[0, :, hs:he, ws:we].max((1, 2))
+    return {"Out": [out]}
+
+
+exp_("roi_pool", _roi_pool)
+
+
+def _psroi_pool(i, a):
+    x, rois = i["X"], i["ROIs"]
+    ph, pw = a["pooled_height"], a["pooled_width"]
+    oc = a["output_channels"]
+    scale = a["spatial_scale"]
+    n, c, h, w = x.shape
+    out = np.zeros((rois.shape[0], oc, ph, pw), np.float32)
+    for r, roi in enumerate(rois):
+        # psroi_pool_op.h: start rounded down, end rounded up, +1 shift
+        x1 = round(roi[0] * scale)
+        y1 = round(roi[1] * scale)
+        x2 = round((roi[2] + 1) * scale)
+        y2 = round((roi[3] + 1) * scale)
+        rw = max(x2 - x1, 0.1)
+        rh = max(y2 - y1, 0.1)
+        bw, bh = rw / pw, rh / ph
+        for co in range(oc):
+            for pi in range(ph):
+                for pj in range(pw):
+                    hs = int(np.floor(y1 + pi * bh))
+                    he = int(np.ceil(y1 + (pi + 1) * bh))
+                    ws = int(np.floor(x1 + pj * bw))
+                    we = int(np.ceil(x1 + (pj + 1) * bw))
+                    hs, he = np.clip([hs, he], 0, h)
+                    ws, we = np.clip([ws, we], 0, w)
+                    cix = (co * ph + pi) * pw + pj
+                    if he > hs and we > ws:
+                        out[r, co, pi, pj] = (
+                            x[0, cix, hs:he, ws:we].sum()
+                            / ((he - hs) * (we - ws)))
+    return {"Out": [out]}
+
+
+exp_("psroi_pool", _psroi_pool)
+
+
+def _generate_mask_labels(i, a):
+    # generate_mask_labels_op.cc:199-254 + mask_util.cc
+    # Polys2MaskWrtBox:186-211 on pre-binarized image-grid masks:
+    # match each fg roi to the same-class gt with max bbox IoU, crop
+    # the matched mask to the roi box at `resolution`, class-expand
+    # with -1 ignore labels
+    rois = i["Rois"]
+    labels = i["LabelsInt32"].reshape(-1)
+    segms = i["GtSegms"]
+    gt_cls = i["GtClasses"].reshape(-1)
+    im = i["ImInfo"]
+    res = a["resolution"]
+    ncls = a["num_classes"]
+    g, m, _ = segms.shape
+    n = rois.shape[0]
+    ih, iw = im[0, 0], im[0, 1]
+    # gt boxes from mask extents (normalized), same-class IoU argmax
+    tgt = np.full((n, ncls * res * res), -1, np.int32)
+    for r in range(n):
+        if labels[r] <= 0:
+            continue
+        best, best_iou = 0, -1.0
+        rb = rois[r] / np.array([iw, ih, iw, ih])
+        for j in range(g):
+            if gt_cls[j] != labels[r]:
+                continue
+            ys, xs = np.where(segms[j] > 0)
+            gb = np.array([xs.min() / m, ys.min() / m,
+                           (xs.max() + 1) / m, (ys.max() + 1) / m])
+            ix = max(0.0, min(rb[2], gb[2]) - max(rb[0], gb[0]))
+            iy = max(0.0, min(rb[3], gb[3]) - max(rb[1], gb[1]))
+            inter = ix * iy
+            ua = ((rb[2] - rb[0]) * (rb[3] - rb[1])
+                  + (gb[2] - gb[0]) * (gb[3] - gb[1]) - inter)
+            iou = inter / ua if ua > 0 else 0.0
+            if iou > best_iou:
+                best_iou, best = iou, j
+        bw = max(rois[r, 2] - rois[r, 0], 1.0)
+        bh = max(rois[r, 3] - rois[r, 1], 1.0)
+        crop = np.zeros((res, res), np.int32)
+        for ii in range(res):
+            for jj in range(res):
+                y = rois[r, 1] + (ii + 0.5) * bh / res
+                x = rois[r, 0] + (jj + 0.5) * bw / res
+                rr = min(max(int(y / ih * m), 0), m - 1)
+                cc = min(max(int(x / iw * m), 0), m - 1)
+                crop[ii, jj] = 1 if segms[best, rr, cc] > 0 else 0
+        c = labels[r]
+        tgt[r, c * res * res:(c + 1) * res * res] = crop.reshape(-1)
+    return {"MaskInt32": [tgt]}
+
+
+exp_("generate_mask_labels", _generate_mask_labels)
+grads("prroi_pool", "X")
+grads("psroi_pool", "X")
+
+# ---------------------------------------------------------------------------
+# fused / misc (fusion ops decompose into the primitives above)
+# ---------------------------------------------------------------------------
+# BinaryCompound form: binary(X, unary(Y)) for
+# functor_list=[elementwise_add, relu] (fused_elemwise_activation_op.h)
+exp_("fused_elemwise_activation", lambda i, a: {"Out": [
+    i["X"] + np.maximum(i["Y"], 0)]})
+
+
+def _fused_emb_seq_pool(i, a):
+    w, ids = i["W"], i["Ids"]
+    emb = w[ids.reshape(ids.shape[0], -1)]
+    return {"Out": [emb.sum(1)]}
+
+
+exp_("fused_embedding_seq_pool", _fused_emb_seq_pool)
+exp_("fusion_squared_mat_sub", lambda i, a: {"Out": [
+    a.get("scalar", 1.0) * ((i["X"] @ i["Y"]) ** 2
+                            - (i["X"] ** 2) @ (i["Y"] ** 2))]})
+grads("fusion_squared_mat_sub", "X", "Y")
+
+
+def _fusion_repeated_fc_relu(i, a):
+    h = np.maximum(i["X"] @ i["frfr_w1"] + i["frfr_b1"], 0)
+    return {"Out": [np.maximum(h @ i["frfr_w2"] + i["frfr_b2"], 0)]}
+
+
+exp_("fusion_repeated_fc_relu", _fusion_repeated_fc_relu)
+grads("fusion_repeated_fc_relu", "X")
+
+
+def _fused_fc_eln(i, a):
+    y = i["X"] @ i["W"] + i["Y"]
+    mu = y.mean(-1, keepdims=True)
+    var = y.var(-1, keepdims=True)
+    out = (y - mu) / np.sqrt(var + a["epsilon"])
+    return {"Out": [out * i["Scale"] + i["Bias1"]]}
+
+
+exp_("fused_fc_elementwise_layernorm", _fused_fc_eln)
+grads("fused_fc_elementwise_layernorm", "X", "W")
+
+
+def _fusion_transpose_flatten_concat(i, a):
+    xs = [i["ftfc_a"], i["ftfc_b"]]
+    ts = [np.transpose(x, a["trans_axis"]) for x in xs]
+    fl = [t.reshape(int(np.prod(t.shape[:a["flatten_axis"]])), -1)
+          for t in ts]
+    return {"Out": [np.concatenate(fl, axis=a["concat_axis"])]}
+
+
+exp_("fusion_transpose_flatten_concat",
+     _fusion_transpose_flatten_concat)
+grads("fusion_transpose_flatten_concat", "X")
+grads("multihead_matmul", "Input", "W")
+grads("attention_lstm", "X")
+grads("fusion_gru", "X")
+grads("fusion_lstm", "X")
+grads("fusion_seqconv_eltadd_relu", "X")
+grads("fusion_seqpool_concat", "X")
+grads("match_matrix_tensor", "X", "Y", "W")
+grads("var_conv_2d", "X", "W")
+grads("tree_conv", "NodesVector", "Filter")
+grads("cudnn_gru", "Input")
+grads("unpool", "X")
+grads("linear_chain_crf", "Transition")
+grads("deformable_conv_v1", "Input")
+grads("deformable_psroi_pooling", "Input", "Trans")
+grads("conv2d_fusion", "Input", "Filter")
+grads("fused_embedding_fc_lstm", "Embeddings")
+grads("conv3d", "Filter")
+grads("conv3d_transpose", "Filter")
+grads("box_coder", "TargetBox")
+
+# ---------------------------------------------------------------------------
+# batch B refs: remaining feasible families (conv3d, pooling variants,
+# sampling/warping, sequence convs, CRF/CTC, misc losses, mkldnn quant)
+# ---------------------------------------------------------------------------
+exp_("split", lambda i, a: {"Out": np.split(i["X"], a["num"],
+                                            axis=a.get("axis", 0))})
+exp_("unstack", lambda i, a: {"Y": [
+    np.squeeze(s, a.get("axis", 0))
+    for s in np.split(i["X"], i["X"].shape[a.get("axis", 0)],
+                      a.get("axis", 0))]})
+exp_("lod_reset", lambda i, a: {"Out": [i["X"]]})
+exp_("data_norm", lambda i, a: {"Y": [
+    (i["X"] - i["BatchSum"] / i["BatchSize"])
+    * np.sqrt(i["BatchSize"] / i["BatchSquareSum"])]})
+exp_("center_loss", lambda i, a: {"Loss": [
+    0.5 * ((i["X"] - i["Centers"][i["Label"].reshape(-1)]) ** 2)
+    .sum(1, keepdims=True)]})
+
+
+def _flash_attention_ref(i, a):
+    q, k, v = (x.astype(np.float64) for x in (i["Q"], i["K"], i["V"]))
+    s = np.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(q.shape[-1])
+    if a.get("causal"):
+        t = q.shape[2]
+        s = np.where(np.tril(np.ones((t, t), bool)), s, -1e30)
+    e = np.exp(s - s.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    return {"Out": [np.einsum("bhqk,bhkd->bhqd", p, v)
+                    .astype(np.float32)]}
+
+
+exp_("flash_attention", _flash_attention_ref)
+
+
+def _max_pool2d_index(i, a):
+    x = i["X"]
+    kh, kw = a["ksize"]
+    sh, sw = a["strides"]
+    n, c, h, w = x.shape
+    oh, ow = (h - kh) // sh + 1, (w - kw) // sw + 1
+    out = np.zeros((n, c, oh, ow), x.dtype)
+    idx = np.zeros((n, c, oh, ow), np.int64)
+    for pi in range(oh):
+        for pj in range(ow):
+            win = x[:, :, pi * sh:pi * sh + kh, pj * sw:pj * sw + kw]
+            flat = win.reshape(n, c, -1)
+            am = flat.argmax(-1)
+            out[:, :, pi, pj] = flat.max(-1)
+            # mask index is global within the h*w feature map
+            r = pi * sh + am // kw
+            col = pj * sw + am % kw
+            idx[:, :, pi, pj] = r * w + col
+    return {"Out": [out], "Mask": [idx]}
+
+
+exp_("max_pool2d_with_index", _max_pool2d_index)
+
+
+def _pool3d(i, a):
+    x = i["X"]
+    kd, kh, kw = a["ksize"]
+    sd, sh, sw = a["strides"]
+    n, c, d, h, w = x.shape
+    od, oh, ow = ((d - kd) // sd + 1, (h - kh) // sh + 1,
+                  (w - kw) // sw + 1)
+    out = np.zeros((n, c, od, oh, ow), np.float32)
+    red = (lambda win: win.max((2, 3, 4))) \
+        if a["pooling_type"] == "max" else (lambda win: win.mean((2, 3, 4)))
+    for pi in range(od):
+        for pj in range(oh):
+            for pk in range(ow):
+                out[:, :, pi, pj, pk] = red(
+                    x[:, :, pi * sd:pi * sd + kd, pj * sh:pj * sh + kh,
+                      pk * sw:pk * sw + kw])
+    return {"Out": [out]}
+
+
+exp_("pool3d", _pool3d)
+
+
+def _unpool(i, a):
+    x, ind = i["X"], i["Indices"]
+    n, c, h, w = x.shape
+    oh = (h - 1) * a["strides"][0] + a["ksize"][0]
+    ow = (w - 1) * a["strides"][1] + a["ksize"][1]
+    out = np.zeros((n, c, oh, ow), x.dtype)
+    for b in range(n):
+        for ch in range(c):
+            for pi in range(h):
+                for pj in range(w):
+                    p = ind[b, ch, pi, pj]
+                    out[b, ch, p // ow, p % ow] = x[b, ch, pi, pj]
+    return {"Out": [out]}
+
+
+exp_("unpool", _unpool)
+
+
+def _spp(i, a):
+    # spp_op.h:39-50: per level, bins=2^p, ksize=ceil(h/bins),
+    # pad=(ksize*bins-h+1)//2, stride=ksize; flatten + concat
+    x = i["X"]
+    n, c, h, w = x.shape
+    outs = []
+    for p in range(a["pyramid_height"]):
+        bins = 2 ** p
+        kh, kw = -(-h // bins), -(-w // bins)
+        ph, pw = (kh * bins - h + 1) // 2, (kw * bins - w + 1) // 2
+        fill = -np.inf if a["pooling_type"] == "max" else 0.0
+        xp = np.pad(x, [(0, 0), (0, 0), (ph, kh * bins - h - ph),
+                        (pw, kw * bins - w - pw)], constant_values=fill)
+        lvl = np.zeros((n, c, bins, bins), np.float32)
+        for pi in range(bins):
+            for pj in range(bins):
+                win = xp[:, :, pi * kh:(pi + 1) * kh,
+                         pj * kw:(pj + 1) * kw]
+                lvl[:, :, pi, pj] = (win.max((2, 3))
+                                     if a["pooling_type"] == "max"
+                                     else win.mean((2, 3)))
+        outs.append(lvl.reshape(n, -1))
+    return {"Out": [np.concatenate(outs, 1)]}
+
+
+exp_("spp", _spp)
+
+
+def _conv3d_np(x, w, strides, pads, dilations=(1, 1, 1), groups=1):
+    n, cin = x.shape[:2]
+    cout = w.shape[0]
+    kd, kh, kw = w.shape[2:]
+    sd, sh, sw = strides
+    pd, ph, pw = pads
+    xp = np.pad(x, [(0, 0), (0, 0), (pd, pd), (ph, ph), (pw, pw)])
+    od = (x.shape[2] + 2 * pd - kd) // sd + 1
+    oh = (x.shape[3] + 2 * ph - kh) // sh + 1
+    ow = (x.shape[4] + 2 * pw - kw) // sw + 1
+    out = np.zeros((n, cout, od, oh, ow), np.float64)
+    cpg = cin // groups
+    opg = cout // groups
+    for b in range(n):
+        for oc in range(cout):
+            g = oc // opg
+            xs = xp[b, g * cpg:(g + 1) * cpg]
+            for i_ in range(od):
+                for j in range(oh):
+                    for k_ in range(ow):
+                        win = xs[:, i_ * sd:i_ * sd + kd,
+                                 j * sh:j * sh + kh,
+                                 k_ * sw:k_ * sw + kw]
+                        out[b, oc, i_, j, k_] = (win * w[oc]).sum()
+    return out.astype(np.float32)
+
+
+exp_("conv3d", lambda i, a: {"Output": [_conv3d_np(
+    i["Input"], i["Filter"], a["strides"], a["paddings"],
+    a.get("dilations", [1, 1, 1]), a.get("groups", 1))]})
+
+
+def _conv3d_transpose_np(i, a):
+    x, w = i["Input"], i["Filter"]  # w: [C_in, C_out/g, kd, kh, kw]
+    sd, sh, sw = a["strides"]
+    pd, ph, pw = a["paddings"]
+    n, cin = x.shape[:2]
+    cog = w.shape[1]
+    kd, kh, kw = w.shape[2:]
+    od = (x.shape[2] - 1) * sd + kd
+    oh = (x.shape[3] - 1) * sh + kh
+    ow = (x.shape[4] - 1) * sw + kw
+    out = np.zeros((n, cog, od + 2 * pd, oh + 2 * ph, ow + 2 * pw),
+                   np.float64)
+    for b in range(n):
+        for ic in range(cin):
+            for oc in range(cog):
+                for i_ in range(x.shape[2]):
+                    for j in range(x.shape[3]):
+                        for k_ in range(x.shape[4]):
+                            out[b, oc, i_ * sd:i_ * sd + kd,
+                                j * sh:j * sh + kh,
+                                k_ * sw:k_ * sw + kw] += (
+                                x[b, ic, i_, j, k_] * w[ic, oc])
+    out = out[:, :, pd:pd + od, ph:ph + oh, pw:pw + ow]
+    return {"Output": [out.astype(np.float32)]}
+
+
+exp_("conv3d_transpose", _conv3d_transpose_np)
+
+
+def _grid_sampler(i, a):
+    # grid_sampler_op.h:54-90: x = (g+1)·(W−1)/2 (align-corners),
+    # bilinear with zero contribution outside bounds
+    x, g = i["X"].astype(np.float64), i["Grid"]
+    n, c, h, w = x.shape
+    gh, gw = g.shape[1], g.shape[2]
+    out = np.zeros((n, c, gh, gw))
+    for b in range(n):
+        for pi in range(gh):
+            for pj in range(gw):
+                gx = (g[b, pi, pj, 0] + 1) * 0.5 * (w - 1)
+                gy = (g[b, pi, pj, 1] + 1) * 0.5 * (h - 1)
+                x0, y0 = int(np.floor(gx)), int(np.floor(gy))
+                for dy in (0, 1):
+                    for dx in (0, 1):
+                        xx, yy = x0 + dx, y0 + dy
+                        if 0 <= xx < w and 0 <= yy < h:
+                            wt = ((1 - abs(gx - xx))
+                                  * (1 - abs(gy - yy)))
+                            out[b, :, pi, pj] += wt * x[b, :, yy, xx]
+    return {"Output": [out.astype(np.float32)]}
+
+
+def _affine_grid(i, a):
+    theta = i["Theta"]  # [n, 2, 3]
+    n_, _, h, w = a["output_shape"]
+    ys = np.linspace(-1, 1, h)
+    xs = np.linspace(-1, 1, w)
+    base = np.stack([np.tile(xs, (h, 1)),
+                     np.tile(ys[:, None], (1, w)),
+                     np.ones((h, w))], axis=-1)  # [h, w, 3]
+    out = np.einsum("hwk,njk->nhwj", base, theta)
+    return {"Output": [out.astype(np.float32)]}
+
+
+exp_("affine_grid", _affine_grid)
+
+
+def _row_conv(i, a):
+    # row_conv_op.cc: lookahead conv, out[t] = sum_j w[j]·x[t+j]
+    x, w = i["X"], i["Filter"]  # [b, t, d], [fc, d]
+    b, t, d = x.shape
+    fc = w.shape[0]
+    out = np.zeros_like(x)
+    for j in range(fc):
+        out[:, :t - j] += x[:, j:] * w[j][None, None, :]
+    return {"Out": [out]}
+
+
+exp_("row_conv", _row_conv)
+
+
+def _sequence_conv(i, a):
+    # sequence_conv_op: context window [start, start+len) rows of x
+    # concatenated then projected by Filter [len·d, od]
+    x, w = i["X"], i["Filter"]  # [b, t, d], [cl*d, od]
+    cl = a["contextLength"]
+    cs = a.get("contextStart", -((cl - 1) // 2))
+    b, t, d = x.shape
+    cols = np.zeros((b, t, cl * d), x.dtype)
+    for j in range(cl):
+        src = cs + j
+        lo, hi = max(0, -src), min(t, t - src)
+        if lo < hi:
+            cols[:, lo:hi, j * d:(j + 1) * d] = x[:, lo + src:hi + src]
+    return {"Out": [cols @ w]}
+
+
+exp_("sequence_conv", _sequence_conv)
+exp_("fusion_seqconv_eltadd_relu", lambda i, a: {"Out": [np.maximum(
+    _sequence_conv(i, a)["Out"][0] + i["Bias"], 0.0)]})
+exp_("fusion_seqpool_concat", lambda i, a: {"Out": [np.concatenate(
+    [i["fspc_a"].sum(1), i["fspc_b"].sum(1)], axis=1)]})
+
+
+def _im2sequence(i, a):
+    x = i["X"]
+    kh, kw = a["kernels"]
+    sh, sw = a["strides"]
+    n, c, h, w = x.shape
+    oh, ow = (h - kh) // sh + 1, (w - kw) // sw + 1
+    rows = []
+    for b in range(n):
+        for pi in range(oh):
+            for pj in range(ow):
+                rows.append(x[b, :, pi * sh:pi * sh + kh,
+                              pj * sw:pj * sw + kw].reshape(-1))
+    return {"Out": [np.stack(rows)]}
+
+
+exp_("im2sequence", _im2sequence)
+
+
+def _match_matrix_tensor(i, a):
+    x, y, w = i["X"], i["Y"], i["W"]  # [b,l1,d], [b,l2,d], [d,dim_t,d]
+    out = np.einsum("bld,dte,bme->btlm", x, w, y)
+    return {"Out": [out.astype(np.float32)]}
+
+
+exp_("match_matrix_tensor", _match_matrix_tensor)
+exp_("var_conv_2d", lambda i, a: {"Out": [_conv2d_np(
+    i["X"], i["W"], [a["StrideH"], a["StrideW"]],
+    [(a["KernelH"] - 1) // 2, (a["KernelW"] - 1) // 2])]})
+
+
+def _spectral_norm(i, a):
+    w, u, v = (x.astype(np.float64) for x in (i["Weight"], i["U"],
+                                              i["V"]))
+    eps = a.get("eps", 1e-12)
+    for _ in range(a.get("power_iters", 1)):
+        v = w.T @ u
+        v /= np.sqrt((v * v).sum()) + eps
+        u = w @ v
+        u /= np.sqrt((u * u).sum()) + eps
+    sigma = u @ w @ v
+    return {"Out": [(w / sigma).astype(np.float32)]}
+
+
+# ---------------------------------------------------------------------------
+# batch C refs: CRF/CTC, metric-learning losses, padded select/unique,
+# NMS, anchors, recurrent units
+# ---------------------------------------------------------------------------
+def _where_index_ref(i, a):
+    cond = i.get("Condition", i.get("X"))
+    idx = np.argwhere(cond != 0).astype(np.int64)
+    out = np.full((cond.size, cond.ndim), -1, np.int64)
+    out[:idx.shape[0]] = idx
+    return {"Out": [out]}
+
+
+exp_("where", _where_index_ref)
+exp_("where_index", _where_index_ref)
+
+
+def _unique_ref(i, a):
+    # documented static-shape contract: SORTED uniques, sentinel-padded
+    # (dtype max for ints), Index maps each element to its slot
+    x = i["X"].reshape(-1)
+    u, inv, cnt = np.unique(x, return_inverse=True, return_counts=True)
+    sent = np.iinfo(x.dtype).max if np.issubdtype(x.dtype, np.integer) \
+        else np.inf
+    out = np.full(x.size, sent, x.dtype)
+    out[:u.size] = u
+    counts = np.zeros(x.size, np.int64)
+    counts[:u.size] = cnt
+    return {"Out": [out], "Index": [inv.astype(np.int64)],
+            "Count": [counts]}
+
+
+exp_("unique", lambda i, a: {k: v for k, v in _unique_ref(i, a).items()
+                             if k != "Count"})
+exp_("unique_with_counts", _unique_ref)
+
+
+def _sigmoid_focal_loss(i, a):
+    # sigmoid_focal_loss_op.h:43-73
+    x, lbl = i["X"].astype(np.float64), i["Label"].reshape(-1)
+    fg = max(float(i["FgNum"].reshape(-1)[0]), 1.0)
+    gamma, alpha = a["gamma"], a["alpha"]
+    n, c = x.shape
+    d = np.arange(c)[None, :]
+    g = lbl[:, None]
+    c_pos = (g == d + 1).astype(np.float64)
+    c_neg = ((g != -1) & (g != d + 1)).astype(np.float64)
+    p = 1.0 / (1.0 + np.exp(-x))
+    term_pos = (1 - p) ** gamma * np.log(np.maximum(p, 1e-37))
+    term_neg = p ** gamma * (-x * (x >= 0)
+                             - np.log1p(np.exp(x - 2 * x * (x >= 0))))
+    out = (-c_pos * term_pos * (alpha / fg)
+           - c_neg * term_neg * ((1 - alpha) / fg))
+    return {"Out": [out.astype(np.float32)]}
+
+
+exp_("sigmoid_focal_loss", _sigmoid_focal_loss)
+
+
+def _npair_loss(i, a):
+    # layers/nn.py:16592-16649 composition, Beta = 0.25
+    anchor, pos = i["Anchor"].astype(np.float64), \
+        i["Positive"].astype(np.float64)
+    lbl = i["Labels"].reshape(-1)
+    n = lbl.shape[0]
+    lab = (lbl[:, None] == lbl[None, :]).astype(np.float64)
+    lab = lab / lab.sum(1, keepdims=True)
+    l2 = (( (anchor ** 2).sum(1).mean() + (pos ** 2).sum(1).mean() )
+          * 0.25 * a.get("l2_reg", 0.002))
+    sim = anchor @ pos.T
+    logp = sim - sim.max(1, keepdims=True)
+    logp = logp - np.log(np.exp(logp).sum(1, keepdims=True))
+    ce = -(lab * logp).sum(1, keepdims=True)       # [n, 1]
+    cross = (lab * ce).sum(0)                       # reference quirk
+    return {"Out": [np.float32(l2 + cross.mean())]}
+
+
+exp_("npair_loss", _npair_loss)
+
+
+def _linear_chain_crf(i, a):
+    # linear_chain_crf_op.h:160-216: LogLikelihood = logZ − gold score;
+    # Transition row 0 = start, row 1 = stop, rows 2.. = transitions
+    em = i["Emission"].astype(np.float64)     # [b, t, n] padded batch
+    w = i["Transition"].astype(np.float64)    # [n+2, n]
+    lbl = i["Label"]
+    b, t, n = em.shape
+    out = np.zeros((b, 1), np.float64)
+    for s in range(b):
+        x = em[s]
+        # logsumexp alpha recursion
+        alpha = w[0] + x[0]
+        for k in range(1, t):
+            m = alpha.max()
+            alpha = x[k] + m + np.log(
+                np.exp(alpha - m) @ np.exp(w[2:]))
+        m = alpha.max()
+        logz = m + np.log(np.exp(alpha - m) @ np.exp(w[1]))
+        ls = lbl[s]
+        gold = w[0, ls[0]] + x[0, ls[0]] + w[1, ls[t - 1]]
+        for k in range(1, t):
+            gold += x[k, ls[k]] + w[ls[k - 1] + 2, ls[k]]
+        out[s, 0] = logz - gold
+    return {"LogLikelihood": [out.astype(np.float32)]}
+
+
+exp_("linear_chain_crf", _linear_chain_crf)
+
+
+def _crf_decoding(i, a):
+    em = i["Emission"].astype(np.float64)
+    w = i["Transition"].astype(np.float64)
+    b, t, n = em.shape
+    paths = np.zeros((b, t), np.int64)
+    for s in range(b):
+        x = em[s]
+        score = w[0] + x[0]
+        back = np.zeros((t, n), np.int64)
+        for k in range(1, t):
+            cand = score[:, None] + w[2:]
+            back[k] = cand.argmax(0)
+            score = x[k] + cand.max(0)
+        score = score + w[1]
+        paths[s, t - 1] = score.argmax()
+        for k in range(t - 1, 0, -1):
+            paths[s, k - 1] = back[k, paths[s, k]]
+    return {"ViterbiPath": [paths]}
+
+
+exp_("crf_decoding", _crf_decoding)
+
+
+def _warpctc(i, a):
+    # standard CTC forward (alpha) on softmax(logits); loss per sequence
+    logits = i["Logits"].astype(np.float64)   # [b, t, c]
+    labels = i["Label"]
+    blank = a.get("blank", 0)
+    b, t, c = logits.shape
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    probs = e / e.sum(-1, keepdims=True)
+    out = np.zeros((b, 1), np.float64)
+    for s in range(b):
+        lab = [v for v in labels[s] if v >= 0]
+        ext = [blank]
+        for v in lab:
+            ext += [v, blank]
+        m = len(ext)
+        al = np.zeros((t, m))
+        al[0, 0] = probs[s, 0, blank]
+        if m > 1:
+            al[0, 1] = probs[s, 0, ext[1]]
+        for k in range(1, t):
+            for j in range(m):
+                v = al[k - 1, j]
+                if j > 0:
+                    v += al[k - 1, j - 1]
+                if j > 1 and ext[j] != blank and ext[j] != ext[j - 2]:
+                    v += al[k - 1, j - 2]
+                al[k, j] = v * probs[s, k, ext[j]]
+        out[s, 0] = -np.log(max(al[t - 1, m - 1]
+                                + (al[t - 1, m - 2] if m > 1 else 0.0),
+                                1e-300))
+    return {"Loss": [out.astype(np.float32)]}
+
+
+exp_("warpctc", _warpctc)
+
+
+def _gru_unit(i, a):
+    # gru_unit_op.h:55-121 (origin_mode False default):
+    # u,r = sigmoid(input[:, :2d] + h_prev @ W[:, :2d]);
+    # c = tanh(input[:, 2d:] + (r·h_prev) @ W[:, 2d:]);
+    # h = u·(c − h_prev) + h_prev
+    x, hp, w = i["Input"], i["HiddenPrev"], i["Weight"]
+    d = hp.shape[1]
+    gate = x[:, :2 * d] + hp @ w[:, :2 * d]
+    if "Bias" in i:
+        gate = gate + i["Bias"][0, :2 * d]
+    u = _sig(gate[:, :d])
+    r = _sig(gate[:, d:])
+    cin = x[:, 2 * d:] + (r * hp) @ w[:, 2 * d:]
+    if "Bias" in i:
+        cin = cin + i["Bias"][0, 2 * d:]
+    cand = np.tanh(cin)
+    h = u * (cand - hp) + hp
+    return {"Hidden": [h.astype(np.float32)]}
+
+
+exp_("gru_unit", _gru_unit)
+
+
+def _lstm_unit(i, a):
+    # lstm_unit_op.h:63-72: gates ordered i, f(+forget_bias), o, g
+    x, cp = i["X"], i["C_prev"]
+    d = cp.shape[1]
+    fb = a.get("forget_bias", 0.0)
+    ig = _sig(x[:, :d])
+    f = _sig(x[:, d:2 * d] + fb)
+    o = _sig(x[:, 2 * d:3 * d])
+    g = np.tanh(x[:, 3 * d:])
+    cc = f * cp + ig * g
+    return {"C": [cc.astype(np.float32)],
+            "H": [(o * np.tanh(cc)).astype(np.float32)]}
+
+
+exp_("lstm_unit", _lstm_unit)
+
+
+def _anchor_generator(i, a):
+    # anchor_generator_op.h:60-94
+    feat = i["Input"]
+    h, w = feat.shape[2], feat.shape[3]
+    sizes = a["anchor_sizes"]
+    ratios = a["aspect_ratios"]
+    sw, sh = a["stride"]
+    offset = a.get("offset", 0.5)
+    var = a["variances"]
+    nprior = len(sizes) * len(ratios)
+    anchors = np.zeros((h, w, nprior, 4), np.float32)
+    # reference: x_ctr = w_idx * stride_w + offset * (stride_w - 1)
+    for hi in range(h):
+        yc = hi * sh + offset * (sh - 1)
+        for wi in range(w):
+            xc = wi * sw + offset * (sw - 1)
+            idx = 0
+            for r in ratios:
+                for s in sizes:
+                    area = sw * sh
+                    base_w = round(np.sqrt(area / r))
+                    base_h = round(base_w * r)
+                    aw = (s / sw) * base_w
+                    ah = (s / sh) * base_h
+                    anchors[hi, wi, idx] = [xc - 0.5 * (aw - 1),
+                                            yc - 0.5 * (ah - 1),
+                                            xc + 0.5 * (aw - 1),
+                                            yc + 0.5 * (ah - 1)]
+                    idx += 1
+    variances = np.tile(np.asarray(var, np.float32),
+                        (h, w, nprior, 1)).reshape(h, w, nprior, 4)
+    return {"Anchors": [anchors], "Variances": [variances]}
+
+
+exp_("anchor_generator", _anchor_generator)
+
+
+def _multiclass_nms_ref(i, a):
+    # multiclass_nms_op semantics on the padded [B, keep_top_k, 6]
+    # contract (class, score, x1, y1, x2, y2; -1 rows = empty)
+    boxes, scores = i["BBoxes"], i["Scores"]  # [B,N,4], [B,C,N]
+    st = a.get("score_threshold", 0.0)
+    nt = a.get("nms_threshold", 0.3)
+    keep_k = a.get("keep_top_k", 16)
+    if keep_k <= 0:
+        keep_k = 16
+    bg = a.get("background_label", 0)
+    bsz = boxes.shape[0]
+    ncls = scores.shape[1] - (1 if 0 <= bg < scores.shape[1] else 0)
+    keep_k = min(keep_k, ncls * boxes.shape[1])  # lowering's static cap
+    out = np.full((bsz, keep_k, 6), -1.0, np.float32)
+    for b in range(bsz):
+        rows = []
+        for c in range(scores.shape[1]):
+            if c == bg:
+                continue
+            order = np.argsort(-scores[b, c], kind="stable")
+            kept = []
+            for idx in order:
+                if scores[b, c, idx] <= st:
+                    continue
+                ok = True
+                for j in kept:
+                    if _iou(boxes[b, idx:idx + 1],
+                            boxes[b, j:j + 1])[0, 0] > nt:
+                        ok = False
+                        break
+                if ok:
+                    kept.append(idx)
+            for j in kept:
+                rows.append([c, scores[b, c, j]] + list(boxes[b, j]))
+        rows.sort(key=lambda r: -r[1])
+        for k, r in enumerate(rows[:keep_k]):
+            out[b, k] = r
+    return {"Out": [out]}
+
+
+exp_("multiclass_nms", _multiclass_nms_ref)
+exp_("multiclass_nms2", _multiclass_nms_ref)
+
+
+exp_("quantize", lambda i, a: {"Output": [np.clip(
+    np.round(i["Input"] * a.get("Scale", 1.0)), -128, 127)
+    .astype(np.int8)]})
+exp_("dequantize", lambda i, a: {"Output": [
+    i["Input"].astype(np.float32) / a.get("Scale", 1.0)]})
+exp_("requantize", lambda i, a: {"Output": [np.clip(
+    np.round(i["Input"] * (a["Scale_out"] / a["Scale_in"])), -128, 127)
+    .astype(np.int8)]})
+# polygon_box_transform: whole op is marked nondiff (assigner-shaped);
+# grid_sampler Grid grad: numeric diff crosses bilinear cell boundaries
+grads("top_k", "X")           # gather-of-max: exact as long as no ties
+grads("argsort", "X")         # permutation gradient
+grads("lod_reset", "X")
+grads("spectral_norm", "Weight")
+grads("filter_by_instag", "Ins")
+grads("sequence_topk_avg_pooling", "X")
+grads("fusion_seqexpand_concat_fc", "X")

@@ -638,8 +638,11 @@ spec("filter_by_instag",
      attrs={"is_lod": False})
 spec("similarity_focus", ins={"X": f32(1, 2, 3, 3)},
      attrs={"axis": 1, "indexes": [0]})
-spec("cvm", ins={"X": f32(2, 4), "CVM": f32(2, 2)},
-     attrs={"use_cvm": True}, grad=["X"])
+# no grad check: the reference injects the CVM input as the show/click
+# column gradients (cvm_op.h CvmGradComputeKernel) — intentionally NOT
+# the numeric derivative of the forward's log transform
+spec("cvm", ins={"X": pos(2, 4), "CVM": f32(2, 2)},
+     attrs={"use_cvm": True})
 spec("hash", ins={"X": np.array([[1, 2], [3, 4]], np.int64)},
      attrs={"num_hash": 2, "mod_by": 1000})
 
@@ -794,7 +797,8 @@ spec("quantize", ins={"Input": _X, "Scale": np.array([2.0], np.float32)})
 spec("dequantize", ins={"Input": ints(2, 3, lo=-10, hi=10).astype(
     np.float32), "Scale": np.array([2.0], np.float32)})
 spec("requantize", ins={"Input": ints(2, 3, lo=-10, hi=10).astype(
-    np.float32)}, attrs={"scale_in": 2.0, "scale_out": 4.0})
+    np.float32)}, attrs={"Scale_in": 2.0, "Scale_out": 4.0})
+# attr names are capitalized in the reference (requantize_op.cc:36-37)
 spec("dgc", ins={"U": np.zeros(20, np.float32),
                  "V": np.zeros(20, np.float32), "Grad": f32(20)},
      attrs={"m": 0.9, "sparsity": [0.8]})
@@ -821,7 +825,8 @@ spec("box_clip", ins={"Input": _BOXES1,
                                          np.float32)})
 spec("box_coder",
      ins={"PriorBox": _BOXES1, "PriorBoxVar": pos(3, 4),
-          "TargetBox": _BOXES1},
+          # distinct buffer: the numeric-grad pass perturbs in place
+          "TargetBox": _BOXES1 + np.float32(0.5)},
      attrs={"code_type": "encode_center_size"})
 spec("box_decoder_and_assign",
      ins={"PriorBox": _BOXES1, "PriorBoxVar": pos(3, 4),
@@ -1012,3 +1017,18 @@ skip("read", "host reader infeed; covered in "
              "tests/test_straggler_ops.py")
 skip("create_custom_reader", "host reader binding; covered in "
                              "tests/test_straggler_ops.py")
+
+# ===========================================================================
+# independent numpy references + extra grad slots (op_expects.py) —
+# merged last so every entry targets an existing spec
+# ===========================================================================
+from op_expects import EXPECTS, EXTRA_GRADS  # noqa: E402
+
+for _op, _fn in EXPECTS.items():
+    assert _op in SPECS, f"expect for unspec'd op {_op}"
+    if SPECS[_op]["expect"] is None:
+        SPECS[_op]["expect"] = _fn
+for _op, _slots in EXTRA_GRADS.items():
+    assert _op in SPECS, f"extra grads for unspec'd op {_op}"
+    SPECS[_op]["grad"] = tuple(
+        dict.fromkeys(list(SPECS[_op]["grad"]) + list(_slots)))
