@@ -90,13 +90,32 @@ class QuantizationTransformPass:
                            {"Out": [out], "OutScale": [scale]},
                            {"bit_length": self.weight_bits,
                             "quant_axis": quant_axis})
+            # reference QuantizationTransformPass pairs every quant op
+            # (integer-grid output) with its dequant op; the consumer
+            # reads the dequantized float value
+            new_ops.append(qop)
+            out = self._insert_dequant(
+                block, new_ops, out, name,
+                "fake_channel_wise_dequantize_max_abs",
+                {"Scales": [scale]},
+                {"quant_bits": [self.weight_bits],
+                 "quant_axis": quant_axis})
+            cache[name] = out
+            return out
         elif is_weight or self.act_type == "abs_max":
             block.create_var(name=scale, shape=(1,), dtype="float32",
                             stop_gradient=True)
+            bits = self.weight_bits if is_weight else self.activation_bits
             qop = Operator(block, "fake_quantize_abs_max", {"X": [name]},
                            {"Out": [out], "OutScale": [scale]},
-                           {"bit_length": self.weight_bits if is_weight
-                            else self.activation_bits})
+                           {"bit_length": bits})
+            new_ops.append(qop)
+            out = self._insert_dequant(
+                block, new_ops, out, name, "fake_dequantize_max_abs",
+                {"Scale": [scale]},
+                {"max_range": float((1 << (bits - 1)) - 1)})
+            cache[name] = out
+            return out
         else:
             # moving-average activation quant: persistent scale + ema state;
             # at eval (is_test flipped by clone(for_test=True)) the op reads
@@ -117,6 +136,17 @@ class QuantizationTransformPass:
                  "moving_rate": self.moving_rate, "is_test": False})
         new_ops.append(qop)
         cache[name] = out
+        return out
+
+    def _insert_dequant(self, block, new_ops, quantized, orig_name,
+                        op_type, extra_ins, attrs):
+        out = unique_name.generate(f"{orig_name}.dequantized")
+        qv = block.var(quantized)
+        block.create_var(name=out, shape=qv.shape, dtype=qv.dtype,
+                         stop_gradient=qv.stop_gradient)
+        new_ops.append(Operator(block, op_type,
+                                {"X": [quantized], **extra_ins},
+                                {"Out": [out]}, attrs))
         return out
 
     def _state_var(self, block, hint, startup_program, init=0.0):

@@ -149,23 +149,47 @@ def _prroi_pool(ctx, ins, attrs):
 
 @register_op("psroi_pool", nondiff_inputs=("ROIs",))
 def _psroi_pool(ctx, ins, attrs):
-    """position-sensitive RoI pooling: channel c*oh*ow is split so bin
-    (i, j) reads channel group (i*ow + j)."""
+    """position-sensitive RoI pooling (psroi_pool_op.h:30-90): integer
+    floor/ceil bin boundaries, average over the bin's pixels; channel
+    group (co·oh + i)·ow + j feeds output bin (i, j). Vectorized as
+    grid masks so roi-dependent bin edges stay XLA-static."""
     x = ins["X"][0]
     rois = ins["ROIs"][0]
     oh = attrs.get("pooled_height", 1)
     ow = attrs.get("pooled_width", 1)
     out_c = attrs.get("output_channels", x.shape[1] // (oh * ow))
     scale = attrs.get("spatial_scale", 1.0)
-    aligned = _roi_align(ctx, {"X": [x], "ROIs": [rois]},
-                         {"pooled_height": oh, "pooled_width": ow,
-                          "spatial_scale": scale})["Out"][0]
-    r = aligned.shape[0]
-    # [R, out_c, oh*ow, oh, ow] -> take the matching group per bin
-    g = aligned.reshape(r, out_c, oh * ow, oh, ow)
-    sel = jnp.arange(oh * ow).reshape(oh, ow)
-    out = g[:, :, sel, jnp.arange(oh)[:, None], jnp.arange(ow)[None, :]]
-    return {"Out": [out]}
+    h, w = x.shape[2], x.shape[3]
+    # psroi_pool_op.h: start = round(roi)·scale, end = (round(roi)+1)·scale
+    x1 = jnp.round(rois[:, 0]) * scale
+    y1 = jnp.round(rois[:, 1]) * scale
+    x2 = (jnp.round(rois[:, 2]) + 1.0) * scale
+    y2 = (jnp.round(rois[:, 3]) + 1.0) * scale
+    bh = jnp.maximum(y2 - y1, 0.1) / oh
+    bw = jnp.maximum(x2 - x1, 0.1) / ow
+    pi = jnp.arange(oh, dtype=x1.dtype)
+    pj = jnp.arange(ow, dtype=x1.dtype)
+    hs = jnp.clip(jnp.floor(y1[:, None] + pi[None] * bh[:, None]), 0, h)
+    he = jnp.clip(jnp.ceil(y1[:, None] + (pi[None] + 1) * bh[:, None]),
+                  0, h)
+    ws = jnp.clip(jnp.floor(x1[:, None] + pj[None] * bw[:, None]), 0, w)
+    we = jnp.clip(jnp.ceil(x1[:, None] + (pj[None] + 1) * bw[:, None]),
+                  0, w)
+    ys = jnp.arange(h, dtype=x1.dtype)
+    xs = jnp.arange(w, dtype=x1.dtype)
+    hm = ((ys[None, None, :] >= hs[..., None])
+          & (ys[None, None, :] < he[..., None])).astype(x.dtype)  # [R,oh,H]
+    wm = ((xs[None, None, :] >= ws[..., None])
+          & (xs[None, None, :] < we[..., None])).astype(x.dtype)  # [R,ow,W]
+    # each roi pools from ITS image (RoisNum/RoisLod mapping), not x[0]
+    bidx = _batch_index_of_rois(ins, rois.shape[0])
+    xg = x.reshape(x.shape[0], out_c, oh, ow, h, w)
+    xsel = jnp.take(xg, jnp.clip(bidx, 0, x.shape[0] - 1), axis=0)
+    s = jnp.einsum("rcijyx,riy,rjx->rcij", xsel, hm, wm)
+    area = ((he - hs)[:, :, None] * (we - ws)[:, None, :])  # [R, oh, ow]
+    out = jnp.where(area[:, None] > 0,
+                    s / jnp.maximum(area[:, None], 1.0), 0.0)
+    return {"Out": [out.astype(x.dtype)]}
 
 
 # ---------------------------------------------------------------------------
@@ -184,15 +208,24 @@ def _anchor_generator(ctx, ins, attrs):
     stride = attrs.get("stride", [16.0, 16.0])
     variances = attrs.get("variances", [0.1, 0.1, 0.2, 0.2])
     offset = attrs.get("offset", 0.5)
+    # anchor_generator_op.h:60-85: base_w = round(sqrt(area/ratio)),
+    # base_h = round(base_w·ratio), scaled by size/stride; ratio-outer
+    # size-inner ordering; centers at idx·stride + offset·(stride−1);
+    # pixel-inclusive ±(dim−1)/2 corners
+    sw, sh = stride
     base = []
-    for s in sizes:
-        for r in ratios:
-            aw = s * np.sqrt(r)
-            ah = s / np.sqrt(r)
-            base.append([-aw / 2, -ah / 2, aw / 2, ah / 2])
+    for r in ratios:
+        for s in sizes:
+            area = sw * sh
+            bw = np.round(np.sqrt(area / r))
+            bh = np.round(bw * r)
+            aw = (s / sw) * bw
+            ah = (s / sh) * bh
+            base.append([-0.5 * (aw - 1), -0.5 * (ah - 1),
+                         0.5 * (aw - 1), 0.5 * (ah - 1)])
     base = jnp.asarray(base)  # [A, 4]
-    cx = (jnp.arange(w) + offset) * stride[0]
-    cy = (jnp.arange(h) + offset) * stride[1]
+    cx = jnp.arange(w) * sw + offset * (sw - 1)
+    cy = jnp.arange(h) * sh + offset * (sh - 1)
     gx, gy = jnp.meshgrid(cx, cy)  # [h, w]
     centers = jnp.stack([gx, gy, gx, gy], axis=-1)  # [h, w, 4]
     anchors = centers[:, :, None, :] + base[None, None]

@@ -49,20 +49,43 @@ def _sigmoid_focal_loss(ctx, ins, attrs):
 def _ts_sigmoid_loss(ctx, ins, attrs):
     x = ins["X"][0]
     label = ins["Label"][0]
-    # reference: label < -1 -> teacher branch encoded, here the documented
-    # piecewise form (teacher_student_sigmoid_loss_op.cc)
-    ce = jnp.logaddexp(0.0, x) - x * (label > 0.0)
-    soft = jnp.logaddexp(0.0, x) - x * jnp.clip(label, 0.0, 1.0)
-    return {"Y": [jnp.where(jnp.abs(label) <= 1.0, soft, ce)]}
+    # teacher_student_sigmoid_loss_op.h:43-62 encodes (clk, teacher
+    # score q) in one label: <-1 → clk=0 no q; [-1,0) → clk=1 no q;
+    # [0,1) → clk=0, q=label; >=1 → clk=1, q=label-1. With
+    # sp = softplus(x) the four branches reduce to three: the two
+    # teacher branches are both 2·sp − x·label.
+    sp = jnp.logaddexp(0.0, x)
+    out = jnp.where(label < -1.0, sp,
+                    jnp.where(label < 0.0, sp - x,
+                              2.0 * sp - x * label))
+    return {"Y": [out]}
 
 
-@register_op("cvm", nondiff_inputs=("CVM",))
+def _cvm_grad(ctx, ins, attrs):
+    # cvm_op.h:42-53 CvmGradComputeKernel: the show/click columns take
+    # their gradient from the CVM input (recommendation-system trick),
+    # remaining columns pass through
+    gy = ins["Y@GRAD"][0]
+    cvm = ins["CVM"][0]
+    import jax.numpy as jnp
+    if attrs.get("use_cvm", True):
+        gx = jnp.concatenate([cvm[:, :2].astype(gy.dtype), gy[:, 2:]],
+                             axis=1)
+    else:
+        gx = jnp.concatenate([cvm[:, :2].astype(gy.dtype), gy], axis=1)
+    return {"X@GRAD": [gx]}
+
+
+@register_op("cvm", nondiff_inputs=("CVM",), manual_grad=_cvm_grad)
 def _cvm(ctx, ins, attrs):
-    """continuous_value_model op: strip/keep the 2 leading show/click
-    columns (cvm_op.cc)."""
+    """continuous_value_model op (cvm_op.h:26-39): use_cvm=True keeps
+    all columns with the 2 leading show/click columns log-transformed —
+    y0 = log(x0+1), y1 = log(x1+1) − y0; use_cvm=False strips them."""
     x = ins["X"][0]
     if attrs.get("use_cvm", True):
-        return {"Y": [x]}
+        y0 = jnp.log(x[:, :1] + 1.0)
+        y1 = jnp.log(x[:, 1:2] + 1.0) - y0
+        return {"Y": [jnp.concatenate([y0, y1, x[:, 2:]], axis=1)]}
     return {"Y": [x[:, 2:]]}
 
 

@@ -74,7 +74,7 @@ def _square_error_cost(ctx, ins, attrs):
     return {"Out": [jnp.square(ins["X"][0] - ins["Y"][0])]}
 
 
-@register_op("huber_loss", nondiff_inputs=("Y",))
+@register_op("huber_loss")
 def _huber_loss(ctx, ins, attrs):
     x, y = ins["X"][0], ins["Y"][0]  # x=pred, y=label
     d = attrs.get("delta", 1.0)
@@ -84,7 +84,7 @@ def _huber_loss(ctx, ins, attrs):
     return {"Out": [loss], "Residual": [r]}
 
 
-@register_op("smooth_l1_loss", nondiff_inputs=("Y",))
+@register_op("smooth_l1_loss")
 def _smooth_l1(ctx, ins, attrs):
     x, y = ins["X"][0], ins["Y"][0]
     sigma = attrs.get("sigma", 1.0)
@@ -153,8 +153,12 @@ def _bpr_loss(ctx, ins, attrs):
     pos = jnp.take_along_axis(x, lbl[:, None], axis=-1)
     diff = x - pos
     n = x.shape[-1]
-    loss = jnp.sum(jnp.log1p(jnp.exp(diff)), axis=-1, keepdims=True) \
-        / (n - 1)
+    # bpr_loss_op.h:62-77 skips j == label (its log1p(exp(0)) = log 2
+    # term would otherwise bias every row's mean)
+    ele = jnp.log1p(jnp.exp(diff))
+    is_lbl = jnp.arange(n)[None, :] == lbl[:, None]
+    loss = jnp.sum(jnp.where(is_lbl, 0.0, ele), axis=-1,
+                   keepdims=True) / (n - 1)
     return {"Y": [loss]}
 
 
@@ -168,8 +172,9 @@ def _npair_loss(ctx, ins, attrs):
     tgt = tgt / jnp.sum(tgt, axis=1, keepdims=True)
     logp = jax.nn.log_softmax(sim, axis=1)
     ce = -jnp.mean(jnp.sum(tgt * logp, axis=1))
-    l2 = reg * (jnp.mean(jnp.sum(anchor * anchor, 1)) +
-                jnp.mean(jnp.sum(pos * pos, 1))) / 2
+    # layers/nn.py:16629 npair_loss: Beta = 0.25 on the l2 term
+    l2 = reg * 0.25 * (jnp.mean(jnp.sum(anchor * anchor, 1)) +
+                       jnp.mean(jnp.sum(pos * pos, 1)))
     return {"Out": [(ce + l2).reshape(())]}
 
 

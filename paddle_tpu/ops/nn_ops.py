@@ -87,7 +87,8 @@ def _conv2d_transpose(ctx, ins, attrs):
     out = _conv_transpose(x, w, attrs.get("strides", [1, 1]),
                           attrs.get("paddings", [0, 0]), 2,
                           groups=attrs.get("groups", 1),
-                          dilations=attrs.get("dilations", [1, 1]))
+                          dilations=attrs.get("dilations", [1, 1]),
+                          output_padding=attrs.get("output_padding"))
     return {"Output": [out]}
 
 
@@ -134,8 +135,42 @@ def _pool2d(ctx, ins, attrs):
 
 @register_op("max_pool2d_with_index", nondiff_outputs=("Mask",))
 def _max_pool2d_with_index(ctx, ins, attrs):
-    out = _pool2d_impl(ins["X"][0], {**attrs, "pooling_type": "max"})
-    return {"Out": [out], "Mask": [jnp.zeros(out.shape, jnp.int32)]}
+    """max pool + the winning element's flattened h·W+w index within
+    the UNPADDED input map (pooling.cc MaxPool2dWithIndexFunctor)."""
+    x = ins["X"][0]
+    n, c, h, w = x.shape
+    if attrs.get("global_pooling", False):
+        kh, kw = h, w
+        sh, sw = h, w
+        ph, pw = 0, 0
+    elif attrs.get("adaptive", False):
+        oh_, ow_ = attrs.get("ksize", [1, 1])
+        if h % oh_ or w % ow_:
+            raise NotImplementedError(
+                "adaptive max_pool2d_with_index needs divisible sizes "
+                "under static XLA shapes")
+        kh, kw = h // oh_, w // ow_
+        sh, sw = kh, kw
+        ph, pw = 0, 0
+    else:
+        kh, kw = attrs.get("ksize", [2, 2])
+        sh, sw = attrs.get("strides", [kh, kw])
+        ph, pw = attrs.get("paddings", [0, 0])
+    xp = jnp.pad(x, [(0, 0), (0, 0), (ph, ph), (pw, pw)],
+                 constant_values=-jnp.inf)
+    oh = (h + 2 * ph - kh) // sh + 1
+    ow = (w + 2 * pw - kw) // sw + 1
+    ii = ((jnp.arange(oh) * sh)[:, None, None, None]
+          + jnp.arange(kh)[None, None, :, None])     # [oh,1,kh,1]
+    jj = ((jnp.arange(ow) * sw)[None, :, None, None]
+          + jnp.arange(kw)[None, None, None, :])     # [1,ow,1,kw]
+    win = xp[:, :, ii, jj]                           # [n,c,oh,ow,kh,kw]
+    flat = win.reshape(n, c, oh, ow, kh * kw)
+    out = flat.max(-1)
+    am = flat.argmax(-1)
+    row = (jnp.arange(oh) * sh)[None, None, :, None] + am // kw - ph
+    col = (jnp.arange(ow) * sw)[None, None, None, :] + am % kw - pw
+    return {"Out": [out], "Mask": [(row * w + col).astype(jnp.int32)]}
 
 
 @register_op("batch_norm", nondiff_inputs=("Mean", "Variance"),
@@ -233,8 +268,10 @@ def _data_norm(ctx, ins, attrs):
     size = ins["BatchSize"][0]
     s = ins["BatchSum"][0]
     sq = ins["BatchSquareSum"][0]
+    # data_norm_op.cc:198-199: mean = Σx/n, scale = sqrt(n/Σx²) — the
+    # accumulators are raw sums, NOT a variance estimate
     mean = s / size
-    scale = jax.lax.rsqrt(sq / size - mean * mean + 1e-4)
+    scale = jnp.sqrt(size / sq)
     return {"Y": [(x - mean) * scale], "Means": [mean], "Scales": [scale]}
 
 
@@ -289,6 +326,40 @@ def _lrn(ctx, ins, attrs):
     return {"Out": [x / jnp.power(mid, beta)], "MidOut": [mid]}
 
 
+def _interp_src(od, d, align, mode):
+    """Source coordinates per interpolate_op.h: align_corners →
+    dst·(d−1)/(od−1); else align_mode 0 → (dst+0.5)·d/od − 0.5 (clamped
+    at 0), align_mode 1 (the DEFAULT) → dst·d/od. jax.image.resize only
+    implements the half-pixel convention, so the gathers are explicit."""
+    i = jnp.arange(od, dtype=jnp.float32)
+    if align:
+        return i * ((d - 1) / max(od - 1, 1))
+    if mode == 0:
+        return jnp.maximum((i + 0.5) * (d / od) - 0.5, 0.0)
+    return i * (d / od)
+
+
+def _linear_interp_axis(x, od, axis, align, mode):
+    d = x.shape[axis]
+    f = _interp_src(od, d, align, mode)
+    i0 = jnp.clip(jnp.floor(f).astype(jnp.int32), 0, d - 1)
+    i1 = jnp.minimum(i0 + 1, d - 1)
+    w = (f - i0).astype(x.dtype)
+    shape = [1] * x.ndim
+    shape[axis] = od
+    w = w.reshape(shape)
+    return (jnp.take(x, i0, axis=axis) * (1 - w)
+            + jnp.take(x, i1, axis=axis) * w)
+
+
+def _nearest_interp_axis(x, od, axis, align):
+    d = x.shape[axis]
+    i = jnp.arange(od, dtype=jnp.float32)
+    f = i * ((d - 1) / max(od - 1, 1)) if align else i * (d / od)
+    idx = (jnp.round(f) if align else jnp.floor(f)).astype(jnp.int32)
+    return jnp.take(x, jnp.clip(idx, 0, d - 1), axis=axis)
+
+
 def _interp(x, attrs, method):
     oh = attrs.get("out_h", -1)
     ow = attrs.get("out_w", -1)
@@ -297,27 +368,12 @@ def _interp(x, attrs, method):
         oh = int(x.shape[2] * scale)
         ow = int(x.shape[3] * scale)
     align = attrs.get("align_corners", True)
-    if align and method != "nearest":
-        return _bilinear_align_corners(x, oh, ow)
-    m = {"bilinear": "linear", "nearest": "nearest",
-         "trilinear": "linear"}[method]
-    return jax.image.resize(x, x.shape[:2] + (oh, ow), method=m)
-
-
-def _bilinear_align_corners(x, oh, ow):
-    h, w = x.shape[2], x.shape[3]
-    ys = jnp.linspace(0, h - 1, oh)
-    xs = jnp.linspace(0, w - 1, ow)
-    y0 = jnp.floor(ys).astype(jnp.int32)
-    x0 = jnp.floor(xs).astype(jnp.int32)
-    y1 = jnp.minimum(y0 + 1, h - 1)
-    x1 = jnp.minimum(x0 + 1, w - 1)
-    wy = (ys - y0)[None, None, :, None]
-    wx = (xs - x0)[None, None, None, :]
-    g = lambda yy, xx: x[:, :, yy][:, :, :, xx]  # noqa: E731
-    out = (g(y0, x0) * (1 - wy) * (1 - wx) + g(y0, x1) * (1 - wy) * wx +
-           g(y1, x0) * wy * (1 - wx) + g(y1, x1) * wy * wx)
-    return out
+    mode = attrs.get("align_mode", 1)
+    if method == "nearest":
+        x = _nearest_interp_axis(x, oh, 2, align)
+        return _nearest_interp_axis(x, ow, 3, align)
+    x = _linear_interp_axis(x, oh, 2, align, mode)
+    return _linear_interp_axis(x, ow, 3, align, mode)
 
 
 @register_op("bilinear_interp")

@@ -174,10 +174,11 @@ def _ftrl(ctx, ins, attrs):
     else:
         sigma = (jnp.power(new_sq, -lr_power) - jnp.power(sq, -lr_power)) / lr
     lin_out = lin + g - sigma * p
+    # ftrl_op.h:88-99: the shrink denominator carries TWICE l2
     if lr_power == -0.5:
-        x = l2 + jnp.sqrt(new_sq) / lr
+        x = 2.0 * l2 + jnp.sqrt(new_sq) / lr
     else:
-        x = l2 + jnp.power(new_sq, -lr_power) / lr
+        x = 2.0 * l2 + jnp.power(new_sq, -lr_power) / lr
     pre = jnp.clip(lin_out, -l1, l1) - lin_out
     p_out = pre / x
     return {"ParamOut": [p_out], "SquaredAccumOut": [new_sq],
@@ -196,9 +197,9 @@ def _lamb(ctx, ins, attrs):
     wd = attrs.get("weight_decay", 0.01)
     m1o = b1 * m1 + (1 - b1) * g
     m2o = b2 * m2 + (1 - b2) * g * g
-    mhat = m1o / (1 - b1p)
-    vhat = m2o / (1 - b2p)
-    r = mhat / (jnp.sqrt(vhat) + eps) + wd * p
+    # lamb_op.h:65-73: NO bias correction in the trust-ratio term (the
+    # beta pows round-trip through state but are unused in the update)
+    r = m1o / (jnp.sqrt(m2o) + eps) + wd * p
     pn = jnp.sqrt(jnp.sum(jnp.square(p)))
     rn = jnp.sqrt(jnp.sum(jnp.square(r)))
     trust = jnp.where((pn > 0) & (rn > 0), pn / rn, 1.0)

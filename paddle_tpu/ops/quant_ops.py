@@ -21,13 +21,22 @@ def _quant_dequant(x, scale, bits):
     return jnp.round(jnp.clip(x / s, -1.0, 1.0) * bnt) * s / bnt
 
 
+def _quantize_to_grid(x, scale, bits):
+    """fake_quantize_op.cc ClipAndFakeQuantFunctor:56-67 —
+    out = round(bin_cnt / s * clip(x, -s, s)), the INTEGER grid; the
+    paired fake_dequantize op scales back by s / bin_cnt."""
+    bnt = (1 << (bits - 1)) - 1
+    s = jnp.maximum(scale, 1e-8)
+    return jnp.round(jnp.clip(x / s, -1.0, 1.0) * bnt)
+
+
 @register_op("fake_quantize_abs_max", manual_grad=_ste_grad,
              nondiff_outputs=("OutScale",))
 def _fake_quantize_abs_max(ctx, ins, attrs):
     x = ins["X"][0]
     bits = attrs.get("bit_length", 8)
     scale = jnp.max(jnp.abs(x))
-    return {"Out": [_quant_dequant(x, scale, bits)],
+    return {"Out": [_quantize_to_grid(x, scale, bits)],
             "OutScale": [scale.reshape(1)]}
 
 
@@ -44,7 +53,7 @@ def _fake_channel_wise_quantize(ctx, ins, attrs):
     shape = [1] * x.ndim
     shape[axis] = -1
     s = scale.reshape(shape)
-    return {"Out": [_quant_dequant(x, s, bits)], "OutScale": [scale]}
+    return {"Out": [_quantize_to_grid(x, s, bits)], "OutScale": [scale]}
 
 
 @register_op("fake_quantize_moving_average_abs_max", manual_grad=_ste_grad,
@@ -71,21 +80,31 @@ def _fake_quantize_moving_avg(ctx, ins, attrs):
         outs["OutState"] = [new_state.reshape(1)]
         outs["OutAccum"] = [new_accum.reshape(1)]
         outs["OutScale"] = [scale.reshape(1)]
-    outs["Out"] = [_quant_dequant(x, scale, bits)]
+    outs["Out"] = [_quantize_to_grid(x, scale, bits)]
     return outs
 
 
-# the reference registers the _dequantize variant separately; semantics of
-# the fused quant+dequant path are identical at training time
+# the fused variant quantizes AND dequantizes in one op (reference
+# ClipAndFakeQuantDequantFunctor) — its Out stays in the float domain
 @register_op("fake_quantize_dequantize_moving_average_abs_max",
              manual_grad=_ste_grad,
              nondiff_inputs=("InScale", "InAccum", "InState"),
              nondiff_outputs=("OutScale", "OutAccum", "OutState"))
 def _fake_qdq_moving_avg(ctx, ins, attrs):
-    return _fake_quantize_moving_avg(ctx, ins, attrs)
+    outs = _fake_quantize_moving_avg(ctx, ins, attrs)
+    x = ins["X"][0]
+    bits = attrs.get("bit_length", 8)
+    scale = outs["OutScale"][0].reshape(())
+    outs["Out"] = [_quant_dequant(x, scale, bits)]
+    return outs
 
 
-@register_op("fake_dequantize_max_abs", nondiff_inputs=("Scale",))
+# STE identity: in the QAT quant→dequant pair the combined gradient is
+# identity (the reference pass updates the fp32 master weight with the
+# gradient taken at the dequantized weight), so the dequant leg must not
+# scale the cotangent by s/bin_cnt
+@register_op("fake_dequantize_max_abs", nondiff_inputs=("Scale",),
+             manual_grad=_ste_grad)
 def _fake_dequantize_max_abs(ctx, ins, attrs):
     x, scale = ins["X"][0], ins["Scale"][0]
     bnt = (1 << (attrs.get("max_range_bits", 8) - 1)) - 1
@@ -115,29 +134,31 @@ def _moving_avg_scale(ctx, ins, attrs):
              nondiff_inputs=("InScale", "Iter"))
 def _fake_quantize_range_abs_max(ctx, ins, attrs):
     """window-max scale variant (fake_quantize_op): in train mode tracks
-    the running max of |x| over a window; out = round(x / s * bnt) / bnt * s.
-    """
+    the running max of |x| over a window; Out is the INTEGER grid
+    round(clip(x, -s, s) / s * bnt) — pair with fake_dequantize_max_abs
+    to return to the float domain."""
     x = ins["X"][0]
     bits = attrs.get("bit_length", 8)
-    bnt = float((1 << (bits - 1)) - 1)
     cur = jnp.max(jnp.abs(x))
     in_scale = ins["InScale"][0].reshape(()) if "InScale" in ins else cur
     is_test = attrs.get("is_test", False) or ctx.is_test
     scale = jnp.where(is_test, in_scale, jnp.maximum(cur, in_scale))
-    s = jnp.maximum(scale, 1e-8)
-    q = jnp.round(jnp.clip(x / s, -1.0, 1.0) * bnt) / bnt * s
-    return {"Out": [q], "OutScale": [scale.reshape(1)],
+    return {"Out": [_quantize_to_grid(x, scale, bits)],
+            "OutScale": [scale.reshape(1)],
             "OutScales": [scale.reshape(1)]}
 
 
 @register_op("fake_channel_wise_dequantize_max_abs",
-             nondiff_inputs=("Scales",))
+             nondiff_inputs=("Scales",), manual_grad=_ste_grad)
 def _fake_channel_wise_dequant(ctx, ins, attrs):
     x = ins["X"][0]
     scales = ins["Scales"]
     bits = attrs.get("quant_bits", [8])
     bnt = float((1 << (bits[0] - 1)) - 1)
-    s = scales[0].reshape((-1,) + (1,) * (x.ndim - 1))
+    axis = attrs.get("quant_axis", 0)  # matches the paired quant op
+    shape = [1] * x.ndim
+    shape[axis] = -1
+    s = scales[0].reshape(shape)
     out = x.astype(jnp.float32) * s / bnt
     if len(scales) > 1:  # second-level (whole-tensor) scale
         bnt2 = float((1 << (bits[1] - 1)) - 1) if len(bits) > 1 else bnt

@@ -236,16 +236,16 @@ def _lstm(ctx, ins, attrs):
 
 @register_op("lstm_unit")
 def _lstm_unit(ctx, ins, attrs):
-    """x [B, 4D] pre-projected, gate order [i, f, c~, o] (lstm_unit_op.h
-    uses the unprojected 4-gate layout); returns C, H."""
+    """x [B, 4D] pre-projected, gate order [i, f, o, c~]
+    (lstm_unit_op.h:63-66: o = X[2D+d], g = X[3D+d]); returns C, H."""
     x = ins["X"][0]
     c_prev = ins["C_prev"][0]
     d = c_prev.shape[-1]
     forget_bias = attrs.get("forget_bias", 0.0)
     i = jax.nn.sigmoid(x[:, :d])
     f = jax.nn.sigmoid(x[:, d:2 * d] + forget_bias)
-    cand = jnp.tanh(x[:, 2 * d:3 * d])
-    o = jax.nn.sigmoid(x[:, 3 * d:])
+    o = jax.nn.sigmoid(x[:, 2 * d:3 * d])
+    cand = jnp.tanh(x[:, 3 * d:])
     c = f * c_prev + i * cand
     h = o * jnp.tanh(c)
     return {"C": [c], "H": [h]}

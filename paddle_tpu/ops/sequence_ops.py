@@ -93,15 +93,32 @@ def _sequence_reverse(ctx, ins, attrs):
 
 @register_op("sequence_pad", nondiff_inputs=("PadValue",))
 def _sequence_pad(ctx, ins, attrs):
-    # Input already padded-dense in this representation: identity + lengths.
+    # Input already padded-dense in this representation, but the op
+    # still honours padded_length > t by widening the time dim with
+    # PadValue (sequence_pad_op.cc contract; -1 keeps the current
+    # max-length width).
     x = ins["X"][0]
-    return {"Out": [x],
-            "Length": [jnp.full((x.shape[0],), x.shape[1], jnp.int64)]}
+    t = x.shape[1]
+    pl = attrs.get("padded_length", -1)
+    if pl is not None and pl > t:
+        pv = ins["PadValue"][0].reshape(-1)[0].astype(x.dtype)
+        pads = [(0, 0), (0, pl - t)] + [(0, 0)] * (x.ndim - 2)
+        x = jnp.pad(x, pads, constant_values=pv)
+    lens = ins["Lengths"][0] if "Lengths" in ins \
+        else jnp.full((x.shape[0],), t, jnp.int64)
+    return {"Out": [x], "Length": [lens]}
 
 
 @register_op("sequence_unpad", nondiff_inputs=("Length",))
 def _sequence_unpad(ctx, ins, attrs):
-    return {"Out": [ins["X"][0]]}
+    # Positions past each row's Length are zeroed so downstream
+    # reductions over the padded layout match the reference's ragged
+    # output (sequence_unpad_op.cc)
+    x = ins["X"][0]
+    lens = ins["Length"][0].reshape(-1)
+    mask = jnp.arange(x.shape[1])[None, :] < lens[:, None]
+    mask = mask.reshape(mask.shape + (1,) * (x.ndim - 2))
+    return {"Out": [jnp.where(mask, x, jnp.zeros((), x.dtype))]}
 
 
 @register_op("sequence_expand_as")

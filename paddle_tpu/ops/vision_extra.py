@@ -124,18 +124,23 @@ def _spp(ctx, ins, attrs):
 
 
 def _conv_transpose(x, w, strides, paddings, nd, groups=1,
-                    dilations=None):
+                    dilations=None, output_padding=None):
     """Transposed conv, any spatial rank (conv2d/3d_transpose_op.cc
     col2im semantics), shared by conv2d_transpose / conv3d_transpose /
     depthwise_conv2d_transpose: gradient-of-conv formulation —
     lhs-dilate by stride, flip the kernel, swap in/out channels.
-    w: [C_in, C_out/g, k...]."""
+    w: [C_in, C_out/g, k...]. output_padding (0 <= op[i] < stride[i])
+    widens the bottom/right crop of the col2im scatter buffer, realizing
+    any output_size in [natural, natural + stride) — the reference's
+    reachable range."""
     spatial = tuple(range(2, 2 + nd))
     k = w.shape[2:]
     cin, cog = w.shape[0], w.shape[1]
     dil = tuple(dilations or (1,) * nd)
+    opad = tuple(output_padding or (0,) * nd)
     padding = [(dil[i] * (k[i] - 1) - paddings[i],
-                dil[i] * (k[i] - 1) - paddings[i]) for i in range(nd)]
+                dil[i] * (k[i] - 1) - paddings[i] + opad[i])
+               for i in range(nd)]
     w_f = jnp.flip(w, axis=spatial)
     if groups == 1:
         w_t = w_f.swapaxes(0, 1)               # [C_out, C_in, k...]
@@ -162,7 +167,8 @@ def _conv3d_transpose(ctx, ins, attrs):
     out = _conv_transpose(x, w, attrs.get("strides", [1, 1, 1]),
                           attrs.get("paddings", [0, 0, 0]), 3,
                           groups=attrs.get("groups", 1),
-                          dilations=attrs.get("dilations", [1, 1, 1]))
+                          dilations=attrs.get("dilations", [1, 1, 1]),
+                          output_padding=attrs.get("output_padding"))
     return {"Output": [out]}
 
 
@@ -173,10 +179,11 @@ def _depthwise_conv2d_transpose(ctx, ins, attrs):
     # the HLO to a single batched conv instead of C separate ops)
     strides = attrs.get("strides", [1, 1])
     paddings = attrs.get("paddings", [0, 0])
+    opad = attrs.get("output_padding")
 
     def one(xc, wc):
         return _conv_transpose(xc[:, None], wc[None], strides,
-                               paddings, 2)[:, 0]
+                               paddings, 2, output_padding=opad)[:, 0]
 
     out = jax.vmap(one, in_axes=(1, 0), out_axes=1)(x, w)
     return {"Output": [out]}
@@ -239,8 +246,12 @@ def _trilinear_interp(ctx, ins, attrs):
     od = attrs.get("out_d")
     oh = attrs.get("out_h")
     ow = attrs.get("out_w")
-    n, c = x.shape[:2]
-    out = jax.image.resize(x, (n, c, od, oh, ow), method="trilinear")
+    align = attrs.get("align_corners", True)
+    mode = attrs.get("align_mode", 1)
+    from .nn_ops import _linear_interp_axis
+    out = _linear_interp_axis(x, od, 2, align, mode)
+    out = _linear_interp_axis(out, oh, 3, align, mode)
+    out = _linear_interp_axis(out, ow, 4, align, mode)
     return {"Out": [out.astype(x.dtype)]}
 
 
@@ -365,12 +376,17 @@ def _similarity_focus(ctx, ins, attrs):
 
 @register_op("var_conv_2d")
 def _var_conv_2d(ctx, ins, attrs):
-    """variable-size 2d conv (var_conv_2d_op) — padded formulation: plain
-    conv2d over the padded batch."""
+    """variable-size 2d conv (var_conv_2d_op) — padded formulation:
+    conv2d over the padded batch. The reference im2col yields
+    (dim-1)/stride+1 outputs per spatial dim (var_conv_2d_op.cc:144-158),
+    i.e. SAME padding (k-1)/2, not VALID."""
     conv = REGISTRY.get("conv2d")
+    a = {"strides": [attrs.get("StrideH", 1), attrs.get("StrideW", 1)],
+         "paddings": [(attrs.get("KernelH", 1) - 1) // 2,
+                      (attrs.get("KernelW", 1) - 1) // 2]}
     return {"Out": [conv.lower(ctx, {"Input": ins["X"],
                                      "Filter": ins["W"]},
-                               attrs)["Output"][0]]}
+                               a)["Output"][0]]}
 
 
 @register_op("tree_conv")
