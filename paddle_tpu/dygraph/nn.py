@@ -318,22 +318,31 @@ class Conv2DTranspose(Layer):
                                            is_bias=True))
 
     def forward(self, x):
+        attrs = {"strides": self._stride, "paddings": self._padding,
+                 "dilations": self._dilation, "groups": self._groups}
         if self._output_size is not None:
             fs = self.weight.shape[-2:]
-            got = [(int(x.shape[2 + i]) - 1) * self._stride[i]
-                   - 2 * self._padding[i]
-                   + self._dilation[i] * (fs[i] - 1) + 1 for i in range(2)]
+            natural = [(int(x.shape[2 + i]) - 1) * self._stride[i]
+                       - 2 * self._padding[i]
+                       + self._dilation[i] * (fs[i] - 1) + 1
+                       for i in range(2)]
             want = list(self._output_size)
-            if got != want:
+            extra = [want[i] - natural[i] for i in range(2)]
+            # reference conv2d_transpose accepts the whole reachable
+            # range [natural, natural + stride); realized by trimming
+            # less off the bottom/right of the col2im buffer
+            if any(e < 0 or e >= self._stride[i]
+                   for i, e in enumerate(extra)):
                 raise ValueError(
                     f"Conv2DTranspose: output_size {want} unreachable "
-                    f"with stride/padding/filter (natural output {got}); "
-                    f"adjust padding or filter_size")
+                    f"with stride/padding/filter (natural output "
+                    f"{natural}, reachable up to "
+                    f"{[natural[i] + self._stride[i] - 1 for i in range(2)]})")
+            if any(extra):
+                attrs["output_padding"] = extra
         out = trace_op("conv2d_transpose",
                        {"Input": [x], "Filter": [self.weight]},
-                       {"strides": self._stride, "paddings": self._padding,
-                        "dilations": self._dilation,
-                        "groups": self._groups})["Output"][0]
+                       attrs)["Output"][0]
         if self.bias is not None:
             out = trace_op("elementwise_add",
                            {"X": [out], "Y": [self.bias]},
@@ -366,22 +375,30 @@ class Conv3DTranspose(Layer):
                                            is_bias=True))
 
     def forward(self, x):
+        attrs = {"strides": self._stride, "paddings": self._padding,
+                 "dilations": self._dilation, "groups": self._groups}
         if self._output_size is not None:
             fs = self.weight.shape[-3:]
-            got = [(int(x.shape[2 + i]) - 1) * self._stride[i]
-                   - 2 * self._padding[i]
-                   + self._dilation[i] * (fs[i] - 1) + 1 for i in range(3)]
+            natural = [(int(x.shape[2 + i]) - 1) * self._stride[i]
+                       - 2 * self._padding[i]
+                       + self._dilation[i] * (fs[i] - 1) + 1
+                       for i in range(3)]
             want = list(self._output_size)
-            if got != want:
+            extra = [want[i] - natural[i] for i in range(3)]
+            # reachable range [natural, natural + stride), as in the
+            # reference conv3d_transpose
+            if any(e < 0 or e >= self._stride[i]
+                   for i, e in enumerate(extra)):
                 raise ValueError(
                     f"Conv3DTranspose: output_size {want} unreachable "
-                    f"with stride/padding/filter (natural output {got}); "
-                    f"adjust padding or filter_size")
+                    f"with stride/padding/filter (natural output "
+                    f"{natural}, reachable up to "
+                    f"{[natural[i] + self._stride[i] - 1 for i in range(3)]})")
+            if any(extra):
+                attrs["output_padding"] = extra
         out = trace_op("conv3d_transpose",
                        {"Input": [x], "Filter": [self.weight]},
-                       {"strides": self._stride, "paddings": self._padding,
-                        "dilations": self._dilation,
-                        "groups": self._groups})["Output"][0]
+                       attrs)["Output"][0]
         if self.bias is not None:
             out = trace_op("elementwise_add",
                            {"X": [out], "Y": [self.bias]},

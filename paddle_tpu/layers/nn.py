@@ -1133,11 +1133,31 @@ logical_or = _logical("logical_or")
 logical_xor = _logical("logical_xor")
 
 
+_PADDED_CONTRACT_WARNED = set()
+
+
+def _warn_padded_contract(name, detail):
+    """One-time heads-up that a layer's output is padded to a static
+    shape (XLA requires it) where the reference emits a dynamically
+    sized tensor — reference programs that relied on the dynamic size
+    now compute over pad rows unless they mask."""
+    if name not in _PADDED_CONTRACT_WARNED:
+        _PADDED_CONTRACT_WARNED.add(name)
+        import warnings
+        warnings.warn(
+            f"layers.{name}: {detail} (static-shape contract; the "
+            f"reference returns a dynamically sized tensor)",
+            UserWarning, stacklevel=3)
+
+
 def where(condition):
     """Indices of true elements (reference where_index_op). The
     reference emits a [num_true, rank] tensor; static XLA shapes make
     this [condition.size, rank] with -1 rows past the true count —
     mask on row >= 0 (or pair with the ops' padded conventions)."""
+    _warn_padded_contract(
+        "where", "output is [size, rank] with -1 rows past the true "
+        "count; mask on row >= 0")
     helper = LayerHelper("where")
     out = helper.create_variable_for_type_inference("int64", True)
     helper.append_op(type="where_index",
@@ -1154,6 +1174,9 @@ def unique(x, dtype="int32"):
     Index is emitted as the widest available int (int64, truncated to
     int32 when jax x64 mode is off); cast afterwards if the reference's
     `dtype` argument matters downstream."""
+    _warn_padded_contract(
+        "unique", "Out is sentinel-padded to x.size past the unique "
+        "count (valid count = max(Index) + 1)")
     helper = LayerHelper("unique")
     out = helper.create_variable_for_type_inference(x.dtype, True)
     index = helper.create_variable_for_type_inference("int64", True)

@@ -311,18 +311,22 @@ def _py_func(ctx, ins, attrs):
     dtypes = [as_np_dtype(d) for d in attrs["out_dtypes"]]
 
     def concretize(shape):
-        # declared var shapes carry -1 dynamic dims; resolve them from
-        # the first runtime input (the batch dim in practice)
+        # declared var shapes carry -1 dynamic dims; ONLY the leading
+        # (batch) dim can be resolved from the runtime input — an inner
+        # -1 has no positional relationship to ins['X'][0], so guessing
+        # one risks a silently mis-shaped callback output
         out = []
         for i, s in enumerate(shape):
             if s >= 0:
                 out.append(int(s))
-            elif xs and i < len(xs[0].shape):
-                out.append(int(xs[0].shape[i]))
+            elif i == 0 and xs:
+                out.append(int(xs[0].shape[0]))
             else:
                 raise ValueError(
                     f"py_func: cannot resolve dynamic dim {i} of "
-                    f"declared output shape {shape}")
+                    f"declared output shape {shape}; only the leading "
+                    f"batch dim is inferred from the input — declare "
+                    f"inner dims statically")
         return tuple(out)
 
     structs = tuple(jax.ShapeDtypeStruct(concretize(s), d)
@@ -331,8 +335,18 @@ def _py_func(ctx, ins, attrs):
     def cb(*arrs):
         out = fn(*[np.asarray(a) for a in arrs])
         out = out if isinstance(out, (list, tuple)) else [out]
-        return tuple(np.asarray(o).astype(d)
-                     for o, d in zip(out, dtypes))
+        if len(out) != len(dtypes):
+            raise ValueError(
+                f"py_func: callback returned {len(out)} outputs but "
+                f"the op declared {len(dtypes)}")
+        res = tuple(np.asarray(o).astype(d)
+                    for o, d in zip(out, dtypes))
+        for k, (o, st) in enumerate(zip(res, structs)):
+            if tuple(o.shape) != tuple(st.shape):
+                raise ValueError(
+                    f"py_func: callback output {k} has shape "
+                    f"{tuple(o.shape)} but the op declared {st.shape}")
+        return res
 
     bid = attrs.get("backward_func_id", -1)
     if bid < 0:

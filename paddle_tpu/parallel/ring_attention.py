@@ -41,10 +41,13 @@ def _masked_scores(q, k_blk, sm_scale, q_off, k_off, causal):
     return s
 
 
-def _block_attn(q, k, v, sm_scale, q_off, k_off, causal):
+def _block_attn(q, k, v, sm_scale, q_off, k_off, causal, live=None):
     """Attention of local q against one k/v block, returning (o, lse).
-    q: [b, h, tq, d]; k/v: [b, h, tk, d]."""
+    q: [b, h, tq, d]; k/v: [b, h, tk, d]. `live` (optional [tk] bool)
+    masks padded keys out of the block softmax."""
     s = _masked_scores(q, k, sm_scale, q_off, k_off, causal)
+    if live is not None:
+        s = jnp.where(live[None, None, None, :], s, NEG_INF)
     m = jnp.max(s, axis=-1, keepdims=True)
     m = jnp.maximum(m, NEG_INF)  # avoid -inf - -inf
     p = jnp.exp(s - m)
@@ -53,6 +56,16 @@ def _block_attn(q, k, v, sm_scale, q_off, k_off, causal):
                    preferred_element_type=jnp.float32)
     lse = m + jnp.log(l)
     return o, lse  # o normalised within the block; merge by lse weights
+
+
+def _lse_merge(o, lse, o_i, lse_i):
+    """Online softmax merge over the union of seen keys — the single
+    home for this math (used by the ring forward and the ulysses
+    blockwise path; the ring backward recomputes from saved lse)."""
+    new_lse = jnp.logaddexp(lse, lse_i)
+    o = (o * jnp.exp(lse - new_lse).astype(o.dtype)
+         + o_i * jnp.exp(lse_i - new_lse).astype(o.dtype))
+    return o, new_lse
 
 
 def _ring_fwd_loop(q, k, v, axis_name, causal, sm_scale):
@@ -69,10 +82,7 @@ def _ring_fwd_loop(q, k, v, axis_name, causal, sm_scale):
         k_off = src * t_local
         o_i, lse_i = _block_attn(q, k_blk, v_blk, sm_scale, q_off, k_off,
                                  causal)
-        # online merge: softmax over the union of seen keys
-        new_lse = jnp.logaddexp(lse, lse_i)
-        o = (o * jnp.exp(lse - new_lse).astype(o.dtype)
-             + o_i * jnp.exp(lse_i - new_lse).astype(o.dtype))
+        o, new_lse = _lse_merge(o, lse, o_i, lse_i)
         kv = jax.lax.ppermute((k_blk, v_blk), axis_name, perm)
         return o, new_lse, kv
 

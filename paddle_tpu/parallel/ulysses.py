@@ -49,15 +49,52 @@ def ulysses_attention(q, k, v, axis_name="sp", causal=False,
                                   concat_axis=1, tiled=True)
 
     qf, kf, vf = scatter_heads(q), scatter_heads(k), scatter_heads(v)
-    # exact attention over the full sequence for the local head group;
-    # score+mask math shared with the ring scheme (positions are global
-    # after the scatter, so offsets are 0)
-    from .ring_attention import _masked_scores
-    s = _masked_scores(qf, kf, sm_scale, 0, 0, causal)
-    p = jax.nn.softmax(s, axis=-1)
-    o = jnp.einsum("bhqk,bhkd->bhqd", p.astype(vf.dtype), vf,
-                   preferred_element_type=jnp.float32).astype(q.dtype)
+    # exact attention over the full sequence for the local head group,
+    # computed blockwise over K/V (online log-sum-exp merge) so per-
+    # device memory is O(T·block), not the O(T^2) score matrix — dense
+    # softmax would OOM at exactly the long-context lengths sequence
+    # parallelism targets. Math shared with the ring scheme (positions
+    # are global after the scatter, so offsets are 0).
+    o = _blockwise_full_attn(qf, kf, vf, sm_scale, causal)
     return gather_heads(o)
+
+
+def _blockwise_full_attn(q, k, v, sm_scale, causal, block_k=512):
+    """Exact attention of q against the FULL k/v, scanning k/v in
+    blocks with the same online-lse merge as the ring forward
+    (ring_attention._block_attn). q/k/v: [b, h, T, d]."""
+    from .ring_attention import NEG_INF, _block_attn
+
+    t = k.shape[2]
+    if t <= block_k:
+        o, _ = _block_attn(q, k, v, sm_scale, 0, 0, causal)
+        return o.astype(q.dtype)
+    nb = -(-t // block_k)
+    pad = nb * block_k - t
+    if pad:
+        # padded keys are masked out of the merge via -inf scores
+        kp = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        vp = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    else:
+        kp, vp = k, v
+
+    from .ring_attention import _lse_merge
+
+    def step(i, carry):
+        o, lse = carry
+        k_blk = jax.lax.dynamic_slice_in_dim(kp, i * block_k, block_k, 2)
+        v_blk = jax.lax.dynamic_slice_in_dim(vp, i * block_k, block_k, 2)
+        # mask padded keys out of the final block's softmax
+        live = (i * block_k + jnp.arange(block_k) < t) if pad else None
+        o_i, lse_i = _block_attn(q, k_blk, v_blk, sm_scale, 0,
+                                 i * block_k, causal, live=live)
+        return _lse_merge(o, lse, o_i, lse_i)
+
+    b, h, tq, d = q.shape
+    o0 = jnp.zeros((b, h, tq, d), jnp.float32)
+    lse0 = jnp.full((b, h, tq, 1), NEG_INF, jnp.float32)
+    o, _ = jax.lax.fori_loop(0, nb, step, (o0, lse0))
+    return o.astype(q.dtype)
 
 
 def ulysses_attention_sharded(q, k, v, mesh, seq_axis, causal=False,

@@ -8,10 +8,11 @@ import paddle_tpu as fluid
 from paddle_tpu import layers
 
 
-def _naive_attn(q, k, v, causal):
+def _naive_attn(q, k, v, causal, sm_scale=None):
     d = q.shape[-1]
     t = q.shape[2]
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / jnp.sqrt(d)
+    scale = 1.0 / jnp.sqrt(d) if sm_scale is None else sm_scale
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
     if causal:
         mask = jnp.tril(jnp.ones((t, t), bool))
         s = jnp.where(mask, s, -1e30)
@@ -163,6 +164,27 @@ def test_flash_attention_kernel_path_t256():
         gkr = jax.grad(lambda k_: reference_attention(
             q, k_, v, causal=causal).sum())(k)
         np.testing.assert_allclose(gk, gkr, atol=3e-4)
+
+
+def test_ulysses_blockwise_full_attn():
+    """The O(T·block)-memory blockwise path (used for long sequences so
+    Ulysses never materializes the T^2 score matrix) matches dense
+    attention, including the ragged final block (pad path) and its
+    gradients."""
+    from paddle_tpu.parallel.ulysses import _blockwise_full_attn
+    rng = np.random.RandomState(5)
+    mk = lambda t: jnp.asarray(rng.randn(1, 2, t, 8), jnp.float32)  # noqa
+    for t, blk in ((32, 8), (20, 8)):  # exact split + ragged tail
+        q, k, v = mk(t), mk(t), mk(t)
+        for causal in (False, True):
+            o = _blockwise_full_attn(q, k, v, 0.35, causal, block_k=blk)
+            ref = _naive_attn(q, k, v, causal, sm_scale=0.35)
+            np.testing.assert_allclose(o, ref, atol=2e-5)
+            gb = jax.grad(lambda q_: (_blockwise_full_attn(
+                q_, k, v, 0.35, causal, block_k=blk) ** 2).sum())(q)
+            gr = jax.grad(lambda q_: (_naive_attn(
+                q_, k, v, causal, sm_scale=0.35) ** 2).sum())(q)
+            np.testing.assert_allclose(gb, gr, atol=3e-4)
 
 
 def test_ulysses_attention_matches_naive():

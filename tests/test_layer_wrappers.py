@@ -193,6 +193,22 @@ def test_dygraph_new_layers():
         x2 = dg.to_variable(rng.randn(1, 2, 5, 5).astype(np.float32))
         assert ct(x2).numpy().shape == (1, 3, 5, 5)
 
+        # output_size anywhere in [natural, natural + stride) is valid
+        # (reference conv_transpose semantics); natural here is
+        # (5-1)*2 + 3 = 11
+        for osz, ok in ((11, True), (12, True), (13, False), (10, False)):
+            ct2 = dg.Conv2DTranspose(num_channels=2, num_filters=3,
+                                     filter_size=3, stride=2,
+                                     output_size=[osz, osz])
+            if ok:
+                assert ct2(x2).numpy().shape == (1, 3, osz, osz)
+            else:
+                try:
+                    ct2(x2)
+                    raise AssertionError("output_size %d accepted" % osz)
+                except ValueError:
+                    pass
+
         gu = dg.GRUUnit(size=12)
         inp = dg.to_variable(rng.randn(2, 12).astype(np.float32))
         hid = dg.to_variable(rng.randn(2, 4).astype(np.float32))
