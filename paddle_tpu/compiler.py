@@ -153,7 +153,35 @@ class CompiledProgram:
             out_state = {n: shard_of(n) for n in state_out_names}
         else:
             out_state = None
-        return jax.jit(step_fn, donate_argnums=(0,),
-                       in_shardings=(state_shard, feed_shard, repl),
-                       out_shardings=(None, out_state) if out_state
-                       else None)
+        jitted = jax.jit(step_fn, donate_argnums=(0,),
+                         in_shardings=(state_shard, feed_shard, repl),
+                         out_shardings=(None, out_state) if out_state
+                         else None)
+        if jax.process_count() <= 1:
+            return jitted
+
+        # Multi-process (multi-host) mesh: jit cannot shard raw numpy
+        # feeds, and startup-produced params live on one process-local
+        # device. Both carry the SAME value on every process (seeded
+        # startup; the trainer feeds the global batch), so lift them to
+        # global jax.Arrays explicitly. Step outputs are already global
+        # and pass through untouched.
+        global_devs = set(np.asarray(mesh.devices).flat)
+
+        def _globalize(val, sharding):
+            if isinstance(val, jax.Array):
+                if val.sharding.device_set == global_devs:
+                    return val
+                val = np.asarray(val)  # process-local -> host
+            arr = np.asarray(val)
+            return jax.make_array_from_callback(
+                arr.shape, sharding, lambda idx: arr[idx])
+
+        def run_global(state, feeds, step_idx):
+            state = {n: _globalize(v, state_shard.get(n, repl))
+                     for n, v in state.items()}
+            feeds = {n: _globalize(v, feed_shard.get(n, repl))
+                     for n, v in feeds.items()}
+            return jitted(state, feeds, step_idx)
+
+        return run_global

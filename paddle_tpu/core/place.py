@@ -28,7 +28,12 @@ class Place:
         return f"{type(self).__name__}({self.device_id})"
 
     def jax_device(self):
-        devs = jax.devices(self.backend()) if self.backend() else jax.devices()
+        # local_devices, not devices: in a multi-process (multi-host)
+        # job jax.devices() lists every process's chips, and pinning
+        # the single-device executor to another process's device makes
+        # its outputs unfetchable from this one
+        devs = jax.local_devices(backend=self.backend()) \
+            if self.backend() else jax.local_devices()
         return devs[self.device_id]
 
     def backend(self):

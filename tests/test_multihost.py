@@ -1,0 +1,158 @@
+"""2-process multi-host bootstrap rehearsal.
+
+Reference analogue: test_dist_base.py:533-770 — multi-process localhost
+training with loss-equivalence against single-process. Here each worker
+process carries 4 virtual CPU devices; `init_parallel_env()` performs
+the REAL `jax.distributed.initialize` coordinator handshake (trainer 0's
+endpoint, the PADDLE_TRAINER_* env contract), then:
+
+1. a global-mesh allreduce across both processes' devices, and
+2. three dp train steps of the shared MLP through Executor +
+   CompiledProgram.with_distributed, whose losses must match a
+   single-process run of the same seeded program.
+
+The single-process 8-device mesh in test_parallel.py covers the SPMD
+math; this covers the process-bootstrap path those tests bypass.
+"""
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_MLP_SOURCE = '''
+def build_and_run(fluid, layers, mesh=None, steps=3):
+    import numpy as np
+    rng = np.random.RandomState(0)
+    xs = rng.randn(32, 16).astype(np.float32)
+    ys = rng.randn(32, 1).astype(np.float32)
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 7
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[16], dtype="float32")
+        label = layers.data("y", shape=[1], dtype="float32")
+        h = layers.fc(x, size=32, act="relu")
+        pred = layers.fc(h, size=1)
+        loss = layers.mean(layers.square_error_cost(pred, label))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        prog = main
+        if mesh is not None:
+            prog = fluid.CompiledProgram(main).with_distributed(mesh)
+        vals = []
+        for _ in range(steps):
+            lv, = exe.run(prog, feed={"x": xs, "y": ys},
+                          fetch_list=[loss])
+            vals.append(float(np.asarray(lv)))
+    return vals
+'''
+
+_WORKER = f'''
+import os, sys, json
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+sys.path.insert(0, {ROOT!r})
+import paddle_tpu as fluid
+import paddle_tpu.distributed as dist
+from paddle_tpu import layers
+
+dist.init_parallel_env()   # PADDLE_TRAINER_* -> jax.distributed.initialize
+assert jax.process_count() == 2, jax.process_count()
+assert jax.device_count() == 8, jax.device_count()
+assert dist.parallel_env_world_size() == 2
+rank = dist.parallel_env_rank()
+
+# 1. global-mesh allreduce: every device contributes its global index
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+mesh = dist.global_mesh({{"dp": -1}})
+sh = NamedSharding(mesh, P("dp"))
+local = np.arange(4, dtype=np.float32) + 4 * jax.process_index()
+g = jax.make_array_from_process_local_data(sh, local, (8,))
+total = jax.jit(jnp.sum, out_shardings=NamedSharding(mesh, P()))(g)
+total = float(np.asarray(total))
+assert total == 28.0, f"allreduce over the global mesh got {{total}}"
+
+# 2. dp train steps through the framework over the 2-process mesh
+{_MLP_SOURCE}
+vals = build_and_run(fluid, layers, mesh=mesh)
+print("LOSSES", json.dumps(vals))
+'''
+
+_SINGLE = f'''
+import os, sys, json
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, {ROOT!r})
+import paddle_tpu as fluid
+from paddle_tpu import layers
+{_MLP_SOURCE}
+vals = build_and_run(fluid, layers, mesh=None)
+print("LOSSES", json.dumps(vals))
+'''
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _run_worker(code, env, timeout=420):
+    e = dict(os.environ)
+    e.pop("XLA_FLAGS", None)
+    # drop the axon sitecustomize so workers start on a clean backend
+    e["PYTHONPATH"] = ROOT
+    e.update(env)
+    return subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True, timeout=timeout,
+                          env=e)
+
+
+def _losses(proc, who):
+    assert proc.returncode == 0, \
+        f"{who} failed rc={proc.returncode}\n{proc.stdout}\n{proc.stderr}"
+    for line in proc.stdout.splitlines():
+        if line.startswith("LOSSES "):
+            return json.loads(line[len("LOSSES "):])
+    raise AssertionError(f"{who}: no LOSSES line\n{proc.stdout}")
+
+
+def test_two_process_bootstrap_and_loss_parity():
+    import concurrent.futures as cf
+
+    port = _free_port()
+    eps = f"127.0.0.1:{port},127.0.0.1:{port + 1}"
+    with cf.ThreadPoolExecutor(2) as pool:
+        futs = [
+            pool.submit(_run_worker, _WORKER,
+                        {"PADDLE_TRAINERS_NUM": "2",
+                         "PADDLE_TRAINER_ID": str(i),
+                         "PADDLE_TRAINER_ENDPOINTS": eps})
+            for i in range(2)
+        ]
+        procs = [f.result() for f in futs]
+    l0 = _losses(procs[0], "worker 0")
+    l1 = _losses(procs[1], "worker 1")
+    np.testing.assert_allclose(l0, l1, rtol=1e-6,
+                               err_msg="ranks disagree on the loss")
+
+    single = _losses(_run_worker(_SINGLE, {}), "single-process")
+    np.testing.assert_allclose(
+        l0, single, rtol=1e-4, atol=1e-5,
+        err_msg="2-process dp loss must match single-process")
+    assert single[0] > single[-1], "loss must decrease over steps"
