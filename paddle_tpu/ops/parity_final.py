@@ -383,10 +383,13 @@ def _generate_proposal_labels(ctx, ins, attrs):
              nondiff_outputs=("MaskRois", "RoiHasMaskInt32", "MaskInt32"))
 def _generate_mask_labels(ctx, ins, attrs):
     """mask targets for fg rois — rasterized gt polygons are assumed
-    pre-binarized into GtSegms [G, M, M]; each roi takes the mask of its
-    MATCHED gt instance (mask_util + IoU argmax over same-class gts,
-    reference generate_mask_labels_op.cc), approximated by the full gt
-    mask (deterministic simplification: no per-roi crop)."""
+    pre-binarized into GtSegms [G, M, M] over the image grid; each roi
+    takes the mask of its MATCHED gt instance (IoU argmax over
+    same-class gts, generate_mask_labels_op.cc:199-225), CROPPED to the
+    roi box and resampled at `resolution` (mask_util.cc
+    Polys2MaskWrtBox:186-211), then class-expanded to
+    [R, num_classes·res²] with -1 ignore labels outside the roi's class
+    slice (ExpandMaskTarget, generate_mask_labels_op.cc:93-115)."""
     rois = ins["Rois"][0]
     labels = ins["LabelsInt32"][0].reshape(-1).astype(jnp.int32)
     segms = ins["GtSegms"][0]
@@ -427,12 +430,33 @@ def _generate_mask_labels(ctx, ins, attrs):
         gt_img = _index_from_counts(gnums, g)
         ious = jnp.where(roi_img[:, None] == gt_img[None, :], ious, -2.0)
     pick = jnp.argmax(ious, axis=1).astype(jnp.int32)
-    masks = jnp.take(segms, pick, axis=0)
-    if masks.shape[-1] != res:
-        masks = jax.image.resize(masks, (n, res, res), "nearest")
+    masks = jnp.take(segms, pick, axis=0)  # [n, M, M], image grid
+    # per-roi crop + resize: target pixel (i, j) samples the image
+    # point box_origin + (idx+0.5)·extent/res (the pre-binarized-mask
+    # analogue of Polys2MaskWrtBox's coordinate shift/scale), nearest
+    # on the gt mask's image-covering grid
+    ihv = jnp.broadcast_to(jnp.asarray(ih, jnp.float32), (n,))
+    iwv = jnp.broadcast_to(jnp.asarray(iw, jnp.float32), (n,))
+    bx1, by1 = rois[:, 0], rois[:, 1]
+    bw = jnp.maximum(rois[:, 2] - bx1, 1.0)
+    bh = jnp.maximum(rois[:, 3] - by1, 1.0)
+    ri = jnp.arange(res, dtype=jnp.float32)
+    sx = bx1[:, None] + (ri[None] + 0.5) * bw[:, None] / res  # [n, res]
+    sy = by1[:, None] + (ri[None] + 0.5) * bh[:, None] / res
+    col = jnp.clip((sx / iwv[:, None] * m).astype(jnp.int32), 0, m - 1)
+    row = jnp.clip((sy / ihv[:, None] * m).astype(jnp.int32), 0, m - 1)
+    cropped = jax.vmap(
+        lambda mk, r, c: mk[r[:, None], c[None, :]])(masks, row, col)
+    flat = (cropped > 0).astype(jnp.int32).reshape(n, res * res)
+    # class-expanded int targets: -1 (ignore) everywhere except the
+    # fg roi's own class slice
+    m2 = res * res
+    tgt = jnp.full((n, num_cls * m2), -1, jnp.int32)
+    cols = labels[:, None] * m2 + jnp.arange(m2)[None, :]
+    vals = jnp.where((labels > 0)[:, None], flat, -1)
+    tgt = jax.vmap(lambda t, c, v: t.at[c].set(v))(tgt, cols, vals)
     return {"MaskRois": [rois], "RoiHasMaskInt32": [has.reshape(-1, 1)],
-            "MaskInt32": [jnp.tile(masks.reshape(n, -1),
-                                   (1, 1)).astype(jnp.int32)]}
+            "MaskInt32": [tgt]}
 
 
 @register_op("roi_perspective_transform",

@@ -49,8 +49,13 @@ def test_generate_mask_labels_matches_instance_not_class():
                "GtSegms": [segms],
                "ImInfo": [np.array([[16.0, 16.0, 1.0]], np.float32)]},
               {"resolution": m, "num_classes": 2})
-    mask = np.asarray(out["MaskInt32"][0]).reshape(m, m)
-    assert np.array_equal(mask, seg0.astype(np.int32)), \
+    # class-expanded targets [R, num_classes*res^2]: class-1 slice holds
+    # the roi-cropped mask, class-0 slice stays -1 (ignore)
+    tgt = np.asarray(out["MaskInt32"][0]).reshape(2, m, m)
+    assert np.all(tgt[0] == -1), "non-matched class slice must be ignore"
+    # the roi covers exactly instance 0's region (left strip), so its
+    # crop of seg0 is all ones; instance-1's mask would crop to zeros
+    assert np.all(tgt[1] == 1), \
         "roi over the left instance must take instance 0's mask"
 
 
@@ -141,8 +146,16 @@ def test_generate_mask_labels_partitions_gts_by_image():
                "ImInfo": [np.array([[16.0, 16.0, 1.0],
                                     [16.0, 16.0, 1.0]], np.float32)]},
               {"resolution": m, "num_classes": 2})
-    mask = np.asarray(out["MaskInt32"][0]).reshape(m, m)
-    assert np.array_equal(mask, seg_marked.astype(np.int32)), \
+    tgt = np.asarray(out["MaskInt32"][0]).reshape(2, m, m)
+    assert np.all(tgt[0] == -1), "non-matched class slice must be ignore"
+    # the roi covers instance 1's region; its crop is all ones except
+    # the samples hitting the marked corner cell (the gt grid is 2x2
+    # image pixels per cell; target cols 0-1 of row 0 both sample it) —
+    # instance 0's crop would be all ones, so the zeros prove image
+    # partitioning
+    expect = np.ones((m, m), np.int32)
+    expect[0, 0] = expect[0, 1] = 0
+    assert np.array_equal(tgt[1], expect), \
         "roi on image 1 must match image 1's gt instance"
 
 
