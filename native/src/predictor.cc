@@ -58,11 +58,20 @@ int ptn_predictor_run(void* handle, int n, const char** names,
                       const void** bufs, const uint64_t* nbytes,
                       const char** dtypes, const int64_t* shapes,
                       const int* ranks) {
+  if (!handle || n < 0) {
+    ptn_embed::last_error() = "run: NULL handle or negative feed count";
+    return -1;
+  }
   Gil gil;
   Predictor* p = static_cast<Predictor*>(handle);
   PyObject* feed = PyList_New(n);
   const int64_t* sp = shapes;
   for (int i = 0; i < n; ++i) {
+    if (ranks[i] < 0 || !bufs[i] || !names[i] || !dtypes[i]) {
+      ptn_embed::last_error() = "run: malformed feed entry";
+      Py_DECREF(feed);
+      return -1;
+    }
     PyObject* shape = PyTuple_New(ranks[i]);
     for (int d = 0; d < ranks[i]; ++d)
       PyTuple_SET_ITEM(shape, d, PyLong_FromLongLong(sp[d]));
@@ -96,6 +105,10 @@ int ptn_predictor_run(void* handle, int n, const char** names,
 int ptn_predictor_output_meta(void* handle, int i, char* dtype_buf,
                               int dtype_cap, int* rank_out,
                               int64_t* dims_out, uint64_t* nbytes_out) {
+  if (!handle) {
+    ptn_embed::last_error() = "output_meta: NULL handle";
+    return -1;
+  }
   Gil gil;
   Predictor* p = static_cast<Predictor*>(handle);
   PyObject* r = PyObject_CallMethod(p->obj, "output_meta", "i", i);
@@ -130,6 +143,10 @@ int ptn_predictor_output_meta(void* handle, int i, char* dtype_buf,
 // -1.
 int64_t ptn_predictor_output_data(void* handle, int i, void* dst,
                                   uint64_t cap) {
+  if (!handle || !dst) {
+    ptn_embed::last_error() = "output_data: NULL handle or dst";
+    return -1;
+  }
   Gil gil;
   Predictor* p = static_cast<Predictor*>(handle);
   PyObject* r = PyObject_CallMethod(p->obj, "output_bytes", "i", i);

@@ -97,6 +97,34 @@ with fluid.program_guard(main, startup), fluid.scope_guard(scope):
                                   out_bytes) == (int64_t)out_bytes);
   for (size_t i = 0; i < out.size(); ++i) CHECK(out[i] == out2[i]);
 
+  // malformed input must surface as error codes, never crash the
+  // embedded interpreter:
+  // 1. NULL handle
+  CHECK(ptn_predictor_run(nullptr, 1, names, bufs, nbytes, dtypes,
+                          shapes, ranks) == -1);
+  CHECK(std::strlen(ptn_predictor_last_error()) > 0);
+  // 2. wrong feature width (8 -> 5): byte count and shape disagree
+  //    with the saved program's declared input
+  const int64_t bad_shapes[] = {6, 5};
+  const uint64_t bad_nbytes[] = {6 * 5 * sizeof(float)};
+  CHECK(ptn_predictor_run(pred, 1, names, bufs, bad_nbytes, dtypes,
+                          bad_shapes, ranks) == -1);
+  // 3. byte buffer inconsistent with the declared shape
+  const uint64_t short_nbytes[] = {7};
+  CHECK(ptn_predictor_run(pred, 1, names, bufs, short_nbytes, dtypes,
+                          shapes, ranks) == -1);
+  // 4. unknown feed name
+  const char* bad_names[] = {"not_a_var"};
+  CHECK(ptn_predictor_run(pred, 1, bad_names, bufs, nbytes, dtypes,
+                          shapes, ranks) == -1);
+  // 5. negative rank in the feed meta
+  const int bad_ranks[] = {-1};
+  CHECK(ptn_predictor_run(pred, 1, names, bufs, nbytes, dtypes, shapes,
+                          bad_ranks) == -1);
+  // ...and the predictor still works after every rejected call
+  CHECK(ptn_predictor_run(pred, 1, names, bufs, nbytes, dtypes, shapes,
+                          ranks) == 1);
+
   ptn_predictor_destroy(pred);
   std::printf("predictor_test OK\n");
   return 0;

@@ -68,11 +68,21 @@ double ptn_trainer_run_step(void* handle, int n, const char** names,
                             const void** bufs, const uint64_t* nbytes,
                             const char** dtypes, const int64_t* shapes,
                             const int* ranks) {
+  if (!handle || n < 0) {
+    ptn_embed::last_error() =
+        "run_step: NULL handle or negative feed count";
+    return NAN;
+  }
   Gil gil;
   Trainer* t = static_cast<Trainer*>(handle);
   PyObject* feed = PyList_New(n);
   const int64_t* sp = shapes;
   for (int i = 0; i < n; ++i) {
+    if (ranks[i] < 0 || !bufs[i] || !names[i] || !dtypes[i]) {
+      ptn_embed::last_error() = "run_step: malformed feed entry";
+      Py_DECREF(feed);
+      return NAN;
+    }
     PyObject* shape = PyTuple_New(ranks[i]);
     for (int d = 0; d < ranks[i]; ++d)
       PyTuple_SET_ITEM(shape, d, PyLong_FromLongLong(sp[d]));
@@ -101,6 +111,10 @@ double ptn_trainer_run_step(void* handle, int n, const char** names,
 
 // Persist the trainer's current state back into the model dir.
 int ptn_trainer_save(void* handle, const char* model_dir) {
+  if (!handle) {
+    ptn_embed::last_error() = "save: NULL handle";
+    return -1;
+  }
   Gil gil;
   Trainer* t = static_cast<Trainer*>(handle);
   PyObject* r = PyObject_CallMethod(t->obj, "save", "s", model_dir);
