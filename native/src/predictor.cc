@@ -58,8 +58,11 @@ int ptn_predictor_run(void* handle, int n, const char** names,
                       const void** bufs, const uint64_t* nbytes,
                       const char** dtypes, const int64_t* shapes,
                       const int* ranks) {
-  if (!handle || n < 0) {
-    ptn_embed::last_error() = "run: NULL handle or negative feed count";
+  if (!handle || n < 0 ||
+      (n > 0 && (!names || !bufs || !nbytes || !dtypes || !shapes ||
+                 !ranks))) {
+    ptn_embed::last_error() =
+        "run: NULL handle/feed arrays or negative feed count";
     return -1;
   }
   Gil gil;

@@ -68,9 +68,11 @@ double ptn_trainer_run_step(void* handle, int n, const char** names,
                             const void** bufs, const uint64_t* nbytes,
                             const char** dtypes, const int64_t* shapes,
                             const int* ranks) {
-  if (!handle || n < 0) {
+  if (!handle || n < 0 ||
+      (n > 0 && (!names || !bufs || !nbytes || !dtypes || !shapes ||
+                 !ranks))) {
     ptn_embed::last_error() =
-        "run_step: NULL handle or negative feed count";
+        "run_step: NULL handle/feed arrays or negative feed count";
     return NAN;
   }
   Gil gil;

@@ -134,14 +134,21 @@ def _yolo_box(ctx, ins, attrs):
     bw = jnp.exp(x[:, :, 2]) * an[None, :, 0, None, None] / input_w
     bh = jnp.exp(x[:, :, 3]) * an[None, :, 1, None, None] / input_h
     conf = jnp.reciprocal(1 + jnp.exp(-x[:, :, 4]))
-    probs = jnp.reciprocal(1 + jnp.exp(-x[:, :, 5:])) * conf[:, :, None]
-    probs = jnp.where(probs > conf_thresh, probs, 0.0)
+    # yolo_box_op.h:117-126: the WHOLE cell is skipped when conf <
+    # conf_thresh (box and scores zero), not per-class prob gating
+    live = (conf >= conf_thresh).astype(jnp.float32)
+    probs = (jnp.reciprocal(1 + jnp.exp(-x[:, :, 5:]))
+             * (conf * live)[:, :, None])
     img_h = img_size[:, 0].astype(jnp.float32)[:, None]
     img_w = img_size[:, 1].astype(jnp.float32)[:, None]
+    lv = live.reshape(n, -1)
+    # CalcDetectionBox clamps to [0, img-1]
     boxes = jnp.stack([
-        (bx - bw / 2).reshape(n, -1) * img_w,
-        (by - bh / 2).reshape(n, -1) * img_h,
-        (bx + bw / 2).reshape(n, -1) * img_w,
-        (by + bh / 2).reshape(n, -1) * img_h], -1)
+        jnp.maximum((bx - bw / 2).reshape(n, -1) * img_w, 0.0) * lv,
+        jnp.maximum((by - bh / 2).reshape(n, -1) * img_h, 0.0) * lv,
+        jnp.minimum((bx + bw / 2).reshape(n, -1) * img_w,
+                    img_w - 1) * lv,
+        jnp.minimum((by + bh / 2).reshape(n, -1) * img_h,
+                    img_h - 1) * lv], -1)
     scores = probs.transpose(0, 1, 3, 4, 2).reshape(n, -1, cnum)
     return {"Boxes": [boxes], "Scores": [scores]}

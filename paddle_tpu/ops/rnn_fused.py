@@ -135,33 +135,13 @@ def _cudnn_gru(ctx, ins, attrs):
 
 @register_op("lstmp", nondiff_inputs=())
 def _lstmp(ctx, ins, attrs):
-    """LSTM with projection (lstmp_op): standard LSTM whose output is
-    projected h @ P each step. Input [B, T, 4h] (pre-projected x, like
-    the reference's dynamic_lstmp front end)."""
-    x = ins["Input"][0]
-    w = ins["Weight"][0]       # [p, 4h] recurrent weight on projected h
-    proj = ins["ProjWeight"][0]  # [h, p]
-    bias = ins["Bias"][0].reshape(-1)
-    h4 = w.shape[1]
-    h = h4 // 4
-    p = proj.shape[1]
-    b, t = x.shape[0], x.shape[1]
-
-    def step(carry, xt):
-        rp, cp = carry
-        g = xt + rp @ w + bias[:h4]
-        i, f, gg, o = jnp.split(g, 4, axis=-1)
-        c = _sigmoid(f) * cp + _sigmoid(i) * jnp.tanh(gg)
-        hn = _sigmoid(o) * jnp.tanh(c)
-        r = hn @ proj
-        return (r, c), (r, hn)
-
-    init = (jnp.zeros((b, p), x.dtype), jnp.zeros((b, h), x.dtype))
-    (_, _), (rs, hs) = jax.lax.scan(step, init,
-                                    jnp.swapaxes(x, 0, 1))
-    return {"Projection": [jnp.swapaxes(rs, 0, 1)],
-            "Cell": [jnp.zeros((b, t, h), x.dtype)],
-            "Hidden": [jnp.swapaxes(hs, 0, 1)]}
+    """LSTM with projection (lstmp_op): delegates to the lstm lowering,
+    whose ProjWeight path already implements the projected recurrent
+    state with the reference gate order [c~, i, f, o]
+    (math/detail/lstm_cpu_kernel.h:51-54)."""
+    outs = REGISTRY.get("lstm").lower(ctx, ins, attrs)
+    return {"Projection": outs["Hidden"], "Hidden": outs["Hidden"],
+            "Cell": outs["Cell"]}
 
 
 @register_op("attention_lstm")
@@ -203,23 +183,33 @@ def _attention_lstm(ctx, ins, attrs):
 
 @register_op("multihead_matmul")
 def _multihead_matmul(ctx, ins, attrs):
-    """fused multihead attention (fused/multihead_matmul_op): Q/K/V come
-    fused in one input; routes to the flash attention kernel."""
-    from .pallas.flash_attention import reference_attention
-
-    qkv = ins["Input"][0]  # [B, T, 3*d_model] fused projections
+    """fused multihead attention (multihead_matmul_op.cc:108-130):
+    separate Q/K/V [B, T, d] with per-input biases; scores =
+    alpha·(Q+bq)(K+bk)^T + BiasQK, softmax over keys, context against
+    (V+bv). Output [B, T, d]."""
+    q = ins["Q"][0]
+    k = ins["K"][0]
+    v = ins["V"][0]
+    if "BiasQ" in ins:
+        q = q + ins["BiasQ"][0]
+    if "BiasK" in ins:
+        k = k + ins["BiasK"][0]
+    if "BiasV" in ins:
+        v = v + ins["BiasV"][0]
     heads = attrs.get("head_number", 1)
-    b, t, three_d = qkv.shape
-    d = three_d // 3
+    alpha = attrs.get("alpha", 1.0)
+    b, t, d = q.shape
     hd = d // heads
 
-    def split(i):
-        part = qkv[..., i * d:(i + 1) * d]
-        return part.reshape(b, t, heads, hd).transpose(0, 2, 1, 3)
+    def split(z):
+        return z.reshape(b, t, heads, hd).transpose(0, 2, 1, 3)
 
-    q, k, v = split(0), split(1), split(2)
-    scale = attrs.get("alpha", 1.0 / np.sqrt(hd))
-    out = reference_attention(q, k, v, sm_scale=scale)
+    qh, kh, vh = split(q), split(k), split(v)
+    s = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * alpha
+    if "BiasQK" in ins:
+        s = s + ins["BiasQK"][0]
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p, vh)
     return {"Out": [out.transpose(0, 2, 1, 3).reshape(b, t, d)]}
 
 

@@ -158,7 +158,10 @@ def _filter_by_instag(ctx, ins, attrs):
     """keep rows whose tag set intersects the filter tags; padded
     formulation returns a loss-weight mask instead of compacting."""
     x = ins["Ins"][0]
-    tags = ins["Ins_tag"][0]       # [B, K]
+    # [B] single-tag or [B, K] multi-tag rows — normalize to 2-D so the
+    # any() reduces per ROW (a 1-D input would otherwise collapse to one
+    # scalar and keep everything)
+    tags = ins["Ins_tag"][0].reshape(x.shape[0], -1)
     ftags = ins["Filter_tag"][0].reshape(-1)
     hit = jnp.any(jnp.isin(tags, ftags), axis=-1)
     w = hit.astype(x.dtype)

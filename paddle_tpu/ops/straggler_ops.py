@@ -276,7 +276,9 @@ def _fused_embedding_fc_lstm(ctx, ins, attrs):
     def step(carry, x_t):
         h, c = carry
         gates = x_t + h @ wh + gate_b
-        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        # gate layout W_ch, W_ih, W_fh, W_oh — candidate FIRST
+        # (fused_embedding_fc_lstm_op.cc:274)
+        g, i, f, o = jnp.split(gates, 4, axis=-1)
         i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
         c_new = f * c + i * jnp.tanh(g)
         h_new = o * jnp.tanh(c_new)
@@ -296,12 +298,16 @@ def _fusion_seqpool_cvm_concat(ctx, ins, attrs):
     pooltype = attrs.get("pooltype", "SUM")
     use_cvm = attrs.get("use_cvm", True)
     sp = REGISTRY.get("sequence_pool")
+    cvm = REGISTRY.get("cvm")
     outs = []
     for x in ins["X"]:
         pooled = sp.lower(ctx, {"X": [x]}, {"pooltype": pooltype})["Out"][0]
         pooled = pooled.reshape(pooled.shape[0], -1)
-        if not use_cvm:
-            pooled = pooled[:, 2:]
+        # fusion_seqpool_cvm_concat_op.cc:127-129: each pooled input
+        # goes through the CVM transform — delegate so the semantics
+        # live only in the cvm lowering
+        pooled = cvm.lower(ctx, {"X": [pooled], "CVM": ins["CVM"]},
+                           {"use_cvm": use_cvm})["Y"][0]
         outs.append(pooled)
     return {"Out": [jnp.concatenate(outs, axis=1)]}
 

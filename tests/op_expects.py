@@ -1710,7 +1710,7 @@ def _fusion_transpose_flatten_concat(i, a):
 exp_("fusion_transpose_flatten_concat",
      _fusion_transpose_flatten_concat)
 grads("fusion_transpose_flatten_concat", "X")
-grads("multihead_matmul", "Input", "W")
+grads("multihead_matmul", "Q", "K", "V")
 grads("attention_lstm", "X")
 grads("fusion_gru", "X")
 grads("fusion_lstm", "X")
@@ -1940,6 +1940,9 @@ def _grid_sampler(i, a):
     return {"Output": [out.astype(np.float32)]}
 
 
+exp_("grid_sampler", _grid_sampler)
+
+
 def _affine_grid(i, a):
     theta = i["Theta"]  # [n, 2, 3]
     n_, _, h, w = a["output_shape"]
@@ -2033,6 +2036,271 @@ def _spectral_norm(i, a):
         u /= np.sqrt((u * u).sum()) + eps
     sigma = u @ w @ v
     return {"Out": [(w / sigma).astype(np.float32)]}
+
+
+exp_("spectral_norm", _spectral_norm)
+
+
+# ---------------------------------------------------------------------------
+# batch D refs: full recurrences, multihead attention, priors, yolo,
+# deformable conv
+# ---------------------------------------------------------------------------
+def _gru_seq(x, w, b, origin=False, h0=None):
+    """gru over pre-projected x [b, t, 3d] (gru_unit math per step,
+    math/detail/gru kernels: gates [u, r, c])."""
+    bsz, t, _ = x.shape
+    d = w.shape[0]
+    h = np.zeros((bsz, d)) if h0 is None else h0.astype(np.float64)
+    hs = np.zeros((bsz, t, d))
+    for k in range(t):
+        xt = x[:, k].astype(np.float64)
+        if b is not None:
+            xt = xt + b.reshape(-1)
+        gate = xt[:, :2 * d] + h @ w[:, :2 * d]
+        u = _sig(gate[:, :d])
+        r = _sig(gate[:, d:])
+        cand = np.tanh(xt[:, 2 * d:] + (r * h) @ w[:, 2 * d:])
+        h = (cand + u * (h - cand)) if origin else (u * (cand - h) + h)
+        hs[:, k] = h
+    return hs.astype(np.float32)
+
+
+exp_("gru", lambda i, a: {"Hidden": [_gru_seq(
+    i["Input"], i["Weight"].astype(np.float64), i.get("Bias"),
+    a.get("origin_mode", False))]})
+exp_("fusion_gru", lambda i, a: {"Hidden": [_gru_seq(
+    i["X"].astype(np.float64) @ i["WeightX"].astype(np.float64),
+    i["WeightH"].astype(np.float64), i.get("Bias"),
+    a.get("origin_mode", False))]})
+
+
+def _lstm_seq(x, w, b=None, proj=None):
+    """lstm over pre-projected x [b, t, 4d], gate order [c~, i, f, o]
+    (math/detail/lstm_cpu_kernel.h:51-54), no peepholes."""
+    bsz, t, _ = x.shape
+    d = w.shape[1] // 4
+    p = w.shape[0]
+    h = np.zeros((bsz, p))
+    c = np.zeros((bsz, d))
+    hs = np.zeros((bsz, t, p))
+    cs = np.zeros((bsz, t, d))
+    for k in range(t):
+        xt = x[:, k].astype(np.float64)
+        if b is not None:
+            xt = xt + b.reshape(-1)[:4 * d]
+        g = xt + h @ w
+        cand = np.tanh(g[:, :d])
+        ig = _sig(g[:, d:2 * d])
+        f = _sig(g[:, 2 * d:3 * d])
+        c = cand * ig + c * f
+        o = _sig(g[:, 3 * d:])
+        hcell = o * np.tanh(c)
+        h = hcell @ proj if proj is not None else hcell
+        hs[:, k] = h
+        cs[:, k] = c
+    return hs.astype(np.float32), cs.astype(np.float32)
+
+
+def _lstm_ref(i, a):
+    hs, cs = _lstm_seq(i["Input"], i["Weight"].astype(np.float64),
+                       i.get("Bias"))
+    return {"Hidden": [hs], "Cell": [cs]}
+
+
+exp_("lstm", _lstm_ref)
+
+
+def _lstmp_ref(i, a):
+    hs, _ = _lstm_seq(i["Input"], i["Weight"].astype(np.float64),
+                      i.get("Bias"),
+                      proj=i["ProjWeight"].astype(np.float64))
+    return {"Hidden": [hs]}
+
+
+exp_("lstmp", _lstmp_ref)
+
+
+def _fusion_lstm_ref(i, a):
+    x = i["X"].astype(np.float64) @ i["WeightX"].astype(np.float64)
+    hs, cs = _lstm_seq(x, i["WeightH"].astype(np.float64), i.get("Bias"))
+    return {"Hidden": [hs]}
+
+
+exp_("fusion_lstm", _fusion_lstm_ref)
+
+
+def _fused_emb_fc_lstm(i, a):
+    ids = i["Ids"].reshape(i["Ids"].shape[0], -1)
+    x = i["Embeddings"][ids]  # [b, t, 4d] pre-projected embedding rows
+    hs, cs = _lstm_seq(x, i["WeightH"].astype(np.float64), i.get("Bias"))
+    return {"Hidden": [hs]}
+
+
+exp_("fused_embedding_fc_lstm", _fused_emb_fc_lstm)
+
+
+def _prior_box(i, a):
+    # prior_box_op.h: centers at (idx+0.5)·step, step = image/feature;
+    # min-size square first, then non-unit aspect ratios; clipped and
+    # normalized by the image size
+    feat, img = i["Input"], i["Image"]
+    fh, fw = feat.shape[2], feat.shape[3]
+    ih, iw = img.shape[2], img.shape[3]
+    mins = a["min_sizes"]
+    ars = [1.0]
+    for r in a.get("aspect_ratios", [1.0]):
+        if all(abs(r - e) > 1e-6 for e in ars):
+            ars.append(r)
+    maxs = a.get("max_sizes", [])
+    var = a["variances"]
+    clip = a.get("clip", True)
+    step_w = a.get("step_w", 0.0) or iw / fw
+    step_h = a.get("step_h", 0.0) or ih / fh
+    offset = a.get("offset", 0.5)
+    npr = len(mins) * len(ars) + len(maxs)
+    boxes = np.zeros((fh, fw, npr, 4), np.float32)
+    for hi in range(fh):
+        cy = (hi + offset) * step_h
+        for wi in range(fw):
+            cx = (wi + offset) * step_w
+            k = 0
+            for mi, ms in enumerate(mins):
+                for r in ars:
+                    bw = ms * np.sqrt(r) / 2
+                    bh = ms / np.sqrt(r) / 2
+                    boxes[hi, wi, k] = [(cx - bw) / iw, (cy - bh) / ih,
+                                        (cx + bw) / iw, (cy + bh) / ih]
+                    k += 1
+                if mi < len(maxs):
+                    sz = np.sqrt(ms * maxs[mi]) / 2
+                    boxes[hi, wi, k] = [(cx - sz) / iw, (cy - sz) / ih,
+                                        (cx + sz) / iw, (cy + sz) / ih]
+                    k += 1
+    if clip:
+        boxes = np.clip(boxes, 0.0, 1.0)
+    variances = np.tile(np.asarray(var, np.float32),
+                        (fh, fw, npr, 1)).reshape(fh, fw, npr, 4)
+    return {"Boxes": [boxes], "Variances": [variances]}
+
+
+exp_("prior_box", _prior_box)
+
+
+def _multihead_matmul(i, a):
+    # multihead_matmul_op.cc:108-130: scores = alpha·(Q+bq)(K+bk)^T
+    # + BiasQK, softmax, context vs (V+bv)
+    q = i["Q"] + i["BiasQ"]
+    k = i["K"] + i["BiasK"]
+    v = i["V"] + i["BiasV"]
+    nh = a["head_number"]
+    bt, t, d = q.shape
+    dh = d // nh
+
+    def heads(z):
+        return z.reshape(bt, t, nh, dh).transpose(0, 2, 1, 3)
+
+    s = np.einsum("bhqd,bhkd->bhqk", heads(q), heads(k)) * a["alpha"]
+    s = s + i["BiasQK"]
+    e = np.exp(s - s.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    o = np.einsum("bhqk,bhkd->bhqd", p, heads(v))
+    return {"Out": [o.transpose(0, 2, 1, 3).reshape(bt, t, d)
+                    .astype(np.float32)]}
+
+
+exp_("multihead_matmul", _multihead_matmul)
+
+
+def _yolo_box(i, a):
+    # yolo_box_op.h: bx = (j + sigmoid(tx))/W · img_w, bw = anchor_w ·
+    # exp(tw) · img_w/downsample·W ... boxes in image pixels, centered
+    x = i["X"].astype(np.float64)
+    imgs = i["ImgSize"]
+    anchors = a["anchors"]
+    cn = a["class_num"]
+    conf_thr = a["conf_thresh"]
+    ds = a["downsample_ratio"]
+    n, c, h, w = x.shape
+    na = len(anchors) // 2
+    attrs_len = 5 + cn
+    img_h, img_w = int(imgs[0, 0]), int(imgs[0, 1])
+    boxes, scores = [], []
+    xr = x.reshape(n, na, attrs_len, h, w)
+    for an in range(na):
+        aw, ah = anchors[2 * an], anchors[2 * an + 1]
+        for hi in range(h):
+            for wi in range(w):
+                pred = xr[0, an, :, hi, wi]
+                conf = _sig(pred[4])
+                if conf < conf_thr:
+                    boxes.append([0, 0, 0, 0])
+                    scores.append([0.0] * cn)
+                    continue
+                cx = (wi + _sig(pred[0])) / w * img_w
+                cy = (hi + _sig(pred[1])) / h * img_h
+                bw = np.exp(pred[2]) * aw / (ds * w) * img_w
+                bh = np.exp(pred[3]) * ah / (ds * h) * img_h
+                x1 = max(cx - bw / 2, 0)
+                y1 = max(cy - bh / 2, 0)
+                x2 = min(cx + bw / 2, img_w - 1)
+                y2 = min(cy + bh / 2, img_h - 1)
+                boxes.append([x1, y1, x2, y2])
+                scores.append(list(conf * _sig(pred[5:])))
+    return {"Boxes": [np.asarray(boxes, np.float32)[None]],
+            "Scores": [np.asarray(scores, np.float32)[None]]}
+
+
+exp_("yolo_box", _yolo_box)
+
+
+def _deformable_conv_ref(i, a):
+    # deformable_conv_op semantics (modulated_deformable_im2col):
+    # sample x at p0 + pn + Δp with bilinear weights, modulated by mask
+    x, w = i["Input"].astype(np.float64), i["Filter"].astype(np.float64)
+    off = i["Offset"].astype(np.float64)
+    mask = i["Mask"].astype(np.float64) if "Mask" in i else None
+    sh, sw = a["strides"]
+    ph, pw = a["paddings"]
+    dh, dw = a.get("dilations", [1, 1])
+    n, cin, h, wid = x.shape
+    cout, _, kh, kw = w.shape
+    oh = (h + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    ow = (wid + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+    out = np.zeros((n, cout, oh, ow))
+
+    def sample(b, c, y, xx):
+        y0, x0 = int(np.floor(y)), int(np.floor(xx))
+        v = 0.0
+        for yy in (y0, y0 + 1):
+            for xc in (x0, x0 + 1):
+                if 0 <= yy < h and 0 <= xc < wid:
+                    v += ((1 - abs(y - yy)) * (1 - abs(xx - xc))
+                          * x[b, c, yy, xc])
+        return v
+
+    for b in range(n):
+        for oc in range(cout):
+            for pi in range(oh):
+                for pj in range(ow):
+                    acc = 0.0
+                    for r in range(kh):
+                        for cc in range(kw):
+                            kidx = r * kw + cc
+                            dy = off[b, 2 * kidx, pi, pj]
+                            dx = off[b, 2 * kidx + 1, pi, pj]
+                            m = mask[b, kidx, pi, pj] \
+                                if mask is not None else 1.0
+                            y = pi * sh - ph + r * dh + dy
+                            xx = pj * sw - pw + cc * dw + dx
+                            for ic in range(cin):
+                                acc += (w[oc, ic, r, cc] * m
+                                        * sample(b, ic, y, xx))
+                    out[b, oc, pi, pj] = acc
+    return {"Output": [out.astype(np.float32)]}
+
+
+exp_("deformable_conv", _deformable_conv_ref)
+exp_("deformable_conv_v1", _deformable_conv_ref)
 
 
 # ---------------------------------------------------------------------------
@@ -2321,6 +2589,70 @@ def _multiclass_nms_ref(i, a):
 
 exp_("multiclass_nms", _multiclass_nms_ref)
 exp_("multiclass_nms2", _multiclass_nms_ref)
+
+
+exp_("conv2d_fusion", lambda i, a: {"Output": [np.maximum(
+    _conv2d_np(i["Input"], i["Filter"], a["strides"], a["paddings"])
+    + i["Bias"].reshape(1, -1, 1, 1), 0.0)]})
+exp_("dgc_clip_by_norm", lambda i, a: {"Out": [
+    i["X"] * min(1.0, a["max_norm"]
+                 / max(float(np.sqrt((i["X"] ** 2).sum())), 1e-10))]})
+
+
+def _pnpair_ref(i, a):
+    score = i["Score"].reshape(-1)
+    label = i["Label"].reshape(-1)
+    qid = i["QueryID"].reshape(-1)
+    pos = neg = neu = 0
+    n = score.shape[0]
+    for x in range(n):
+        for y in range(x + 1, n):
+            if qid[x] != qid[y] or label[x] == label[y]:
+                continue
+            ds = score[x] - score[y]
+            dl = label[x] - label[y]
+            if ds * dl > 0:
+                pos += 1
+            elif ds * dl < 0:
+                neg += 1
+            else:
+                neu += 1
+    f = lambda v: np.asarray([v], np.float32)  # noqa: E731
+    return {"PositivePair": [f(pos)], "NegativePair": [f(neg)],
+            "NeutralPair": [f(neu)]}
+
+
+exp_("positive_negative_pair", _pnpair_ref)
+
+
+def _filter_by_instag_ref(i, a):
+    # padded contract: rows whose tag set misses the filter are zeroed
+    # and LossWeight marks the kept rows
+    x, tags = i["Ins"], i["Ins_tag"]
+    ftags = set(i["Filter_tag"].reshape(-1).tolist())
+    keep = np.array([bool(set(np.atleast_1d(t).tolist()) & ftags)
+                     for t in tags], np.float32)
+    return {"Out": [x * keep.reshape((-1,) + (1,) * (x.ndim - 1))],
+            "LossWeight": [keep.reshape(-1, 1)]}
+
+
+exp_("filter_by_instag", _filter_by_instag_ref)
+
+
+def _fusion_seqpool_cvm_concat_ref(i, a):
+    pooled = [i["fspcc_a"].sum(1), i["fspcc_b"].sum(1)]
+    outs = []
+    for p in pooled:
+        if a.get("use_cvm", True):
+            y0 = np.log(p[:, :1] + 1)
+            y1 = np.log(p[:, 1:2] + 1) - y0
+            outs.append(np.concatenate([y0, y1, p[:, 2:]], 1))
+        else:
+            outs.append(p[:, 2:])
+    return {"Out": [np.concatenate(outs, 1).astype(np.float32)]}
+
+
+exp_("fusion_seqpool_cvm_concat", _fusion_seqpool_cvm_concat_ref)
 
 
 exp_("quantize", lambda i, a: {"Output": [np.clip(

@@ -676,8 +676,10 @@ spec("attention_lstm",
           "AttentionWeight": f32(9, 1),
           "LSTMWeight": f32(9, 12), "LSTMBias": f32(1, 12)})
 spec("multihead_matmul",
-     ins={"Input": f32(2, 4, 6), "W": f32(6, 18), "Bias": f32(18)},
-     attrs={"head_number": 2})
+     ins={"Q": f32(2, 4, 6), "K": f32(2, 4, 6), "V": f32(2, 4, 6),
+          "BiasQ": f32(6), "BiasK": f32(6), "BiasV": f32(6),
+          "BiasQK": f32(2, 2, 4, 4)},
+     attrs={"head_number": 2, "alpha": 0.4})
 spec("fused_elemwise_activation",
      ins={"X": f32(2, 3), "Y": f32(2, 3)},
      attrs={"functor_list": ["elementwise_add", "relu"]}, grad=["X"])
@@ -994,7 +996,8 @@ spec("fused_embedding_fc_lstm",
           "Embeddings": f32(6, 16), "WeightH": f32(4, 16),
           "Bias": f32(1, 16)})
 spec("fusion_seqpool_cvm_concat",
-     ins={"X": [("fspcc_a", f32(2, 3, 4)), ("fspcc_b", f32(2, 3, 4))],
+     # positive values: the CVM transform takes log(show/click + 1)
+     ins={"X": [("fspcc_a", pos(2, 3, 4)), ("fspcc_b", pos(2, 3, 4))],
           "CVM": f32(2, 2)},
      attrs={"pooltype": "SUM", "use_cvm": True})
 spec("pull_box_sparse",
