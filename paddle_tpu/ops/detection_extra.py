@@ -245,18 +245,26 @@ def _density_prior_box(ctx, ins, attrs):
     dens = attrs.get("densities", [1])
     variances = attrs.get("variances", [0.1, 0.1, 0.2, 0.2])
     offset = attrs.get("offset", 0.5)
-    sw, sh = iw / w, ih / h
+    # density_prior_box_op.h:46-53: explicit step_w/step_h attrs win;
+    # only 0 falls back to the image/feature ratio
+    sw = attrs.get("step_w", 0.0) or iw / w
+    sh = attrs.get("step_h", 0.0) or ih / h
+    # :69-110: the density grid shifts by step_average/density (integer
+    # division) around the cell center; boxes are clamped to [0, 1]
+    step_avg = int((sw + sh) * 0.5)
     boxes = []
     for size, d in zip(fsizes, dens):
+        shift = step_avg // d
         for r in fratios:
             bw = size * np.sqrt(r)
             bh = size / np.sqrt(r)
-            shift = size / d
             for di in range(d):
                 for dj in range(d):
                     boxes.append((bw, bh,
-                                  -size / 2 + shift / 2 + dj * shift,
-                                  -size / 2 + shift / 2 + di * shift))
+                                  -step_avg / 2.0 + shift / 2.0
+                                  + dj * shift,
+                                  -step_avg / 2.0 + shift / 2.0
+                                  + di * shift))
     cx = (jnp.arange(w) + offset) * sw
     cy = (jnp.arange(h) + offset) * sh
     gx, gy = jnp.meshgrid(cx, cy)
@@ -266,6 +274,7 @@ def _density_prior_box(ctx, ins, attrs):
             (gx + ox) - bw / 2, (gy + oy) - bh / 2,
             (gx + ox) + bw / 2, (gy + oy) + bh / 2], axis=-1))
     prior = jnp.stack(out, axis=2) / jnp.asarray([iw, ih, iw, ih])
+    prior = jnp.clip(prior, 0.0, 1.0)
     var = jnp.broadcast_to(jnp.asarray(variances), prior.shape)
     return {"Boxes": [prior], "Variances": [var]}
 
@@ -421,18 +430,41 @@ def _mine_hard_examples(ctx, ins, attrs):
     ratio = attrs.get("neg_pos_ratio", 3.0)
     p = cls_loss.shape[1]
 
-    def one(loss, m):
-        pos = m >= 0
-        n_pos = jnp.sum(pos)
-        n_neg = jnp.minimum((n_pos * ratio).astype(jnp.int32),
-                            p - n_pos)
-        neg_loss = jnp.where(pos, -jnp.inf, loss)
-        order = jnp.argsort(-neg_loss)
-        sel = jnp.arange(p) < n_neg
-        return jnp.where(sel, order, -1).astype(jnp.int32)
+    # mine_hard_examples_op.cc:29-38: max_negative eligibility is
+    # unmatched AND match distance under the threshold; hard_example
+    # treats every prior as eligible, caps by sample_size, and clears
+    # unselected positives from UpdatedMatchIndices (:106-136). Either
+    # way NegIndices come out in ASCENDING prior order (the reference
+    # drains a std::set, :137-140).
+    thr = attrs.get("neg_dist_threshold", 0.5)
+    mining = attrs.get("mining_type", "max_negative")
+    sample_size = attrs.get("sample_size", 0)
+    dist = ins["MatchDist"][0] if "MatchDist" in ins \
+        else jnp.zeros_like(cls_loss)
+    loss_all = cls_loss
+    if mining == "hard_example" and "LocLoss" in ins:
+        loss_all = cls_loss + ins["LocLoss"][0]
 
-    neg = jax.vmap(one)(cls_loss, match)
-    return {"NegIndices": [neg], "UpdatedMatchIndices": [match]}
+    def one(loss, m, d):
+        if mining == "hard_example":
+            eligible = jnp.ones_like(m, dtype=bool)
+            n_neg = jnp.minimum(sample_size, p)
+        else:
+            eligible = (m == -1) & (d < thr)
+            n_pos = jnp.sum(m != -1)
+            n_neg = jnp.minimum((n_pos * ratio).astype(jnp.int32),
+                                jnp.sum(eligible))
+        masked = jnp.where(eligible, loss, -jnp.inf)
+        order = jnp.argsort(-masked)
+        chosen = jnp.zeros(p, bool).at[order].set(jnp.arange(p) < n_neg)
+        asc = jnp.sort(jnp.where(chosen, jnp.arange(p), p))
+        neg = jnp.where(asc < p, asc, -1).astype(jnp.int32)
+        upd = jnp.where(chosen | (m == -1), m, -1) \
+            if mining == "hard_example" else m
+        return neg, upd
+
+    neg, upd = jax.vmap(one)(loss_all, match, dist)
+    return {"NegIndices": [neg], "UpdatedMatchIndices": [upd]}
 
 
 @register_op("polygon_box_transform", nondiff_inputs=("Input",),
@@ -457,26 +489,43 @@ def _box_decoder_and_assign(ctx, ins, attrs):
     prior = ins["PriorBox"][0]        # [N, 4]
     deltas = ins["TargetBox"][0]      # [N, C*4]
     score = ins["BoxScore"][0]        # [N, C]
-    var = attrs.get("box_clip", 2.0)
+    clip = attrs.get("box_clip", 2.0)
+    # box_decoder_and_assign_op.h:45-95: one variance vector (the first
+    # 4 entries) scales the deltas; +1-offset widths; dw/dh upper-
+    # clipped only; x2/y2 get −1; assignment argmaxes over classes > 0
+    # and falls back to the prior box when no positive class exists
+    pv = ins["PriorBoxVar"][0].reshape(-1)[:4] if "PriorBoxVar" in ins \
+        else jnp.ones(4, prior.dtype)
     n, c4 = deltas.shape
     c = c4 // 4
-    pw = prior[:, 2] - prior[:, 0]
-    ph = prior[:, 3] - prior[:, 1]
+    pw = prior[:, 2] - prior[:, 0] + 1
+    ph = prior[:, 3] - prior[:, 1] + 1
     pcx = prior[:, 0] + pw / 2
     pcy = prior[:, 1] + ph / 2
     d = deltas.reshape(n, c, 4)
-    dx, dy, dw, dh = d[..., 0], d[..., 1], d[..., 2], d[..., 3]
-    dw = jnp.clip(dw, -var, var)
-    dh = jnp.clip(dh, -var, var)
+    dx = pv[0] * d[..., 0]
+    dy = pv[1] * d[..., 1]
+    dw = jnp.minimum(pv[2] * d[..., 2], clip)
+    dh = jnp.minimum(pv[3] * d[..., 3], clip)
     cx = pcx[:, None] + dx * pw[:, None]
     cy = pcy[:, None] + dy * ph[:, None]
     bw = jnp.exp(dw) * pw[:, None]
     bh = jnp.exp(dh) * ph[:, None]
     boxes = jnp.stack([cx - bw / 2, cy - bh / 2,
-                       cx + bw / 2, cy + bh / 2], axis=-1)  # [N, C, 4]
-    best = jnp.argmax(score, axis=1)
-    assigned = jnp.take_along_axis(
-        boxes, best[:, None, None].repeat(4, -1), axis=1)[:, 0]
+                       cx + bw / 2 - 1, cy + bh / 2 - 1],
+                      axis=-1)  # [N, C, 4]
+    # assignment (op.h:79-99): argmax over classes j>0 starting from
+    # max_score=-1 — when every non-background score is <= -1 the raw
+    # prior box is assigned instead of a decoded box
+    if c > 1:
+        best_s = jnp.max(score[:, 1:], axis=1)
+        best = jnp.argmax(score[:, 1:], axis=1) + 1
+        picked = jnp.take_along_axis(
+            boxes, best[:, None, None].repeat(4, -1), axis=1)[:, 0]
+        assigned = jnp.where((best_s > -1)[:, None], picked,
+                             prior[:, :4])
+    else:
+        assigned = prior[:, :4]
     return {"DecodeBox": [boxes.reshape(n, c4)],
             "OutputAssignBox": [assigned]}
 

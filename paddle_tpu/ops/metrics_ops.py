@@ -67,27 +67,52 @@ def _mean_iou(ctx, ins, attrs):
             "OutCorrect": [inter.astype(jnp.int32)]}
 
 
+def _pr_metrics(states):
+    """[macro_p, macro_r, macro_f1, micro_p, micro_r, micro_f1] from a
+    [cls, 4] (TP, FP, TN, FN) state block — precision_recall_op.h:
+    102-156, including the 1.0 default for classes with no counts."""
+    tp, fp, fn = states[:, 0], states[:, 1], states[:, 3]
+
+    def prec(t, f):
+        return jnp.where(t + f > 0, t / jnp.maximum(t + f, 1e-12), 1.0)
+
+    def f1(p, r):
+        return jnp.where(p + r > 0,
+                         2 * p * r / jnp.maximum(p + r, 1e-12), 0.0)
+
+    macro_p = jnp.mean(prec(tp, fp))
+    macro_r = jnp.mean(prec(tp, fn))
+    micro_p = prec(jnp.sum(tp), jnp.sum(fp))
+    micro_r = prec(jnp.sum(tp), jnp.sum(fn))
+    return jnp.stack([macro_p, macro_r, f1(macro_p, macro_r),
+                      micro_p, micro_r, f1(micro_p, micro_r)])
+
+
 @register_op("precision_recall",
              nondiff_inputs=("MaxProbs", "Indices", "Labels", "Weights",
                              "StatesInfo"),
              nondiff_outputs=("BatchMetrics", "AccumMetrics",
                               "AccumStatesInfo"))
 def _precision_recall(ctx, ins, attrs):
+    """precision_recall_op.h:56-99: per-class TP/FP/TN/FN state block;
+    BatchMetrics from this batch alone, AccumMetrics from batch +
+    StatesInfo."""
     idx = ins["Indices"][0].reshape(-1)
     label = ins["Labels"][0].reshape(-1)
     cls = attrs["class_number"]
-    tp = jnp.zeros(cls, jnp.float32).at[label].add(
-        (idx == label).astype(jnp.float32))
-    fp = jnp.zeros(cls, jnp.float32).at[idx].add(
-        (idx != label).astype(jnp.float32))
-    fn = jnp.zeros(cls, jnp.float32).at[label].add(
-        (idx != label).astype(jnp.float32))
-    prec = jnp.sum(tp) / jnp.maximum(jnp.sum(tp) + jnp.sum(fp), 1e-12)
-    rec = jnp.sum(tp) / jnp.maximum(jnp.sum(tp) + jnp.sum(fn), 1e-12)
-    f1 = 2 * prec * rec / jnp.maximum(prec + rec, 1e-12)
-    batch = jnp.stack([prec, rec, f1, prec, rec, f1])
-    states = jnp.stack([tp, fp, fn, tp], axis=1)
-    if "StatesInfo" in ins:
-        states = states + ins["StatesInfo"][0]
-    return {"BatchMetrics": [batch], "AccumMetrics": [batch],
-            "AccumStatesInfo": [states]}
+    w = ins["Weights"][0].reshape(-1).astype(jnp.float32) \
+        if "Weights" in ins else jnp.ones(idx.shape[0], jnp.float32)
+    wrong = (idx != label).astype(jnp.float32) * w
+    right = (idx == label).astype(jnp.float32) * w
+    tp = jnp.zeros(cls, jnp.float32).at[idx].add(right)
+    fp = jnp.zeros(cls, jnp.float32).at[idx].add(wrong)
+    fn = jnp.zeros(cls, jnp.float32).at[label].add(wrong)
+    # TN: +w for every class per sample, -w at idx, -w at label when wrong
+    tn = (jnp.sum(w) - jnp.zeros(cls, jnp.float32).at[idx].add(w)
+          - jnp.zeros(cls, jnp.float32).at[label].add(wrong))
+    batch_states = jnp.stack([tp, fp, tn, fn], axis=1)
+    accum = batch_states + ins["StatesInfo"][0].astype(jnp.float32) \
+        if "StatesInfo" in ins else batch_states
+    return {"BatchMetrics": [_pr_metrics(batch_states)],
+            "AccumMetrics": [_pr_metrics(accum)],
+            "AccumStatesInfo": [accum]}

@@ -146,27 +146,39 @@ def _lstmp(ctx, ins, attrs):
 
 @register_op("attention_lstm")
 def _attention_lstm(ctx, ins, attrs):
-    """attention_lstm_op: per-step scalar attention over the encoder
-    states feeding an LSTM cell (padded [B, T, d] formulation)."""
-    x = ins["X"][0]                   # [B, T, d] encoder states
+    """attention_lstm_op.cc:355-405 (padded [B, T, M] formulation):
+    per step, scores = relu(x@Wa[:M] + prev_CELL·Wa[M:]) softmaxed over
+    the sequence; the context vector feeds an LSTM whose combined
+    weight stacks [hidden rows; x rows] with gate order
+    {forget, input, output, cand}."""
+    x = ins["X"][0]                   # [B, T, M] encoder states
     c0 = ins["C0"][0]
     h0 = ins["H0"][0] if "H0" in ins else jnp.zeros_like(c0)
-    att_w = ins["AttentionWeight"][0]   # [d+h?, 1]
-    lstm_w = ins["LSTMWeight"][0]       # [d+h, 4h]
+    att_w = ins["AttentionWeight"][0]   # [M+D, 1]
+    lstm_w = ins["LSTMWeight"][0]       # [D+M, 4D]
     lstm_b = ins["LSTMBias"][0].reshape(-1)
-    b, t, d = x.shape
-    h = c0.shape[-1]
+    b, t, m = x.shape
+    d = c0.shape[-1]
+    atten_x = (x @ att_w[:m]).squeeze(-1)     # [B, T], precomputed fc
+    if "AttentionBias" in ins:
+        atten_x = atten_x + ins["AttentionBias"][0].reshape(())
+    scalar = ins["AttentionScalar"][0].reshape(()) \
+        if "AttentionScalar" in ins else None
+    scalar_b = ins["AttentionScalarBias"][0].reshape(()) \
+        if "AttentionScalarBias" in ins else 0.0
 
     def step(carry, _):
         hp, cp = carry
-        hx = jnp.concatenate(
-            [x, jnp.broadcast_to(hp[:, None, :], (b, t, h))], axis=-1)
-        e = (hx @ att_w[:d + h, :1]).squeeze(-1)      # [B, T]
+        cell_bias = cp @ att_w[m:]            # [B, 1]
+        e = jax.nn.relu(atten_x + cell_bias)
+        if scalar is not None:
+            # attention_lstm_op.cc:366-371: fc scalar + bias_relu
+            e = jax.nn.relu(scalar * e + scalar_b)
         a = jax.nn.softmax(e, axis=-1)
-        ctxv = jnp.einsum("bt,btd->bd", a, x)
-        g = jnp.concatenate([ctxv, hp], axis=-1) @ lstm_w + lstm_b
-        i, f, gg, o = jnp.split(g, 4, axis=-1)
-        c = _sigmoid(f) * cp + _sigmoid(i) * jnp.tanh(gg)
+        ctxv = jnp.einsum("bt,btm->bm", a, x)
+        g = hp @ lstm_w[:d] + ctxv @ lstm_w[d:] + lstm_b
+        f, i, o, cand = jnp.split(g, 4, axis=-1)
+        c = _sigmoid(f) * cp + _sigmoid(i) * jnp.tanh(cand)
         hn = _sigmoid(o) * jnp.tanh(c)
         return (hn, c), hn
 

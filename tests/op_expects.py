@@ -2655,6 +2655,321 @@ def _fusion_seqpool_cvm_concat_ref(i, a):
 exp_("fusion_seqpool_cvm_concat", _fusion_seqpool_cvm_concat_ref)
 
 
+def _auc_ref(i, a):
+    # auc_op.h: bucket = pred_pos · num_thresholds, histogram stats,
+    # trapezoid area over descending thresholds
+    pred = i["Predict"][:, -1]
+    label = i["Label"].reshape(-1)
+    nt = a.get("num_thresholds", 4095)
+    pos = i["StatPos"].astype(np.float64).copy()
+    neg = i["StatNeg"].astype(np.float64).copy()
+    for p, l_ in zip(pred, label):
+        b = min(max(int(p * nt), 0), nt)
+        if l_ == 1:
+            pos[b] += 1
+        else:
+            neg[b] += 1
+    tp = fp = 0.0
+    area = 0.0
+    for b in range(nt, -1, -1):
+        tp_new, fp_new = tp + pos[b], fp + neg[b]
+        area += (fp_new - fp) * (tp + tp_new) / 2.0
+        tp, fp = tp_new, fp_new
+    auc = area / (tp * fp) if tp * fp > 0 else 0.0
+    return {"AUC": [np.float64(auc)],
+            "StatPosOut": [pos.astype(i["StatPos"].dtype)],
+            "StatNegOut": [neg.astype(i["StatNeg"].dtype)]}
+
+
+exp_("auc", _auc_ref)
+
+
+def _precision_recall_ref(i, a):
+    # precision_recall_op.h:56-156
+    idx = i["Indices"].reshape(-1)
+    lbl = i["Labels"].reshape(-1)
+    cls = a["class_number"]
+    ws = i["Weights"].reshape(-1) if "Weights" in i \
+        else np.ones(idx.shape[0])
+    st = np.zeros((cls, 4))  # TP FP TN FN
+    for x, l_, w in zip(idx, lbl, ws):
+        if x == l_:
+            st[x, 0] += w
+            st[:, 2] += w
+            st[x, 2] -= w
+        else:
+            st[l_, 3] += w
+            st[x, 1] += w
+            st[:, 2] += w
+            st[x, 2] -= w
+            st[l_, 2] -= w
+
+    def metrics(s):
+        def prec(t, f):
+            return t / (t + f) if t > 0 or f > 0 else 1.0
+
+        pc = [prec(s[c, 0], s[c, 1]) for c in range(cls)]
+        rc = [prec(s[c, 0], s[c, 3]) for c in range(cls)]
+        mp, mr = np.mean(pc), np.mean(rc)
+        mf = 2 * mp * mr / (mp + mr) if mp > 0 or mr > 0 else 0.0
+        up = prec(s[:, 0].sum(), s[:, 1].sum())
+        ur = prec(s[:, 0].sum(), s[:, 3].sum())
+        uf = 2 * up * ur / (up + ur) if up > 0 or ur > 0 else 0.0
+        return np.array([mp, mr, mf, up, ur, uf])
+
+    accum = st + i["StatesInfo"].astype(np.float64) \
+        if "StatesInfo" in i else st
+    return {"BatchMetrics": [metrics(st)],
+            "AccumMetrics": [metrics(accum)],
+            "AccumStatesInfo": [accum.astype(np.float32)]}
+
+
+exp_("precision_recall", _precision_recall_ref)
+
+
+def _mine_hard_examples_ref(i, a):
+    # mine_hard_examples_op.cc:29-38 + :90-122 (max_negative)
+    loss = i["ClsLoss"]
+    match = i["MatchIndices"]
+    dist = i["MatchDist"]
+    thr = a.get("neg_dist_threshold", 0.5)
+    ratio = a.get("neg_pos_ratio", 3.0)
+    b, p = match.shape
+    out = np.full((b, p), -1, np.int32)
+    for n in range(b):
+        elig = [(loss[n, m], m) for m in range(p)
+                if match[n, m] == -1 and dist[n, m] < thr]
+        n_pos = int((match[n] != -1).sum())
+        n_neg = min(int(n_pos * ratio), len(elig))
+        elig.sort(key=lambda t: -t[0])
+        # :137-140 — the selected indices drain out of a std::set,
+        # i.e. ASCENDING prior order
+        sel = sorted(m for _, m in elig[:n_neg])
+        for k, m in enumerate(sel):
+            out[n, k] = m
+    return {"NegIndices": [out], "UpdatedMatchIndices": [match]}
+
+
+exp_("mine_hard_examples", _mine_hard_examples_ref)
+
+
+def _density_prior_box_ref(i, a):
+    feat, img = i["Input"], i["Image"]
+    fh, fw = feat.shape[2], feat.shape[3]
+    ih, iw = img.shape[2], img.shape[3]
+    sw, sh = iw / fw, ih / fh
+    step_avg = int((sw + sh) * 0.5)
+    offset = a.get("offset", 0.5)
+    entries = []
+    for size, d in zip(a["fixed_sizes"], a["densities"]):
+        shift = step_avg // d
+        for r in a["fixed_ratios"]:
+            bw, bh = size * np.sqrt(r), size / np.sqrt(r)
+            for di in range(d):
+                for dj in range(d):
+                    entries.append(
+                        (bw, bh,
+                         -step_avg / 2 + shift / 2 + dj * shift,
+                         -step_avg / 2 + shift / 2 + di * shift))
+    npr = len(entries)
+    boxes = np.zeros((fh, fw, npr, 4), np.float32)
+    for hi in range(fh):
+        for wi in range(fw):
+            cx, cy = (wi + offset) * sw, (hi + offset) * sh
+            for k, (bw, bh, ox, oy) in enumerate(entries):
+                boxes[hi, wi, k] = [
+                    max((cx + ox - bw / 2) / iw, 0.0),
+                    max((cy + oy - bh / 2) / ih, 0.0),
+                    min((cx + ox + bw / 2) / iw, 1.0),
+                    min((cy + oy + bh / 2) / ih, 1.0)]
+    var = np.tile(np.asarray(a["variances"], np.float32),
+                  (fh, fw, npr, 1)).reshape(fh, fw, npr, 4)
+    return {"Boxes": [boxes], "Variances": [var]}
+
+
+exp_("density_prior_box", _density_prior_box_ref)
+
+
+def _box_decoder_and_assign_ref(i, a):
+    # box_decoder_and_assign_op.h:45-95
+    prior = i["PriorBox"]
+    pv = i["PriorBoxVar"].reshape(-1)[:4]
+    deltas = i["TargetBox"]
+    score = i["BoxScore"]
+    clip = a.get("box_clip", 2.0)
+    n = prior.shape[0]
+    c = score.shape[1]
+    out = np.zeros((n, c * 4))
+    assign = np.zeros((n, 4))
+    for r in range(n):
+        pw = prior[r, 2] - prior[r, 0] + 1
+        ph = prior[r, 3] - prior[r, 1] + 1
+        pcx = prior[r, 0] + pw / 2
+        pcy = prior[r, 1] + ph / 2
+        for j in range(c):
+            o = j * 4
+            dw = min(pv[2] * deltas[r, o + 2], clip)
+            dh = min(pv[3] * deltas[r, o + 3], clip)
+            cx = pv[0] * deltas[r, o] * pw + pcx
+            cy = pv[1] * deltas[r, o + 1] * ph + pcy
+            bw, bh = np.exp(dw) * pw, np.exp(dh) * ph
+            out[r, o:o + 4] = [cx - bw / 2, cy - bh / 2,
+                               cx + bw / 2 - 1, cy + bh / 2 - 1]
+        best, best_s = -1, -1.0
+        for j in range(1, c):
+            if score[r, j] > best_s:
+                best_s, best = score[r, j], j
+        assign[r] = out[r, best * 4:best * 4 + 4] if best > 0 \
+            else prior[r, :4]
+    return {"DecodeBox": [out.astype(np.float32)],
+            "OutputAssignBox": [assign.astype(np.float32)]}
+
+
+exp_("box_decoder_and_assign", _box_decoder_and_assign_ref)
+
+
+def _rpn_target_assign_ref(i, a):
+    # deterministic contract of the redesigned lowering: threshold
+    # labels + best-anchor-per-gt positive + delta encoding with the
+    # reference's +1 pixel-inclusive widths (rpn_target_assign_op.cc
+    # bbox2delta); the reference additionally SAMPLES 256 anchors,
+    # which the static-shape redesign replaces with full assignment
+    anchors = i["Anchor"]
+    gt = i["GtBoxes"]
+    pos_t = a.get("rpn_positive_overlap", 0.7)
+    neg_t = a.get("rpn_negative_overlap", 0.3)
+    ious = _iou(anchors, gt)
+    best = ious.max(1)
+    arg = ious.argmax(1)
+    lab = np.where(best >= pos_t, 1, np.where(best < neg_t, 0, -1))
+    lab[ious.argmax(0)] = 1
+    m = gt[arg]
+    aw = anchors[:, 2] - anchors[:, 0] + 1
+    ah = anchors[:, 3] - anchors[:, 1] + 1
+    gw = m[:, 2] - m[:, 0] + 1
+    gh = m[:, 3] - m[:, 1] + 1
+    deltas = np.stack([
+        (m[:, 0] + gw / 2 - anchors[:, 0] - aw / 2) / aw,
+        (m[:, 1] + gh / 2 - anchors[:, 1] - ah / 2) / ah,
+        np.log(gw / aw), np.log(gh / ah)], 1)
+    return {"TargetLabel": [lab.astype(np.int32).reshape(-1, 1)],
+            "TargetBBox": [deltas.astype(np.float32)]}
+
+
+exp_("rpn_target_assign", _rpn_target_assign_ref)
+# padded contract: each X row repeats Y_rows/X_rows times
+exp_("sequence_expand", lambda i, a: {"Out": [np.repeat(
+    i["X"], i["Y"].shape[0] // i["X"].shape[0], axis=0)]})
+
+
+def _seq_topk_avg_ref(i, a):
+    x = i["X"]
+    outs = []
+    for k in a["topks"]:
+        kk = min(k, x.shape[-1])
+        v = np.sort(x, axis=-1)[..., ::-1][..., :kk]
+        outs.append(v.mean(-1))
+    return {"Out": [np.concatenate(outs, -1).astype(np.float32)]}
+
+
+exp_("sequence_topk_avg_pooling", _seq_topk_avg_ref)
+
+
+def _attention_lstm_ref(i, a):
+    # attention_lstm_op.cc:355-405
+    x = i["X"].astype(np.float64)
+    c = i["C0"].astype(np.float64)
+    h = i.get("H0", np.zeros_like(c)).astype(np.float64)
+    aw = i["AttentionWeight"].astype(np.float64)
+    lw = i["LSTMWeight"].astype(np.float64)
+    lb = i["LSTMBias"].reshape(-1).astype(np.float64)
+    b, t, m = x.shape
+    d = c.shape[-1]
+    atten_x = (x @ aw[:m]).squeeze(-1)
+    hs = np.zeros((b, t, d))
+    for k in range(t):
+        e = np.maximum(atten_x + c @ aw[m:], 0.0)
+        ex = np.exp(e - e.max(-1, keepdims=True))
+        att = ex / ex.sum(-1, keepdims=True)
+        ctxv = np.einsum("bt,btm->bm", att, x)
+        g = h @ lw[:d] + ctxv @ lw[d:] + lb
+        f, ig, o, cand = np.split(g, 4, axis=-1)
+        c = _sig(f) * c + _sig(ig) * np.tanh(cand)
+        h = _sig(o) * np.tanh(c)
+        hs[:, k] = h
+    return {"Hidden": [hs.astype(np.float32)],
+            "Cell": [c.astype(np.float32)]}
+
+
+exp_("attention_lstm", _attention_lstm_ref)
+
+
+def _cudnn_lstm_ref(i, a):
+    # cudnn canonical single-layer LSTM: gates [i, f, g, o],
+    # c = f·c + i·tanh(g), h = o·tanh(c); weight blob Wih|Whh|bih|bhh
+    x = i["Input"].astype(np.float64)       # [T, B, in]
+    h = i["InitH"][0].astype(np.float64)
+    c = i["InitC"][0].astype(np.float64)
+    w = i["W"].reshape(-1).astype(np.float64)
+    hid = a["hidden_size"]
+    t, b, insz = x.shape
+    o = 0
+    wih = w[o:o + 4 * hid * insz].reshape(4 * hid, insz)
+    o += 4 * hid * insz
+    whh = w[o:o + 4 * hid * hid].reshape(4 * hid, hid)
+    o += 4 * hid * hid
+    bih = w[o:o + 4 * hid]
+    o += 4 * hid
+    bhh = w[o:o + 4 * hid]
+    ys = np.zeros((t, b, hid))
+    for k in range(t):
+        g = x[k] @ wih.T + h @ whh.T + bih + bhh
+        ig, f, gg, og = np.split(g, 4, axis=-1)
+        c = _sig(f) * c + _sig(ig) * np.tanh(gg)
+        h = _sig(og) * np.tanh(c)
+        ys[k] = h
+    return {"Out": [ys.astype(np.float32)],
+            "LastH": [h[None].astype(np.float32)],
+            "LastC": [c[None].astype(np.float32)]}
+
+
+exp_("cudnn_lstm", _cudnn_lstm_ref)
+
+
+def _cudnn_gru_ref(i, a):
+    # cudnn canonical GRU: r/z/n gates, n = tanh(xn + r·(h@Whn + bhn)),
+    # h = (1−z)·n + z·h
+    x = i["Input"].astype(np.float64)
+    h = i["InitH"][0].astype(np.float64)
+    w = i["W"].reshape(-1).astype(np.float64)
+    hid = a["hidden_size"]
+    t, b, insz = x.shape
+    o = 0
+    wih = w[o:o + 3 * hid * insz].reshape(3 * hid, insz)
+    o += 3 * hid * insz
+    whh = w[o:o + 3 * hid * hid].reshape(3 * hid, hid)
+    o += 3 * hid * hid
+    bih = w[o:o + 3 * hid]
+    o += 3 * hid
+    bhh = w[o:o + 3 * hid]
+    ys = np.zeros((t, b, hid))
+    for k in range(t):
+        gx = x[k] @ wih.T + bih
+        gh = h @ whh.T + bhh
+        xr, xz, xn = np.split(gx, 3, axis=-1)
+        hr, hz, hn = np.split(gh, 3, axis=-1)
+        r = _sig(xr + hr)
+        z = _sig(xz + hz)
+        n = np.tanh(xn + r * hn)
+        h = (1 - z) * n + z * h
+        ys[k] = h
+    return {"Out": [ys.astype(np.float32)],
+            "LastH": [h[None].astype(np.float32)]}
+
+
+exp_("cudnn_gru", _cudnn_gru_ref)
+
+
 exp_("quantize", lambda i, a: {"Output": [np.clip(
     np.round(i["Input"] * a.get("Scale", 1.0)), -128, 127)
     .astype(np.int8)]})
