@@ -160,17 +160,23 @@ def _max_pool2d_with_index(ctx, ins, attrs):
                  constant_values=-jnp.inf)
     oh = (h + 2 * ph - kh) // sh + 1
     ow = (w + 2 * pw - kw) // sw + 1
-    ii = ((jnp.arange(oh) * sh)[:, None, None, None]
-          + jnp.arange(kh)[None, None, :, None])     # [oh,1,kh,1]
-    jj = ((jnp.arange(ow) * sw)[None, :, None, None]
-          + jnp.arange(kw)[None, None, None, :])     # [1,ow,1,kw]
-    win = xp[:, :, ii, jj]                           # [n,c,oh,ow,kh,kw]
-    flat = win.reshape(n, c, oh, ow, kh * kw)
-    out = flat.max(-1)
-    am = flat.argmax(-1)
-    row = (jnp.arange(oh) * sh)[None, None, :, None] + am // kw - ph
-    col = (jnp.arange(ow) * sw)[None, None, None, :] + am % kw - pw
-    return {"Out": [out], "Mask": [(row * w + col).astype(jnp.int32)]}
+    # one strided slice per kernel offset keeps memory O(output);
+    # strict > in scan order = the reference's first-max tie-break
+    gr = (jnp.arange(oh) * sh)[:, None]
+    gc = (jnp.arange(ow) * sw)[None, :]
+    best = jnp.full((n, c, oh, ow), -jnp.inf, x.dtype)
+    bidx = jnp.zeros((n, c, oh, ow), jnp.int32)
+    for dy in range(kh):
+        for dx in range(kw):
+            sl = jax.lax.slice(
+                xp, (0, 0, dy, dx),
+                (n, c, dy + (oh - 1) * sh + 1, dx + (ow - 1) * sw + 1),
+                (1, 1, sh, sw))
+            idx = ((gr + dy - ph) * w + gc + dx - pw).astype(jnp.int32)
+            upd = sl > best
+            best = jnp.where(upd, sl, best)
+            bidx = jnp.where(upd, idx[None, None], bidx)
+    return {"Out": [best], "Mask": [bidx]}
 
 
 @register_op("batch_norm", nondiff_inputs=("Mean", "Variance"),

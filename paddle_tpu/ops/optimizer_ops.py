@@ -247,10 +247,42 @@ def _dpsgd(ctx, ins, attrs):
 
 @register_op("average_accumulates", inplace=True)
 def _average_accumulates(ctx, ins, attrs):
-    # ModelAverage support (average_accumulates_op.cc): accumulate param sums.
+    """ModelAverage accumulators (average_accumulates_op.h:43-110):
+    sum1 += param each step; every 16384 updates sum1 rolls into sum2
+    (precision guard); when the window saturates (num_accumulates >=
+    min_window and >= min(max_window, num_updates·average_window)) the
+    sums roll into sum3 and the window restarts."""
     p = ins["Param"][0]
-    s1 = ins["InSum1"][0]
-    n = ins["InNumAccumulates"][0].reshape(())
-    return {"OutSum1": [s1 + p],
-            "OutNumAccumulates": [(n + 1).reshape(
-                ins["InNumAccumulates"][0].shape)]}
+    s1, s2, s3 = (ins["InSum1"][0], ins["InSum2"][0], ins["InSum3"][0])
+    na = ins["InNumAccumulates"][0].reshape(()).astype(jnp.int64)
+    ona = ins["InOldNumAccumulates"][0].reshape(()).astype(jnp.int64) \
+        if "InOldNumAccumulates" in ins else jnp.int64(0)
+    nu = ins["InNumUpdates"][0].reshape(()).astype(jnp.int64) \
+        if "InNumUpdates" in ins else na
+    aw = attrs.get("average_window", 0.0)
+    maxw = min(int(attrs.get("max_average_window", 2 ** 31 - 1)),
+               2 ** 31 - 1)  # int32 backend (jax x64 off repo-wide)
+    minw = attrs.get("min_average_window", 10000)
+    nu1 = nu + 1
+    na1 = na + 1
+    # the reference runs with aliased in/out accumulators, so each
+    # branch reads the ALREADY-UPDATED sum1 (= s1 + param)
+    o1 = s1 + p
+    roll = (nu1 % 16384) == 0
+    o2 = jnp.where(roll, s2 + o1, s2)
+    o1 = jnp.where(roll, jnp.zeros_like(o1), o1)
+    # threshold nu1·average_window: f32 is exact to ~1e7 steps — the
+    # int32 backend bounds nu1 well inside the same regime
+    thr = jnp.floor(nu1.astype(jnp.float32) * jnp.float32(aw)
+                    + jnp.float32(1e-3)).astype(na1.dtype)
+    win = (na1 >= minw) & (na1 >= jnp.minimum(
+        jnp.asarray(maxw, na1.dtype), thr))
+    o3 = jnp.where(win, o1 + o2, s3)
+    o1 = jnp.where(win, jnp.zeros_like(o1), o1)
+    o2 = jnp.where(win, jnp.zeros_like(o2), o2)
+    sh = ins["InNumAccumulates"][0].shape
+    return {"OutSum1": [o1], "OutSum2": [o2], "OutSum3": [o3],
+            "OutNumAccumulates": [jnp.where(win, 0, na1).reshape(sh)],
+            "OutOldNumAccumulates": [jnp.where(win, na1,
+                                               ona).reshape(sh)],
+            "OutNumUpdates": [nu1.reshape(sh)]}

@@ -99,24 +99,21 @@ def _dgc_momentum(ctx, ins, attrs):
 
 
 def _default_paths(num_classes, max_depth):
-    """Complete-binary-tree (code, sign) tables: node ids 1..num_classes-1
-    (heap layout), leaf c corresponds to heap index num_classes-1+c."""
+    """Reference SimpleCode tables (matrix_bit_code.h:109-118): class c
+    encodes as code = c + num_classes; path node j = (code >> (j+1)) − 1
+    and branch bit j = code & (1 << j), so the per-edge loss
+    softplus(pre) − bit·pre equals logaddexp(0, −sign·pre) with
+    sign = 2·bit − 1."""
     codes = np.zeros((num_classes, max_depth), np.int64)
     signs = np.zeros((num_classes, max_depth), np.float32)
     valid = np.zeros((num_classes, max_depth), np.float32)
     for c in range(num_classes):
-        node = num_classes - 1 + c  # heap leaf
-        path = []
-        while node > 0:
-            parent = (node - 1) // 2
-            is_left = node == 2 * parent + 1
-            path.append((parent, 1.0 if is_left else -1.0))
-            node = parent
-        path = path[::-1][:max_depth]
-        for d, (n, s) in enumerate(path):
-            codes[c, d] = n
-            signs[c, d] = s
-            valid[c, d] = 1.0
+        code = c + num_classes
+        length = code.bit_length() - 1
+        for j in range(min(length, max_depth)):
+            codes[c, j] = (code >> (j + 1)) - 1
+            signs[c, j] = 1.0 if (code >> j) & 1 else -1.0
+            valid[c, j] = 1.0
     return codes, signs, valid
 
 

@@ -73,11 +73,40 @@ def _pool3d(ctx, ins, attrs):
 
 @register_op("max_pool3d_with_index", nondiff_outputs=("Mask",))
 def _max_pool3d_with_index(ctx, ins, attrs):
+    """max pool + the winner's flattened (d·H + h)·W + w index within
+    the unpadded input (pooling.cc MaxPool3dWithIndexFunctor)."""
     x = ins["X"][0]
-    out = _pool_nd(x, attrs.get("ksize", [2, 2, 2]),
-                   attrs.get("strides", [2, 2, 2]),
-                   attrs.get("paddings", [0, 0, 0]), "max", 3, False)
-    return {"Out": [out], "Mask": [jnp.zeros(out.shape, jnp.int32)]}
+    kd, kh, kw = attrs.get("ksize", [2, 2, 2])
+    sd, sh, sw = attrs.get("strides", [kd, kh, kw])
+    pd, ph, pw = attrs.get("paddings", [0, 0, 0])
+    n, c, d, h, w = x.shape
+    xp = jnp.pad(x, [(0, 0), (0, 0), (pd, pd), (ph, ph), (pw, pw)],
+                 constant_values=-jnp.inf)
+    od = (d + 2 * pd - kd) // sd + 1
+    oh = (h + 2 * ph - kh) // sh + 1
+    ow = (w + 2 * pw - kw) // sw + 1
+    # one strided slice per kernel offset keeps memory O(output) — a
+    # materialized window gather would be kd·kh·kw× the input. Strict >
+    # in scan order reproduces the reference's first-max tie-break.
+    gd = (jnp.arange(od) * sd).reshape(od, 1, 1)
+    gh = (jnp.arange(oh) * sh).reshape(1, oh, 1)
+    gw = (jnp.arange(ow) * sw).reshape(1, 1, ow)
+    best = jnp.full((n, c, od, oh, ow), -jnp.inf, x.dtype)
+    bidx = jnp.zeros((n, c, od, oh, ow), jnp.int32)
+    for dz in range(kd):
+        for dy in range(kh):
+            for dx in range(kw):
+                sl = jax.lax.slice(
+                    xp, (0, 0, dz, dy, dx),
+                    (n, c, dz + (od - 1) * sd + 1,
+                     dy + (oh - 1) * sh + 1, dx + (ow - 1) * sw + 1),
+                    (1, 1, sd, sh, sw))
+                idx = (((gd + dz - pd) * h + gh + dy - ph) * w
+                       + gw + dx - pw).astype(jnp.int32)
+                upd = sl > best
+                best = jnp.where(upd, sl, best)
+                bidx = jnp.where(upd, idx[None, None], bidx)
+    return {"Out": [best], "Mask": [bidx]}
 
 
 @register_op("unpool", nondiff_inputs=("Indices",))

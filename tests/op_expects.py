@@ -2970,6 +2970,284 @@ def _cudnn_gru_ref(i, a):
 exp_("cudnn_gru", _cudnn_gru_ref)
 
 
+def _avg_accumulates_ref(i, a):
+    # average_accumulates_op.h:43-110
+    p = i["Param"].astype(np.float64)
+    s1 = i["InSum1"].astype(np.float64)
+    s2 = i["InSum2"].astype(np.float64)
+    s3 = i["InSum3"].astype(np.float64)
+    na = int(i["InNumAccumulates"].reshape(-1)[0]) + 1
+    ona = int(i["InOldNumAccumulates"].reshape(-1)[0]) \
+        if "InOldNumAccumulates" in i else 0
+    nu = (int(i["InNumUpdates"].reshape(-1)[0]) + 1
+          if "InNumUpdates" in i else na)
+    # aliased-accumulator semantics: branches read the updated sum1
+    o1, o2, o3 = s1 + p, s2.copy(), s3.copy()
+    if nu % 16384 == 0:
+        o2 = o2 + o1
+        o1 = np.zeros_like(o1)
+    if na >= a["min_average_window"] and na >= min(
+            a["max_average_window"], int(nu * a["average_window"])):
+        o3 = o1 + o2
+        o1 = np.zeros_like(o1)
+        o2 = np.zeros_like(o2)
+        ona, na = na, 0
+    return {"OutSum1": [o1.astype(np.float32)],
+            "OutSum2": [o2.astype(np.float32)],
+            "OutSum3": [o3.astype(np.float32)],
+            "OutNumAccumulates": [np.asarray([na], np.int64)],
+            "OutOldNumAccumulates": [np.asarray([ona], np.int64)],
+            "OutNumUpdates": [np.asarray([nu], np.int64)]}
+
+
+exp_("average_accumulates", _avg_accumulates_ref)
+
+
+def _max_pool3d_index_ref(i, a):
+    x = i["X"]
+    kd, kh, kw = a["ksize"]
+    sd, sh, sw = a["strides"]
+    n, c, d, h, w = x.shape
+    od, oh, ow = ((d - kd) // sd + 1, (h - kh) // sh + 1,
+                  (w - kw) // sw + 1)
+    out = np.zeros((n, c, od, oh, ow), x.dtype)
+    idx = np.zeros((n, c, od, oh, ow), np.int64)
+    for pi in range(od):
+        for pj in range(oh):
+            for pk in range(ow):
+                win = x[:, :, pi * sd:pi * sd + kd,
+                        pj * sh:pj * sh + kh, pk * sw:pk * sw + kw]
+                flat = win.reshape(n, c, -1)
+                am = flat.argmax(-1)
+                out[:, :, pi, pj, pk] = flat.max(-1)
+                dd = pi * sd + am // (kh * kw)
+                hh = pj * sh + (am % (kh * kw)) // kw
+                ww = pk * sw + am % kw
+                idx[:, :, pi, pj, pk] = (dd * h + hh) * w + ww
+    return {"Out": [out], "Mask": [idx]}
+
+
+exp_("max_pool3d_with_index", _max_pool3d_index_ref)
+
+
+def _dgc_ref(i, a):
+    # dgc_op.h: U = m·U + g; V += U; threshold at the k-th largest |V|
+    u = a["m"] * i["U"] + i["Grad"]
+    v = i["V"] + u
+    ratio = 1.0 - a["sparsity"][-1]
+    k = max(int(v.size * ratio), 1)
+    thr = np.sort(np.abs(v).reshape(-1))[::-1][k - 1]
+    mask = np.abs(v) >= thr
+    enc = np.where(mask, v, 0.0)
+    return {"EncodeGrad": [enc.astype(np.float32)],
+            "U_out": [np.where(mask, 0.0, u).astype(np.float32)],
+            "V_out": [np.where(mask, 0.0, v).astype(np.float32)]}
+
+
+exp_("dgc", _dgc_ref)
+
+
+def _trilinear_interp_ref(i, a):
+    x = i["X"].astype(np.float64)
+    n, c, d, h, w = x.shape
+    od, oh, ow = a["out_d"], a["out_h"], a["out_w"]
+    align = a.get("align_corners", True)
+    mode = a.get("align_mode", 1)
+
+    def src(oi, dim, odim):
+        if align:
+            return oi * (dim - 1) / max(odim - 1, 1)
+        if mode == 0:
+            return max((oi + 0.5) * dim / odim - 0.5, 0.0)
+        return oi * dim / odim
+
+    out = np.zeros((n, c, od, oh, ow))
+    for zi in range(od):
+        for yi in range(oh):
+            for xi in range(ow):
+                fz = src(zi, d, od)
+                fy = src(yi, h, oh)
+                fx = src(xi, w, ow)
+                z0, y0, x0 = int(fz), int(fy), int(fx)
+                z1 = min(z0 + 1, d - 1)
+                y1 = min(y0 + 1, h - 1)
+                x1 = min(x0 + 1, w - 1)
+                dz, dy, dx = fz - z0, fy - y0, fx - x0
+                acc = 0.0
+                for (za, wz) in ((z0, 1 - dz), (z1, dz)):
+                    for (ya, wy) in ((y0, 1 - dy), (y1, dy)):
+                        for (xa, wx) in ((x0, 1 - dx), (x1, dx)):
+                            acc = acc + wz * wy * wx * x[:, :, za, ya, xa]
+                out[:, :, zi, yi, xi] = acc
+    return {"Out": [out.astype(np.float32)]}
+
+
+exp_("trilinear_interp", _trilinear_interp_ref)
+# padded time-axis concat of equal-batch sequences
+exp_("sequence_concat", lambda i, a: {"Out": [np.concatenate(
+    [i["sqc_a"], i["sqc_b"]], axis=1)]})
+
+
+def _sequence_scatter_ref(i, a):
+    out = i["X"].astype(np.float64).copy()
+    ids, upd = i["Ids"], i["Updates"]
+    for r in range(out.shape[0]):
+        for k in range(ids.shape[1]):
+            out[r, ids[r, k]] += upd[r, k]
+    return {"Out": [out.astype(np.float32)]}
+
+
+exp_("sequence_scatter", _sequence_scatter_ref)
+# documented fused global-dice contract: 1 − 2Σxl/(Σx+Σl+1e-5)
+exp_("dice_loss", lambda i, a: {"Out": [np.float32(
+    1 - 2 * (i["X"] * i["Label"]).sum()
+    / ((i["X"].sum() + i["Label"].sum()) + 1e-5))]})
+exp_("fake_channel_wise_dequantize_max_abs", lambda i, a: {"Out": [
+    i["X"] * i["Scales"].reshape(-1, 1)
+    / float((1 << (a["quant_bits"][0] - 1)) - 1)]})
+
+
+def _fusion_seqexpand_concat_fc_ref(i, a):
+    # fusion_seqexpand_concat_fc_op: non-reference inputs broadcast
+    # over the reference sequence's time axis, concat, fc, activation
+    ref = i["fsecf_a"]
+    b, t, d = ref.shape
+    other = np.broadcast_to(i["fsecf_b"][:, None, :],
+                            (b, t, i["fsecf_b"].shape[-1]))
+    cat = np.concatenate([ref, other], axis=-1)
+    out = cat @ i["FCWeight"]
+    if a.get("fc_activation", "relu") == "relu":
+        out = np.maximum(out, 0.0)
+    return {"Out": [out.astype(np.float32)]}
+
+
+exp_("fusion_seqexpand_concat_fc", _fusion_seqexpand_concat_fc_ref)
+
+
+def _hsigmoid_ref(i, a):
+    # matrix_bit_code.h SimpleCode (:109-118): code = label+num_classes,
+    # node j = (code >> (j+1)) − 1, bit j = code & (1<<j);
+    # loss = Σ softplus(pre) − bit·pre over the path
+    x, w = i["X"].astype(np.float64), i["W"].astype(np.float64)
+    lbl = i["Label"].reshape(-1)
+    ncls = a["num_classes"]
+    bias = i["Bias"].reshape(-1).astype(np.float64) if "Bias" in i \
+        else None
+    out = np.zeros((len(lbl), 1))
+    for r, c in enumerate(lbl):
+        code = int(c) + ncls
+        for bit in range(code.bit_length() - 1):
+            node = (code >> (bit + 1)) - 1
+            b = (code >> bit) & 1
+            pre = x[r] @ w[node % w.shape[0]]
+            if bias is not None:
+                pre += bias[node % bias.shape[0]]
+            out[r, 0] += np.log1p(np.exp(pre)) - b * pre
+    return {"Out": [out.astype(np.float32)]}
+
+
+exp_("hierarchical_sigmoid", _hsigmoid_ref)
+
+
+def _deformable_psroi_ref(i, a):
+    # documented TPU sampling contract (straggler_ops.py): bin (pi, pj)
+    # reads channel group pi·pw+pj, origin y1 + pi·bin_h shifted by
+    # Trans·trans_std·span, averaged over an (s+0.5)/s bilinear grid
+    x, rois, tr = i["Input"], i["ROIs"], i["Trans"]
+    ph, pw = a["pooled_height"], a["pooled_width"]
+    oc = a["output_dim"]
+    scale = a["spatial_scale"]
+    std = a["trans_std"]
+    samp = a["sample_per_part"]
+    n, c, h, w = x.shape
+    out = np.zeros((rois.shape[0], oc, ph, pw))
+
+    def bil(feat, y, xx):
+        y0, x0 = int(np.floor(y)), int(np.floor(xx))
+        v = 0.0
+        for yy in (y0, y0 + 1):
+            for xc in (x0, x0 + 1):
+                if 0 <= yy < h and 0 <= xc < w:
+                    v += (1 - abs(y - yy)) * (1 - abs(xx - xc)) \
+                        * feat[yy, xc]
+        return v
+
+    for r in range(rois.shape[0]):
+        x1, y1, x2, y2 = rois[r] * scale
+        rw, rh = max(x2 - x1, 0.1), max(y2 - y1, 0.1)
+        bw, bh = rw / pw, rh / ph
+        for pi in range(ph):
+            for pj in range(pw):
+                oy = y1 + pi * bh + tr[r, 1, pi, pj] * std * rh
+                ox = x1 + pj * bw + tr[r, 0, pi, pj] * std * rw
+                cix_base = pi * pw + pj
+                for co in range(oc):
+                    cix = co * ph * pw + cix_base
+                    acc = 0.0
+                    for si in range(samp):
+                        for sj in range(samp):
+                            acc += bil(x[0, cix],
+                                       oy + (si + 0.5) / samp * bh,
+                                       ox + (sj + 0.5) / samp * bw)
+                    out[r, co, pi, pj] = acc / (samp * samp)
+    return {"Output": [out.astype(np.float32)]}
+
+
+exp_("deformable_psroi_pooling", _deformable_psroi_ref)
+
+# ---------------------------------------------------------------------------
+# ops intentionally left without an independent numpy reference —
+# recorded so OP_TEST_MATRIX distinguishes "cannot witness" from
+# "not yet witnessed"
+# ---------------------------------------------------------------------------
+NOREF_REASONS = {
+    "uniform_random": "stochastic output; moment checks only",
+    "gaussian_random": "stochastic output; moment checks only",
+    "truncated_gaussian_random": "stochastic output",
+    "uniform_random_batch_size_like": "stochastic output",
+    "gaussian_random_batch_size_like": "stochastic output",
+    "randint": "stochastic output",
+    "random_crop": "stochastic crop origin",
+    "sampling_id": "stochastic sampling",
+    "dpsgd": "stochastic DP noise",
+    "nce": "stochastic negative sampling",
+    "sample_logits": "stochastic candidate sampling",
+    "hash": "reference uses xxhash (external dependency); the TPU "
+            "lowering documents its own polynomial bucket hash",
+    "pull_box_sparse": "host-side BoxPS table service; roundtrip "
+                       "covered in tests/test_straggler_ops.py",
+    "generate_proposals": "multi-stage NMS pipeline; components "
+                          "witnessed via box_coder/iou/nms refs",
+    "generate_proposal_labels": "stochastic fg/bg subsampling in the "
+                                "reference; deterministic redesign "
+                                "covered by dedicated tests",
+    "collect_fpn_proposals": "re-sort/merge plumbing over witnessed "
+                             "component ops",
+    "distribute_fpn_proposals": "level-routing plumbing over "
+                                "witnessed component ops",
+    "retinanet_target_assign": "delegates to the witnessed "
+                               "rpn_target_assign contract",
+    "retinanet_detection_output": "per-level NMS pipeline; components "
+                                  "witnessed via nms/box refs",
+    "roi_perspective_transform": "homography warp; covered by "
+                                 "dedicated batch-routing regression "
+                                 "test",
+    "prroi_pool": "closed-form integral pooling; grad-checked "
+                  "numerically instead",
+    "yolov3_loss": "composite assigner+loss; grad-checked and "
+                   "covered by yolo_box witness for the decode math",
+    "detection_map": "multi-stage mAP accumulation; covered by "
+                     "perfect-detection invariant test",
+    "chunk_eval": "IOB span parsing; covered by dedicated "
+                  "perfect-match invariant test",
+    "similarity_focus": "argmax-selection mask; covered by "
+                        "shape/selection tests",
+    "tree_conv": "message-passing redesign documented in lowering",
+    "conv2d_inception_fusion": "fused branch graph; each branch is "
+                               "the witnessed conv2d math",
+}
+
+
 exp_("quantize", lambda i, a: {"Output": [np.clip(
     np.round(i["Input"] * a.get("Scale", 1.0)), -128, 127)
     .astype(np.int8)]})

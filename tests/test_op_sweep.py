@@ -275,6 +275,10 @@ def write_matrix(path="OP_TEST_MATRIX.json"):
                              "grad_checked": sorted(s["grad"]),
                              "exact": s["exact"],
                              "numpy_ref": s["expect"] is not None}
+                if s["expect"] is None:
+                    from op_expects import NOREF_REASONS
+                    if t in NOREF_REASONS:
+                        matrix[t]["noref_reason"] = NOREF_REASONS[t]
             except Exception as e:  # pragma: no cover
                 matrix[t] = {"status": "fail",
                              "error": traceback.format_exception_only(
