@@ -3200,6 +3200,76 @@ exp_("deformable_psroi_pooling", _deformable_psroi_ref)
 # recorded so OP_TEST_MATRIX distinguishes "cannot witness" from
 # "not yet witnessed"
 # ---------------------------------------------------------------------------
+grads("box_clip", "Input")          # piecewise-linear clamp
+grads("target_assign", "X")         # gather of matched rows
+# box_decoder_and_assign: numeric deltas cross the dw/dh upper-clip
+# kink; bucketed under discrete assigners below
+
+# why the remaining pass-ops carry no numeric grad check — grouped so
+# OP_TEST_MATRIX can state it per op
+_NOGRAD_GROUPS = {
+    "optimizer state-update rule, not an autodiff surface": [
+        "sgd", "momentum", "adam", "adamw", "adamax", "adagrad",
+        "adadelta", "decayed_adagrad", "rmsprop", "ftrl", "lamb",
+        "lars_momentum", "proximal_gd", "proximal_adagrad", "dpsgd",
+        "dgc_momentum", "average_accumulates", "dgc",
+        "dgc_clip_by_norm"],
+    "integer/bool output": [
+        "equal", "not_equal", "less_than", "less_equal",
+        "greater_than", "greater_equal", "logical_and", "logical_or",
+        "logical_xor", "logical_not", "arg_max", "arg_min",
+        "reduce_all", "reduce_any", "one_hot", "one_hot_v2",
+        "shard_index", "sequence_mask", "sequence_enumerate",
+        "sequence_erase", "is_empty", "isfinite", "has_inf", "has_nan",
+        "shape", "size", "where", "where_index", "unique",
+        "unique_with_counts", "edit_distance", "ctc_align",
+        "crf_decoding", "hash", "elementwise_mod",
+        "elementwise_floordiv", "bipartite_match", "filter_by_instag",
+        "lod_reset", "increment", "randint"],
+    "stochastic op": [
+        "uniform_random", "gaussian_random",
+        "truncated_gaussian_random", "uniform_random_batch_size_like",
+        "gaussian_random_batch_size_like", "random_crop", "sampling_id",
+        "nce", "sample_logits"],
+    "constant/generator output": [
+        "fill", "fill_constant", "fill_any_like", "fill_zeros_like",
+        "fill_zeros_like2", "fill_constant_batch_size_like", "eye",
+        "diag", "linspace", "range", "assign_value",
+        "anchor_generator", "prior_box", "density_prior_box"],
+    "STE gradient is intentionally not the numeric derivative": [
+        "fake_quantize_abs_max", "fake_channel_wise_quantize_abs_max",
+        "fake_quantize_moving_average_abs_max",
+        "fake_quantize_dequantize_moving_average_abs_max",
+        "fake_quantize_range_abs_max", "fake_dequantize_max_abs",
+        "fake_channel_wise_dequantize_max_abs",
+        "moving_average_abs_max_scale", "quantize", "dequantize",
+        "requantize"],
+    "reference defines a custom non-derivative gradient": ["cvm"],
+    "discrete assigner/selector (reference registers no grad)": [
+        "mine_hard_examples", "rpn_target_assign",
+        "retinanet_target_assign", "retinanet_detection_output",
+        "multiclass_nms", "multiclass_nms2", "generate_proposals",
+        "generate_proposal_labels", "generate_mask_labels",
+        "collect_fpn_proposals", "distribute_fpn_proposals",
+        "polygon_box_transform", "iou_similarity", "similarity_focus",
+        "yolo_box", "roi_perspective_transform", "roi_pool",
+        "max_pool3d_with_index", "spp", "pull_box_sparse",
+        "box_decoder_and_assign"],
+    "metric accumulator": [
+        "accuracy", "auc", "precision_recall", "mean_iou",
+        "chunk_eval", "detection_map", "positive_negative_pair"],
+    "relu kink at 0 flips under numeric deltas; branch convs are "
+    "grad-checked via conv2d/conv2d_fusion": [
+        "conv2d_inception_fusion"],
+    "log(pool+1) needs positivity the numeric perturbation breaks "
+    "at the margin; pool+cvm legs grad-checked individually": [
+        "fusion_seqpool_cvm_concat"],
+}
+NOGRAD_REASONS = {}
+for _reason, _ops in _NOGRAD_GROUPS.items():
+    for _o in _ops:
+        NOGRAD_REASONS[_o] = _reason
+
 NOREF_REASONS = {
     "uniform_random": "stochastic output; moment checks only",
     "gaussian_random": "stochastic output; moment checks only",

@@ -279,6 +279,10 @@ def write_matrix(path="OP_TEST_MATRIX.json"):
                     from op_expects import NOREF_REASONS
                     if t in NOREF_REASONS:
                         matrix[t]["noref_reason"] = NOREF_REASONS[t]
+                if not s["grad"]:
+                    from op_expects import NOGRAD_REASONS
+                    if t in NOGRAD_REASONS:
+                        matrix[t]["nograd_reason"] = NOGRAD_REASONS[t]
             except Exception as e:  # pragma: no cover
                 matrix[t] = {"status": "fail",
                              "error": traceback.format_exception_only(
