@@ -10,23 +10,39 @@ from __future__ import annotations
 from ..layer_helper import LayerHelper
 
 __all__ = ["shard_hint", "c_allreduce_sum", "c_broadcast", "c_allgather",
-           "c_reducescatter", "ring_attention"]
+           "c_reducescatter", "ring_attention", "ulysses_attention"]
 
 
-def ring_attention(q, k, v, causal=False, sm_scale=None, seq_axis="sp",
-                   batch_axis="dp", name=None):
+def _seq_attention_layer(op_type, doc):
+    def layer(q, k, v, causal=False, sm_scale=None, seq_axis="sp",
+              batch_axis="dp", name=None):
+        helper = LayerHelper(op_type, name=name)
+        out = helper.create_variable_for_type_inference(q.dtype)
+        attrs = {"causal": causal, "seq_axis": seq_axis,
+                 "batch_axis": batch_axis}
+        if sm_scale is not None:
+            attrs["sm_scale"] = float(sm_scale)
+        helper.append_op(type=op_type,
+                         inputs={"Q": [q.name], "K": [k.name],
+                                 "V": [v.name]},
+                         outputs={"Out": [out.name]}, attrs=attrs)
+        return out
+    layer.__name__ = op_type
+    layer.__doc__ = doc
+    return layer
+
+
+ring_attention = _seq_attention_layer(
+    "ring_attention",
     """Sequence-parallel attention over [b, h, T, d]: K/V blocks rotate
-    around the mesh's seq axis (parallel/ring_attention.py)."""
-    helper = LayerHelper("ring_attention", name=name)
-    out = helper.create_variable_for_type_inference(q.dtype)
-    attrs = {"causal": causal, "seq_axis": seq_axis,
-             "batch_axis": batch_axis}
-    if sm_scale is not None:
-        attrs["sm_scale"] = float(sm_scale)
-    helper.append_op(type="ring_attention",
-                     inputs={"Q": [q.name], "K": [k.name], "V": [v.name]},
-                     outputs={"Out": [out.name]}, attrs=attrs)
-    return out
+    around the mesh's seq axis (parallel/ring_attention.py).""")
+ulysses_attention = _seq_attention_layer(
+    "ulysses_attention",
+    """All-to-all (Ulysses) sequence-parallel attention over
+    [b, h, T, d]: two all-to-alls trade the sequence sharding for a
+    head sharding, exact blockwise attention runs per head group
+    (parallel/ulysses.py). Requires seq-axis size | n_heads; use
+    ring_attention below that.""")
 
 
 def shard_hint(x, spec, name=None):

@@ -499,3 +499,18 @@ def test_moe_layer_trains_in_static_graph():
             losses.append(float(lv))
         assert losses[-1] < losses[0] * 0.8, (losses[0], losses[-1])
         assert 0.0 < float(ld) <= 1.0
+
+
+def test_long_context_example_trains():
+    """examples/long_context.py: ring attention through the fluid API
+    over the sp=8 mesh — the user-facing long-context walkthrough."""
+    import os
+    import subprocess
+    import sys
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, os.path.join(root, "examples/long_context.py"),
+         "--cpu", "--steps", "8", "--seq", "128"],
+        capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "ring attention over sp=8" in r.stdout
