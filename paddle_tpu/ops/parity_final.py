@@ -474,33 +474,69 @@ def _roi_perspective_transform(ctx, ins, attrs):
     r = rois.shape[0]
     bidx = _batch_index_of_rois(ins, r)
 
+    def transform_matrix(qx, qy):
+        # get_transform_matrix (roi_perspective_transform_op.cc:110-160):
+        # homography mapping the [0, nw-1]x[0, nh-1] rect onto the quad,
+        # with the rect width estimated from the quad's side lengths
+        len1 = jnp.hypot(qx[0] - qx[1], qy[0] - qy[1])
+        len2 = jnp.hypot(qx[1] - qx[2], qy[1] - qy[2])
+        len3 = jnp.hypot(qx[2] - qx[3], qy[2] - qy[3])
+        len4 = jnp.hypot(qx[3] - qx[0], qy[3] - qy[0])
+        est_h = (len2 + len4) / 2.0
+        est_w = (len1 + len3) / 2.0
+        nh = max(2, oh)
+        nw = jnp.clip(jnp.round(est_w * (nh - 1)
+                                / jnp.maximum(est_h, 1e-5)) + 1, 2, ow)
+        dx1, dx2 = qx[1] - qx[2], qx[3] - qx[2]
+        dx3 = qx[0] - qx[1] + qx[2] - qx[3]
+        dy1, dy2 = qy[1] - qy[2], qy[3] - qy[2]
+        dy3 = qy[0] - qy[1] + qy[2] - qy[3]
+        den = dx1 * dy2 - dx2 * dy1 + 1e-5
+        m6 = (dx3 * dy2 - dx2 * dy3) / den / (nw - 1)
+        m7 = (dx1 * dy3 - dx3 * dy1) / den / (nh - 1)
+        m3 = (qy[1] - qy[0] + m6 * (nw - 1) * qy[1]) / (nw - 1)
+        m4 = (qy[3] - qy[0] + m7 * (nh - 1) * qy[3]) / (nh - 1)
+        m0 = (qx[1] - qx[0] + m6 * (nw - 1) * qx[1]) / (nw - 1)
+        m1 = (qx[3] - qx[0] + m7 * (nh - 1) * qx[3]) / (nh - 1)
+        return jnp.stack([m0, m1, qx[0], m3, m4, qy[0],
+                          m6, m7, jnp.ones_like(m0)]), nw
+
     def one(feat, quad):
-        q = (quad * scale).reshape(4, 2)  # tl, tr, br, bl
-        u = jnp.linspace(0, 1, ow)[None, :]
-        v = jnp.linspace(0, 1, oh)[:, None]
-        top = q[0] + (q[1] - q[0]) * u[..., None]
-        bot = q[3] + (q[2] - q[3]) * u[..., None]
-        pts = top + (bot - top) * v[..., None]   # [oh, ow, 2] bilinear quad
-        gx, gy = pts[..., 0], pts[..., 1]
+        qx = quad[0::2] * scale
+        qy = quad[1::2] * scale
+        m, nw = transform_matrix(qx, qy)
+        jj = jnp.arange(ow, dtype=x.dtype)[None, :]
+        ii = jnp.arange(oh, dtype=x.dtype)[:, None]
+        u = m[0] * jj + m[1] * ii + m[2]
+        v = m[3] * jj + m[4] * ii + m[5]
+        ww = m[6] * jj + m[7] * ii + m[8]
+        gx = u / ww
+        gy = v / ww
+        # pixels past the estimated width, or sampling outside the
+        # image, produce zeros with mask 0 (the reference's in_quad +
+        # bilinear bounds)
+        inb = ((jj <= nw - 1) & (gx >= -0.5) & (gx <= w - 0.5)
+               & (gy >= -0.5) & (gy <= h - 0.5))
         x0 = jnp.clip(jnp.floor(gx), 0, w - 1).astype(jnp.int32)
         y0 = jnp.clip(jnp.floor(gy), 0, h - 1).astype(jnp.int32)
         x1 = jnp.clip(x0 + 1, 0, w - 1)
         y1 = jnp.clip(y0 + 1, 0, h - 1)
-        wx = gx - x0
-        wy = gy - y0
+        wx = jnp.clip(gx - x0, 0.0, 1.0)
+        wy = jnp.clip(gy - y0, 0.0, 1.0)
 
         def tap(yy, xx):
             return feat[:, yy, xx]
 
-        return (tap(y0, x0) * (1 - wx) * (1 - wy) +
-                tap(y0, x1) * wx * (1 - wy) +
-                tap(y1, x0) * (1 - wx) * wy +
-                tap(y1, x1) * wx * wy)
+        val = (tap(y0, x0) * (1 - wx) * (1 - wy) +
+               tap(y0, x1) * wx * (1 - wy) +
+               tap(y1, x0) * (1 - wx) * wy +
+               tap(y1, x1) * wx * wy)
+        return jnp.where(inb[None], val, 0.0), inb, m
 
-    out = jax.vmap(one)(x[bidx], rois)
+    out, inb, mats = jax.vmap(one)(x[bidx], rois)
     return {"Out": [out],
-            "Mask": [jnp.ones((r, 1, oh, ow), jnp.int32)],
-            "TransformMatrix": [jnp.zeros((r, 9), x.dtype)],
+            "Mask": [inb[:, None].astype(jnp.int32)],
+            "TransformMatrix": [mats],
             "Out2InIdx": [jnp.zeros((r, 1), jnp.int32)],
             "Out2InWeights": [jnp.ones((r, 1), x.dtype)]}
 

@@ -3195,6 +3195,148 @@ def _deformable_psroi_ref(i, a):
 
 exp_("deformable_psroi_pooling", _deformable_psroi_ref)
 
+
+def _chunk_eval_ref(i, a):
+    # chunk_eval_op.h:41-78 GetSegments with the IOB table
+    # (num_tag_types=2, tag 0=B / 1=I, O encoded as type==num_chunk_types)
+    nt = a["num_chunk_types"]
+
+    def segments(seq):
+        segs = []
+        start = ptype = None
+        for pos, v in enumerate(int(x) for x in seq):
+            tag, typ = v % 2, v // 2
+            if typ >= nt:  # O
+                if start is not None:
+                    segs.append((start, pos, ptype))
+                start = None
+                ptype = None
+                continue
+            if tag == 0 or start is None or typ != ptype:
+                if start is not None:
+                    segs.append((start, pos, ptype))
+                start = pos
+            ptype = typ
+        if start is not None:
+            segs.append((start, len(seq), ptype))
+        return set(segs)
+
+    inf = i["Inference"].reshape(i["Inference"].shape[0], -1)
+    lab = i["Label"].reshape(i["Label"].shape[0], -1)
+    ic = lc = cc = 0
+    for a_, b_ in zip(inf, lab):
+        sa, sb = segments(a_), segments(b_)
+        ic += len(sa)
+        lc += len(sb)
+        cc += len(sa & sb)
+    p = cc / ic if ic else 0.0
+    r = cc / lc if lc else 0.0
+    f = 2 * p * r / (p + r) if p + r else 0.0
+    mk = lambda v, d: np.asarray([v], d)  # noqa: E731
+    return {"Precision": [mk(p, np.float32)],
+            "Recall": [mk(r, np.float32)],
+            "F1-Score": [mk(f, np.float32)],
+            "NumInferChunks": [mk(ic, np.int32)],
+            "NumLabelChunks": [mk(lc, np.int32)],
+            "NumCorrectChunks": [mk(cc, np.int32)]}
+
+
+exp_("chunk_eval", _chunk_eval_ref)
+
+
+def _inception_ref(i, a):
+    # the documented branch graph over fusion_conv_inception_op.cc's
+    # InferShape channel bookkeeping, rebuilt from the conv2d ref
+    x = i["Input"]
+    f = [i["inc_f0"], i["inc_f1"], i["inc_f2"], i["inc_f3"]]
+    bs = [i["inc_b0"], i["inc_b1"], i["inc_b2"], i["inc_b3"]]
+
+    def conv(inp, w, bias, k):
+        pad = (k - 1) // 2
+        y = _conv2d_np(inp, w, [1, 1], [pad, pad])
+        return np.maximum(y + bias.reshape(1, -1, 1, 1), 0.0)
+
+    # 3x3/1 avg pool, pad 1, EXCLUSIVE counting (pad cells not counted)
+    n, c, h, w = x.shape
+    pooled = np.zeros_like(x)
+    for pi in range(h):
+        for pj in range(w):
+            y0, y1 = max(pi - 1, 0), min(pi + 2, h)
+            x0, x1 = max(pj - 1, 0), min(pj + 2, w)
+            pooled[:, :, pi, pj] = x[:, :, y0:y1, x0:x1].mean((2, 3))
+    c2i, c3i = f[2].shape[1], f[3].shape[1]
+    b_a = conv(pooled, f[0], bs[0], f[0].shape[2])
+    t = conv(x, f[1], bs[1], f[1].shape[2])
+    keep1 = t.shape[1] - 2 * c2i
+    r1 = t[:, :keep1]
+    u_a = conv(t[:, keep1:keep1 + c2i], f[2], bs[2], f[2].shape[2])
+    u_b = conv(t[:, keep1 + c2i:], f[2], bs[2], f[2].shape[2])
+    keep2 = u_a.shape[1] - c3i
+    b_d = conv(u_b[:, keep2:], f[3], bs[3], f[3].shape[2])
+    out = np.concatenate([b_a, r1, u_a[:, :keep2], b_d], axis=1)
+    return {"Output": [out.astype(np.float32)]}
+
+
+exp_("conv2d_inception_fusion", _inception_ref)
+
+
+def _roi_perspective_ref(i, a):
+    # roi_perspective_transform_op.cc:110-175 homography + bilinear
+    x = i["X"].astype(np.float64)
+    rois = i["ROIs"]
+    oh, ow = a["transformed_height"], a["transformed_width"]
+    scale = a.get("spatial_scale", 1.0)
+    n, c, h, w = x.shape
+    outs = np.zeros((rois.shape[0], c, oh, ow))
+    for r in range(rois.shape[0]):
+        qx = rois[r, 0::2].astype(np.float64) * scale
+        qy = rois[r, 1::2].astype(np.float64) * scale
+        l1 = np.hypot(qx[0] - qx[1], qy[0] - qy[1])
+        l2 = np.hypot(qx[1] - qx[2], qy[1] - qy[2])
+        l3 = np.hypot(qx[2] - qx[3], qy[2] - qy[3])
+        l4 = np.hypot(qx[3] - qx[0], qy[3] - qy[0])
+        est_h, est_w = (l2 + l4) / 2, (l1 + l3) / 2
+        nh = max(2, oh)
+        nw = max(2, min(int(round(est_w * (nh - 1) / est_h)) + 1, ow))
+        dx1, dx2 = qx[1] - qx[2], qx[3] - qx[2]
+        dx3 = qx[0] - qx[1] + qx[2] - qx[3]
+        dy1, dy2 = qy[1] - qy[2], qy[3] - qy[2]
+        dy3 = qy[0] - qy[1] + qy[2] - qy[3]
+        den = dx1 * dy2 - dx2 * dy1 + 1e-5
+        m = np.zeros(9)
+        m[6] = (dx3 * dy2 - dx2 * dy3) / den / (nw - 1)
+        m[7] = (dx1 * dy3 - dx3 * dy1) / den / (nh - 1)
+        m[8] = 1.0
+        m[3] = (qy[1] - qy[0] + m[6] * (nw - 1) * qy[1]) / (nw - 1)
+        m[4] = (qy[3] - qy[0] + m[7] * (nh - 1) * qy[3]) / (nh - 1)
+        m[5] = qy[0]
+        m[0] = (qx[1] - qx[0] + m[6] * (nw - 1) * qx[1]) / (nw - 1)
+        m[1] = (qx[3] - qx[0] + m[7] * (nh - 1) * qx[3]) / (nh - 1)
+        m[2] = qx[0]
+        for ii in range(oh):
+            for jj in range(ow):
+                u = m[0] * jj + m[1] * ii + m[2]
+                v = m[3] * jj + m[4] * ii + m[5]
+                ww = m[6] * jj + m[7] * ii + m[8]
+                gx, gy = u / ww, v / ww
+                if (jj > nw - 1 or gx < -0.5 or gx > w - 0.5
+                        or gy < -0.5 or gy > h - 0.5):
+                    continue
+                x0 = min(max(int(np.floor(gx)), 0), w - 1)
+                y0 = min(max(int(np.floor(gy)), 0), h - 1)
+                x1, y1 = min(x0 + 1, w - 1), min(y0 + 1, h - 1)
+                wx = min(max(gx - x0, 0.0), 1.0)
+                wy = min(max(gy - y0, 0.0), 1.0)
+                outs[r, :, ii, jj] = (
+                    x[0, :, y0, x0] * (1 - wx) * (1 - wy)
+                    + x[0, :, y0, x1] * wx * (1 - wy)
+                    + x[0, :, y1, x0] * (1 - wx) * wy
+                    + x[0, :, y1, x1] * wx * wy)
+    return {"Out": [outs.astype(np.float32)]}
+
+
+exp_("roi_perspective_transform", _roi_perspective_ref)
+
 # ---------------------------------------------------------------------------
 # ops intentionally left without an independent numpy reference —
 # recorded so OP_TEST_MATRIX distinguishes "cannot witness" from
@@ -3299,22 +3441,15 @@ NOREF_REASONS = {
                                "rpn_target_assign contract",
     "retinanet_detection_output": "per-level NMS pipeline; components "
                                   "witnessed via nms/box refs",
-    "roi_perspective_transform": "homography warp; covered by "
-                                 "dedicated batch-routing regression "
-                                 "test",
     "prroi_pool": "closed-form integral pooling; grad-checked "
                   "numerically instead",
     "yolov3_loss": "composite assigner+loss; grad-checked and "
                    "covered by yolo_box witness for the decode math",
     "detection_map": "multi-stage mAP accumulation; covered by "
                      "perfect-detection invariant test",
-    "chunk_eval": "IOB span parsing; covered by dedicated "
-                  "perfect-match invariant test",
     "similarity_focus": "argmax-selection mask; covered by "
                         "shape/selection tests",
     "tree_conv": "message-passing redesign documented in lowering",
-    "conv2d_inception_fusion": "fused branch graph; each branch is "
-                               "the witnessed conv2d math",
 }
 
 
