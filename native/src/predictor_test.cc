@@ -9,6 +9,8 @@
 #include <cstring>
 #include <vector>
 
+#include "test_deadline.h"
+
 extern "C" {
 int ptn_predictor_init(const char* repo_root);
 void* ptn_predictor_load(const char* model_dir);
@@ -37,6 +39,7 @@ int ptn_trainer_exec(const char* code);
   } while (0)
 
 int main(int argc, char** argv) {
+  ptn_test::install_deadline("predictor_test");
   const char* repo = argc > 1 ? argv[1] : "..";
   CHECK(ptn_predictor_init(repo) == 0);
 

@@ -64,18 +64,34 @@ inline int bootstrap(const char* repo_root, const char* module) {
   int rc = 0;
   {
     Gil gil;
+    if (embedded && !std::getenv("PTN_TRAINER_KEEP_PLATFORM")) {
+      // The env var alone is not enough: site images that register a
+      // tunnel PJRT backend from sitecustomize re-pin JAX_PLATFORMS at
+      // interpreter start, so a backend resolve here would claim (or
+      // block on) the tunnel from a side process. jax.config.update
+      // still wins post-import because no XLA client exists yet — the
+      // same pattern tests/conftest.py uses for suite hermeticity.
+      if (PyRun_SimpleString(
+              "import jax\n"
+              "jax.config.update('jax_platforms', 'cpu')\n") != 0) {
+        last_error() = "bootstrap: failed to pin jax to the cpu backend";
+        rc = -1;
+      }
+    }
     PyObject* sys_path = PySys_GetObject("path");  // borrowed
     if (repo_root && *repo_root) {
       PyObject* p = PyUnicode_FromString(repo_root);
       PyList_Insert(sys_path, 0, p);
       Py_DECREF(p);
     }
-    PyObject* mod = PyImport_ImportModule(module);
-    if (!mod) {
-      capture_py_error(module);
-      rc = -1;
-    } else {
-      Py_DECREF(mod);
+    if (rc == 0) {
+      PyObject* mod = PyImport_ImportModule(module);
+      if (!mod) {
+        capture_py_error(module);
+        rc = -1;
+      } else {
+        Py_DECREF(mod);
+      }
     }
   }
   if (embedded) {

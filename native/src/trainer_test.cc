@@ -11,6 +11,8 @@
 #include <string>
 #include <vector>
 
+#include "test_deadline.h"
+
 extern "C" {
 int ptn_trainer_init(const char* repo_root);
 void* ptn_trainer_load(const char* model_dir);
@@ -34,6 +36,7 @@ const char* ptn_trainer_last_error();
   } while (0)
 
 int main(int argc, char** argv) {
+  ptn_test::install_deadline("trainer_test");
   const char* repo = argc > 1 ? argv[1] : "..";
   CHECK(ptn_trainer_init(repo) == 0);
 

@@ -1,0 +1,39 @@
+"""Flash (pallas) vs composed XLA attention at bench shapes, fwd+bwd,
+amortized-RTT timing."""
+import sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np
+import jax, jax.numpy as jnp
+from paddle_tpu.ops.pallas.flash_attention import flash_attention, reference_attention
+
+bh, t, d = 32*12, 512, 64
+k0 = jax.random.PRNGKey(0)
+q = jax.random.normal(k0, (bh, t, d), jnp.bfloat16)
+k = jax.random.normal(k0, (bh, t, d), jnp.bfloat16)
+v = jax.random.normal(k0, (bh, t, d), jnp.bfloat16)
+
+def sync(x):
+    return np.asarray(jax.device_get(jnp.sum(x)))
+
+def timed(f, *args, n=20):
+    g = jax.jit(f)
+    o = g(*args); sync(o)
+    z = jnp.zeros(()); np.asarray(z + 1)
+    t0 = time.perf_counter(); np.asarray(z + 2); rtt = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(n):
+        o = g(*args)
+    sync(o)
+    return max(time.perf_counter() - t0 - rtt, 1e-9) / n
+
+def loss_flash(q, k, v):
+    return jnp.sum(flash_attention(q, k, v).astype(jnp.float32))
+
+def loss_ref(q, k, v):
+    return jnp.sum(reference_attention(q, k, v).astype(jnp.float32))
+
+for name, f in [("flash", loss_flash), ("xla", loss_ref)]:
+    fwd = timed(f, q, k, v)
+    gfn = jax.grad(f, argnums=(0, 1, 2))
+    bwd = timed(lambda q, k, v: sum(jnp.sum(x.astype(jnp.float32)) for x in gfn(q, k, v)), q, k, v)
+    print("%s: fwd %.2f ms  fwd+bwd %.2f ms" % (name, fwd*1e3, bwd*1e3), flush=True)
