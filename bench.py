@@ -9,7 +9,9 @@ star; the reference publishes no numeric baseline — BASELINE.md).
 
 Prints ONE JSON line for the selected model (default: bert).
 BENCH_MODEL selects bert | resnet50 | gpt (causal flash path) |
-both (bert + resnet50) | all (all three).
+transformer (Transformer-big En-De NMT, config 3) | deeplab
+(DeepLabv3+ dilated convs, config 5) | both (bert + resnet50) |
+all (all five).
 """
 from __future__ import annotations
 
@@ -282,6 +284,110 @@ def bench_gpt():
     }
 
 
+def build_transformer_bench(batch=None, src_len=None, trg_len=None):
+    """Transformer-big En-De NMT step (BASELINE config 3); same return
+    contract as build_bert_bench."""
+    import paddle_tpu as fluid
+    from paddle_tpu.models import nmt
+
+    batch = batch or int(os.environ.get("BENCH_BATCH", "32"))
+    src_len = src_len or int(os.environ.get("BENCH_SEQ", "256"))
+    trg_len = trg_len or src_len
+    amp = os.environ.get("BENCH_AMP", "1") == "1"
+    use_flash = os.environ.get("BENCH_FLASH", "1") == "1"
+    cfg = nmt.transformer_big_nmt(dropout=0.1, attn_dropout=0.0,
+                                  use_flash=use_flash)
+    main_prog, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main_prog, startup), fluid.scope_guard(scope):
+        loss, feeds = nmt.build_train(cfg, batch, src_len, trg_len,
+                                      lr=1e-4, amp=amp)
+        exe = fluid.Executor()
+        exe.run(startup)
+    rng = np.random.RandomState(0)
+    feed = {
+        "src_tokens": rng.randint(0, cfg.vocab_size,
+                                  (batch, src_len)).astype(np.int64),
+        "trg_tokens": rng.randint(0, cfg.vocab_size,
+                                  (batch, trg_len + 1)).astype(np.int64),
+    }
+    return exe, main_prog, scope, feed, loss, cfg
+
+
+def bench_transformer():
+    import paddle_tpu as fluid
+    from paddle_tpu.models import nmt
+
+    steps = int(os.environ.get("BENCH_STEPS", "20"))
+    exe, main_prog, scope, feed, loss, cfg = build_transformer_bench()
+    batch, src_len = feed["src_tokens"].shape
+    trg_len = feed["trg_tokens"].shape[1] - 1
+    with fluid.scope_guard(scope):
+        dt, lv, stats = _timed_steps(exe, main_prog, feed, loss, steps)
+    tokens_per_sec = batch * trg_len / dt
+    flops = nmt.flops_per_step(cfg, batch, src_len, trg_len)
+    mfu = flops / dt / peak_flops_per_chip()
+    return {
+        "metric": "transformer_big_ende_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.50, 4),
+        "extra": {"step_ms": round(dt * 1000, 2), "mfu": round(mfu, 4),
+                  "batch": int(batch), "src_len": int(src_len),
+                  "trg_len": int(trg_len),
+                  "loss": float(np.asarray(lv)), **stats},
+    }
+
+
+def build_deeplab_bench(batch=None, img_hw=None):
+    """DeepLabv3+ Cityscapes step (BASELINE config 5 — dilated convs +
+    large activations); same return contract as build_bert_bench."""
+    import paddle_tpu as fluid
+    from paddle_tpu.models import deeplab
+
+    batch = batch or int(os.environ.get("BENCH_BATCH", "8"))
+    img_hw = img_hw or int(os.environ.get("BENCH_IMG", "513"))
+    amp = os.environ.get("BENCH_AMP", "1") == "1"
+    main_prog, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main_prog, startup), fluid.scope_guard(scope):
+        loss, feeds = deeplab.build_train(img_hw=img_hw, batch=batch,
+                                          amp=amp)
+        exe = fluid.Executor()
+        exe.run(startup)
+    rng = np.random.RandomState(0)
+    feed = {
+        "image": rng.randn(batch, 3, img_hw, img_hw).astype(np.float32),
+        "label": rng.randint(0, deeplab.N_CLASSES,
+                             (batch, img_hw, img_hw)).astype(np.int64),
+    }
+    return exe, main_prog, scope, feed, loss, None
+
+
+def bench_deeplab():
+    import paddle_tpu as fluid
+    from paddle_tpu.models import deeplab
+
+    steps = int(os.environ.get("BENCH_STEPS", "20"))
+    exe, main_prog, scope, feed, loss, _ = build_deeplab_bench()
+    batch = feed["image"].shape[0]
+    img_hw = feed["image"].shape[2]
+    with fluid.scope_guard(scope):
+        dt, lv, stats = _timed_steps(exe, main_prog, feed, loss, steps)
+    images_per_sec = batch / dt
+    flops = 3 * deeplab.flops_per_image(img_hw) * batch  # fwd + 2x bwd
+    mfu = flops / dt / peak_flops_per_chip()
+    return {
+        "metric": "deeplabv3p_cityscapes_images_per_sec_per_chip",
+        "value": round(images_per_sec, 1),
+        "unit": "images/s",
+        "vs_baseline": round(mfu / 0.50, 4),
+        "extra": {"step_ms": round(dt * 1000, 2), "mfu": round(mfu, 4),
+                  "batch": int(batch), "img_hw": int(img_hw),
+                  "loss": float(np.asarray(lv)), **stats},
+    }
+
+
 _PROBE_CODE = """
 import jax, numpy as np, jax.numpy as jnp
 d = jax.devices()
@@ -297,14 +403,24 @@ sys.path.insert(0, {root!r})
 os.environ['BENCH_FLASH'] = '0'
 import bench
 import paddle_tpu as fluid
-exe, prog, scope, feed, loss, cfg = bench.build_bert_bench(batch=2,
-                                                           seq_len=64)
+exe, prog, scope, feed, loss, cfg = bench._CPU_TINY_BUILDS[{model!r}]()
 with fluid.scope_guard(scope):
     dt, lv, stats = bench._timed_steps(exe, prog, feed, loss, 2)
 import math
 assert math.isfinite(float(lv)), 'non-finite loss'
 print('cpu ok', dt, float(lv))
 """
+
+# tiny-shape builders used by the wedge-path CPU validation: certify
+# the SELECTED model's bench code path, not just BERT's
+_CPU_TINY_BUILDS = {
+    "bert": lambda: build_bert_bench(batch=2, seq_len=64),
+    "resnet50": lambda: build_resnet50_bench(batch=2),
+    "gpt": lambda: build_gpt_bench(batch=2, seq_len=64),
+    "transformer": lambda: build_transformer_bench(batch=2, src_len=32,
+                                                   trg_len=24),
+    "deeplab": lambda: build_deeplab_bench(batch=1, img_hw=65),
+}
 
 
 def _probe_backend():
@@ -351,27 +467,44 @@ def _probe_backend():
         time.sleep(20)
 
 
-def _cpu_validate():
-    """Run a tiny BERT bench step on CPU in a subprocess to certify the
-    bench code path works even when the chip is unreachable. CPU-only
-    child — safe to kill at its deadline (no tunnel claim)."""
-    code = _CPU_VALIDATE_CODE.format(
-        root=os.path.dirname(os.path.abspath(__file__)))
-    try:
-        rc = subprocess.run(
-            [sys.executable, "-c", code],
-            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
-            timeout=float(os.environ.get("BENCH_CPU_VALIDATE_S", "300")),
-        ).returncode
-        return rc == 0
-    except (subprocess.TimeoutExpired, OSError):
-        return False
+def _cpu_validate(models):
+    """Run a tiny bench step of each model on CPU, all subprocesses in
+    parallel under ONE shared deadline, to certify the bench code paths
+    work even when the chip is unreachable. CPU-only children — safe to
+    kill at the deadline (no tunnel claim). Returns {model: bool}."""
+    root = os.path.dirname(os.path.abspath(__file__))
+    deadline = time.time() + float(
+        os.environ.get("BENCH_CPU_VALIDATE_S", "300"))
+    procs = {}
+    for m in dict.fromkeys(models):
+        code = _CPU_VALIDATE_CODE.format(root=root, model=m)
+        try:
+            procs[m] = subprocess.Popen(
+                [sys.executable, "-c", code],
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        except OSError:
+            procs[m] = None
+    ok = {}
+    for m, p in procs.items():
+        if p is None:
+            ok[m] = False
+            continue
+        try:
+            p.wait(timeout=max(0.1, deadline - time.time()))
+        except subprocess.TimeoutExpired:
+            p.kill()
+        ok[m] = p.poll() == 0
+    return ok
 
 
 _METRICS = {
     "bert": ("bert_base_pretrain_tokens_per_sec_per_chip", "tokens/s"),
     "resnet50": ("resnet50_imagenet_images_per_sec_per_chip", "images/s"),
     "gpt": ("gpt_small_pretrain_tokens_per_sec_per_chip", "tokens/s"),
+    "transformer": ("transformer_big_ende_tokens_per_sec_per_chip",
+                    "tokens/s"),
+    "deeplab": ("deeplabv3p_cityscapes_images_per_sec_per_chip",
+                "images/s"),
 }
 
 
@@ -390,19 +523,23 @@ def main():
     missing artifact is strictly worse than an error artifact."""
     model = os.environ.get("BENCH_MODEL", "bert")
     models = {"both": ["bert", "resnet50"],
-              "all": ["bert", "resnet50", "gpt"]}.get(model, [model])
+              "all": ["bert", "resnet50", "gpt", "transformer",
+                      "deeplab"]}.get(model, [model])
     models = [m for m in models if m in _METRICS] or ["bert"]
 
     ok, detail = _probe_backend()
     if not ok:
         print(f"# {detail}", file=sys.stderr)
-        cpu_ok = _cpu_validate()
+        cpu_ok = _cpu_validate(models)
         for m in models:
-            print(json.dumps(_error_line(m, detail, cpu_validated=cpu_ok)))
+            print(json.dumps(_error_line(m, detail,
+                                         cpu_validated=cpu_ok[m])),
+                  flush=True)
         return
 
     fns = {"bert": bench_bert, "resnet50": bench_resnet50,
-           "gpt": bench_gpt}
+           "gpt": bench_gpt, "transformer": bench_transformer,
+           "deeplab": bench_deeplab}
     for m in models:
         try:
             print(json.dumps(fns[m]()), flush=True)

@@ -171,6 +171,71 @@ class EditDistance(MetricBase):
 
 
 class DetectionMAP(MetricBase):
-    def __init__(self, *args, **kwargs):
-        raise NotImplementedError(
-            "DetectionMAP lands with the detection suite")
+    """Streaming detection mAP (reference metrics.py:805).
+
+    The reference accumulates TruePos/FalsePos/PosCount as graph state
+    threaded through detection_map's accum inputs — shapes there are
+    data-dependent LoD, which XLA's static-shape model rejects. The
+    TPU-first redesign keeps per-batch matching in the detection_map op
+    (ops/parity_final.py) and moves the ACCUMULATION to the host: call
+    `update(detections, gt_label, gt_box, gt_difficult)` once per image
+    with numpy arrays (the fetched op inputs), then `eval()` returns
+    the mAP over everything seen. The matching + AP math mirrors
+    detection_map_op.h:308-475 (strict overlap > threshold, prediction
+    ClipBBox, one GT consumed per match, integral/11point AP).
+
+    detections: [M, 6] (label, confidence, xmin, ymin, xmax, ymax)
+    gt_label: [N, 1]; gt_box: [N, 4]; gt_difficult: [N, 1] or None.
+    """
+
+    def __init__(self, class_num=None, background_label=0,
+                 overlap_threshold=0.5, evaluate_difficult=True,
+                 ap_version="integral", name=None):
+        super().__init__(name)
+        if ap_version not in ("integral", "11point"):
+            raise ValueError("ap_version must be 'integral' or '11point'")
+        self._class_num = class_num
+        self._background = background_label
+        self._thr = overlap_threshold
+        self._eval_difficult = evaluate_difficult
+        self._ap_version = ap_version
+        self.reset()
+
+    def reset(self):
+        # per class: npos count and (score, is_tp) match records
+        self._npos = {}
+        self._records = {}
+
+    def update(self, detections, gt_label, gt_box, gt_difficult=None):
+        """One image's detections + ground truth (numpy). Matching math
+        is shared with the detection_map op (core/detection_eval.py)."""
+        from .core.detection_eval import match_class
+
+        det = np.asarray(detections, np.float32).reshape(-1, 6)
+        gl = np.asarray(gt_label).reshape(-1).astype(np.int64)
+        gb = np.asarray(gt_box, np.float32).reshape(-1, 4)
+        gd = np.zeros(len(gl), bool) if gt_difficult is None else \
+            np.asarray(gt_difficult).reshape(-1) != 0
+        for cls in set(gl.tolist()) | set(det[:, 0].astype(int).tolist()):
+            if cls == self._background:
+                continue
+            sel = gl == cls
+            gts, diff = gb[sel], gd[sel]
+            npos = int(len(gts) if self._eval_difficult
+                       else (~diff).sum())
+            self._npos[cls] = self._npos.get(cls, 0) + npos
+            d = det[det[:, 0] == cls]
+            if len(d) == 0:
+                continue
+            self._records.setdefault(cls, []).extend(
+                match_class(d[:, 1:6], gts, diff, self._thr,
+                            self._eval_difficult))
+
+    def eval(self):
+        from .core.detection_eval import average_precision
+
+        aps = [ap for cls, npos in self._npos.items()
+               if (ap := average_precision(self._records.get(cls, []),
+                                           npos,
+                                           self._ap_version)) is not None]
+        return float(np.mean(aps)) if aps else 0.0

@@ -1,0 +1,190 @@
+"""Transformer-big En-De NMT — encoder-decoder with cross-attention.
+
+BASELINE.json config 3 ("Transformer-big En-De NMT — matmul/softmax/
+layer_norm attention path"). Reference analogues: the PaddleNLP
+transformer workload and the book NMT test
+(python/paddle/fluid/tests/book/test_machine_translation.py:1); the
+attention math matches the composed matmul+softmax path the reference
+assembles per-op (models/PaddleNLP).
+
+TPU-first shape: the whole step (encoder + decoder + label-smoothed CE +
+AdamW) is one XLA computation; decoder self-attention uses the fused
+Pallas flash kernel (causal), cross-attention uses the exact composed
+path (src/trg lengths differ, so the tiled kernel's square-block
+assumption does not apply). Weights carry the same tp/sp shard-hint
+scheme as the encoder LM (transformer.py).
+"""
+from __future__ import annotations
+
+import math
+
+from .. import layers
+from ..framework import ParamAttr
+from ..initializer import Normal
+from .transformer import TransformerConfig, _dense
+
+
+def transformer_big_nmt(**kw):
+    """Transformer-big: 6+6 layers, d_model 1024, 16 heads, d_ff 4096."""
+    kw.setdefault("vocab_size", 32000)
+    kw.setdefault("d_model", 1024)
+    kw.setdefault("n_heads", 16)
+    kw.setdefault("n_layers", 6)
+    kw.setdefault("d_ff", 4096)
+    return TransformerConfig(**kw)
+
+
+def _split_heads(z, b, t, h, hd):
+    z = layers.reshape(z, [b, t, h, hd])
+    return layers.transpose(z, [0, 2, 1, 3])  # [b, h, t, hd]
+
+
+def _mha(q_in, kv_in, cfg, prefix, causal):
+    """Multi-head attention; q_in [b, tq, d], kv_in [b, tk, d].
+
+    Self-attention (q_in is kv_in, causal) rides the fused flash op;
+    cross-attention takes the exact composed path (block_q=0) because
+    tq != tk in general.
+    """
+    b, tq = q_in.shape[0], q_in.shape[1]
+    tk = kv_in.shape[1]
+    d, h = cfg.d_model, cfg.n_heads
+    hd = d // h
+    q = _dense(q_in, d, f"{prefix}.q", cfg, tp_axis="col")
+    k = _dense(kv_in, d, f"{prefix}.k", cfg, tp_axis="col")
+    v = _dense(kv_in, d, f"{prefix}.v", cfg, tp_axis="col")
+    q = _split_heads(q, b, tq, h, hd)
+    k = _split_heads(k, b, tk, h, hd)
+    v = _split_heads(v, b, tk, h, hd)
+    if cfg.tp:
+        q = layers.shard_hint(q, [cfg.dp_axis, cfg.tp_axis, None, None])
+        k = layers.shard_hint(k, [cfg.dp_axis, cfg.tp_axis, None, None])
+        v = layers.shard_hint(v, [cfg.dp_axis, cfg.tp_axis, None, None])
+    self_attn = q_in is kv_in
+    bq = min(128, tq) if (cfg.use_flash and self_attn) else 0
+    ctx = layers.flash_attention(
+        q, k, v, causal=causal, sm_scale=1.0 / math.sqrt(hd),
+        block_q=bq, block_k=bq, attn_dropout=cfg.attn_dropout)
+    ctx = layers.transpose(ctx, [0, 2, 1, 3])
+    ctx = layers.reshape(ctx, [b, tq, d])
+    return _dense(ctx, d, f"{prefix}.proj", cfg, tp_axis="row")
+
+
+def _residual_ln(x, sub, cfg, name):
+    if cfg.dropout:
+        sub = layers.dropout(sub, cfg.dropout,
+                             dropout_implementation="upscale_in_train")
+    return layers.layer_norm(layers.elementwise_add(x, sub),
+                             begin_norm_axis=2,
+                             param_attr=ParamAttr(name=f"{name}.w"),
+                             bias_attr=ParamAttr(name=f"{name}.b"))
+
+
+def _ffn(x, cfg, prefix):
+    hdn = _dense(x, cfg.d_ff, f"{prefix}.fc1", cfg, act="relu",
+                 tp_axis="col")
+    return _dense(hdn, cfg.d_model, f"{prefix}.fc2", cfg, tp_axis="row")
+
+
+def _embed(tokens, cfg, name):
+    emb = layers.embedding(
+        tokens, size=[cfg.vocab_size, cfg.d_model],
+        param_attr=ParamAttr(name=name, initializer=Normal(0.0, 0.02)))
+    emb = layers.scale(emb, scale=math.sqrt(cfg.d_model))
+    x = layers.add_position_encoding(emb, alpha=1.0, beta=1.0)
+    if cfg.dropout:
+        x = layers.dropout(x, cfg.dropout,
+                           dropout_implementation="upscale_in_train")
+    if cfg.sp:
+        x = layers.shard_hint(x, [cfg.dp_axis, cfg.sp_axis, None])
+    return x
+
+
+def encode(src_tokens, cfg):
+    """src_tokens int64 [b, ts] -> encoder memory [b, ts, d]."""
+    x = _embed(src_tokens, cfg, "src_emb")
+    for i in range(cfg.n_layers):
+        p = f"enc_{i}"
+        x = _residual_ln(x, _mha(x, x, cfg, f"{p}.att", causal=False),
+                         cfg, f"{p}.ln1")
+        x = _residual_ln(x, _ffn(x, cfg, f"{p}.ffn"), cfg, f"{p}.ln2")
+        if cfg.sp:
+            x = layers.shard_hint(x, [cfg.dp_axis, cfg.sp_axis, None])
+    return x
+
+
+def decode(trg_tokens, memory, cfg):
+    """trg_tokens int64 [b, tt] -> vocab logits [b, tt, V]."""
+    x = _embed(trg_tokens, cfg, "trg_emb")
+    for i in range(cfg.n_layers):
+        p = f"dec_{i}"
+        x = _residual_ln(x, _mha(x, x, cfg, f"{p}.self", causal=True),
+                         cfg, f"{p}.ln1")
+        x = _residual_ln(x, _mha(x, memory, cfg, f"{p}.cross",
+                                 causal=False), cfg, f"{p}.ln2")
+        x = _residual_ln(x, _ffn(x, cfg, f"{p}.ffn"), cfg, f"{p}.ln3")
+        if cfg.sp:
+            x = layers.shard_hint(x, [cfg.dp_axis, cfg.sp_axis, None])
+    return layers.fc(x, size=cfg.vocab_size, num_flatten_dims=2,
+                     param_attr=ParamAttr(name="nmt_head.w",
+                                          initializer=Normal(0.0, 0.02)),
+                     bias_attr=False)
+
+
+def build_train(cfg, batch, src_len, trg_len, lr=1e-4, amp=False,
+                label_smooth_eps=0.1, optimizer_cls=None):
+    """Training graph: feed src_tokens [b, ts] + trg_tokens [b, tt+1]
+    (BOS-prefixed); the input/label shift happens in-graph. Returns
+    (loss, [src, trg]). Label smoothing 0.1 matches the reference
+    transformer recipe."""
+    from .. import optimizer as opt
+
+    src = layers.data("src_tokens", shape=[batch, src_len], dtype="int64",
+                      append_batch_size=False)
+    trg = layers.data("trg_tokens", shape=[batch, trg_len + 1],
+                      dtype="int64", append_batch_size=False)
+    trg_in = layers.slice(trg, axes=[1], starts=[0], ends=[trg_len])
+    trg_out = layers.slice(trg, axes=[1], starts=[1], ends=[trg_len + 1])
+
+    memory = encode(src, cfg)
+    logits = decode(trg_in, memory, cfg)
+
+    logits2 = layers.reshape(logits, [-1, cfg.vocab_size])
+    if label_smooth_eps:
+        oh = layers.one_hot(layers.reshape(trg_out, [-1, 1]),
+                            depth=cfg.vocab_size)
+        soft = layers.label_smooth(oh, epsilon=label_smooth_eps)
+        loss = layers.softmax_with_cross_entropy(logits2, soft,
+                                                 soft_label=True)
+    else:
+        loss = layers.softmax_with_cross_entropy(
+            logits2, layers.reshape(trg_out, [-1, 1]))
+    loss = layers.mean(loss)
+
+    optimizer_cls = optimizer_cls or opt.AdamW
+    opt_inst = optimizer_cls(learning_rate=lr)
+    if amp:
+        from ..contrib import mixed_precision as mp
+        opt_inst = mp.decorate(opt_inst)
+    opt_inst.minimize(loss)
+    return loss, [src, trg]
+
+
+def flops_per_step(cfg, batch, src_len, trg_len):
+    """Matmul flops for one fwd+bwd step (3x fwd), mirroring
+    transformer.model_flops_per_token's accounting: dense projections +
+    attention score/context terms (self enc, self dec causal ~1/2,
+    cross ts x tt)."""
+    d, L, f, v = cfg.d_model, cfg.n_layers, cfg.d_ff, cfg.vocab_size
+    ts, tt = src_len, trg_len
+    # per-layer dense MACs: enc 4 d^2 + 2 d f; dec (self 4 + cross 4)
+    # d^2 + 2 d f — multiplied by 6 below (2 flops/MAC x 3 for fwd+bwd),
+    # the same convention as bench.model_flops_per_token
+    dense = L * (ts * (4 * d * d + 2 * d * f)
+                 + tt * (8 * d * d + 2 * d * f)) + tt * v * d
+    # attention MACs: 2 d per q-k pair (scores d + context d); causal
+    # decoder self-attention halves the pair count
+    attn = L * (2 * d * ts * ts       # encoder self
+                + 1 * d * tt * tt     # decoder self (causal)
+                + 2 * d * tt * ts)    # cross
+    return 6 * (dense + attn) * batch

@@ -274,7 +274,10 @@ def _density_prior_box(ctx, ins, attrs):
             (gx + ox) - bw / 2, (gy + oy) - bh / 2,
             (gx + ox) + bw / 2, (gy + oy) + bh / 2], axis=-1))
     prior = jnp.stack(out, axis=2) / jnp.asarray([iw, ih, iw, ih])
-    prior = jnp.clip(prior, 0.0, 1.0)
+    # clip only on request (density_prior_box_op.h:117); the layer API
+    # defaults clip=False and border-crossing priors must survive then
+    if attrs.get("clip", False):
+        prior = jnp.clip(prior, 0.0, 1.0)
     var = jnp.broadcast_to(jnp.asarray(variances), prior.shape)
     return {"Boxes": [prior], "Variances": [var]}
 

@@ -420,49 +420,94 @@ def _sample_logits(ctx, ins, attrs):
             "LabelsDim": [jnp.asarray(labels.shape, jnp.int64)]}
 
 
+_CHUNK_SCHEMES = {
+    # scheme -> (num_tag_types, tag_begin, tag_inside, tag_end, tag_single)
+    # chunk_eval_op.h:118-144
+    "IOB": (2, 0, 1, -1, -1),
+    "IOE": (2, -1, 0, 1, -1),
+    "IOBES": (4, 0, 1, 2, 3),
+    "plain": (1, -1, -1, -1, -1),
+}
+
+
+def _chunk_segments(seq, n_types, ntt, tb, ti, te, ts):
+    """GetSegments state machine (chunk_eval_op.h:41-108): yields
+    (begin, end_inclusive, type) for one tag sequence. `other` type is
+    n_types (the O tag encodes as type == num_chunk_types)."""
+    other = n_types
+
+    def chunk_end(pt, pty, t, ty):
+        if pty == other:
+            return False
+        if ty == other or ty != pty:
+            return True
+        if pt == tb or pt == ti:
+            return t == tb or t == ts
+        return pt == te or pt == ts
+
+    def chunk_begin(pt, pty, t, ty):
+        if pty == other:
+            return ty != other
+        if ty == other:
+            return False
+        if ty != pty:
+            return True
+        if t == tb or t == ts:
+            return True
+        if t == ti or t == te:
+            return pt == te or pt == ts
+        return False
+
+    segs = []
+    start, in_chunk = 0, False
+    tag, typ = -1, other
+    for i, v in enumerate(int(x) for x in seq):
+        pt, pty = tag, typ
+        tag, typ = v % ntt, v // ntt
+        if in_chunk and chunk_end(pt, pty, tag, typ):
+            segs.append((start, i - 1, pty))
+            in_chunk = False
+        if chunk_begin(pt, pty, tag, typ):
+            start, in_chunk = i, True
+    if in_chunk:
+        segs.append((start, len(seq) - 1, typ))
+    return segs
+
+
 @register_op("chunk_eval", nondiff_inputs=("Inference", "Label", "SeqLength"),
              nondiff_outputs=("Precision", "Recall", "F1-Score",
                               "NumInferChunks", "NumLabelChunks",
                               "NumCorrectChunks"))
 def _chunk_eval(ctx, ins, attrs):
-    """IOB chunk metrics via a host callback (chunk_eval_op is pure
-    bookkeeping, not device math)."""
+    """Chunk metrics (IOB/IOE/IOBES/plain) via a host callback
+    (chunk_eval_op.h is pure bookkeeping, not device math). Matches the
+    reference's GetSegments/ChunkBegin/ChunkEnd state machine incl.
+    excluded_chunk_types and the padded SeqLength path."""
     inf = ins["Inference"][0]
     lab = ins["Label"][0]
     n_types = attrs.get("num_chunk_types", 1)
     scheme = attrs.get("chunk_scheme", "IOB")
+    excluded = set(attrs.get("excluded_chunk_types", []) or [])
+    ntt, tb, ti, te, ts = _CHUNK_SCHEMES[scheme]
+    seqlen = ins.get("SeqLength", [None])[0]
 
-    def extract(seq):
-        # IOB: tag = type*2 (B) / type*2+1 (I); O = n_types*2
-        chunks = []
-        start, typ = None, None
-        for i, t in enumerate(list(seq)):
-            t = int(t)
-            if t >= n_types * 2:  # O
-                if start is not None:
-                    chunks.append((start, i, typ))
-                start = None
-                continue
-            ty, isB = t // 2, t % 2 == 0
-            if isB or start is None or ty != typ:
-                if start is not None:
-                    chunks.append((start, i, typ))
-                start, typ = i, ty
-        if start is not None:
-            chunks.append((start, len(seq), typ))
-        return set(chunks)
-
-    def cb(inf, lab):
+    def cb(inf, lab, *sl):
+        inf = np.asarray(inf).reshape(inf.shape[0], -1)
+        lab = np.asarray(lab).reshape(lab.shape[0], -1)
+        lengths = np.asarray(sl[0]).reshape(-1) if sl else \
+            np.full(inf.shape[0], inf.shape[1])
         ic = lc = cc = 0
-        for row_i, row_l in zip(np.asarray(inf).reshape(inf.shape[0], -1),
-                                np.asarray(lab).reshape(lab.shape[0], -1)):
-            a, b = extract(row_i), extract(row_l)
-            ic += len(a)
-            lc += len(b)
-            cc += len(a & b)
+        for row_i, row_l, ln in zip(inf, lab, lengths):
+            ln = int(ln)
+            a = _chunk_segments(row_i[:ln], n_types, ntt, tb, ti, te, ts)
+            b = _chunk_segments(row_l[:ln], n_types, ntt, tb, ti, te, ts)
+            sa, sb = set(a), set(b)
+            ic += sum(1 for s in a if s[2] not in excluded)
+            lc += sum(1 for s in b if s[2] not in excluded)
+            cc += sum(1 for s in sa & sb if s[2] not in excluded)
         p = cc / ic if ic else 0.0
         r = cc / lc if lc else 0.0
-        f = 2 * p * r / (p + r) if p + r else 0.0
+        f = 2 * p * r / (p + r) if cc else 0.0
         mk = lambda v, d: np.asarray([v], d)
         # int32 counts: int64 result shapes are rejected by io_callback
         # when jax_enable_x64 is off (the default here)
@@ -471,7 +516,8 @@ def _chunk_eval(ctx, ins, attrs):
 
     structs = (jax.ShapeDtypeStruct((1,), jnp.float32),) * 3 + \
         (jax.ShapeDtypeStruct((1,), jnp.int32),) * 3
-    p, r, f, ic, lc, cc = io_callback(cb, structs, inf, lab, ordered=True)
+    args = (inf, lab) + ((seqlen,) if seqlen is not None else ())
+    p, r, f, ic, lc, cc = io_callback(cb, structs, *args, ordered=True)
     return {"Precision": [p], "Recall": [r], "F1-Score": [f],
             "NumInferChunks": [ic], "NumLabelChunks": [lc],
             "NumCorrectChunks": [cc]}

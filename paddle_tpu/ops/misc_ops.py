@@ -133,22 +133,106 @@ def _random_crop(ctx, ins, attrs):
     return {"Out": [out], "SeedOut": ins.get("Seed", [jnp.zeros(1)])}
 
 
+# XXH64 (public spec, github.com/Cyan4973/xxHash) in pure Python ints
+# masked to 64 bits — bit-exact with the xxhash library the reference
+# links (hash_op.h:17 XXH64(input, sizeof(T)*last_dim, ihash)).
+_XXH_MASK = (1 << 64) - 1
+_XXH_P1 = 0x9E3779B185EBCA87
+_XXH_P2 = 0xC2B2AE3D27D4EB4F
+_XXH_P3 = 0x165667B19E3779F9
+_XXH_P4 = 0x85EBCA77C2B2AE63
+_XXH_P5 = 0x27D4EB2F165667C5
+
+
+def _rotl64(v, r):
+    return ((v << r) | (v >> (64 - r))) & _XXH_MASK
+
+
+def _xxh_round(acc, lane):
+    acc = (acc + lane * _XXH_P2) & _XXH_MASK
+    return (_rotl64(acc, 31) * _XXH_P1) & _XXH_MASK
+
+
+def xxh64(data: bytes, seed: int = 0) -> int:
+    n = len(data)
+    if n >= 32:
+        v1 = (seed + _XXH_P1 + _XXH_P2) & _XXH_MASK
+        v2 = (seed + _XXH_P2) & _XXH_MASK
+        v3 = seed & _XXH_MASK
+        v4 = (seed - _XXH_P1) & _XXH_MASK
+        i = 0
+        while i <= n - 32:
+            lanes = [int.from_bytes(data[i + 8 * k:i + 8 * k + 8],
+                                    "little") for k in range(4)]
+            v1, v2, v3, v4 = (_xxh_round(v1, lanes[0]),
+                              _xxh_round(v2, lanes[1]),
+                              _xxh_round(v3, lanes[2]),
+                              _xxh_round(v4, lanes[3]))
+            i += 32
+        h = (_rotl64(v1, 1) + _rotl64(v2, 7) + _rotl64(v3, 12)
+             + _rotl64(v4, 18)) & _XXH_MASK
+        for v in (v1, v2, v3, v4):
+            h = ((h ^ _xxh_round(0, v)) * _XXH_P1 + _XXH_P4) & _XXH_MASK
+    else:
+        h = (seed + _XXH_P5) & _XXH_MASK
+        i = 0
+    h = (h + n) & _XXH_MASK
+    while i <= n - 8:
+        lane = int.from_bytes(data[i:i + 8], "little")
+        h = ((_rotl64(h ^ _xxh_round(0, lane), 27) * _XXH_P1)
+             + _XXH_P4) & _XXH_MASK
+        i += 8
+    if i <= n - 4:
+        lane = int.from_bytes(data[i:i + 4], "little")
+        h = ((_rotl64(h ^ (lane * _XXH_P1 & _XXH_MASK), 23) * _XXH_P2)
+             + _XXH_P3) & _XXH_MASK
+        i += 4
+    while i < n:
+        h = (_rotl64(h ^ (data[i] * _XXH_P5 & _XXH_MASK), 11)
+             * _XXH_P1) & _XXH_MASK
+        i += 1
+    h ^= h >> 33
+    h = (h * _XXH_P2) & _XXH_MASK
+    h ^= h >> 29
+    h = (h * _XXH_P3) & _XXH_MASK
+    h ^= h >> 32
+    return h
+
+
 @register_op("hash", nondiff_inputs=("X",), nondiff_outputs=("Out",))
 def _hash(ctx, ins, attrs):
-    """hash_op: polynomial bucket-hash of each id row (num_hash hashes
-    mod mod_by)."""
-    x = ins["X"][0].astype(jnp.uint32)
+    """hash_op: XXH64 of each id row's int64 bytes, seeded by the hash
+    index, mod mod_by — exact reference semantics
+    (hash_op.h:60-66: XXH64(input, sizeof(T)*last_dim, ihash) % mod_by)
+    via a host callback (sparse-feature data prep, not MXU math; rows
+    are short). Ids are hashed in the reference's canonical int64 byte
+    layout regardless of the traced integer width."""
+    x = ins["X"][0]
     num_hash = attrs.get("num_hash", 1)
     mod_by = attrs.get("mod_by", 100000)
-    outs = []
-    for h in range(num_hash):
-        mult = jnp.uint32(2654435761 + 97 * h)
-        acc = jnp.zeros(x.shape[:-1], jnp.uint32)
-        for j in range(x.shape[-1]):
-            acc = acc * mult + x[..., j]
-        outs.append((acc % jnp.uint32(mod_by)).astype(jnp.int64))
-    out = jnp.stack(outs, axis=-1)[..., None]
-    return {"Out": [out.reshape(x.shape[:-1] + (num_hash, 1))]}
+    if mod_by > (1 << 31):
+        # the io_callback carrier is int32 (x64 off); fail loudly
+        # rather than alias bucket ids through silent wraparound
+        raise NotImplementedError(
+            f"hash: mod_by {mod_by} exceeds the int32 bucket range "
+            f"supported by this lowering (2**31)")
+
+    def cb(xv):
+        arr = np.asarray(xv)
+        rows = arr.reshape(-1, arr.shape[-1]).astype("<i8")
+        out = np.empty((rows.shape[0], num_hash), np.int64)
+        for r in range(rows.shape[0]):
+            b = rows[r].tobytes()
+            for h in range(num_hash):
+                out[r, h] = xxh64(b, h) % mod_by
+        # int32 carrier: io_callback rejects int64 results with x64 off
+        return out.reshape(arr.shape[:-1] + (num_hash, 1)) \
+            .astype(np.int32)
+
+    shape = x.shape[:-1] + (num_hash, 1)
+    out = io_callback(cb, jax.ShapeDtypeStruct(shape, jnp.int32), x,
+                      ordered=False)
+    return {"Out": [out.astype(x.dtype)]}
 
 
 @register_op("coalesce_tensor")

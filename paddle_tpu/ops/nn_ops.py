@@ -154,7 +154,9 @@ def _max_pool2d_with_index(ctx, ins, attrs):
         ph, pw = 0, 0
     else:
         kh, kw = attrs.get("ksize", [2, 2])
-        sh, sw = attrs.get("strides", [kh, kw])
+        # reference default is {1,1}, NOT the kernel size
+        # (pool_with_index_op.cc:149)
+        sh, sw = attrs.get("strides", [1, 1])
         ph, pw = attrs.get("paddings", [0, 0])
     xp = jnp.pad(x, [(0, 0), (0, 0), (ph, ph), (pw, pw)],
                  constant_values=-jnp.inf)

@@ -547,50 +547,46 @@ def _roi_perspective_transform(ctx, ins, attrs):
              nondiff_outputs=("MAP", "AccumPosCount", "AccumTruePos",
                               "AccumFalsePos"))
 def _detection_map(ctx, ins, attrs):
-    """mAP metric (detection_map_op) via host callback: detections
-    [N, 6] (cls, score, box), labels [M, 6] (cls, x1, y1, x2, y2, diff)."""
+    """mAP metric (detection_map_op.h) via host callback.
+
+    Detections [N, 6] (cls, score, xmin, ymin, xmax, ymax); labels
+    [M, 6] (cls, difficult, xmin, ymin, xmax, ymax) or [M, 5] without
+    the difficult flag (GetBoxes, detection_map_op.h:161-190). Honors
+    ap_type integral|11point (default integral, detection_map_op.cc:167),
+    evaluate_difficult, and the strict `overlap > threshold` match with
+    predictions clipped to [0,1] (CalcTrueAndFalsePositive). Single-
+    image semantics (no LoD segments); the accumulation-state
+    inputs/outputs are stubbed."""
+    from ..core.detection_eval import average_precision, match_class
+
     det = ins["DetectRes"][0]
     lab = ins["Label"][0]
     thr = attrs.get("overlap_threshold", 0.5)
+    ap_type = attrs.get("ap_type", "integral")
+    eval_difficult = attrs.get("evaluate_difficult", True)
 
     def cb(det, lab):
         det = np.asarray(det).reshape(-1, 6)
         lab = np.asarray(lab).reshape(-1, lab.shape[-1])
-        det = det[det[:, 1] > 0]
+        if lab.shape[-1] == 6:
+            gt_cls, gt_diff = lab[:, 0], lab[:, 1] != 0
+            gt_box = lab[:, 2:6]
+        else:
+            gt_cls = lab[:, 0]
+            gt_diff = np.zeros(len(lab), bool)
+            gt_box = lab[:, 1:5]
         aps = []
-        for cls in np.unique(lab[:, 0]):
-            gts = lab[lab[:, 0] == cls][:, 1:5]
+        for cls in np.unique(gt_cls):
+            sel = gt_cls == cls
+            gts, diff = gt_box[sel], gt_diff[sel]
+            npos = int(len(gts) if eval_difficult else (~diff).sum())
             d = det[det[:, 0] == cls]
-            d = d[np.argsort(-d[:, 1])]
-            taken = np.zeros(len(gts), bool)
-            tp = np.zeros(len(d))
-            for i, row in enumerate(d):
-                if len(gts) == 0:
-                    continue
-                x1 = np.maximum(gts[:, 0], row[2])
-                y1 = np.maximum(gts[:, 1], row[3])
-                x2 = np.minimum(gts[:, 2], row[4])
-                y2 = np.minimum(gts[:, 3], row[5])
-                iw = np.maximum(x2 - x1, 0)
-                ih = np.maximum(y2 - y1, 0)
-                inter = iw * ih
-                area_g = (gts[:, 2] - gts[:, 0]) * (gts[:, 3] - gts[:, 1])
-                area_d = (row[4] - row[2]) * (row[5] - row[3])
-                iou = inter / np.maximum(area_g + area_d - inter, 1e-10)
-                j = int(np.argmax(iou))
-                if iou[j] >= thr and not taken[j]:
-                    tp[i] = 1
-                    taken[j] = True
-            if len(d) == 0 or len(gts) == 0:
-                continue
-            cum_tp = np.cumsum(tp)
-            prec = cum_tp / (np.arange(len(d)) + 1)
-            rec = cum_tp / len(gts)
-            ap = 0.0
-            for t in np.linspace(0, 1, 11):
-                p = prec[rec >= t]
-                ap += (p.max() if len(p) else 0.0) / 11
-            aps.append(ap)
+            # a class with GT but no detections is skipped, not
+            # averaged as 0 (CalcMAP: true_pos.find(label) == end)
+            recs = match_class(d[:, 1:6], gts, diff, thr, eval_difficult)
+            ap = average_precision(recs, npos, ap_type)
+            if ap is not None:
+                aps.append(ap)
         return np.asarray([np.mean(aps) if aps else 0.0], np.float32)
 
     mp = io_callback(cb, jax.ShapeDtypeStruct((1,), jnp.float32),

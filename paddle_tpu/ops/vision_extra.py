@@ -77,7 +77,9 @@ def _max_pool3d_with_index(ctx, ins, attrs):
     the unpadded input (pooling.cc MaxPool3dWithIndexFunctor)."""
     x = ins["X"][0]
     kd, kh, kw = attrs.get("ksize", [2, 2, 2])
-    sd, sh, sw = attrs.get("strides", [kd, kh, kw])
+    # reference default is {1,1,1}, NOT the kernel size
+    # (pool_with_index_op.cc:149)
+    sd, sh, sw = attrs.get("strides", [1, 1, 1])
     pd, ph, pw = attrs.get("paddings", [0, 0, 0])
     n, c, d, h, w = x.shape
     xp = jnp.pad(x, [(0, 0), (0, 0), (pd, pd), (ph, ph), (pw, pw)],

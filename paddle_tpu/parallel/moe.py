@@ -101,27 +101,37 @@ def moe_ffn(x, params, axis_name="ep", n_experts_global=None,
     return y, load
 
 
-def _moe_shard_map(inner, x, params, mesh, ep_axis, batch_axis, **kw):
+def _moe_shard_map(inner, x, params, mesh, ep_axis, batch_axis,
+                   seq_axis=None, **kw):
     """Shared shard_map wrapper for the dense and sparse formulations:
     one place owns the spec layout (expert arrays sharded on dim 0 over
-    ep, gate replicated, x optionally batch-sharded)."""
-    x_spec = P(batch_axis, None, None)
+    ep, gate replicated, x optionally batch- and/or sequence-sharded).
+
+    seq_axis composes MoE with sequence parallelism (dp x sp x ep):
+    routing and expert compute are per-token, so sharding T changes
+    which tokens each shard routes, not the math; only the load metric
+    needs the extra pmean to stay global."""
+    x_spec = P(batch_axis, seq_axis, None)
     param_specs = {"gate_w": P(None, None),
                    "w1": P(ep_axis, None, None), "b1": P(ep_axis, None),
                    "w2": P(ep_axis, None, None), "b2": P(ep_axis, None)}
+    reduce_axes = tuple(a for a in (batch_axis, seq_axis) if a)
     fn = functools.partial(inner, axis_name=ep_axis,
                            n_experts_global=params["gate_w"].shape[-1],
-                           batch_axis=batch_axis, **kw)
+                           batch_axis=reduce_axes or None, **kw)
     sm = jax.shard_map(fn, mesh=mesh, in_specs=(x_spec, param_specs),
                        out_specs=(x_spec, P()), check_vma=False)
     return sm(x, params)
 
 
-def moe_ffn_sharded(x, params, mesh, ep_axis="ep", batch_axis=None):
+def moe_ffn_sharded(x, params, mesh, ep_axis="ep", batch_axis=None,
+                    seq_axis=None):
     """Global arrays -> shard_map over the mesh: expert arrays sharded
     on dim 0 over `ep_axis`, x replicated (or batch-sharded over
-    `batch_axis`), output matching x."""
-    return _moe_shard_map(moe_ffn, x, params, mesh, ep_axis, batch_axis)
+    `batch_axis` / sequence-sharded over `seq_axis`), output matching
+    x."""
+    return _moe_shard_map(moe_ffn, x, params, mesh, ep_axis, batch_axis,
+                          seq_axis=seq_axis)
 
 
 def moe_ffn_sparse(x, params, axis_name="ep", capacity=None,
@@ -192,11 +202,11 @@ def moe_ffn_sparse(x, params, axis_name="ep", capacity=None,
 
 
 def moe_ffn_sparse_sharded(x, params, mesh, ep_axis="ep", capacity=None,
-                           batch_axis=None):
+                           batch_axis=None, seq_axis=None):
     """Global-array wrapper for moe_ffn_sparse (same specs as
     moe_ffn_sharded)."""
     return _moe_shard_map(moe_ffn_sparse, x, params, mesh, ep_axis,
-                          batch_axis, capacity=capacity)
+                          batch_axis, seq_axis=seq_axis, capacity=capacity)
 
 
 # ---------------------------------------------------------------------------

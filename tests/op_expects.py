@@ -3223,9 +3223,11 @@ def _chunk_eval_ref(i, a):
 
     inf = i["Inference"].reshape(i["Inference"].shape[0], -1)
     lab = i["Label"].reshape(i["Label"].shape[0], -1)
+    exc = set(a.get("excluded_chunk_types", []) or [])
     ic = lc = cc = 0
     for a_, b_ in zip(inf, lab):
-        sa, sb = segments(a_), segments(b_)
+        sa = {s for s in segments(a_) if s[2] not in exc}
+        sb = {s for s in segments(b_) if s[2] not in exc}
         ic += len(sa)
         lc += len(sb)
         cc += len(sa & sb)
@@ -3242,6 +3244,146 @@ def _chunk_eval_ref(i, a):
 
 
 exp_("chunk_eval", _chunk_eval_ref)
+
+
+def _detection_map_ref(i, a):
+    # detection_map_op.h:308-475 re-derived: greedy score-ranked
+    # matching (strict overlap > threshold, ClipBBox on predictions,
+    # one GT consumed per match), then AP via the recall-step identity:
+    # integral AP == sum over tp hits of precision_at_hit / npos (each
+    # tp advances recall by exactly 1/npos, fps advance it by 0), which
+    # is an algebraically different route than the reference's
+    # prev_recall loop; 11point takes max precision at recall >= j/10.
+    det = i["DetectRes"].reshape(-1, 6)
+    lab = i["Label"].reshape(-1, i["Label"].shape[-1])
+    thr = a.get("overlap_threshold", 0.5)
+    ap_type = a.get("ap_type", "integral")
+    eval_diff = a.get("evaluate_difficult", True)
+    if lab.shape[-1] == 6:
+        gcls, gdiff, gbox = lab[:, 0], lab[:, 1] != 0, lab[:, 2:6]
+    else:
+        gcls, gbox = lab[:, 0], lab[:, 1:5]
+        gdiff = np.zeros(len(lab), bool)
+
+    def iou(b, g):
+        ix = max(0.0, min(b[2], g[2]) - max(b[0], g[0]))
+        iy = max(0.0, min(b[3], g[3]) - max(b[1], g[1]))
+        inter = ix * iy
+        ab = (b[2] - b[0]) * (b[3] - b[1])
+        ag = (g[2] - g[0]) * (g[3] - g[1])
+        return inter / max(ab + ag - inter, 1e-10)
+
+    aps = []
+    for cls in sorted(set(gcls.tolist())):
+        sel = gcls == cls
+        gts, diff = gbox[sel], gdiff[sel]
+        npos = len(gts) if eval_diff else int((~diff).sum())
+        d = det[det[:, 0] == cls]
+        if npos == 0 or len(d) == 0:
+            continue
+        d = d[np.argsort(-d[:, 1], kind="stable")]
+        used = [False] * len(gts)
+        flags = []  # +1 tp / 0 fp / None dropped-difficult
+        for row in d:
+            b = np.clip(row[2:6], 0.0, 1.0)
+            ious = [iou(b, g) for g in gts]
+            j = int(np.argmax(ious))
+            if ious[j] > thr:
+                if not eval_diff and diff[j]:
+                    continue
+                if used[j]:
+                    flags.append(0)
+                else:
+                    used[j] = True
+                    flags.append(1)
+            else:
+                flags.append(0)
+        if not flags:
+            continue
+        tp_run = 0
+        ap = 0.0
+        if ap_type == "11point":
+            precs, recs = [], []
+            for k, fl in enumerate(flags):
+                tp_run += fl
+                precs.append(tp_run / (k + 1))
+                recs.append(tp_run / npos)
+            for j in range(11):
+                t = j / 10.0
+                best = max((p for p, r in zip(precs, recs) if r >= t),
+                           default=0.0)
+                ap += best / 11.0
+        else:
+            for k, fl in enumerate(flags):
+                tp_run += fl
+                if fl:
+                    ap += (tp_run / (k + 1)) / npos
+        aps.append(ap)
+    m = float(np.mean(aps)) if aps else 0.0
+    return {"MAP": [np.asarray([m], np.float32)]}
+
+
+exp_("detection_map", _detection_map_ref)
+
+
+def _hash_ref(i, a):
+    # XXH64 re-derived from the public spec, VECTORIZED over rows in
+    # np.uint64 wraparound arithmetic — an independent implementation
+    # route from the op's scalar python-int version
+    # (hash_op.h:60-66: XXH64(row bytes, seed=ihash) % mod_by)
+    u = np.uint64
+    P1, P2 = u(0x9E3779B185EBCA87), u(0xC2B2AE3D27D4EB4F)
+    P3, P4 = u(0x165667B19E3779F9), u(0x85EBCA77C2B2AE63)
+    P5 = u(0x27D4EB2F165667C5)
+
+    def rotl(v, r):
+        return (v << u(r)) | (v >> u(64 - r))
+
+    def rnd(acc, lane):
+        return rotl(acc + lane * P2, u(31)) * P1
+
+    def rows_hash(lanes, seed):
+        n_rows, n_lanes = lanes.shape
+        nbytes = u(8 * n_lanes)
+        k = 0
+        if n_lanes >= 4:
+            v = [np.full(n_rows, u(seed) + P1 + P2, np.uint64),
+                 np.full(n_rows, u(seed) + P2, np.uint64),
+                 np.full(n_rows, u(seed), np.uint64),
+                 np.full(n_rows, u(seed) - P1, np.uint64)]
+            while k + 4 <= n_lanes:
+                for j in range(4):
+                    v[j] = rnd(v[j], lanes[:, k + j])
+                k += 4
+            h = rotl(v[0], u(1)) + rotl(v[1], u(7)) + \
+                rotl(v[2], u(12)) + rotl(v[3], u(18))
+            for vj in v:
+                h = (h ^ rnd(u(0), vj)) * P1 + P4
+        else:
+            h = np.full(n_rows, u(seed) + P5, np.uint64)
+        h = h + nbytes
+        while k < n_lanes:
+            h = rotl(h ^ rnd(u(0), lanes[:, k]), u(27)) * P1 + P4
+            k += 1
+        h ^= h >> u(33)
+        h *= P2
+        h ^= h >> u(29)
+        h *= P3
+        h ^= h >> u(32)
+        return h
+
+    x = i["X"]
+    nh, mod = a["num_hash"], a["mod_by"]
+    lanes = np.ascontiguousarray(
+        x.reshape(-1, x.shape[-1]).astype("<i8")).view(np.uint64)
+    with np.errstate(over="ignore"):
+        cols = [(rows_hash(lanes, s) % u(mod)).astype(np.int64)
+                for s in range(nh)]
+    out = np.stack(cols, axis=-1).reshape(x.shape[:-1] + (nh, 1))
+    return {"Out": [out.astype(np.int32)]}
+
+
+exp_("hash", _hash_ref)
 
 
 def _inception_ref(i, a):
@@ -3424,8 +3566,6 @@ NOREF_REASONS = {
     "dpsgd": "stochastic DP noise",
     "nce": "stochastic negative sampling",
     "sample_logits": "stochastic candidate sampling",
-    "hash": "reference uses xxhash (external dependency); the TPU "
-            "lowering documents its own polynomial bucket hash",
     "pull_box_sparse": "host-side BoxPS table service; roundtrip "
                        "covered in tests/test_straggler_ops.py",
     "generate_proposals": "multi-stage NMS pipeline; components "
@@ -3445,8 +3585,6 @@ NOREF_REASONS = {
                   "numerically instead",
     "yolov3_loss": "composite assigner+loss; grad-checked and "
                    "covered by yolo_box witness for the decode math",
-    "detection_map": "multi-stage mAP accumulation; covered by "
-                     "perfect-detection invariant test",
     "similarity_focus": "argmax-selection mask; covered by "
                         "shape/selection tests",
     "tree_conv": "message-passing redesign documented in lowering",

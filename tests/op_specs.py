@@ -643,7 +643,10 @@ spec("similarity_focus", ins={"X": f32(1, 2, 3, 3)},
 # the numeric derivative of the forward's log transform
 spec("cvm", ins={"X": pos(2, 4), "CVM": f32(2, 2)},
      attrs={"use_cvm": True})
-spec("hash", ins={"X": np.array([[1, 2], [3, 4]], np.int64)},
+# rows of 5 int64 lanes = 40 bytes: exercises BOTH the 32-byte stripe
+# accumulator and the 8-byte tail path of XXH64
+spec("hash", ins={"X": np.array([[1, 2, 3, 4, 5],
+                                 [3, 4, 5, 6, 7]], np.int64)},
      attrs={"num_hash": 2, "mod_by": 1000})
 
 # --- RNN family ------------------------------------------------------------
@@ -749,10 +752,16 @@ spec("precision_recall",
           "Labels": np.array([[1], [0]], np.int64),
           "StatesInfo": np.zeros((3, 4), np.int64)},
      attrs={"class_number": 3})
+# imperfect IOB inputs: split spans (B where the label has I), merged
+# spans (I where the label has B), I-after-O chunk starts, type
+# changes mid-chunk, chunks ending at the sequence boundary
+# (tags for num_chunk_types=2: B0=0 I0=1 B1=2 I1=3 O=4)
 spec("chunk_eval",
-     ins={"Inference": np.array([[0, 1, 2, 0]], np.int64).reshape(4, 1),
-          "Label": np.array([[0, 1, 2, 0]], np.int64).reshape(4, 1)},
-     attrs={"num_chunk_types": 1, "chunk_scheme": "IOB"})
+     ins={"Inference": np.array([[0, 1, 4, 0, 3, 2, 4, 1],
+                                 [2, 3, 3, 0, 4, 4, 0, 1]], np.int64),
+          "Label": np.array([[0, 1, 1, 4, 2, 3, 4, 1],
+                             [2, 3, 0, 1, 4, 4, 0, 0]], np.int64)},
+     attrs={"num_chunk_types": 2, "chunk_scheme": "IOB"})
 spec("positive_negative_pair",
      ins={"Score": f32(4, 1), "Label": np.array([[1.], [0.], [1.], [0.]],
                                                 np.float32),
@@ -945,10 +954,26 @@ spec("roi_perspective_transform",
           "ROIs": np.array([[1, 1, 6, 1, 6, 6, 1, 6]], np.float32)},
      attrs={"transformed_height": 4, "transformed_width": 4,
             "spatial_scale": 1.0})
+# imperfect detections: duplicates on one GT, a near-miss below the
+# IoU threshold, a difficult GT, ranked scores crossing class lines —
+# the cases where the AP interpolation actually matters
 spec("detection_map",
-     ins={"DetectRes": np.array([[1.0, 0.9, 0, 0, 10, 10]], np.float32),
-          "Label": np.array([[1.0, 0, 0, 10, 10, 0]], np.float32)},
-     attrs={"overlap_threshold": 0.5})
+     ins={"DetectRes": np.array(
+         [[1.0, 0.90, 0.00, 0.00, 0.40, 0.38],   # tp on gt1
+          [1.0, 0.80, 0.02, 0.02, 0.42, 0.40],   # duplicate on gt1 -> fp
+          [1.0, 0.70, 0.50, 0.55, 0.90, 0.95],   # tp on gt2
+          [1.0, 0.60, 0.10, 0.50, 0.30, 0.70],   # near-miss -> fp
+          [2.0, 0.85, 0.21, 0.20, 0.70, 0.71],   # matches difficult gt
+          [2.0, 0.75, 0.00, 0.61, 0.30, 0.89]],  # tp on gt4
+         np.float32),
+          "Label": np.array(
+         [[1.0, 0.0, 0.00, 0.00, 0.40, 0.40],
+          [1.0, 0.0, 0.50, 0.50, 0.90, 0.90],
+          [2.0, 1.0, 0.20, 0.20, 0.70, 0.70],    # difficult
+          [2.0, 0.0, 0.00, 0.60, 0.30, 0.90]],
+         np.float32)},
+     attrs={"overlap_threshold": 0.5, "ap_type": "integral",
+            "evaluate_difficult": False})
 spec("flash_attention",
      ins={"Q": f32(1, 2, 4, 8), "K": f32(1, 2, 4, 8),
           "V": f32(1, 2, 4, 8)},

@@ -1,0 +1,80 @@
+"""Host-metric tests (reference: python/paddle/fluid/metrics.py + the
+unittests test_metrics.py family), focused on the DetectionMAP
+evaluator's streamed accumulation."""
+import numpy as np
+
+import jax.numpy as jnp
+
+from paddle_tpu import metrics
+from paddle_tpu.core.registry import REGISTRY
+
+
+def _op_map(det, lab, **attrs):
+    class _Ctx:
+        is_test = True
+        mesh = None
+        block = None
+        attrs = {}
+        rng = None
+
+    out = REGISTRY.get("detection_map").lower(
+        _Ctx(), {"DetectRes": [jnp.asarray(det)],
+                 "Label": [jnp.asarray(lab)]},
+        {"overlap_threshold": 0.5, **attrs})
+    return float(np.asarray(out["MAP"][0])[0])
+
+
+DET1 = np.array([[1.0, 0.90, 0.00, 0.00, 0.40, 0.38],
+                 [1.0, 0.80, 0.02, 0.02, 0.42, 0.40],
+                 [1.0, 0.70, 0.50, 0.55, 0.90, 0.95],
+                 [2.0, 0.85, 0.21, 0.20, 0.70, 0.71]], np.float32)
+GT_LABEL1 = np.array([[1], [1], [2]], np.int64)
+GT_BOX1 = np.array([[0.00, 0.00, 0.40, 0.40],
+                    [0.50, 0.50, 0.90, 0.90],
+                    [0.20, 0.20, 0.70, 0.70]], np.float32)
+
+
+def test_detection_map_metric_matches_op_single_image():
+    """One update() == the detection_map op on the same data (the op is
+    single-image; the metric's value-add is the cross-image stream)."""
+    for ap in ("integral", "11point"):
+        m = metrics.DetectionMAP(ap_version=ap)
+        m.update(DET1, GT_LABEL1, GT_BOX1)
+        lab = np.concatenate(
+            [GT_LABEL1.astype(np.float32),
+             np.zeros((3, 1), np.float32), GT_BOX1], axis=1)
+        assert abs(m.eval() - _op_map(DET1, lab, ap_type=ap)) < 1e-6
+
+
+def test_detection_map_metric_streams_across_images():
+    """A second image whose detection is a duplicate-style miss must
+    lower the accumulated mAP below the single-image value."""
+    m = metrics.DetectionMAP()
+    m.update(DET1, GT_LABEL1, GT_BOX1)
+    one = m.eval()
+    # image 2: one GT of class 1, detection misses it (low IoU)
+    m.update(np.array([[1.0, 0.95, 0.6, 0.6, 0.9, 0.9]], np.float32),
+             np.array([[1]], np.int64),
+             np.array([[0.0, 0.0, 0.3, 0.3]], np.float32))
+    two = m.eval()
+    assert two < one, (one, two)
+    m.reset()
+    assert m.eval() == 0.0
+
+
+def test_detection_map_metric_difficult_excluded():
+    m = metrics.DetectionMAP(evaluate_difficult=False)
+    m.update(DET1[:1], np.array([[1], [1]], np.int64),
+             np.array([[0.0, 0.0, 0.4, 0.4],
+                       [0.5, 0.5, 0.9, 0.9]], np.float32),
+             gt_difficult=np.array([[0], [1]], np.int64))
+    # the difficult GT does not count toward npos: the single perfect
+    # detection yields AP 1.0
+    assert abs(m.eval() - 1.0) < 1e-6
+
+
+def test_detection_map_metric_background_ignored():
+    m = metrics.DetectionMAP(background_label=1)
+    m.update(DET1, GT_LABEL1, GT_BOX1)
+    # class 1 is background now: only class 2 (perfect match) remains
+    assert abs(m.eval() - 1.0) < 1e-6

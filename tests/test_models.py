@@ -387,3 +387,92 @@ def test_gpt_beam_generate():
                                 prompt=[0, 1, 2], max_new_tokens=4,
                                 seq_len=seq, beam_size=3)
         assert out == [3, 4, 5, 6], out
+
+
+def test_nmt_transformer_trains():
+    """Encoder-decoder NMT (BASELINE config 3): loss must drop on a
+    learnable copy task (trg = src shifted through BOS)."""
+    from paddle_tpu.models import nmt
+
+    vocab = 32
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        cfg = nmt.TransformerConfig(vocab_size=vocab, d_model=32,
+                                    n_heads=4, n_layers=2, d_ff=64,
+                                    dropout=0.0, use_flash=False)
+        loss, feeds = nmt.build_train(cfg, batch=4, src_len=8, trg_len=8,
+                                      lr=5e-3, label_smooth_eps=0.0)
+        exe = fluid.Executor()
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        src = rng.randint(2, vocab, (4, 8)).astype(np.int64)
+        # BOS=1 prefix; target = copy of source
+        trg = np.concatenate([np.ones((4, 1), np.int64), src], axis=1)
+        first = last = None
+        for _ in range(40):
+            lv, = exe.run(main,
+                          feed={"src_tokens": src, "trg_tokens": trg},
+                          fetch_list=[loss])
+            if first is None:
+                first = float(np.asarray(lv))
+            last = float(np.asarray(lv))
+    assert last < first * 0.5, (first, last)
+
+
+def test_nmt_label_smoothing_loss_floor():
+    """With smoothing eps, perfect predictions cannot reach zero loss —
+    the smoothed CE floor is eps-dependent; just check the graph builds
+    and produces a loss strictly above the hard-label run's floor."""
+    from paddle_tpu.models import nmt
+
+    vocab = 32
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        cfg = nmt.TransformerConfig(vocab_size=vocab, d_model=32,
+                                    n_heads=4, n_layers=1, d_ff=64,
+                                    dropout=0.0, use_flash=False)
+        loss, feeds = nmt.build_train(cfg, batch=2, src_len=6, trg_len=6,
+                                      lr=5e-3, label_smooth_eps=0.1)
+        exe = fluid.Executor()
+        exe.run(startup)
+        rng = np.random.RandomState(1)
+        src = rng.randint(2, vocab, (2, 6)).astype(np.int64)
+        trg = np.concatenate([np.ones((2, 1), np.int64), src], axis=1)
+        for _ in range(60):
+            lv, = exe.run(main,
+                          feed={"src_tokens": src, "trg_tokens": trg},
+                          fetch_list=[loss])
+    # smoothed CE floor: -(1-eps)ln(1-eps+eps/V) - eps*(V-1)/V*ln(eps/V)
+    # ~= 0.38 for eps=.1, V=32; hard-label training would go to ~0
+    assert 0.2 < float(np.asarray(lv)) < 2.0
+
+
+def test_deeplab_trains():
+    """DeepLabv3+ (BASELINE config 5): per-pixel CE drops on a fixed
+    tiny batch; checks the dilated backbone + ASPP + decoder wiring."""
+    from paddle_tpu.models import deeplab
+
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        loss, feeds = deeplab.build_train(img_hw=33, batch=2,
+                                          n_classes=5, lr=0.01)
+        exe = fluid.Executor()
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        img = rng.randn(2, 3, 33, 33).astype(np.float32)
+        # learnable labels: one constant class per image (per-PIXEL random
+        # labels are unlearnable through the OS16 bottleneck — the finest
+        # decoder resolution is /4)
+        lab = np.zeros((2, 33, 33), np.int64)
+        lab[1, :, :] = 1
+        first = last = None
+        for _ in range(15):
+            lv, = exe.run(main, feed={"image": img, "label": lab},
+                          fetch_list=[loss])
+            if first is None:
+                first = float(np.asarray(lv))
+            last = float(np.asarray(lv))
+    assert last < first * 0.8, (first, last)

@@ -350,10 +350,30 @@ def test_yolov3_loss_finite():
 
 
 def test_detection_map_perfect_detection():
-    det = np.array([[1.0, 0.9, 0, 0, 10, 10]], np.float32)
-    lab = np.array([[1.0, 0, 0, 10, 10, 0]], np.float32)
+    # label layout per detection_map_op.h:161-190:
+    # (cls, difficult, xmin, ymin, xmax, ymax), normalized coords
+    det = np.array([[1.0, 0.9, 0.0, 0.0, 0.5, 0.5]], np.float32)
+    lab = np.array([[1.0, 0.0, 0.0, 0.0, 0.5, 0.5]], np.float32)
+    for ap_type in ("integral", "11point"):
+        out = run("detection_map", {"DetectRes": [det], "Label": [lab]},
+                  {"overlap_threshold": 0.5, "ap_type": ap_type})
+        np.testing.assert_allclose(float(np.asarray(out["MAP"][0])), 1.0,
+                                   rtol=1e-5)
+
+
+def test_detection_map_difficult_gt_excluded():
+    """evaluate_difficult=False: a difficult GT neither counts toward
+    npos nor penalizes the detection matching it
+    (CalcTrueAndFalsePositive, detection_map_op.h:308-408)."""
+    det = np.array([[1.0, 0.9, 0.0, 0.0, 0.5, 0.5],
+                    [1.0, 0.8, 0.5, 0.5, 1.0, 1.0]], np.float32)
+    lab = np.array([[1.0, 1.0, 0.0, 0.0, 0.5, 0.5],     # difficult
+                    [1.0, 0.0, 0.5, 0.5, 1.0, 1.0]], np.float32)
     out = run("detection_map", {"DetectRes": [det], "Label": [lab]},
-              {"overlap_threshold": 0.5})
+              {"overlap_threshold": 0.5, "ap_type": "integral",
+               "evaluate_difficult": False})
+    # only the non-difficult GT counts: one detection matches it
+    # perfectly, the difficult-matched one is dropped -> AP = 1.0
     np.testing.assert_allclose(float(np.asarray(out["MAP"][0])), 1.0,
                                rtol=1e-5)
 
@@ -382,3 +402,76 @@ def test_fused_elemwise_activation_order():
     out = run("fused_elemwise_activation", {"X": [x], "Y": [y]},
               {"functor_list": ["relu", "elementwise_add"]})["Out"][0]
     np.testing.assert_allclose(np.asarray(out), [0.0, 0.0])  # relu(add)
+
+
+def _chunk_counts(out):
+    return (int(np.asarray(out["NumInferChunks"][0])[0]),
+            int(np.asarray(out["NumLabelChunks"][0])[0]),
+            int(np.asarray(out["NumCorrectChunks"][0])[0]))
+
+
+def test_chunk_eval_iobes_scheme():
+    """IOBES tags (B=t*4, I=t*4+1, E=t*4+2, S=t*4+3, O=num*4): an S
+    chunk, a B-I-E chunk, and a split E (chunk_eval_op.h:130-136)."""
+    # label: [S0, O, B0, I0, E0]  -> chunks (0,0,0), (2,4,0)
+    lab = np.array([[3, 4, 0, 1, 2]], np.int64)
+    # inference: [S0, O, B0, E0, S0] -> (0,0,0), (2,3,0), (4,4,0)
+    inf = np.array([[3, 4, 0, 2, 3]], np.int64)
+    out = run("chunk_eval", {"Inference": [inf], "Label": [lab]},
+              {"num_chunk_types": 1, "chunk_scheme": "IOBES"})
+    ic, lc, cc = _chunk_counts(out)
+    assert (ic, lc, cc) == (3, 2, 1), (ic, lc, cc)
+
+
+def test_chunk_eval_ioe_scheme():
+    """IOE (I=t*2, E=t*2+1): chunks end at E; trailing I without E
+    still closes at sequence end (GetSegments tail flush)."""
+    # O = num_chunk_types * num_tag_types = 2 here
+    # label: [I0, E0, O, I0] -> (0,1,0), (3,3,0)
+    lab = np.array([[0, 1, 2, 0]], np.int64)
+    # inference: [I0, I0, O, I0]: I-after-I continues (no E seen), the
+    # O flushes (0,1,0); (3,3,0) at the tail -> both chunks match
+    inf = np.array([[0, 0, 2, 0]], np.int64)
+    out = run("chunk_eval", {"Inference": [inf], "Label": [lab]},
+              {"num_chunk_types": 1, "chunk_scheme": "IOE"})
+    ic, lc, cc = _chunk_counts(out)
+    assert (ic, lc, cc) == (2, 2, 2), (ic, lc, cc)
+
+
+def test_chunk_eval_plain_scheme():
+    """plain (tag==type, O=num_chunk_types): runs of equal type."""
+    lab = np.array([[0, 0, 1, 2, 2]], np.int64)   # types 0,1 + O=2
+    inf = np.array([[0, 1, 1, 2, 0]], np.int64)
+    out = run("chunk_eval", {"Inference": [inf], "Label": [lab]},
+              {"num_chunk_types": 2, "chunk_scheme": "plain"})
+    ic, lc, cc = _chunk_counts(out)
+    # label: (0,1,0), (2,2,1); inf: (0,0,0), (1,2,1), (4,4,0)
+    assert (ic, lc, cc) == (3, 2, 0), (ic, lc, cc)
+
+
+def test_chunk_eval_excluded_types():
+    """excluded_chunk_types drops that type from every count
+    (EvalOneSeq, chunk_eval_op.h:252-261)."""
+    lab = np.array([[0, 1, 4, 2, 3]], np.int64)   # (0,1,t0), (3,4,t1)
+    inf = np.array([[0, 1, 4, 2, 3]], np.int64)
+    out = run("chunk_eval", {"Inference": [inf], "Label": [lab]},
+              {"num_chunk_types": 2, "chunk_scheme": "IOB",
+               "excluded_chunk_types": [1]})
+    ic, lc, cc = _chunk_counts(out)
+    assert (ic, lc, cc) == (1, 1, 1), (ic, lc, cc)
+
+
+def test_chunk_eval_seq_length():
+    """SeqLength truncates padded rows (the use_padding path,
+    chunk_eval_op.h:180-195): padding tags beyond the length must not
+    produce chunks."""
+    lab = np.array([[0, 1, 2, 0, 0]], np.int64)   # O = 1*2 = 2
+    inf = np.array([[0, 1, 2, 0, 0]], np.int64)
+    full = run("chunk_eval", {"Inference": [inf], "Label": [lab]},
+               {"num_chunk_types": 1, "chunk_scheme": "IOB"})
+    trunc = run("chunk_eval",
+                {"Inference": [inf], "Label": [lab],
+                 "SeqLength": [np.array([3], np.int64)]},
+                {"num_chunk_types": 1, "chunk_scheme": "IOB"})
+    assert _chunk_counts(full) == (3, 3, 3)
+    assert _chunk_counts(trunc) == (1, 1, 1)

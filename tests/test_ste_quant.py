@@ -1,0 +1,79 @@
+"""STE-contract tests for the fake-quantization ops.
+
+The fake-quant ops register a straight-through-estimator gradient
+(quant_ops._ste_grad): the cotangent passes through UNCHANGED, by
+design NOT the numeric derivative of the staircase (which is 0 almost
+everywhere) and NOT scaled by the dequant factor s/bin_cnt. Reference:
+fake_quantize_op.cc registers FakeQuantGradOp as dX = dOut (QAT master
+weights are updated with the gradient taken at the quantized weight).
+
+These tests pin that contract explicitly per op: with loss = mean(Out),
+the analytic dX through the Program-IR backward must equal exactly
+ones/size — a staircase derivative would be ~0 and a dequant-scaled
+pass-through would be off by s/bin_cnt.
+"""
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.backward import append_backward
+from paddle_tpu.core.registry import REGISTRY
+from paddle_tpu.framework import grad_var_name
+from paddle_tpu.ops import quant_ops
+
+sys.path.insert(0, __file__.rsplit("/", 1)[0])
+from op_specs import SPECS  # noqa: E402
+from test_op_sweep import _build_program, _float_out_names  # noqa: E402
+
+STE_OPS = sorted(
+    t for t in REGISTRY.types()
+    if REGISTRY.get(t).manual_grad is quant_ops._ste_grad)
+
+
+def test_ste_registry_coverage():
+    """Every fake-quant/dequant op carries the STE manual grad."""
+    assert set(STE_OPS) >= {
+        "fake_quantize_abs_max", "fake_channel_wise_quantize_abs_max",
+        "fake_quantize_moving_average_abs_max",
+        "fake_quantize_dequantize_moving_average_abs_max",
+        "fake_quantize_range_abs_max", "fake_dequantize_max_abs",
+        "fake_channel_wise_dequantize_max_abs"}, STE_OPS
+
+
+@pytest.mark.parametrize("op", STE_OPS)
+def test_ste_gradient_is_identity(op):
+    spec = dict(SPECS[op])
+    spec["grad"] = ("X",)
+    main, feeds, out_map, direct, grad_names = _build_program(
+        op, spec, grad_slots=("X",))
+    opdef = REGISTRY.get(op)
+    blk = main.global_block()
+    with fluid.program_guard(main):
+        means = []
+        for slot, nm in _float_out_names(out_map, direct):
+            if slot in opdef.nondiff_outputs or slot != "Out":
+                continue
+            m = blk.create_var(name=f"{nm}__mean", stop_gradient=False)
+            blk.append_op("mean", inputs={"X": [nm]},
+                          outputs={"Out": [m.name]})
+            means.append(m.name)
+        assert means, f"{op}: no differentiable Out"
+        loss = blk.create_var(name="loss__", stop_gradient=False)
+        blk.append_op("sum", inputs={"X": means},
+                      outputs={"Out": [loss.name]})
+        append_backward(blk.var("loss__"))
+
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        analytic, = exe.run(main, feed=feeds,
+                            fetch_list=[grad_var_name(grad_names[0])])
+    x = feeds[grad_names[0]]
+    want = np.full(x.shape, 1.0 / x.size, np.float32)
+    # exact: the STE is dX = dOut with no staircase zeros and no
+    # s/bin_cnt scaling
+    np.testing.assert_allclose(np.asarray(analytic), want, rtol=1e-6,
+                               err_msg=f"{op}: STE pass-through violated")

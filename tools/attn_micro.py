@@ -1,39 +1,104 @@
-"""Flash (pallas) vs composed XLA attention at bench shapes, fwd+bwd,
-amortized-RTT timing."""
-import sys, time
-sys.path.insert(0, "/root/repo")
-import numpy as np
-import jax, jax.numpy as jnp
-from paddle_tpu.ops.pallas.flash_attention import flash_attention, reference_attention
+"""Flash (Pallas) vs composed-XLA attention A/B at bench shapes.
 
-bh, t, d = 32*12, 512, 64
-k0 = jax.random.PRNGKey(0)
-q = jax.random.normal(k0, (bh, t, d), jnp.bfloat16)
-k = jax.random.normal(k0, (bh, t, d), jnp.bfloat16)
-v = jax.random.normal(k0, (bh, t, d), jnp.bfloat16)
+Sweeps seq 512/1024/2048 (fwd and fwd+bwd, amortized-RTT timing) and,
+at each seq, the flash block-tile grid — the measurement VERDICT r04
+next-step #4 needs to settle `models/transformer.py`'s `use_flash`
+default with a number. Run on a healthy chip:
+
+    python tools/attn_micro.py [--seqs 512,1024,2048] [--bh 384]
+
+Reference analogue for measure-then-dispatch:
+paddle/fluid/operators/jit/benchmark.cc.
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+import numpy as np  # noqa: E402
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from paddle_tpu.ops.pallas.flash_attention import (  # noqa: E402
+    flash_attention, reference_attention)
+
 
 def sync(x):
     return np.asarray(jax.device_get(jnp.sum(x)))
 
+
 def timed(f, *args, n=20):
     g = jax.jit(f)
-    o = g(*args); sync(o)
-    z = jnp.zeros(()); np.asarray(z + 1)
-    t0 = time.perf_counter(); np.asarray(z + 2); rtt = time.perf_counter() - t0
+    o = g(*args)
+    sync(o)
+    z = jnp.zeros(())
+    np.asarray(z + 1)
+    t0 = time.perf_counter()
+    np.asarray(z + 2)
+    rtt = time.perf_counter() - t0
     t0 = time.perf_counter()
     for _ in range(n):
         o = g(*args)
     sync(o)
     return max(time.perf_counter() - t0 - rtt, 1e-9) / n
 
-def loss_flash(q, k, v):
-    return jnp.sum(flash_attention(q, k, v).astype(jnp.float32))
 
-def loss_ref(q, k, v):
-    return jnp.sum(reference_attention(q, k, v).astype(jnp.float32))
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seqs", default="512,1024,2048")
+    ap.add_argument("--bh", type=int, default=32 * 12,
+                    help="batch*heads (BERT-base bench default)")
+    ap.add_argument("--d", type=int, default=64)
+    ap.add_argument("--blocks", default="128,256,512",
+                    help="flash block tiles to sweep (q=k)")
+    args = ap.parse_args()
 
-for name, f in [("flash", loss_flash), ("xla", loss_ref)]:
-    fwd = timed(f, q, k, v)
-    gfn = jax.grad(f, argnums=(0, 1, 2))
-    bwd = timed(lambda q, k, v: sum(jnp.sum(x.astype(jnp.float32)) for x in gfn(q, k, v)), q, k, v)
-    print("%s: fwd %.2f ms  fwd+bwd %.2f ms" % (name, fwd*1e3, bwd*1e3), flush=True)
+    d = args.d
+    k0 = jax.random.PRNGKey(0)
+    for t in [int(s) for s in args.seqs.split(",")]:
+        # hold tokens ~constant so long-seq rows fit HBM
+        bh = args.bh if t <= 512 else max(8, args.bh * 512 // t)
+        q = jax.random.normal(k0, (bh, t, d), jnp.bfloat16)
+        k = jax.random.normal(k0, (bh, t, d), jnp.bfloat16)
+        v = jax.random.normal(k0, (bh, t, d), jnp.bfloat16)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(reference_attention(q, k, v)
+                           .astype(jnp.float32))
+
+        rows = []
+        fwd = timed(loss_ref, q, k, v)
+        g = jax.grad(loss_ref, argnums=(0, 1, 2))
+        bwd = timed(lambda q, k, v: sum(
+            jnp.sum(x.astype(jnp.float32)) for x in g(q, k, v)), q, k, v)
+        rows.append(("xla", None, fwd, bwd))
+
+        for blk in [int(b) for b in args.blocks.split(",")]:
+            if blk > t or t % blk:
+                continue
+
+            def loss_flash(q, k, v, _blk=blk):
+                return jnp.sum(
+                    flash_attention(q, k, v, block_q=_blk, block_k=_blk)
+                    .astype(jnp.float32))
+
+            fwd = timed(loss_flash, q, k, v)
+            gf = jax.grad(loss_flash, argnums=(0, 1, 2))
+            bwd = timed(lambda q, k, v: sum(
+                jnp.sum(x.astype(jnp.float32)) for x in gf(q, k, v)),
+                q, k, v)
+            rows.append(("flash", blk, fwd, bwd))
+
+        best = min(rows, key=lambda r: r[3])
+        for name, blk, fwd, bwd in rows:
+            tag = f"{name}" + (f" blk={blk}" if blk else "")
+            star = "  <- winner" if (name, blk) == best[:2] else ""
+            print(f"seq {t} bh {bh}: {tag}: fwd {fwd * 1e3:.2f} ms  "
+                  f"fwd+bwd {bwd * 1e3:.2f} ms{star}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
