@@ -77,48 +77,8 @@ class Executor:
             run_pserver(program, scope=scope)
             return []
 
-        feed = dict(feed or {})
-        fetch_list = list(fetch_list or [])
-        fetch_names = [v.name if isinstance(v, Variable) else str(v)
-                       for v in fetch_list]
-
-        block = program.global_block()
-        feed_arrays = self._prepare_feed(block, feed, compiled)
-
-        # Surface fetch targets hidden inside recompute sub-blocks BEFORE
-        # keying the cache: the rewrite mutates the program fingerprint
-        # (parallel/recompute.py).
-        from .parallel.recompute import expose_fetch_vars
-        expose_fetch_vars(program, fetch_names)
-
-        key = self._cache_key(program, feed_arrays, fetch_names, compiled)
-        step_fn = self._cache.get(key) if use_program_cache else None
-        if step_fn is not None:
-            self._cache.move_to_end(key)  # LRU touch
-        else:
-            step_fn = self._compile(program, block, feed_arrays, fetch_names,
-                                    scope, compiled)
-            self._cache[key] = step_fn
-            if compiled is not None:
-                self._compiled_refs[id(compiled)] = compiled
-            from .core.flags import FLAGS
-            cap = FLAGS.executor_cache_capacity
-            while cap > 0 and len(self._cache) > cap:
-                old_key, _ = self._cache.popitem(last=False)
-                # drop the compiled-program strong ref if no other cache
-                # entry still uses it
-                cid = old_key[3]
-                if cid is not None and all(k[3] != cid for k in self._cache):
-                    self._compiled_refs.pop(cid, None)
-
-        state = {}
-        for n in step_fn.state_in_names:
-            v = scope.find_var(n)
-            if v is None:
-                raise RuntimeError(
-                    f"persistable var {n!r} is not initialised — run the "
-                    f"startup program first")
-            state[n] = v if isinstance(v, jax.Array) else jnp.asarray(v)
+        step_fn, state, feed_arrays = self._resolve_step(
+            program, feed, fetch_list, scope, compiled, use_program_cache)
 
         fp = program.fingerprint()
         step = self._step_counters.get(fp, 0)
@@ -136,6 +96,55 @@ class Executor:
         return list(fetches)
 
     # ------------------------------------------------------------------
+    def _resolve_step(self, program, feed, fetch_list, scope, compiled,
+                      use_program_cache=True):
+        """Shared front half of run() and lowered_stablehlo(): feed
+        preparation, compile-or-cache, and persistable state gathering.
+        Returns (step_fn, state, feed_arrays)."""
+        feed = dict(feed or {})
+        fetch_names = [v.name if isinstance(v, Variable) else str(v)
+                       for v in (fetch_list or [])]
+
+        block = program.global_block()
+        feed_arrays = self._prepare_feed(block, feed, compiled)
+
+        # Surface fetch targets hidden inside recompute sub-blocks BEFORE
+        # keying the cache: the rewrite mutates the program fingerprint
+        # (parallel/recompute.py).
+        from .parallel.recompute import expose_fetch_vars
+        expose_fetch_vars(program, fetch_names)
+
+        key = self._cache_key(program, feed_arrays, fetch_names, compiled)
+        step_fn = self._cache.get(key) if use_program_cache else None
+        if step_fn is not None:
+            self._cache.move_to_end(key)  # LRU touch
+        else:
+            step_fn = self._compile(program, block, feed_arrays,
+                                    fetch_names, scope, compiled)
+            self._cache[key] = step_fn
+            if compiled is not None:
+                self._compiled_refs[id(compiled)] = compiled
+            from .core.flags import FLAGS
+            cap = FLAGS.executor_cache_capacity
+            while cap > 0 and len(self._cache) > cap:
+                old_key, _ = self._cache.popitem(last=False)
+                # drop the compiled-program strong ref if no other cache
+                # entry still uses it
+                cid = old_key[3]
+                if cid is not None and all(k[3] != cid
+                                           for k in self._cache):
+                    self._compiled_refs.pop(cid, None)
+
+        state = {}
+        for n in step_fn.state_in_names:
+            v = scope.find_var(n)
+            if v is None:
+                raise RuntimeError(
+                    f"persistable var {n!r} is not initialised — run the "
+                    f"startup program first")
+            state[n] = v if isinstance(v, jax.Array) else jnp.asarray(v)
+        return step_fn, state, feed_arrays
+
     def _prepare_feed(self, block, feed, compiled):
         out = {}
         for name, val in feed.items():
@@ -254,6 +263,28 @@ class Executor:
         else:
             fn = jax.jit(step, donate_argnums=(0,))
         return _CompiledStep(fn, state_in, state_out, fetch_names)
+
+    def lowered_stablehlo(self, program=None, feed=None, fetch_list=None,
+                          scope: Optional[Scope] = None) -> str:
+        """StableHLO text of the jitted whole-block step for (program,
+        feed, fetch_list) — the audit surface behind PERF.md's bf16
+        dot/conv checks (tools/hlo_audit.py). No reference equivalent:
+        the reference interprets ops one-by-one, so there is no single
+        compiled artifact to audit."""
+        from .compiler import CompiledProgram  # local: avoid cycle
+
+        if program is None:
+            from .framework import default_main_program
+            program = default_main_program()
+        compiled = None
+        if isinstance(program, CompiledProgram):
+            compiled = program
+            program = compiled.program
+        scope = scope or global_scope()
+        step_fn, state, feed_arrays = self._resolve_step(
+            program, feed, fetch_list, scope, compiled)
+        return step_fn.fn.lower(state, feed_arrays,
+                                jnp.uint32(0)).as_text()
 
     def close(self):
         self._cache.clear()

@@ -476,3 +476,52 @@ def test_deeplab_trains():
                 first = float(np.asarray(lv))
             last = float(np.asarray(lv))
     assert last < first * 0.8, (first, last)
+
+
+def test_label_semantic_roles_crf_trains():
+    """book/07.label_semantic_roles at toy scale: embeddings ->
+    bidirectional LSTM -> CRF loss, decoded with crf_decoding and
+    scored with chunk_eval (reference:
+    python/paddle/fluid/tests/book/test_label_semantic_roles.py)."""
+    from paddle_tpu.framework import ParamAttr
+
+    vocab, n_tags, B, T, hid = 24, 4, 8, 10, 16
+    rng = np.random.RandomState(0)
+    words = rng.randint(0, vocab, (B, T)).astype(np.int64)
+    tags = (words % n_tags).astype(np.int64)  # learnable tag rule
+
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        w = layers.data("words", shape=[B, T], dtype="int64",
+                        append_batch_size=False)
+        lab = layers.data("tags", shape=[B, T], dtype="int64",
+                          append_batch_size=False)
+        emb = layers.embedding(w, size=[vocab, hid])
+        proj = layers.fc(emb, size=4 * hid, num_flatten_dims=2)
+        fwd, _ = layers.dynamic_lstm(proj, size=4 * hid)
+        rev, _ = layers.dynamic_lstm(proj, size=4 * hid, is_reverse=True)
+        feat = layers.concat([fwd, rev], axis=2)
+        scores = layers.fc(feat, size=n_tags, num_flatten_dims=2)
+        crf_attr = ParamAttr(name="crf_w")
+        ll = layers.linear_chain_crf(scores, lab, param_attr=crf_attr)
+        loss = layers.mean(ll)
+        fluid.optimizer.SGD(learning_rate=0.2).minimize(loss)
+        decoded = layers.crf_decoding(scores, param_attr=crf_attr)
+
+        exe = fluid.Executor()
+        exe.run(startup)
+        first = last = None
+        for _ in range(30):
+            lv, = exe.run(main, feed={"words": words, "tags": tags},
+                          fetch_list=[loss])
+            if first is None:
+                first = float(np.asarray(lv))
+            last = float(np.asarray(lv))
+        assert last < first * 0.5, (first, last)
+
+        infer = main.clone(for_test=True)
+        path, = exe.run(infer, feed={"words": words, "tags": tags},
+                        fetch_list=[decoded])
+        acc = float((np.asarray(path).reshape(B, T) == tags).mean())
+        assert acc > 0.9, acc
