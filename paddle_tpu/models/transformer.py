@@ -24,7 +24,7 @@ class TransformerConfig:
     def __init__(self, vocab_size=30522, d_model=768, n_heads=12,
                  n_layers=12, d_ff=3072, max_seq_len=512, dropout=0.1,
                  tp=False, sp=False, dp_axis="dp", tp_axis="tp",
-                 sp_axis="sp", use_flash=True, causal=False,
+                 sp_axis="sp", use_flash="auto", causal=False,
                  attn_dropout=None):
         self.vocab_size = vocab_size
         self.d_model = d_model
@@ -40,6 +40,15 @@ class TransformerConfig:
         # attention WEIGHTS is a separate knob: the flash kernel does not
         # implement it, so attn_dropout > 0 forces the composed path
         # (keeping the trained model identical across kernel choices).
+        # "auto" picks by sequence length from on-chip measurement
+        # (PERF.md r05, v5e): at seq 512 the composed XLA path beats the
+        # flash kernel by ~37% (31.7% vs 19.9% MFU on BERT-base), so
+        # short sequences stay composed; past 1024 the composed path
+        # materializes the O(T^2) score tensor that flash exists to
+        # avoid, so long sequences take the blockwise kernel (the same
+        # one ring/Ulysses sequence parallelism is built on).
+        if use_flash == "auto":
+            use_flash = max_seq_len > 1024
         self.use_flash = use_flash
         self.causal = causal
         self.attn_dropout = dropout if attn_dropout is None else \
