@@ -389,20 +389,38 @@ def _conv_shift(ctx, ins, attrs):
 @register_op("similarity_focus", nondiff_inputs=("X",),
              nondiff_outputs=("Out",))
 def _similarity_focus(ctx, ins, attrs):
-    """similarity_focus_op: binary mask selecting, per (indexed channel),
-    the rows/cols of per-position maxima."""
-    x = ins["X"][0]  # [N, C, A, B]
+    """similarity_focus_op.h:76-140: for each indexed slice along
+    `axis`, a GREEDY ASSIGNMENT over the remaining two dims — visit
+    positions in descending value, keep one whose row and column are
+    both unused, stop after min(A, B) picks; the kept positions are
+    set to 1 across the whole focus axis. Descending-sort greedy ==
+    repeatedly take the global max among unblocked positions, which
+    maps to a fixed-trip lax.scan of argmax reductions (the same
+    retire-row-and-column shape as bipartite_match)."""
+    x = ins["X"][0]  # 4-D
     axis = attrs.get("axis", 1)
     indexes = attrs.get("indexes", [0])
-    n, c, a, b = x.shape
-    mask = jnp.zeros_like(x)
+    xm = jnp.moveaxis(x, axis, 1)  # [N, C_focus, A, B]
+    n, c, a, b = xm.shape
+
+    def greedy(ch):  # [A, B] -> 0/1 mask of the kept positions
+        def step(carry, _):
+            rowu, colu, m = carry
+            v = jnp.where(rowu[:, None] | colu[None, :], -jnp.inf, ch)
+            idx = jnp.argmax(v)
+            i, j = idx // b, idx % b
+            return (rowu.at[i].set(True), colu.at[j].set(True),
+                    m.at[i, j].set(1.0)), None
+        init = (jnp.zeros(a, bool), jnp.zeros(b, bool),
+                jnp.zeros((a, b), xm.dtype))
+        (_, _, m), _ = jax.lax.scan(step, init, None, length=min(a, b))
+        return m
+
+    mask = jnp.zeros((n, a, b), xm.dtype)
     for ind in indexes:
-        ch = x[:, ind]  # [N, A, B]
-        row_max = ch == jnp.max(ch, axis=2, keepdims=True)
-        col_max = ch == jnp.max(ch, axis=1, keepdims=True)
-        sel = (row_max | col_max).astype(x.dtype)[:, None]
-        mask = jnp.maximum(mask, jnp.broadcast_to(sel, mask.shape))
-    return {"Out": [mask]}
+        mask = jnp.maximum(mask, jax.vmap(greedy)(xm[:, ind]))
+    out = jnp.broadcast_to(mask[:, None], xm.shape)
+    return {"Out": [jnp.moveaxis(out, 1, axis)]}
 
 
 @register_op("var_conv_2d")

@@ -1600,6 +1600,110 @@ def _psroi_pool(i, a):
 exp_("psroi_pool", _psroi_pool)
 
 
+def _prroi_pool(i, a):
+    # precise RoI pooling: the reference's per-cell decomposition
+    # (prroi_pool_op.h:32-74 PrRoIPoolingMatCalculation summed over
+    # integer cells, :349-365) — deliberately a DIFFERENT decomposition
+    # from the lowering's separable triangle-CDF weights, so agreement
+    # witnesses the integral itself
+    x, rois = i["X"], i["ROIs"]
+    ph, pw = a["pooled_height"], a["pooled_width"]
+    scale = a["spatial_scale"]
+    n, c, h, w = x.shape
+    nums = i.get("BatchRoINums", i.get("RoisNum"))
+    if nums is not None:
+        bid = np.repeat(np.arange(len(nums)), nums.reshape(-1))
+    else:
+        bid = np.zeros(rois.shape[0], np.int64)
+
+    def data(img, ch, y, xx):
+        if 0 <= y < h and 0 <= xx < w:
+            return float(x[img, ch, y, xx])
+        return 0.0
+
+    def mat(img, ch, sh, sw, eh, ew, y0, x0, y1, x1):
+        s = 0.0
+        al, be = x0 - sw, y0 - sh
+        la, lb = x1 - sw, y1 - sh
+        fb = lb - 0.5 * lb * lb - be + 0.5 * be * be
+        s += data(img, ch, sh, sw) * (
+            (la - 0.5 * la * la - al + 0.5 * al * al) * fb)
+        al, la = ew - x1, ew - x0
+        s += data(img, ch, sh, ew) * (
+            (la - 0.5 * la * la - al + 0.5 * al * al) * fb)
+        al, be = x0 - sw, eh - y1
+        la, lb = x1 - sw, eh - y0
+        fb = lb - 0.5 * lb * lb - be + 0.5 * be * be
+        s += data(img, ch, eh, sw) * (
+            (la - 0.5 * la * la - al + 0.5 * al * al) * fb)
+        al, la = ew - x1, ew - x0
+        s += data(img, ch, eh, ew) * (
+            (la - 0.5 * la * la - al + 0.5 * al * al) * fb)
+        return s
+
+    out = np.zeros((rois.shape[0], c, ph, pw), np.float64)
+    for r, roi in enumerate(rois):
+        x1r, y1r, x2r, y2r = [float(v) * scale for v in roi[:4]]
+        bh = max(y2r - y1r, 0.0) / ph
+        bw = max(x2r - x1r, 0.0) / pw
+        win = max(bh * bw, 0.0)
+        if win <= 0.0:
+            continue
+        for ch in range(c):
+            for pi in range(ph):
+                for pj in range(pw):
+                    wsh, wsw = y1r + pi * bh, x1r + pj * bw
+                    weh, wew = wsh + bh, wsw + bw
+                    s = 0.0
+                    for hi in range(int(np.floor(wsh)),
+                                    int(np.ceil(weh))):
+                        for wi in range(int(np.floor(wsw)),
+                                        int(np.ceil(wew))):
+                            s += mat(int(bid[r]), ch, hi, wi,
+                                     hi + 1, wi + 1,
+                                     max(wsh, hi), max(wsw, wi),
+                                     min(weh, hi + 1.0),
+                                     min(wew, wi + 1.0))
+                    out[r, ch, pi, pj] = s / win
+    return {"Out": [out.astype(np.float32)]}
+
+
+exp_("prroi_pool", _prroi_pool)
+grads("prroi_pool", "X", "ROIs")
+
+
+def _similarity_focus(i, a):
+    # similarity_focus_op.h:76-140: per indexed slice, sort positions
+    # of the remaining two dims descending and greedily keep those
+    # whose row and column are both unused (stop at min(A, B) picks);
+    # kept positions are 1 across the whole focus axis
+    x = i["X"]
+    axis, indexes = a["axis"], a["indexes"]
+    xm = np.moveaxis(x, axis, 1)
+    n, c, aa, bb = xm.shape
+    out = np.zeros_like(xm)
+    for bi in range(n):
+        for ind in indexes:
+            ch = xm[bi, ind]
+            order = np.argsort(-ch, axis=None, kind="stable")
+            ru = np.zeros(aa, bool)
+            cu = np.zeros(bb, bool)
+            picks = 0
+            for flat in order:
+                r2, c3 = divmod(int(flat), bb)
+                if ru[r2] or cu[c3]:
+                    continue
+                ru[r2] = cu[c3] = True
+                out[bi, :, r2, c3] = 1
+                picks += 1
+                if picks == min(aa, bb):
+                    break
+    return {"Out": [np.moveaxis(out, 1, axis)]}
+
+
+exp_("similarity_focus", _similarity_focus)
+
+
 def _generate_mask_labels(i, a):
     # generate_mask_labels_op.cc:199-254 + mask_util.cc
     # Polys2MaskWrtBox:186-211 on pre-binarized image-grid masks:
@@ -3581,12 +3685,8 @@ NOREF_REASONS = {
                                "rpn_target_assign contract",
     "retinanet_detection_output": "per-level NMS pipeline; components "
                                   "witnessed via nms/box refs",
-    "prroi_pool": "closed-form integral pooling; grad-checked "
-                  "numerically instead",
     "yolov3_loss": "composite assigner+loss; grad-checked and "
                    "covered by yolo_box witness for the decode math",
-    "similarity_focus": "argmax-selection mask; covered by "
-                        "shape/selection tests",
     "tree_conv": "message-passing redesign documented in lowering",
 }
 
