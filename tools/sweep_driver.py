@@ -53,6 +53,7 @@ CONFIGS = [
     ("bert_f0_b16_s1024", {"BENCH_FLASH": "0", "BENCH_BATCH": "16",
                            "BENCH_SEQ": "1024"}),
     ("bert_f0_b64", {"BENCH_FLASH": "0", "BENCH_BATCH": "64"}),
+    ("native_jax_bert_b32", None),  # special-cased below
     ("bert_f0_b128", {"BENCH_FLASH": "0", "BENCH_BATCH": "128"}),
     ("resnet50_b128", {"BENCH_MODEL": "resnet50", "BENCH_BATCH": "128"}),
     ("transformer_b32", {"BENCH_MODEL": "transformer", "BENCH_BATCH": "32"}),
@@ -116,15 +117,17 @@ def load_ledger():
                 if key not in known or key in ledger:
                     continue
                 nxt = lines[idx + 1]
-                if nxt.startswith("{"):
+                if nxt.startswith(("{", '"')):
                     try:
                         rec = json.loads(nxt)
                     except ValueError:
                         continue
-                    if "error" not in rec and rec.get("value"):
+                    if isinstance(rec, str):
+                        ledger[key] = rec  # special-step text result
+                    elif "error" not in rec and rec.get("value"):
                         ledger[key] = rec
                 elif nxt and not nxt.startswith(("#", "===")):
-                    ledger[key] = nxt  # special-step text result
+                    ledger[key] = nxt  # legacy raw-text mirror line
     return ledger
 
 
@@ -142,8 +145,9 @@ def mirror(ledger):
     for key, _ in CONFIGS:
         if key in ledger:
             out.append(f"=== {key} ===")
-            rec = ledger[key]
-            out.append(rec if isinstance(rec, str) else json.dumps(rec))
+            # json.dumps for strings too: one escaped line, so
+            # load_ledger can round-trip multiline special-step text
+            out.append(json.dumps(ledger[key]))
     missing = [k for k, _ in CONFIGS if k not in ledger]
     out.append(f"# outstanding: {missing if missing else 'none'}")
     with open(MIRROR, "w") as f:
@@ -181,11 +185,11 @@ def probe_ok(deadline_s=300):
     return False
 
 
-def run_bench(env_over):
+def run_bench(env_over, script="bench.py", timeout=None):
     env = dict(os.environ, BENCH_STEPS=os.environ.get("BENCH_STEPS", "30"),
                BENCH_WAIT_TPU_S="120", **env_over)
-    p = subprocess.run([sys.executable, "bench.py"], cwd=REPO, env=env,
-                       capture_output=True, text=True)
+    p = subprocess.run([sys.executable, script], cwd=REPO, env=env,
+                       capture_output=True, text=True, timeout=timeout)
     line = None
     for ln in p.stdout.splitlines():
         if ln.startswith("{"):
@@ -199,7 +203,10 @@ def run_bench(env_over):
 
 
 def run_special(key):
-    """attn_micro / profile: success = rc 0 with output."""
+    """attn_micro / profile / native twin: success = rc 0 with output."""
+    if key == "native_jax_bert_b32":
+        return run_bench({"BENCH_BATCH": "32"},
+                         script="tools/native_jax_bert.py", timeout=1800)
     if key == "attn_micro":
         p = subprocess.run([sys.executable, "tools/attn_micro.py"],
                            cwd=REPO, capture_output=True, text=True,
