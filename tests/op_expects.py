@@ -1704,6 +1704,51 @@ def _similarity_focus(i, a):
 exp_("similarity_focus", _similarity_focus)
 
 
+def _tree_conv(i, a):
+    # TBCNN tree conv re-derived from tree2col.cc:23-132 +
+    # tree_conv_op.h:30-75: explicit per-root DFS patches with
+    # (eta_l, eta_r, eta_t) position weights, then patch @ flat(Filter)
+    nodes, edges, filt = i["NodesVector"], i["EdgeSet"], i["Filter"]
+    md = a["max_depth"]
+    bsz, n, fdim = nodes.shape
+    _, _, osz, nf = filt.shape
+    out = np.zeros((bsz, n, osz, nf), np.float64)
+    w2 = filt.reshape(fdim * 3, osz * nf)  # row (feat i, coeff c)=i*3+c
+    for b in range(bsz):
+        children = {}
+        node_count = 1
+        for (u, v) in edges[b]:
+            if u == 0 or v == 0:
+                break  # construct_tree stops at the first zero pair
+            children.setdefault(int(u), []).append(int(v))
+            node_count += 1
+        for root in range(1, node_count + 1):
+            patch = [(root, 1, 1, 0)]
+            stack = [(root, 0)]
+            while stack:
+                nd, depth = stack.pop()
+                if depth + 1 < md:
+                    ch = children.get(nd, [])
+                    for ci, c in enumerate(ch, 1):
+                        patch.append((c, ci, len(ch), depth + 1))
+                        stack.append((c, depth + 1))
+            row = np.zeros(fdim * 3, np.float64)
+            for (nd, ci, pl, depth) in patch:
+                eta_t = (md - depth) / md
+                tempv = 0.5 if pl == 1 else (ci - 1.0) / (pl - 1.0)
+                eta_l = (1 - eta_t) * tempv
+                eta_r = (1 - eta_t) * (1 - eta_l)
+                fvec = nodes[b, nd - 1].astype(np.float64)
+                row[0::3] += eta_l * fvec
+                row[1::3] += eta_r * fvec
+                row[2::3] += eta_t * fvec
+            out[b, root - 1] = (row @ w2).reshape(osz, nf)
+    return {"Out": [out.astype(np.float32)]}
+
+
+exp_("tree_conv", _tree_conv)
+
+
 def _generate_mask_labels(i, a):
     # generate_mask_labels_op.cc:199-254 + mask_util.cc
     # Polys2MaskWrtBox:186-211 on pre-binarized image-grid masks:
@@ -3687,7 +3732,6 @@ NOREF_REASONS = {
                                   "witnessed via nms/box refs",
     "yolov3_loss": "composite assigner+loss; grad-checked and "
                    "covered by yolo_box witness for the decode math",
-    "tree_conv": "message-passing redesign documented in lowering",
 }
 
 

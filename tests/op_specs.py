@@ -635,11 +635,16 @@ spec("match_matrix_tensor", ins={"X": f32(1, 3, 4), "Y": f32(1, 5, 4),
 spec("var_conv_2d", ins={"X": f32(1, 2, 4, 4), "W": f32(3, 2, 3, 3)},
      attrs={"OutputChannel": 3, "InputChannel": 2, "KernelH": 3,
             "KernelW": 3, "StrideH": 1, "StrideW": 1})
-spec("tree_conv", ins={"NodesVector": f32(1, 4, 3),
-                       "EdgeSet": np.array([[[0, 1], [1, 2], [2, 3]]],
-                                           np.int32),
+# batch 0: branching tree (1->2,3; 2->4,5) exercises sibling
+# index/count weights at depth 2; batch 1: chain whose post-(0,0) edge
+# must be IGNORED (construct_tree break semantics)
+spec("tree_conv", ins={"NodesVector": f32(2, 6, 3),
+                       "EdgeSet": np.array(
+                           [[[1, 2], [1, 3], [2, 4], [2, 5], [0, 0]],
+                            [[1, 2], [2, 3], [3, 4], [0, 0], [5, 6]]],
+                           np.int32),
                        "Filter": f32(3, 3, 2, 2)},
-     attrs={"max_depth": 2})
+     attrs={"max_depth": 3}, grad=["NodesVector", "Filter"])
 spec("filter_by_instag",
      ins={"Ins": f32(3, 2), "Ins_tag": np.array([1, 2, 1], np.int64),
           "Filter_tag": np.array([1], np.int64)},

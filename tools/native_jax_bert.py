@@ -69,7 +69,7 @@ def init_params(rng, cfg):
     return p
 
 
-def _build_step(cfg, batch, seq_len, lr=1e-4, wd=0.01, dropout=0.1):
+def _build_step(cfg, seq_len, lr=1e-4, wd=0.01, dropout=0.1):
     import jax
     import jax.numpy as jnp
 
@@ -195,31 +195,39 @@ def main():
     zeros = jtu.tree_map(jnp.zeros_like, p)
     state = (p, zeros, jtu.tree_map(jnp.zeros_like, p),
              jnp.zeros((), jnp.int32), jax.random.PRNGKey(0))
-    step = _build_step(cfg, batch, seq_len)
+    step = _build_step(cfg, seq_len)
     r = np.random.RandomState(0)
     toks = jnp.asarray(r.randint(0, cfg.vocab_size, (batch, seq_len)),
                        jnp.int32)
     state, lv = step(state, toks, toks)  # compile + warm
     np.asarray(lv)
 
+    # identical timing discipline to bench.py _timed_steps: median-of-5
+    # RTT probe, async windows synced once, 5%-of-elapsed floor on the
+    # RTT subtraction — the bench-vs-twin comparison is only meaningful
+    # if both sides measure the same way
+    np.asarray(jnp.zeros(()) + 1)  # compile the probe expression
+    rtts = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        np.asarray(jnp.zeros(()) + 1)
+        rtts.append(time.perf_counter() - t0)
+    rtt = float(np.median(rtts))
+
     def window(n):
         nonlocal state
-        z = jnp.zeros(())
-        np.asarray(z + 1)
-        t0 = time.perf_counter()
-        np.asarray(z + 2)
-        rtt = time.perf_counter() - t0
         t0 = time.perf_counter()
         lv = None
         for _ in range(n):
             state, lv = step(state, toks, toks)
         lv = float(np.asarray(lv))
-        return max(time.perf_counter() - t0 - rtt, 1e-9) / n, lv, rtt
+        elapsed = time.perf_counter() - t0
+        return max(elapsed - rtt, 0.05 * elapsed) / n, lv
 
     n1 = max(1, steps // 2)
     n2 = max(1, steps - n1)
-    dt1, _, rtt = window(n1)
-    dt2, lv, _ = window(n2)
+    dt1, _ = window(n1)
+    dt2, lv = window(n2)
     dt = (dt1 * n1 + dt2 * n2) / (n1 + n2)
     flops = bench.model_flops_per_token(cfg, seq_len) * batch * seq_len
     mfu = flops / dt / bench.peak_flops_per_chip()
