@@ -388,27 +388,57 @@ def _target_assign(ctx, ins, attrs):
     return {"Out": [out], "OutWeight": [w]}
 
 
-def _nms_padded(boxes, scores, iou_thr, score_thr, keep):
-    """greedy NMS -> fixed `keep` indices, -1 padded."""
+def _iou_pixel(a, b):
+    """[N,4] x [M,4] -> [N,M] IoU in the reference's integer-pixel
+    convention (JaccardOverlap normalized=false,
+    generate_proposals_op.cc:218-234): +1 on widths/heights, and
+    degenerate boxes (x2<x0 or y2<y1) have area 0."""
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.maximum(rb - lt + 1.0, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+
+    def area(x):
+        w = x[:, 2] - x[:, 0]
+        h = x[:, 3] - x[:, 1]
+        return jnp.where((w < 0) | (h < 0), 0.0, (w + 1.0) * (h + 1.0))
+
+    return inter / jnp.maximum(area(a)[:, None] + area(b)[None, :]
+                               - inter, 1e-10)
+
+
+def _nms_padded(boxes, scores, iou_thr, score_thr, keep, pixel=False,
+                eta=1.0):
+    """greedy NMS -> fixed `keep` indices, -1 padded. pixel=True uses
+    the +1 integer-pixel IoU; eta<1 decays the threshold after each
+    accepted box while it stays >0.5 (reference adaptive NMS,
+    generate_proposals_op.cc:283-285)."""
     n = boxes.shape[0]
     order = jnp.argsort(-scores)
     boxes_s = boxes[order]
     scores_s = scores[order]
-    alive = scores_s > score_thr
+    eligible = scores_s > score_thr
+    iou_fn = _iou_pixel if pixel else _iou
 
+    # reference turn order: each candidate (descending score) is tested
+    # against ALL previously accepted boxes with the threshold as it
+    # stands at the candidate's OWN turn — with eta < 1 the threshold
+    # decays after every acceptance, so testing at the killer's step
+    # instead would use a stale (larger) threshold
     def step(carry, i):
-        alive, out = carry
-        take = alive[i]
+        accepted, out, thr = carry
+        ious = iou_fn(boxes_s[i][None, :], boxes_s)[0]
+        max_iou = jnp.max(jnp.where(accepted, ious, 0.0))
+        take = eligible[i] & (max_iou <= thr)
         out = out.at[i].set(jnp.where(take, order[i], -1))
-        ious = _iou(boxes_s[i][None, :], boxes_s)[0]
-        # only a box that was actually kept suppresses its overlaps
-        kill = take & (ious > iou_thr) & (jnp.arange(n) > i)
-        alive = alive & ~kill
-        alive = alive.at[i].set(False)
-        return (alive, out), take
+        accepted = accepted.at[i].set(take)
+        if eta < 1.0:
+            thr = jnp.where(take & (thr > 0.5), thr * eta, thr)
+        return (accepted, out, thr), take
 
-    (alive, out), took = jax.lax.scan(
-        step, (alive, jnp.full((n,), -1, jnp.int32)), jnp.arange(n))
+    (_, out, _), took = jax.lax.scan(
+        step, (jnp.zeros((n,), bool), jnp.full((n,), -1, jnp.int32),
+               jnp.asarray(iou_thr, boxes.dtype)), jnp.arange(n))
     # compact kept first, crop/pad to `keep`
     sel = jnp.argsort(out < 0, stable=True)
     out = out[sel]
@@ -624,44 +654,80 @@ def _distribute_fpn_proposals(ctx, ins, attrs):
                              "Variances"),
              nondiff_outputs=("RpnRois", "RpnRoiProbs", "RpnRoisNum"))
 def _generate_proposals(ctx, ins, attrs):
-    """RPN proposal generation (generate_proposals_op): decode deltas at
-    anchors, clip to image, top-k by score, NMS; padded output."""
+    """RPN proposal generation (generate_proposals_op.cc:288-430), the
+    full reference pipeline in static shapes: transpose to [H, W, A]
+    order, top pre_nms_topN by raw score, decode the survivors at their
+    anchors WITH variances and the log(1000/16) exp clamp (BoxCoder
+    :70-128, -1 max-corner convention), clip to the image
+    (ClipTiledBoxes :132-152), drop boxes below min_size at origin
+    scale or with centers outside the image (FilterBoxes :155-185),
+    greedy NMS in the +1 integer-pixel IoU with adaptive-eta threshold
+    (:248-287), cap at post_nms_topN. Padded redesign: fixed
+    [N*post_n, 4] outputs with RpnRoisNum valid counts instead of the
+    reference's LoD-batched variable rows."""
     scores = ins["Scores"][0]        # [N, A, H, W]
     deltas = ins["BboxDeltas"][0]    # [N, A*4, H, W]
-    iminfo = ins["ImInfo"][0]        # [N, 3]
+    iminfo = ins["ImInfo"][0]        # [N, 3] = (h, w, scale)
     anchors = ins["Anchors"][0].reshape(-1, 4)
+    variances = ins["Variances"][0].reshape(-1, 4) \
+        if "Variances" in ins else None
     pre_n = attrs.get("pre_nms_topN", 256)
     post_n = attrs.get("post_nms_topN", 64)
     nms_thr = attrs.get("nms_thresh", 0.7)
+    eta = attrs.get("eta", 1.0)
+    min_size = max(attrs.get("min_size", 0.1), 1.0)
+    bbox_clip = float(np.log(1000.0 / 16.0))
 
     def one(sc, dl, info):
         # anchors are laid out [H, W, A, 4] (anchor_generator); flatten
         # scores [A, H, W] and deltas [A*4, H, W] into the same H, W, A
-        # order (the reference transposes with axis={0,2,3,1},
-        # generate_proposals_op.cc)
+        # order (the reference transposes with axis={0,2,3,1})
         s = sc.transpose(1, 2, 0).reshape(-1)
         d = dl.reshape(-1, 4, dl.shape[-2], dl.shape[-1]) \
             .transpose(2, 3, 0, 1).reshape(-1, 4)
-        aw = anchors[:, 2] - anchors[:, 0] + 1
-        ah = anchors[:, 3] - anchors[:, 1] + 1
-        acx = anchors[:, 0] + aw / 2
-        acy = anchors[:, 1] + ah / 2
-        cx = acx + d[:, 0] * aw
-        cy = acy + d[:, 1] * ah
-        bw = jnp.exp(jnp.clip(d[:, 2], -10, 10)) * aw
-        bh = jnp.exp(jnp.clip(d[:, 3], -10, 10)) * ah
-        boxes = jnp.stack([cx - bw / 2, cy - bh / 2,
-                           cx + bw / 2, cy + bh / 2], axis=1)
-        boxes = jnp.clip(boxes, 0.0,
-                         jnp.asarray([info[1], info[0],
-                                      info[1], info[0]]) - 1)
-        k = min(pre_n, s.shape[0])
+        k = min(pre_n, s.shape[0]) if pre_n > 0 else s.shape[0]
         top_s, top_i = jax.lax.top_k(s, k)
-        top_b = boxes[top_i]
-        kept = _nms_padded(top_b, top_s, nms_thr, -1e9,
-                           min(post_n, k))
+        d = d[top_i]
+        an = anchors[top_i]
+        aw = an[:, 2] - an[:, 0] + 1
+        ah = an[:, 3] - an[:, 1] + 1
+        acx = an[:, 0] + aw / 2
+        acy = an[:, 1] + ah / 2
+        if variances is not None:
+            v = variances[top_i]
+            cx = acx + v[:, 0] * d[:, 0] * aw
+            cy = acy + v[:, 1] * d[:, 1] * ah
+            bw = jnp.exp(jnp.minimum(v[:, 2] * d[:, 2], bbox_clip)) * aw
+            bh = jnp.exp(jnp.minimum(v[:, 3] * d[:, 3], bbox_clip)) * ah
+        else:
+            cx = acx + d[:, 0] * aw
+            cy = acy + d[:, 1] * ah
+            bw = jnp.exp(jnp.minimum(d[:, 2], bbox_clip)) * aw
+            bh = jnp.exp(jnp.minimum(d[:, 3], bbox_clip)) * ah
+        boxes = jnp.stack([cx - bw / 2, cy - bh / 2,
+                           cx + bw / 2 - 1, cy + bh / 2 - 1], axis=1)
+        boxes = jnp.clip(jnp.clip(boxes,
+                                  None,
+                                  jnp.asarray([info[1], info[0],
+                                               info[1], info[0]]) - 1),
+                         0.0, None)
+        # FilterBoxes: min_size at origin scale + center inside image
+        ws = boxes[:, 2] - boxes[:, 0] + 1
+        hs = boxes[:, 3] - boxes[:, 1] + 1
+        ws_o = (boxes[:, 2] - boxes[:, 0]) / info[2] + 1
+        hs_o = (boxes[:, 3] - boxes[:, 1]) / info[2] + 1
+        xc = boxes[:, 0] + ws / 2
+        yc = boxes[:, 1] + hs / 2
+        keep = ((ws_o >= min_size) & (hs_o >= min_size)
+                & (xc <= info[1]) & (yc <= info[0]))
+        nms_s = jnp.where(keep, top_s, -1e9)
+        kept = _nms_padded(boxes, nms_s, nms_thr, -1e8,
+                           min(post_n, k), pixel=True, eta=eta)
+        if k < post_n:  # fixed [post_n] rows even when pre_n/anchors < post_n
+            kept = jnp.concatenate(
+                [kept, jnp.full((post_n - k,), -1, jnp.int32)])
         out_b = jnp.where((kept >= 0)[:, None],
-                          top_b[jnp.maximum(kept, 0)], 0.0)
+                          boxes[jnp.maximum(kept, 0)], 0.0)
         out_s = jnp.where(kept >= 0, top_s[jnp.maximum(kept, 0)], 0.0)
         return out_b, out_s, jnp.sum(kept >= 0)
 

@@ -1749,6 +1749,96 @@ def _tree_conv(i, a):
 exp_("tree_conv", _tree_conv)
 
 
+def _generate_proposals(i, a):
+    # full RPN pipeline re-derived from generate_proposals_op.cc:288-430
+    # (BoxCoder with variances + log(1000/16) clamp and -1 max corner,
+    # ClipTiledBoxes, FilterBoxes origin-scale min_size + center-inside,
+    # greedy +1-pixel NMS with adaptive eta, post_nms cap), emitted in
+    # the lowering's padded fixed-shape convention
+    scores, deltas = i["Scores"], i["BboxDeltas"]
+    iminfo = i["ImInfo"]
+    anchors = i["Anchors"].reshape(-1, 4).astype(np.float64)
+    variances = i["Variances"].reshape(-1, 4).astype(np.float64)
+    pre_n = a.get("pre_nms_topN", 256)
+    post_n = a.get("post_nms_topN", 64)
+    nms_thr = a.get("nms_thresh", 0.7)
+    eta = a.get("eta", 1.0)
+    min_size = max(a.get("min_size", 0.1), 1.0)
+    clipv = np.log(1000.0 / 16.0)
+    bsz = scores.shape[0]
+    out_b = np.zeros((bsz, post_n, 4), np.float64)
+    out_s = np.zeros((bsz, post_n), np.float64)
+    nums = np.zeros(bsz, np.int32)
+
+    def iou(b1, b2):
+        if b2[0] > b1[2] or b2[2] < b1[0] or b2[1] > b1[3] \
+                or b2[3] < b1[1]:
+            return 0.0
+
+        def area(b):
+            if b[2] < b[0] or b[3] < b[1]:
+                return 0.0
+            return (b[2] - b[0] + 1.0) * (b[3] - b[1] + 1.0)
+
+        iw = min(b1[2], b2[2]) - max(b1[0], b2[0]) + 1.0
+        ih = min(b1[3], b2[3]) - max(b1[1], b2[1]) + 1.0
+        inter = max(iw, 0.0) * max(ih, 0.0)
+        return inter / (area(b1) + area(b2) - inter)
+
+    for b in range(bsz):
+        h, w, scale = [float(x) for x in iminfo[b][:3]]
+        s = scores[b].transpose(1, 2, 0).reshape(-1).astype(np.float64)
+        d = deltas[b].reshape(-1, 4, deltas.shape[-2], deltas.shape[-1]) \
+            .transpose(2, 3, 0, 1).reshape(-1, 4).astype(np.float64)
+        order = np.argsort(-s, kind="stable")
+        if 0 < pre_n < len(s):
+            order = order[:pre_n]
+        ts, td, ta, tv = s[order], d[order], anchors[order], \
+            variances[order]
+        aw = ta[:, 2] - ta[:, 0] + 1
+        ah = ta[:, 3] - ta[:, 1] + 1
+        acx = ta[:, 0] + aw / 2
+        acy = ta[:, 1] + ah / 2
+        cx = acx + tv[:, 0] * td[:, 0] * aw
+        cy = acy + tv[:, 1] * td[:, 1] * ah
+        bw = np.exp(np.minimum(tv[:, 2] * td[:, 2], clipv)) * aw
+        bh = np.exp(np.minimum(tv[:, 3] * td[:, 3], clipv)) * ah
+        boxes = np.stack([cx - bw / 2, cy - bh / 2,
+                          cx + bw / 2 - 1, cy + bh / 2 - 1], 1)
+        boxes[:, 0] = np.clip(boxes[:, 0], 0, w - 1)
+        boxes[:, 1] = np.clip(boxes[:, 1], 0, h - 1)
+        boxes[:, 2] = np.clip(boxes[:, 2], 0, w - 1)
+        boxes[:, 3] = np.clip(boxes[:, 3], 0, h - 1)
+        kept_rows = []
+        for r in range(len(boxes)):
+            ws = boxes[r, 2] - boxes[r, 0] + 1
+            hs = boxes[r, 3] - boxes[r, 1] + 1
+            ws_o = (boxes[r, 2] - boxes[r, 0]) / scale + 1
+            hs_o = (boxes[r, 3] - boxes[r, 1]) / scale + 1
+            if ws_o >= min_size and hs_o >= min_size \
+                    and boxes[r, 0] + ws / 2 <= w \
+                    and boxes[r, 1] + hs / 2 <= h:
+                kept_rows.append(r)
+        sel = []
+        thr = nms_thr
+        for r in sorted(kept_rows, key=lambda r: -ts[r]):
+            if all(iou(boxes[r], boxes[kr]) <= thr for kr in sel):
+                sel.append(r)
+                if eta < 1.0 and thr > 0.5:
+                    thr *= eta
+        sel = sel[:post_n]
+        nums[b] = len(sel)
+        for j, r in enumerate(sel):
+            out_b[b, j] = boxes[r]
+            out_s[b, j] = ts[r]
+    return {"RpnRois": [out_b.reshape(-1, 4).astype(np.float32)],
+            "RpnRoiProbs": [out_s.reshape(-1, 1).astype(np.float32)],
+            "RpnRoisNum": [nums]}
+
+
+exp_("generate_proposals", _generate_proposals)
+
+
 def _generate_mask_labels(i, a):
     # generate_mask_labels_op.cc:199-254 + mask_util.cc
     # Polys2MaskWrtBox:186-211 on pre-binarized image-grid masks:
@@ -3717,8 +3807,6 @@ NOREF_REASONS = {
     "sample_logits": "stochastic candidate sampling",
     "pull_box_sparse": "host-side BoxPS table service; roundtrip "
                        "covered in tests/test_straggler_ops.py",
-    "generate_proposals": "multi-stage NMS pipeline; components "
-                          "witnessed via box_coder/iou/nms refs",
     "generate_proposal_labels": "stochastic fg/bg subsampling in the "
                                 "reference; deterministic redesign "
                                 "covered by dedicated tests",

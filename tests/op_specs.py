@@ -909,13 +909,25 @@ spec("collect_fpn_proposals",
 spec("distribute_fpn_proposals", ins={"FpnRois": _BOXES1},
      attrs={"min_level": 2, "max_level": 3, "refer_level": 2,
             "refer_scale": 16})
+# well-formed anchor grid (x1<x2), two images with different sizes and
+# scales: exercises variance-scaled decoding, the origin-scale
+# min_size filter, center-inside-image rejection, and adaptive-eta NMS
+_gp_anchors = np.zeros((3, 3, 2, 4), np.float32)
+for _yy in range(3):
+    for _xx in range(3):
+        for _ai, _sz in enumerate((3.0, 6.0)):
+            _gp_anchors[_yy, _xx, _ai] = [8 * _xx + 4 - _sz,
+                                          8 * _yy + 4 - _sz,
+                                          8 * _xx + 4 + _sz,
+                                          8 * _yy + 4 + _sz]
 spec("generate_proposals",
-     ins={"Scores": pos(1, 2, 3, 3), "BboxDeltas": f32(1, 8, 3, 3),
-          "ImInfo": np.array([[24.0, 24.0, 1.0]], np.float32),
-          "Anchors": f32(3, 3, 2, 4, lo=0, hi=20),
+     ins={"Scores": pos(2, 2, 3, 3), "BboxDeltas": f32(2, 8, 3, 3),
+          "ImInfo": np.array([[24.0, 24.0, 2.0],
+                              [20.0, 28.0, 1.0]], np.float32),
+          "Anchors": _gp_anchors,
           "Variances": pos(3, 3, 2, 4)},
-     attrs={"pre_nms_topN": 6, "post_nms_topN": 4, "nms_thresh": 0.5,
-            "min_size": 0.1})
+     attrs={"pre_nms_topN": 12, "post_nms_topN": 6, "nms_thresh": 0.6,
+            "min_size": 2.0, "eta": 0.9})
 spec("generate_proposal_labels",
      ins={"RpnRois": _BOXES1, "GtClasses": np.array([1], np.int32),
           "IsCrowd": np.array([0], np.int32),
