@@ -397,14 +397,21 @@ def _iou_pixel(a, b):
     rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
     wh = jnp.maximum(rb - lt + 1.0, 0.0)
     inter = wh[..., 0] * wh[..., 1]
+    # JaccardOverlap's early return: STRICTLY disjoint boxes are 0 even
+    # when the +1 pixel convention would give a sub-pixel-gap overlap
+    disjoint = ((b[None, :, 0] > a[:, None, 2])
+                | (b[None, :, 2] < a[:, None, 0])
+                | (b[None, :, 1] > a[:, None, 3])
+                | (b[None, :, 3] < a[:, None, 1]))
 
     def area(x):
         w = x[:, 2] - x[:, 0]
         h = x[:, 3] - x[:, 1]
         return jnp.where((w < 0) | (h < 0), 0.0, (w + 1.0) * (h + 1.0))
 
-    return inter / jnp.maximum(area(a)[:, None] + area(b)[None, :]
-                               - inter, 1e-10)
+    iou = inter / jnp.maximum(area(a)[:, None] + area(b)[None, :]
+                              - inter, 1e-10)
+    return jnp.where(disjoint, 0.0, iou)
 
 
 def _nms_padded(boxes, scores, iou_thr, score_thr, keep, pixel=False,
@@ -628,24 +635,42 @@ def _collect_fpn_proposals(ctx, ins, attrs):
              nondiff_outputs=("MultiFpnRois", "RestoreIndex",
                               "MultiLevelRoIsNum"))
 def _distribute_fpn_proposals(ctx, ins, attrs):
-    """route each RoI to its FPN level by scale (distribute_fpn_
-    proposals_op); padded per-level outputs, inactive rows zeroed."""
+    """route each RoI to its FPN level by scale
+    (distribute_fpn_proposals_op.h:55-140): target level =
+    clip(floor(log2(sqrt(pixel_area) / refer_scale + 1e-6)
+    + refer_level)) with pixel_area = (w+1)*(h+1) (BBoxArea
+    normalized=false). Static-shape redesign of the variable-length
+    outputs: each level is [N, 4] with that level's rois COMPACTED to
+    the top rows in original order (zero tail) and
+    MultiLevelRoIsNum[l] valid rows; RestoreIndex[orig] is the roi's
+    slot in the padded concat of the levels (level_idx*N + rank), so
+    concat(MultiFpnRois)[RestoreIndex] == FpnRois — the reference's
+    compacted-concat restore contract transposed to padding."""
     rois = ins["FpnRois"][0]
     min_level = attrs.get("min_level", 2)
     max_level = attrs.get("max_level", 5)
     refer_level = attrs.get("refer_level", 4)
     refer_scale = attrs.get("refer_scale", 224)
     n = rois.shape[0]
-    scale = jnp.sqrt(_area(rois))
-    lvl = jnp.floor(refer_level + jnp.log2(scale / refer_scale + 1e-8))
+    w = rois[:, 2] - rois[:, 0]
+    h = rois[:, 3] - rois[:, 1]
+    area = jnp.where((w < 0) | (h < 0), 0.0, (w + 1.0) * (h + 1.0))
+    lvl = jnp.floor(jnp.log2(jnp.sqrt(area) / refer_scale + 1e-6)
+                    + refer_level)
     lvl = jnp.clip(lvl, min_level, max_level).astype(jnp.int32)
     outs, nums = [], []
-    for l in range(min_level, max_level + 1):
-        m = (lvl == l)[:, None]
-        outs.append(jnp.where(m, rois, 0.0))
-        nums.append(jnp.sum(lvl == l))
+    restore = jnp.zeros((n,), jnp.int32)
+    for li, l in enumerate(range(min_level, max_level + 1)):
+        member = lvl == l
+        cnt = jnp.sum(member)
+        order = jnp.argsort(~member, stable=True)  # members first,
+        outs.append(jnp.where((jnp.arange(n) < cnt)[:, None],  # orig order
+                              rois[order], 0.0))
+        rank = jnp.cumsum(member.astype(jnp.int32)) - 1
+        restore = jnp.where(member, li * n + rank, restore)
+        nums.append(cnt)
     return {"MultiFpnRois": outs,
-            "RestoreIndex": [jnp.arange(n, dtype=jnp.int32)[:, None]],
+            "RestoreIndex": [restore[:, None]],
             "MultiLevelRoIsNum": [jnp.stack(nums).astype(jnp.int32)]}
 
 
@@ -693,17 +718,12 @@ def _generate_proposals(ctx, ins, attrs):
         ah = an[:, 3] - an[:, 1] + 1
         acx = an[:, 0] + aw / 2
         acy = an[:, 1] + ah / 2
-        if variances is not None:
-            v = variances[top_i]
-            cx = acx + v[:, 0] * d[:, 0] * aw
-            cy = acy + v[:, 1] * d[:, 1] * ah
-            bw = jnp.exp(jnp.minimum(v[:, 2] * d[:, 2], bbox_clip)) * aw
-            bh = jnp.exp(jnp.minimum(v[:, 3] * d[:, 3], bbox_clip)) * ah
-        else:
-            cx = acx + d[:, 0] * aw
-            cy = acy + d[:, 1] * ah
-            bw = jnp.exp(jnp.minimum(d[:, 2], bbox_clip)) * aw
-            bh = jnp.exp(jnp.minimum(d[:, 3], bbox_clip)) * ah
+        v = variances[top_i] if variances is not None \
+            else jnp.ones_like(d)
+        cx = acx + v[:, 0] * d[:, 0] * aw
+        cy = acy + v[:, 1] * d[:, 1] * ah
+        bw = jnp.exp(jnp.minimum(v[:, 2] * d[:, 2], bbox_clip)) * aw
+        bh = jnp.exp(jnp.minimum(v[:, 3] * d[:, 3], bbox_clip)) * ah
         boxes = jnp.stack([cx - bw / 2, cy - bh / 2,
                            cx + bw / 2 - 1, cy + bh / 2 - 1], axis=1)
         boxes = jnp.clip(jnp.clip(boxes,

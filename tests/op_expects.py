@@ -1839,6 +1839,53 @@ def _generate_proposals(i, a):
 exp_("generate_proposals", _generate_proposals)
 
 
+def _distribute_fpn_proposals(i, a):
+    # distribute_fpn_proposals_op.h:55-140 re-derived: pixel-area level
+    # routing, per-level compaction in original order, restore slots —
+    # emitted in the lowering's padded static-shape convention
+    rois = i["FpnRois"].astype(np.float64)
+    mn, mx = a["min_level"], a["max_level"]
+    rl, rs = a["refer_level"], a["refer_scale"]
+    n = rois.shape[0]
+    nlv = mx - mn + 1
+    levels = []
+    for r in rois:
+        w, h = r[2] - r[0], r[3] - r[1]
+        area = 0.0 if (w < 0 or h < 0) else (w + 1.0) * (h + 1.0)
+        t = int(np.floor(np.log2(np.sqrt(area) / rs + 1e-6) + rl))
+        levels.append(min(mx, max(t, mn)))
+    outs = [np.zeros((n, 4), np.float32) for _ in range(nlv)]
+    nums = np.zeros(nlv, np.int32)
+    restore = np.zeros((n, 1), np.int32)
+    for orig, (r, lv) in enumerate(zip(rois, levels)):
+        li = lv - mn
+        restore[orig, 0] = li * n + nums[li]
+        outs[li][nums[li]] = r
+        nums[li] += 1
+    return {"MultiFpnRois": outs, "RestoreIndex": [restore],
+            "MultiLevelRoIsNum": [nums]}
+
+
+exp_("distribute_fpn_proposals", _distribute_fpn_proposals)
+
+
+def _collect_fpn_proposals(i, a):
+    # collect_fpn_proposals_op.h:60-150 re-derived (single batch):
+    # concat levels, stable-sort by score descending, keep top
+    # post_nms_topN; the batch-id re-sort is the identity here
+    # (multi-entry slots arrive flattened under their spec entry names)
+    rois = np.concatenate([i["cfp_r1"], i["cfp_r2"]])
+    scores = np.concatenate([i["cfp_s1"].reshape(-1),
+                             i["cfp_s2"].reshape(-1)])
+    k = min(a.get("post_nms_topN", len(scores)), len(scores))
+    order = np.argsort(-scores, kind="stable")[:k]
+    return {"FpnRois": [rois[order].astype(np.float32)],
+            "RoisNum": [np.array([k], np.int32)]}
+
+
+exp_("collect_fpn_proposals", _collect_fpn_proposals)
+
+
 def _generate_mask_labels(i, a):
     # generate_mask_labels_op.cc:199-254 + mask_util.cc
     # Polys2MaskWrtBox:186-211 on pre-binarized image-grid masks:
@@ -3810,10 +3857,6 @@ NOREF_REASONS = {
     "generate_proposal_labels": "stochastic fg/bg subsampling in the "
                                 "reference; deterministic redesign "
                                 "covered by dedicated tests",
-    "collect_fpn_proposals": "re-sort/merge plumbing over witnessed "
-                             "component ops",
-    "distribute_fpn_proposals": "level-routing plumbing over "
-                                "witnessed component ops",
     "retinanet_target_assign": "delegates to the witnessed "
                                "rpn_target_assign contract",
     "retinanet_detection_output": "per-level NMS pipeline; components "

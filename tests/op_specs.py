@@ -906,8 +906,13 @@ spec("collect_fpn_proposals",
      ins={"MultiLevelRois": [("cfp_r1", _BOXES1), ("cfp_r2", _BOXES1)],
           "MultiLevelScores": [("cfp_s1", pos(3)), ("cfp_s2", pos(3))]},
      attrs={"post_nms_topN": 4})
-spec("distribute_fpn_proposals", ins={"FpnRois": _BOXES1},
-     attrs={"min_level": 2, "max_level": 3, "refer_level": 2,
+# mixed-scale rois spread across 3 levels, incl. a degenerate box
+# (x2<x1 -> area 0 -> clamped to min_level)
+spec("distribute_fpn_proposals",
+     ins={"FpnRois": np.array(
+         [[0, 0, 7, 7], [0, 0, 31, 31], [2, 2, 60, 50],
+          [5, 3, 1, 9], [1, 1, 16, 14], [0, 0, 63, 63]], np.float32)},
+     attrs={"min_level": 2, "max_level": 4, "refer_level": 3,
             "refer_scale": 16})
 # well-formed anchor grid (x1<x2), two images with different sizes and
 # scales: exercises variance-scaled decoding, the origin-scale
