@@ -147,103 +147,144 @@ def _hierarchical_sigmoid(ctx, ins, attrs):
 # ---------------------------------------------------------------------------
 
 
-@register_op("yolov3_loss", nondiff_inputs=("GTBox", "GTLabel", "GTScore"))
+@register_op("yolov3_loss", nondiff_inputs=("GTBox", "GTLabel", "GTScore"),
+             nondiff_outputs=("ObjectnessMask", "GTMatchMask"))
 def _yolov3_loss(ctx, ins, attrs):
-    """x: [N, A*(5+C), H, W]; gtbox: [N, B, 4] (cx, cy, w, h relative);
-    anchor-responsible cells get coord+obj+cls loss, others noobj loss
-    (ignore above ignore_thresh)."""
+    """YOLOv3 training loss, exact reference semantics
+    (yolov3_loss_op.h:253-407). Per image:
+
+    1. every masked-anchor cell decodes its predicted box (GetYoloBox)
+       and takes the best IoU over valid gts; above ignore_thresh the
+       cell's objectness slot is marked -1 (exempt from no-object loss);
+    2. each valid gt matches the best of ALL anchors by centred wh-IoU;
+       if that anchor is in anchor_mask the cell (gi, gj) becomes a
+       positive sample: sigmoid-CE on tx/ty, L1 on tw/th, all scaled by
+       (2 - gw*gh)*score (CalcBoxLocationLoss), per-class sigmoid-CE
+       with label smoothing (CalcLabelLoss), objectness slot = score;
+    3. objectness loss: positive slots weight sigmoid-CE(logit, 1) by
+       the mixup score, zero slots take sigmoid-CE(logit, 0), -1 slots
+       are skipped (CalcObjnessLoss).
+
+    Outputs Loss [N], ObjectnessMask [N, mask, H, W] (-1/0/score),
+    GTMatchMask [N, B] (mask index or -1). gt boxes are (cx, cy, w, h)
+    normalized; a gt with w or h < 1e-6 is invalid (LessEqualZero).
+    The reference assumes square grids (it passes grid_size=h for both
+    axes and input_size = downsample*h); this lowering keeps the same
+    h-based input_size, so like the reference it is square-grid only —
+    the x-axis cell index merely uses w instead of h.
+    """
     x = ins["X"][0]
     gtbox = ins["GTBox"][0]
     gtlabel = ins["GTLabel"][0].astype(jnp.int32)
+    gtscore = ins["GTScore"][0] if "GTScore" in ins else None
     anchors = attrs.get("anchors", [10, 13, 16, 30, 33, 23])
-    mask = attrs.get("anchor_mask", list(range(len(anchors) // 2)))
+    mask = list(attrs.get("anchor_mask", range(len(anchors) // 2)))
     class_num = attrs.get("class_num", 1)
     ignore = attrs.get("ignore_thresh", 0.7)
     downsample = attrs.get("downsample_ratio", 32)
+    smooth = attrs.get("use_label_smooth", True)
     n, _, h, w = x.shape
     na = len(mask)
     input_size = downsample * h
-    x = x.reshape(n, na, 5 + class_num, h, w)
-    px = jax.nn.sigmoid(x[:, :, 0])
-    py = jax.nn.sigmoid(x[:, :, 1])
-    pw = x[:, :, 2]
-    ph = x[:, :, 3]
-    pobj = x[:, :, 4]
-    pcls = x[:, :, 5:]
-    all_anchors = np.asarray(anchors, np.float32).reshape(-1, 2)
-    sel_anchors = jnp.asarray(all_anchors[mask])  # [na, 2] input pixels
+    pos_l, neg_l = 1.0, 0.0
+    if smooth:
+        sw = min(1.0 / class_num, 1.0 / 40.0)
+        pos_l, neg_l = 1.0 - sw, sw
+    x5 = x.reshape(n, na, 5 + class_num, h, w)
+    all_an = np.asarray(anchors, np.float32).reshape(-1, 2)
+    sel_wh = jnp.asarray(all_an[mask] / input_size)      # [na, 2] norm
+    an_wh = jnp.asarray(all_an / input_size)             # [an_num, 2]
+    mask_arr = jnp.asarray(np.asarray(mask, np.int32))
 
-    def per_image(px, py, pw, ph, pobj, pcls, gtb, gtl):
+    def sce(logit, label):
+        # SigmoidCrossEntropy: max(x,0) - x*z + log(1 + exp(-|x|))
+        return (jnp.maximum(logit, 0.0) - logit * label
+                + jnp.log1p(jnp.exp(-jnp.abs(logit))))
+
+    def per_image(x5i, gtb, gtl, gts):
+        txl, tyl = x5i[:, 0], x5i[:, 1]
+        twl, thl = x5i[:, 2], x5i[:, 3]
+        tol = x5i[:, 4]
+        tcl = x5i[:, 5:]                                  # [na, C, h, w]
         nb = gtb.shape[0]
-        gx = gtb[:, 0] * w
-        gy = gtb[:, 1] * h
-        gw = gtb[:, 2] * input_size
-        gh = gtb[:, 3] * input_size
-        valid = gtb[:, 2] > 0
-        # best anchor per gt by wh-IoU
-        inter = jnp.minimum(gw[:, None], sel_anchors[None, :, 0]) * \
-            jnp.minimum(gh[:, None], sel_anchors[None, :, 1])
-        union = gw[:, None] * gh[:, None] + \
-            sel_anchors[None, :, 0] * sel_anchors[None, :, 1] - inter
-        best_a = jnp.argmax(inter / jnp.maximum(union, 1e-10), axis=1)
-        ci = jnp.clip(gx.astype(jnp.int32), 0, w - 1)
-        cj = jnp.clip(gy.astype(jnp.int32), 0, h - 1)
-        tx = gx - ci
-        ty = gy - cj
-        tw = jnp.log(jnp.maximum(
-            gw / jnp.maximum(sel_anchors[best_a, 0], 1e-6), 1e-6))
-        th = jnp.log(jnp.maximum(
-            gh / jnp.maximum(sel_anchors[best_a, 1], 1e-6), 1e-6))
-        scale = 2.0 - gtb[:, 2] * gtb[:, 3]
+        gx, gy = gtb[:, 0], gtb[:, 1]
+        gw, gh = gtb[:, 2], gtb[:, 3]
+        valid = (gw >= 1e-6) & (gh >= 1e-6)
 
-        obj_mask = jnp.zeros((na, h, w))
-        coord = 0.0
-        cls_loss = 0.0
-        for b in range(nb):
-            va = valid[b]
-            a, j, i = best_a[b], cj[b], ci[b]
-            sel = lambda t: t[a, j, i]
-            coord = coord + va * scale[b] * (
-                jnp.square(sel(px) - tx[b]) + jnp.square(sel(py) - ty[b]) +
-                jnp.square(sel(pw) - tw[b]) + jnp.square(sel(ph) - th[b]))
-            onehot = jax.nn.one_hot(gtl[b], class_num)
-            logits = pcls[a, :, j, i]
-            cls_loss = cls_loss + va * jnp.sum(
-                jnp.logaddexp(0.0, logits) - logits * onehot)
-            obj_mask = obj_mask.at[a, j, i].max(va.astype(obj_mask.dtype))
+        # -- 1. ignore_thresh scan over every predicted box ------------
+        col = jnp.arange(w, dtype=x.dtype)[None, None, :]
+        row = jnp.arange(h, dtype=x.dtype)[None, :, None]
+        bx = (col + jax.nn.sigmoid(txl)) / w
+        by = (row + jax.nn.sigmoid(tyl)) / h
+        bw = jnp.exp(jnp.clip(twl, -20, 20)) * sel_wh[:, 0, None, None]
+        bh = jnp.exp(jnp.clip(thl, -20, 20)) * sel_wh[:, 1, None, None]
 
-        # ignore_thresh (yolov3_loss_op.h:325-344): predictions whose best
-        # IoU with any gt exceeds the threshold are exempt from the
-        # no-object loss
-        ii, jj = jnp.meshgrid(jnp.arange(w), jnp.arange(h), indexing="xy")
-        bx = (px + ii[None]) / w * input_size          # [na, h, w]
-        by = (py + jj[None]) / h * input_size
-        bw_ = jnp.exp(jnp.clip(pw, -10, 10)) * sel_anchors[:, 0, None,
-                                                           None]
-        bh_ = jnp.exp(jnp.clip(ph, -10, 10)) * sel_anchors[:, 1, None,
-                                                           None]
-        pred_xyxy = jnp.stack([bx - bw_ / 2, by - bh_ / 2,
-                               bx + bw_ / 2, by + bh_ / 2],
-                              axis=-1).reshape(-1, 4)
-        gx_px = gx / w * input_size
-        gy_px = gy / h * input_size
-        gt_xyxy = jnp.stack([gx_px - gw / 2, gy_px - gh / 2,
-                             gx_px + gw / 2, gy_px + gh / 2], axis=1)
-        best_iou = jnp.max(jnp.where(valid[None, :],
-                                     _iou(pred_xyxy, gt_xyxy), 0.0),
-                           axis=1).reshape(na, h, w)
-        ignore_mask = (best_iou > ignore).astype(pobj.dtype)
+        def overlap(c1, w1, c2, w2):
+            return (jnp.minimum(c1 + w1 / 2, c2 + w2 / 2)
+                    - jnp.maximum(c1 - w1 / 2, c2 - w2 / 2))
 
-        obj_bce = jnp.logaddexp(0.0, pobj) - pobj * obj_mask
-        obj_loss = jnp.sum(obj_bce * obj_mask)
-        noobj_loss = jnp.sum(obj_bce * (1.0 - obj_mask) *
-                             (1.0 - ignore_mask))
-        return coord + cls_loss + obj_loss + noobj_loss
+        wov = overlap(bx[..., None], bw[..., None], gx, gw)
+        hov = overlap(by[..., None], bh[..., None], gy, gh)
+        inter = jnp.where((wov < 0) | (hov < 0), 0.0, wov * hov)
+        union = bw[..., None] * bh[..., None] + gw * gh - inter
+        iou = inter / jnp.maximum(union, 1e-10)
+        best_iou = jnp.max(jnp.where(valid[None, None, None, :], iou,
+                                     0.0), axis=-1)
+        obj = jnp.where(best_iou > ignore, -1.0, 0.0)     # [na, h, w]
 
-    loss = jax.vmap(per_image)(px, py, pw, ph, pobj, pcls, gtbox, gtlabel)
-    return {"Loss": [loss],
-            "ObjectnessMask": [jnp.zeros((n, na, h, w), x.dtype)],
-            "GTMatchMask": [jnp.zeros(gtbox.shape[:2], jnp.int32)]}
+        # -- 2. gt -> best-anchor matching, positive samples -----------
+        gi = jnp.clip((gx * w).astype(jnp.int32), 0, w - 1)
+        gj = jnp.clip((gy * h).astype(jnp.int32), 0, h - 1)
+        inter_a = (jnp.minimum(an_wh[None, :, 0], gw[:, None])
+                   * jnp.minimum(an_wh[None, :, 1], gh[:, None]))
+        union_a = (an_wh[:, 0] * an_wh[:, 1])[None] \
+            + (gw * gh)[:, None] - inter_a
+        best_n = jnp.argmax(inter_a / jnp.maximum(union_a, 1e-10),
+                            axis=1)                       # [nb]
+        eqm = best_n[:, None] == mask_arr[None, :]
+        mask_idx = jnp.where(jnp.any(eqm, 1),
+                             jnp.argmax(eqm, 1).astype(jnp.int32), -1)
+        match = jnp.where(valid, mask_idx, -1).astype(jnp.int32)
+        score = gts
+        tx_t = gx * w - gi
+        ty_t = gy * h - gj
+        an_px = jnp.asarray(all_an)
+        tw_t = jnp.log(jnp.maximum(
+            gw * input_size / jnp.maximum(an_px[best_n, 0], 1e-12),
+            1e-12))
+        th_t = jnp.log(jnp.maximum(
+            gh * input_size / jnp.maximum(an_px[best_n, 1], 1e-12),
+            1e-12))
+        scale = (2.0 - gw * gh) * score
+        loss = jnp.zeros((), x.dtype)
+        for t in range(nb):
+            va = valid[t] & (mask_idx[t] >= 0)
+            vaf = va.astype(x.dtype)
+            mi = jnp.maximum(mask_idx[t], 0)
+            jj, ii = gj[t], gi[t]
+            coord = (sce(txl[mi, jj, ii], tx_t[t])
+                     + sce(tyl[mi, jj, ii], ty_t[t])
+                     + jnp.abs(twl[mi, jj, ii] - tw_t[t])
+                     + jnp.abs(thl[mi, jj, ii] - th_t[t]))
+            lab = (jax.nn.one_hot(gtl[t], class_num, dtype=x.dtype)
+                   * (pos_l - neg_l) + neg_l)
+            cls = jnp.sum(sce(tcl[mi, :, jj, ii], lab))
+            loss = loss + vaf * (scale[t] * coord + score[t] * cls)
+            obj = obj.at[mi, jj, ii].set(
+                jnp.where(va, score[t], obj[mi, jj, ii]))
+
+        # -- 3. objectness loss ----------------------------------------
+        loss = loss + jnp.sum(jnp.where(obj > 1e-5,
+                                        sce(tol, 1.0) * obj, 0.0))
+        loss = loss + jnp.sum(jnp.where((obj > -0.5) & (obj <= 1e-5),
+                                        sce(tol, 0.0), 0.0))
+        return loss, obj.astype(x.dtype), match
+
+    if gtscore is None:
+        gtscore = jnp.ones(gtbox.shape[:2], x.dtype)
+    loss, obj, match = jax.vmap(per_image)(x5, gtbox, gtlabel, gtscore)
+    return {"Loss": [loss], "ObjectnessMask": [obj],
+            "GTMatchMask": [match]}
 
 
 # ---------------------------------------------------------------------------

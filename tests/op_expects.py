@@ -1886,6 +1886,115 @@ def _collect_fpn_proposals(i, a):
 exp_("collect_fpn_proposals", _collect_fpn_proposals)
 
 
+def _yolov3_loss(i, a):
+    # scalar transliteration of yolov3_loss_op.h:253-407: per-cell
+    # ignore scan (GetYoloBox + CalcBoxIoU), per-gt best-anchor match
+    # over ALL anchors (centred wh-IoU), CalcBoxLocationLoss (sigmoid-CE
+    # tx/ty + L1 tw/th, (2-gw*gh)*score scale), CalcLabelLoss with
+    # label smoothing, CalcObjnessLoss over the -1/0/score mask
+    x = i["X"].astype(np.float64)
+    gtbox = i["GTBox"].astype(np.float64)
+    gtlabel = i["GTLabel"]
+    gtscore = i.get("GTScore")
+    anchors = a["anchors"]
+    mask = list(a["anchor_mask"])
+    C = a["class_num"]
+    ignore = a["ignore_thresh"]
+    ds = a.get("downsample_ratio", 32)
+    smooth = a.get("use_label_smooth", True)
+    n, _, h, w = x.shape
+    na = len(mask)
+    an_num = len(anchors) // 2
+    isz = ds * h
+    pos, neg = 1.0, 0.0
+    if smooth:
+        sw = min(1.0 / C, 1.0 / 40)
+        pos, neg = 1.0 - sw, sw
+
+    def sce(z, t):
+        return max(z, 0.0) - z * t + np.log1p(np.exp(-abs(z)))
+
+    def sig(z):
+        return 1.0 / (1.0 + np.exp(-z))
+
+    def iou_box(b1, b2):  # (cx, cy, w, h)
+        wov = min(b1[0] + b1[2] / 2, b2[0] + b2[2] / 2) \
+            - max(b1[0] - b1[2] / 2, b2[0] - b2[2] / 2)
+        hov = min(b1[1] + b1[3] / 2, b2[1] + b2[3] / 2) \
+            - max(b1[1] - b1[3] / 2, b2[1] - b2[3] / 2)
+        inter = 0.0 if (wov < 0 or hov < 0) else wov * hov
+        return inter / max(b1[2] * b1[3] + b2[2] * b2[3] - inter, 1e-10)
+
+    x5 = x.reshape(n, na, 5 + C, h, w)
+    nb = gtbox.shape[1]
+    loss = np.zeros(n)
+    obj = np.zeros((n, na, h, w))
+    matchm = np.full((n, nb), -1, np.int32)
+    for im in range(n):
+        valid = [gtbox[im, t, 2] >= 1e-6 and gtbox[im, t, 3] >= 1e-6
+                 for t in range(nb)]
+        for j in range(na):
+            for k in range(h):
+                for ll in range(w):
+                    p = ((ll + sig(x5[im, j, 0, k, ll])) / w,
+                         (k + sig(x5[im, j, 1, k, ll])) / h,
+                         np.exp(min(x5[im, j, 2, k, ll], 20))
+                         * anchors[2 * mask[j]] / isz,
+                         np.exp(min(x5[im, j, 3, k, ll], 20))
+                         * anchors[2 * mask[j] + 1] / isz)
+                    best = 0.0
+                    for t in range(nb):
+                        if valid[t]:
+                            best = max(best, iou_box(p, tuple(gtbox[im, t])))
+                    if best > ignore:
+                        obj[im, j, k, ll] = -1.0
+        for t in range(nb):
+            if not valid[t]:
+                continue
+            g = gtbox[im, t]
+            gi = min(max(int(g[0] * w), 0), w - 1)
+            gj = min(max(int(g[1] * h), 0), h - 1)
+            best_iou, best_n = 0.0, 0
+            for ai in range(an_num):
+                iou = iou_box((0, 0, anchors[2 * ai] / isz,
+                               anchors[2 * ai + 1] / isz),
+                              (0, 0, g[2], g[3]))
+                if iou > best_iou:
+                    best_iou, best_n = iou, ai
+            mi = mask.index(best_n) if best_n in mask else -1
+            matchm[im, t] = mi
+            if mi < 0:
+                continue
+            sc = 1.0 if gtscore is None else float(gtscore[im, t])
+            tx, ty = g[0] * w - gi, g[1] * h - gj
+            tw = np.log(g[2] * isz / anchors[2 * best_n])
+            th = np.log(g[3] * isz / anchors[2 * best_n + 1])
+            scl = (2.0 - g[2] * g[3]) * sc
+            loss[im] += (sce(x5[im, mi, 0, gj, gi], tx)
+                         + sce(x5[im, mi, 1, gj, gi], ty)
+                         + abs(x5[im, mi, 2, gj, gi] - tw)
+                         + abs(x5[im, mi, 3, gj, gi] - th)) * scl
+            obj[im, mi, gj, gi] = sc
+            lab = int(gtlabel[im, t])
+            for c in range(C):
+                loss[im] += sce(x5[im, mi, 5 + c, gj, gi],
+                                pos if c == lab else neg) * sc
+        for j in range(na):
+            for k in range(h):
+                for ll in range(w):
+                    o = obj[im, j, k, ll]
+                    if o > 1e-5:
+                        loss[im] += sce(x5[im, j, 4, k, ll], 1.0) * o
+                    elif o > -0.5:
+                        loss[im] += sce(x5[im, j, 4, k, ll], 0.0)
+    return {"Loss": [loss.astype(np.float32)],
+            "ObjectnessMask": [obj.astype(np.float32)],
+            "GTMatchMask": [matchm]}
+
+
+exp_("yolov3_loss", _yolov3_loss)
+
+
 def _generate_mask_labels(i, a):
     # generate_mask_labels_op.cc:199-254 + mask_util.cc
     # Polys2MaskWrtBox:186-211 on pre-binarized image-grid masks:
@@ -3861,8 +3970,6 @@ NOREF_REASONS = {
                                "rpn_target_assign contract",
     "retinanet_detection_output": "per-level NMS pipeline; components "
                                   "witnessed via nms/box refs",
-    "yolov3_loss": "composite assigner+loss; grad-checked and "
-                   "covered by yolo_box witness for the decode math",
 }
 
 

@@ -873,13 +873,21 @@ spec("yolo_box", ins={"X": f32(1, 3 * 7, 4, 4),
                       "ImgSize": np.array([[128, 128]], np.int32)},
      attrs={"anchors": [10, 13, 16, 30, 33, 23], "class_num": 2,
             "conf_thresh": 0.01, "downsample_ratio": 32})
+# three gts: a big box (best-anchor inside the mask -> positive), a
+# small box whose best anchor (0) is OUTSIDE the mask -> match -1 with
+# only the ignore scan applying, and an all-zero invalid box; GTScore
+# exercises the mixup-score weighting; anchor_mask=[1,2] subsets the
+# anchor list
 spec("yolov3_loss",
-     ins={"X": f32(1, 3 * 7, 4, 4),
-          "GTBox": np.array([[[0.5, 0.5, 0.4, 0.4]]], np.float32),
-          "GTLabel": np.array([[1]], np.int64)},
+     ins={"X": f32(1, 2 * 7, 4, 4),
+          "GTBox": np.array([[[0.52, 0.47, 0.4, 0.42],
+                              [0.25, 0.75, 0.05, 0.06],
+                              [0.0, 0.0, 0.0, 0.0]]], np.float32),
+          "GTLabel": np.array([[1, 0, 0]], np.int64),
+          "GTScore": np.array([[0.8, 0.6, 1.0]], np.float32)},
      attrs={"anchors": [10, 13, 16, 30, 33, 23],
-            "anchor_mask": [0, 1, 2], "class_num": 2,
-            "ignore_thresh": 0.7, "downsample_ratio": 32},
+            "anchor_mask": [1, 2], "class_num": 2,
+            "ignore_thresh": 0.5, "downsample_ratio": 32},
      grad=["X"], grad_tol=5e-2)
 spec("bipartite_match", ins={"DistMat": np.array([[0.9, 0.1],
                                                   [0.2, 0.8]],
