@@ -295,19 +295,20 @@ def retinanet_detection_output(bboxes, scores, anchors, im_info,
                                score_threshold=0.05, nms_top_k=1000,
                                keep_top_k=100, nms_threshold=0.3,
                                nms_eta=1.0):
-    from .tensor import concat
     helper = LayerHelper("retinanet_detection_output")
     out = _mk(helper, "float32")
-    bb = bboxes if not isinstance(bboxes, (list, tuple)) else \
-        concat(bboxes, axis=1)
-    sc = scores if not isinstance(scores, (list, tuple)) else \
-        concat(scores, axis=1)
-    an = anchors if not isinstance(anchors, (list, tuple)) else \
-        concat(anchors, axis=0)
+    # the op is per-FPN-level (per-level nms_top_k truncation and the
+    # last-level threshold-0 rule) — pass the lists through, never
+    # concatenate levels into one tensor
+    bb = bboxes if isinstance(bboxes, (list, tuple)) else [bboxes]
+    sc = scores if isinstance(scores, (list, tuple)) else [scores]
+    an = anchors if isinstance(anchors, (list, tuple)) else [anchors]
     helper.append_op(
         type="retinanet_detection_output",
-        inputs={"BBoxes": [bb.name], "Scores": [sc.name],
-                "Anchors": [an.name], "ImInfo": [im_info.name]},
+        inputs={"BBoxes": [v.name for v in bb],
+                "Scores": [v.name for v in sc],
+                "Anchors": [v.name for v in an],
+                "ImInfo": [im_info.name]},
         outputs={"Out": [out.name]},
         attrs={"score_threshold": score_threshold, "nms_top_k": nms_top_k,
                "keep_top_k": keep_top_k, "nms_threshold": nms_threshold,

@@ -208,3 +208,42 @@ def build_train(cfg: TransformerConfig, batch, seq_len, lr=1e-4,
         opt_inst = mp.decorate(opt_inst)
     opt_inst.minimize(loss)
     return loss, [tokens, labels]
+
+
+def build_train_mlm(cfg: TransformerConfig, batch, seq_len, n_mask,
+                    lr=1e-4, optimizer_cls=None, amp=False):
+    """BERT-style masked-LM pretraining graph: the vocab projection and
+    softmax CE run only at the `n_mask` masked positions per sequence
+    (gathered via `mask_pos`), not all T positions — the actual MLM
+    objective (BERT gathers mask positions the same way; the full-T
+    lm head in build_train is the GPT-shaped objective). At 15% masking
+    this removes ~85% of the lm-head matmul + vocab-wide CE + their
+    backward, the single largest cost block in the measured step
+    (PERF.md r05 profile: lm-head fwd/bwd/CE fusions ~87 of 185 ms).
+
+    Feeds: tokens [b, T] int64; mask_pos [b*n_mask] int32 (flattened
+    row-major indices into [b*T]); mask_label [b*n_mask, 1] int64.
+    """
+    from .. import optimizer as opt
+    tokens = layers.data("tokens", shape=[batch, seq_len], dtype="int64",
+                         append_batch_size=False)
+    mask_pos = layers.data("mask_pos", shape=[batch * n_mask],
+                           dtype="int32", append_batch_size=False)
+    mask_label = layers.data("mask_label", shape=[batch * n_mask, 1],
+                             dtype="int64", append_batch_size=False)
+    hidden = encoder(tokens, cfg)
+    flat = layers.reshape(hidden, [-1, cfg.d_model])
+    picked = layers.gather(flat, mask_pos)
+    logits = layers.fc(picked, size=cfg.vocab_size,
+                       param_attr=ParamAttr(name="lm_head.w",
+                                            initializer=Normal(0.0, 0.02)),
+                       bias_attr=False)
+    loss = layers.mean(layers.softmax_with_cross_entropy(
+        logits, mask_label))
+    optimizer_cls = optimizer_cls or opt.AdamW
+    opt_inst = optimizer_cls(learning_rate=lr)
+    if amp:
+        from ..contrib import mixed_precision as mp
+        opt_inst = mp.decorate(opt_inst)
+    opt_inst.minimize(loss)
+    return loss, [tokens, mask_pos, mask_label]

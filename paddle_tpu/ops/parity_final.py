@@ -357,31 +357,101 @@ def _retinanet_target_assign(ctx, ins, attrs):
              nondiff_inputs=("BBoxes", "Scores", "Anchors", "ImInfo"),
              nondiff_outputs=("Out",))
 def _retinanet_detection_output(ctx, ins, attrs):
-    """decode per-level deltas at anchors, merge levels, NMS."""
-    from .detection_extra import _multiclass_nms_impl
+    """RetinaNet final detections, exact reference pipeline
+    (retinanet_detection_output_op.cc:174-452): per FPN level keep
+    scores STRICTLY above score_threshold — the last (highest) level
+    uses threshold 0 (:356) — stable-sorted descending, truncated to
+    nms_top_k (:116-131); decode the winners at that level's anchors in
+    the +1 integer-pixel convention with no variances and -1 on the
+    max corners (:214-248), divide by im_scale and clip to the
+    round(im/scale)-1 frame (:249-260); merge levels, then per-class
+    greedy NMS with pixel IoU and the adaptive-eta threshold decay
+    (:176-212) and a global stable keep_top_k (:272-319). Rows are
+    [label+1, score, x1, y1, x2, y2] (:370-384) on the padded
+    [B, keep_top_k, 6] contract (-1 = empty)."""
+    from .detection_extra import _nms_padded
 
-    deltas = jnp.concatenate([b.reshape(b.shape[0], -1, 4)
-                              for b in ins["BBoxes"]], axis=1)
-    scores = jnp.concatenate([s.reshape(s.shape[0], -1, s.shape[-1])
-                              for s in ins["Scores"]], axis=1)
-    anchors = jnp.concatenate([a.reshape(-1, 4) for a in ins["Anchors"]])
-    aw = anchors[:, 2] - anchors[:, 0]
-    ah = anchors[:, 3] - anchors[:, 1]
-    acx = anchors[:, 0] + aw / 2
-    acy = anchors[:, 1] + ah / 2
-    cx = acx + deltas[..., 0] * aw
-    cy = acy + deltas[..., 1] * ah
-    bw = jnp.exp(jnp.clip(deltas[..., 2], -10, 10)) * aw
-    bh = jnp.exp(jnp.clip(deltas[..., 3], -10, 10)) * ah
-    boxes = jnp.stack([cx - bw / 2, cy - bh / 2, cx + bw / 2,
-                       cy + bh / 2], axis=-1)
-    return {"Out": _multiclass_nms_impl(
-        ctx, {"BBoxes": [boxes],
-              "Scores": [jnp.swapaxes(scores, 1, 2)]},
-        {"score_threshold": attrs.get("score_threshold", 0.05),
-         "nms_threshold": attrs.get("nms_threshold", 0.3),
-         "keep_top_k": attrs.get("keep_top_k", 100),
-         "background_label": -1})["Out"]}
+    score_thr = attrs.get("score_threshold", 0.05)
+    nms_thr = attrs.get("nms_threshold", 0.3)
+    nms_eta = attrs.get("nms_eta", 1.0)
+    nms_top_k = attrs.get("nms_top_k", 1000)
+    keep_top_k = attrs.get("keep_top_k", 100)
+    levels = len(ins["BBoxes"])
+    ncls = ins["Scores"][0].shape[-1]
+
+    def one_image(blist, slist, info):
+        im_h, im_w, im_scale = info[0], info[1], info[2]
+        # std::round = half away from zero (dims are positive, so
+        # floor(x+0.5)); jnp.round would be half-to-even
+        fr_w = jnp.floor(im_w / im_scale + 0.5) - 1.0   # clip frame
+        fr_h = jnp.floor(im_h / im_scale + 0.5) - 1.0
+        cand_box, cand_score, cand_cls, cand_ok = [], [], [], []
+        for lv in range(levels):
+            anchors = ins["Anchors"][lv].reshape(-1, 4)
+            deltas = blist[lv].reshape(-1, 4)
+            s = slist[lv].reshape(-1)               # [Ml*C], a*C + c
+            thr = score_thr if lv < levels - 1 else 0.0
+            eligible = s > thr
+            k = s.shape[0] if nms_top_k <= -1 else min(nms_top_k,
+                                                       s.shape[0])
+            # stable desc sort with ineligibles sunk to the bottom ==
+            # filter-then-stable-sort-then-truncate of GetMaxScoreIndex
+            order = jnp.argsort(-jnp.where(eligible, s, -jnp.inf))[:k]
+            a_idx = order // ncls
+            aw = anchors[:, 2] - anchors[:, 0] + 1.0
+            ah = anchors[:, 3] - anchors[:, 1] + 1.0
+            acx = anchors[:, 0] + aw / 2
+            acy = anchors[:, 1] + ah / 2
+            d = deltas[a_idx]
+            cx = d[:, 0] * aw[a_idx] + acx[a_idx]
+            cy = d[:, 1] * ah[a_idx] + acy[a_idx]
+            bw = jnp.exp(d[:, 2]) * aw[a_idx]
+            bh = jnp.exp(d[:, 3]) * ah[a_idx]
+            x1 = (cx - bw / 2) / im_scale
+            y1 = (cy - bh / 2) / im_scale
+            x2 = (cx + bw / 2 - 1) / im_scale
+            y2 = (cy + bh / 2 - 1) / im_scale
+            cand_box.append(jnp.stack(
+                [jnp.clip(x1, 0.0, fr_w), jnp.clip(y1, 0.0, fr_h),
+                 jnp.clip(x2, 0.0, fr_w), jnp.clip(y2, 0.0, fr_h)],
+                axis=1))
+            cand_score.append(s[order])
+            cand_cls.append((order % ncls).astype(jnp.int32))
+            cand_ok.append(eligible[order])
+        boxes = jnp.concatenate(cand_box)        # insertion order ==
+        scores = jnp.concatenate(cand_score)     # level-major, score-
+        cls = jnp.concatenate(cand_cls)          # desc within level
+        ok = jnp.concatenate(cand_ok)
+        k_all = boxes.shape[0]
+        # per-class NMSFast; candidate index order IS the reference's
+        # preds[c] insertion order, so the stable argsort inside
+        # _nms_padded reproduces its tie-breaking
+        kept_rows = []
+        for c in range(ncls):
+            mask = ok & (cls == c)
+            sc = jnp.where(mask, scores, -jnp.inf)
+            kept = _nms_padded(boxes, sc, nms_thr, -jnp.inf, k_all,
+                               pixel=True, eta=nms_eta)
+            valid = kept >= 0
+            gi = jnp.clip(kept, 0, k_all - 1)
+            kept_rows.append(jnp.concatenate(
+                [jnp.full((k_all, 1), float(c + 1)),
+                 jnp.where(valid, scores[gi], -jnp.inf)[:, None],
+                 jnp.where(valid[:, None], boxes[gi], -1.0)], axis=1))
+        allr = jnp.concatenate(kept_rows)        # class-major == the
+        final_k = min(keep_top_k if keep_top_k > 0 else allr.shape[0],
+                      allr.shape[0])
+        # stable desc == std::stable_sort over score_index_pairs
+        order = jnp.argsort(-allr[:, 1])[:final_k]
+        rows = allr[order]
+        return jnp.where(jnp.isfinite(rows[:, 1:2]), rows,
+                         jnp.full((1, 6), -1.0))
+
+    out = jax.vmap(one_image)(
+        [b.reshape(b.shape[0], -1, 4) for b in ins["BBoxes"]],
+        [s.reshape(s.shape[0], -1, s.shape[-1]) for s in ins["Scores"]],
+        ins["ImInfo"][0])
+    return {"Out": [out]}
 
 
 @register_op("generate_proposal_labels",

@@ -2986,6 +2986,95 @@ exp_("multiclass_nms", _multiclass_nms_ref)
 exp_("multiclass_nms2", _multiclass_nms_ref)
 
 
+def _rdo_pixel_iou(b1, b2):
+    # JaccardOverlap normalized=false with the strict-disjoint early
+    # return and degenerate-box area 0
+    # (retinanet_detection_output_op.cc:133-171)
+    if b2[0] > b1[2] or b2[2] < b1[0] or b2[1] > b1[3] or b2[3] < b1[1]:
+        return 0.0
+
+    def area(b):
+        if b[2] < b[0] or b[3] < b[1]:
+            return 0.0
+        return (b[2] - b[0] + 1.0) * (b[3] - b[1] + 1.0)
+
+    inter = ((min(b1[2], b2[2]) - max(b1[0], b2[0]) + 1.0)
+             * (min(b1[3], b2[3]) - max(b1[1], b2[1]) + 1.0))
+    return inter / (area(b1) + area(b2) - inter)
+
+
+def _retinanet_detection_output_ref(i, a):
+    # full pipeline scalar re-derivation of
+    # retinanet_detection_output_op.cc:116-452 on the padded
+    # [B, final_k, 6] contract (rows [label+1, score, x1, y1, x2, y2])
+    import math
+    boxes_l = ([i[k] for k in sorted(i) if k.startswith("rdo_box")]
+               or [i["BBoxes"]])
+    scores_l = ([i[k] for k in sorted(i) if k.startswith("rdo_sc")]
+                or [i["Scores"]])
+    anchors_l = ([i[k] for k in sorted(i) if k.startswith("rdo_an")]
+                 or [i["Anchors"]])
+    im_info = i["ImInfo"]
+    st = a.get("score_threshold", 0.05)
+    ntk = a.get("nms_top_k", 1000)
+    ktk = a.get("keep_top_k", 100)
+    nt = a.get("nms_threshold", 0.3)
+    eta = a.get("nms_eta", 1.0)
+    nlv = len(scores_l)
+    ncls = scores_l[0].shape[-1]
+    bsz = scores_l[0].shape[0]
+    k_all = sum(s[0].size if ntk <= -1 else min(ntk, s[0].size)
+                for s in scores_l)
+    final_k = min(ktk if ktk > 0 else ncls * k_all, ncls * k_all)
+    out = np.full((bsz, final_k, 6), -1.0, np.float32)
+    for b in range(bsz):
+        imh, imw, ims = [float(v) for v in im_info[b][:3]]
+        # std::round (half away from zero), not Python's half-to-even
+        imh, imw = math.floor(imh / ims + 0.5), math.floor(imw / ims + 0.5)
+        preds = {}
+        for lv in range(nlv):
+            sc = scores_l[lv][b].reshape(-1)
+            dl = boxes_l[lv][b].reshape(-1, 4)
+            an = anchors_l[lv].reshape(-1, 4)
+            thr = st if lv < nlv - 1 else 0.0  # last level keeps all
+            idxs = [j for j in range(sc.size) if sc[j] > thr]
+            idxs.sort(key=lambda j: -sc[j])    # stable
+            if ntk > -1:
+                idxs = idxs[:ntk]
+            for j in idxs:
+                ai, c = j // ncls, j % ncls
+                aw = an[ai, 2] - an[ai, 0] + 1.0
+                ah = an[ai, 3] - an[ai, 1] + 1.0
+                cx = dl[ai, 0] * aw + an[ai, 0] + aw / 2
+                cy = dl[ai, 1] * ah + an[ai, 1] + ah / 2
+                w = math.exp(dl[ai, 2]) * aw
+                h = math.exp(dl[ai, 3]) * ah
+                box = [max(min((cx - w / 2) / ims, imw - 1.0), 0.0),
+                       max(min((cy - h / 2) / ims, imh - 1.0), 0.0),
+                       max(min((cx + w / 2 - 1) / ims, imw - 1.0), 0.0),
+                       max(min((cy + h / 2 - 1) / ims, imh - 1.0), 0.0)]
+                preds.setdefault(c, []).append(box + [float(sc[j])])
+        rows = []
+        for c in sorted(preds):                # std::map iteration order
+            dets = preds[c]
+            order = sorted(range(len(dets)), key=lambda j: -dets[j][4])
+            sel, adaptive = [], nt
+            for j in order:
+                if all(_rdo_pixel_iou(dets[j], dets[k2]) <= adaptive
+                       for k2 in sel):
+                    sel.append(j)
+                    if eta < 1.0 and adaptive > 0.5:
+                        adaptive *= eta
+            rows.extend([c + 1.0, dets[j][4]] + dets[j][:4] for j in sel)
+        rows.sort(key=lambda r: -r[1])         # stable keep_top_k
+        for k2, r in enumerate(rows[:final_k]):
+            out[b, k2] = r
+    return {"Out": [out]}
+
+
+exp_("retinanet_detection_output", _retinanet_detection_output_ref)
+
+
 exp_("conv2d_fusion", lambda i, a: {"Output": [np.maximum(
     _conv2d_np(i["Input"], i["Filter"], a["strides"], a["paddings"])
     + i["Bias"].reshape(1, -1, 1, 1), 0.0)]})
@@ -3968,8 +4057,6 @@ NOREF_REASONS = {
                                 "covered by dedicated tests",
     "retinanet_target_assign": "delegates to the witnessed "
                                "rpn_target_assign contract",
-    "retinanet_detection_output": "per-level NMS pipeline; components "
-                                  "witnessed via nms/box refs",
 }
 
 

@@ -967,12 +967,32 @@ spec("retinanet_target_assign",
           "IsCrowd": np.array([0], np.int32),
           "ImInfo": np.array([[32.0, 32.0, 1.0]], np.float32)},
      attrs={"positive_overlap": 0.5, "negative_overlap": 0.4})
+# two FPN levels, batch of two images with different im_scale, score
+# ties (stable-sort order), nms_top_k below the per-level candidate
+# count, and adaptive-eta NMS — the full reference pipeline
+_RDO_SC0 = pos(2, 4, 3, lo=0.0, hi=1.0)
+_RDO_SC0[0, 1, 2] = _RDO_SC0[0, 2, 0] = 0.6   # tie within level 0
+_RDO_SC0[1, 0, 1] = 0.01                      # below threshold
+_RDO_SC1 = pos(2, 2, 3, lo=0.0, hi=1.0)
+_RDO_SC1[0, 0, 1] = 0.6                       # cross-level tie
 spec("retinanet_detection_output",
-     ins={"BBoxes": _BOXES1[None], "Scores": pos(1, 3, 2),
-          "Anchors": _BOXES1,
-          "ImInfo": np.array([[32.0, 32.0, 1.0]], np.float32)},
-     attrs={"score_threshold": 0.05, "nms_threshold": 0.3,
-            "nms_top_k": 3, "keep_top_k": 4})
+     ins={"BBoxes": [("rdo_box0", f32(2, 4, 4, lo=-0.6, hi=0.6)),
+                     ("rdo_box1", f32(2, 2, 4, lo=-0.6, hi=0.6))],
+          "Scores": [("rdo_sc0", _RDO_SC0), ("rdo_sc1", _RDO_SC1)],
+          "Anchors": [("rdo_an0",
+                       np.array([[0, 0, 9, 9], [5, 5, 14, 14],
+                                 [20, 20, 29, 29], [0, 20, 9, 29]],
+                                np.float32)),
+                      ("rdo_an1",
+                       np.array([[0, 0, 19, 19], [10, 10, 29, 29]],
+                                np.float32))],
+          "ImInfo": np.array([[64.0, 64.0, 1.0], [65.0, 65.0, 2.0]],
+                             np.float32)},
+     # threshold 0.6 > 0.5 so the adaptive-eta decay gate actually
+     # fires; image 2's 65/2 = 32.5 frame pins half-away-from-zero
+     # rounding (std::round, not banker's)
+     attrs={"score_threshold": 0.05, "nms_threshold": 0.6,
+            "nms_top_k": 5, "keep_top_k": 6, "nms_eta": 0.9})
 spec("roi_align", ins={"X": f32(1, 2, 6, 6),
                        "ROIs": np.array([[0, 0, 4, 4]], np.float32)},
      attrs={"pooled_height": 2, "pooled_width": 2,

@@ -378,6 +378,39 @@ def test_random_ops_and_selected_rows_layers():
     np.testing.assert_allclose(s, 2 * xv)
 
 
+def test_retinanet_detection_output_layer_multilevel():
+    """the layer must hand per-FPN-level lists to the op unconcatenated:
+    score_threshold applies to all but the LAST level (which keeps
+    everything), so a high threshold with a two-level call still yields
+    level-2 detections — a level-concatenating wrapper would drop them."""
+    def build():
+        b0 = layers.data("rdl_b0", shape=[2, 4], dtype="float32")
+        b1 = layers.data("rdl_b1", shape=[1, 4], dtype="float32")
+        s0 = layers.data("rdl_s0", shape=[2, 3], dtype="float32")
+        s1 = layers.data("rdl_s1", shape=[1, 3], dtype="float32")
+        a0 = layers.create_tensor(dtype="float32", name="rdl_a0")
+        a1 = layers.create_tensor(dtype="float32", name="rdl_a1")
+        layers.assign(np.array([[0, 0, 9, 9], [10, 10, 19, 19]],
+                               np.float32), a0)
+        layers.assign(np.array([[0, 0, 19, 19]], np.float32), a1)
+        info = layers.data("rdl_info", shape=[3], dtype="float32")
+        return layers.detection.retinanet_detection_output(
+            [b0, b1], [s0, s1], [a0, a1], info,
+            score_threshold=0.9, nms_top_k=3, keep_top_k=5)
+    out, = _run(build, {
+        "rdl_b0": np.zeros((1, 2, 4), np.float32),
+        "rdl_b1": np.zeros((1, 1, 4), np.float32),
+        "rdl_s0": np.full((1, 2, 3), 0.5, np.float32),
+        "rdl_s1": np.full((1, 1, 3), 0.5, np.float32),
+        "rdl_info": np.array([[32.0, 32.0, 1.0]], np.float32)})
+    kept = out[0][out[0][:, 0] > 0]
+    # level-0 scores (0.5 < 0.9) are filtered; the last level keeps all
+    assert kept.shape[0] >= 1
+    assert np.allclose(kept[:, 1], 0.5)
+    # all survivors decode from the level-1 anchor (exp(0)*20-wide box)
+    np.testing.assert_allclose(kept[:, 4] - kept[:, 2], 19.0, atol=1e-4)
+
+
 def test_where_and_unique_layers_padded():
     """layers.where / layers.unique wrap the padded static-shape ops
     instead of raising (reference where_index_op / unique_op)."""

@@ -95,6 +95,31 @@ def test_gradients_numeric_vs_analytic():
     np.testing.assert_allclose(g, num, rtol=1e-2, atol=1e-3)
 
 
+def test_device_array_feed_passthrough():
+    """jax.Array feeds skip the host round trip (executor._prepare_feed
+    passthrough): same numerics as numpy feeds, dtype mismatches cast
+    on device, and the executable cache is shared between both forms."""
+    import jax
+
+    main, startup = _fresh_programs()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[3], dtype="float32")
+        y = layers.fc(x, size=2, bias_attr=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    xv = np.random.RandomState(0).randn(4, 3).astype(np.float32)
+    out_np, = exe.run(main, feed={"x": xv}, fetch_list=[y])
+    n_cached = len(exe._cache)
+    out_dev, = exe.run(main, feed={"x": jax.device_put(xv)},
+                       fetch_list=[y])
+    np.testing.assert_allclose(out_dev, out_np, rtol=1e-6)
+    assert len(exe._cache) == n_cached, "device feed must hit the cache"
+    # wrong-dtype device feed is cast on device, not rejected
+    out_cast, = exe.run(main, feed={"x": jax.device_put(
+        xv.astype(np.float64))}, fetch_list=[y])
+    np.testing.assert_allclose(out_cast, out_np, rtol=1e-6)
+
+
 def test_scope_pool_clear():
     """App-D scope pool: leaked scopes can be bulk-released
     (framework/scope_pool.h semantics) without breaking live ones."""

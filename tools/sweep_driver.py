@@ -45,7 +45,18 @@ PROBE_INTERVAL_S = int(os.environ.get("SWEEP_PROBE_INTERVAL_S", "240"))
 # (key, env overrides) in priority order: missing headline metrics and
 # the profile first, confirmations of already-measured configs last.
 CONFIGS = [
-    ("resnet50_b64", {"BENCH_MODEL": "resnet50", "BENCH_BATCH": "64"}),
+    # MLM = the true BERT objective (lm head gathered to the 15% masked
+    # positions); the profile shows the full-T lm head is the top cost
+    # block of the composed step, so these are the headline candidates
+    ("bert_mlm_f0_b32", {"BENCH_FLASH": "0", "BENCH_BATCH": "32",
+                         "BENCH_MLM": "1"}),
+    ("bert_mlm_f0_b64", {"BENCH_FLASH": "0", "BENCH_BATCH": "64",
+                         "BENCH_MLM": "1"}),
+    # fresh key: the old resnet50_b64 entry predates the device-staged
+    # feed fix (its 10.7 img/s measured the tunnel H2D, not the chip)
+    # and must not be re-run into the same series
+    ("resnet50_b64_devfeed", {"BENCH_MODEL": "resnet50",
+                              "BENCH_BATCH": "64"}),
     ("profile", None),  # special-cased below
     ("gpt_b32", {"BENCH_MODEL": "gpt", "BENCH_BATCH": "32"}),
     ("bert_f1_b16_s1024", {"BENCH_FLASH": "1", "BENCH_BATCH": "16",
