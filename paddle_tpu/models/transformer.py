@@ -40,15 +40,15 @@ class TransformerConfig:
         # attention WEIGHTS is a separate knob: the flash kernel does not
         # implement it, so attn_dropout > 0 forces the composed path
         # (keeping the trained model identical across kernel choices).
-        # "auto" picks by sequence length from on-chip measurement
-        # (PERF.md r05, v5e): at seq 512 the composed XLA path beats the
-        # flash kernel by ~37% (31.7% vs 19.9% MFU on BERT-base), so
-        # short sequences stay composed; past 1024 the composed path
-        # materializes the O(T^2) score tensor that flash exists to
-        # avoid, so long sequences take the blockwise kernel (the same
-        # one ring/Ulysses sequence parallelism is built on).
+        # "auto" = flash on. Measured on v5e (PERF.md r05 attention
+        # microbench): with the 512-tile defaults the Pallas kernel is
+        # ~2x faster fwd+bwd than XLA composed attention at seq
+        # 512/1024/2048 (e.g. 2.64 vs 5.47 ms at seq 512). The earlier
+        # composed-wins reading (31.7% vs 19.9% MFU on BERT-base) was an
+        # artifact of the old 128-tile default, which loses 2-4x; the
+        # kernel itself takes the exact path below one 128 tile.
         if use_flash == "auto":
-            use_flash = max_seq_len > 1024
+            use_flash = True
         self.use_flash = use_flash
         self.causal = causal
         self.attn_dropout = dropout if attn_dropout is None else \

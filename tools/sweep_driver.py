@@ -52,6 +52,20 @@ CONFIGS = [
                          "BENCH_MLM": "1"}),
     ("bert_mlm_f0_b64", {"BENCH_FLASH": "0", "BENCH_BATCH": "64",
                          "BENCH_MLM": "1"}),
+    # b128 is OOM with the full-T lm head (65536x30522 logits); the
+    # gathered MLM head fits
+    ("bert_mlm_f0_b128", {"BENCH_FLASH": "0", "BENCH_BATCH": "128",
+                          "BENCH_MLM": "1"}),
+    # flash re-race with the 512-tile defaults (the attn microbench has
+    # blk=512 beating XLA composed ~2x at seq 512/1024/2048; the old
+    # f1 ledger entries measured the losing 128 tiles)
+    ("bert_mlm_f1_b32", {"BENCH_FLASH": "1", "BENCH_BATCH": "32",
+                         "BENCH_MLM": "1"}),
+    ("bert_mlm_f1_b64", {"BENCH_FLASH": "1", "BENCH_BATCH": "64",
+                         "BENCH_MLM": "1"}),
+    ("bert_f1blk512_b32", {"BENCH_FLASH": "1", "BENCH_BATCH": "32"}),
+    ("bert_f1blk512_b16_s1024", {"BENCH_FLASH": "1", "BENCH_BATCH": "16",
+                                 "BENCH_SEQ": "1024"}),
     # fresh key: the old resnet50_b64 entry predates the device-staged
     # feed fix (its 10.7 img/s measured the tunnel H2D, not the chip)
     # and must not be re-run into the same series
@@ -247,6 +261,11 @@ def main():
     log(f"start: {len(ledger)}/{len(CONFIGS)} configs already have data")
     t_end = time.time() + MAX_HOURS * 3600
     consecutive_fail = 0
+    attempts = {}   # per-config failures: a config that fails
+    # MAX_ATTEMPTS times with the tunnel healthy is deterministically
+    # broken (e.g. OOM at that batch) — record the error as its ledger
+    # entry instead of re-burning the recovery window on it forever
+    MAX_ATTEMPTS = 3
     while time.time() < t_end:
         missing = [(k, e) for k, e in CONFIGS if k not in ledger]
         if not missing:
@@ -281,7 +300,14 @@ def main():
                 log(f"  OK: {str(val)[:100]}")
             else:
                 consecutive_fail += 1
-                log(f"  FAIL: {str(err)[:200]}")
+                attempts[key] = attempts.get(key, 0) + 1
+                log(f"  FAIL ({attempts[key]}/{MAX_ATTEMPTS}): "
+                    f"{str(err)[:200]}")
+                if attempts[key] >= MAX_ATTEMPTS:
+                    ledger[key] = {"error": str(err)[:300],
+                                   "attempts": attempts[key]}
+                    save_ledger(ledger)
+                    log(f"  giving up on {key} — error recorded")
     missing = [k for k, _ in CONFIGS if k not in ledger]
     log(f"exit: {len(ledger)}/{len(CONFIGS)} configs done; "
         f"outstanding: {missing}")
