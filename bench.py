@@ -15,6 +15,7 @@ all (all five).
 """
 from __future__ import annotations
 
+import argparse
 import json
 import os
 import subprocess
@@ -24,6 +25,50 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import numpy as np  # noqa: E402
+
+
+def _log_path() -> str:
+    """Where result lines + monitor snapshots go (JSONL, append mode):
+    BENCH_LOG env > FLAGS_monitor_export_path > bench_log.jsonl. Every
+    record is flushed the moment it exists, so a harness timeout-kill
+    (the BENCH_r05 `parsed: null` failure) can no longer lose completed
+    configs."""
+    p = os.environ.get("BENCH_LOG")
+    if p:
+        return p
+    try:
+        from paddle_tpu.core.flags import FLAGS
+        if FLAGS.monitor_export_path:
+            return FLAGS.monitor_export_path
+    except Exception:  # noqa: BLE001 — log path must never kill bench
+        pass
+    return "bench_log.jsonl"
+
+
+def _emit(log_path, record):
+    """Append one JSON line to the log (and leave stdout untouched)."""
+    try:
+        with open(log_path, "a") as f:
+            f.write(json.dumps(record) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+    except OSError as e:
+        print(f"# bench log write failed: {e}", file=sys.stderr)
+
+
+def _record_bench_stats(flops_per_step):
+    """Feed the monitor the model's per-step flops + the chip peak so
+    tools/metrics_report.py can derive MFU from the step-time histogram
+    (no-ops unless FLAGS_enable_monitor)."""
+    try:
+        from paddle_tpu import monitor
+        if not monitor.enabled():
+            return
+        monitor.STAT_SET("bench.model_flops_per_step", flops_per_step)
+        monitor.STAT_SET("bench.peak_flops_per_chip",
+                         peak_flops_per_chip())
+    except Exception:  # noqa: BLE001 — stats must never kill bench
+        pass
 
 
 def peak_flops_per_chip():
@@ -123,7 +168,18 @@ def _timed_steps(exe, prog, feed, loss, steps):
     return dt, lv, stats
 
 
-def build_bert_bench(batch=None, seq_len=None):
+def _bench_layers(n_layers=None):
+    """Optional depth override (BENCH_LAYERS env or explicit arg): the
+    CPU-validate path compiles a 2-layer model so certifying the bench
+    code path costs seconds, not the minute+ a 12-layer XLA CPU compile
+    takes. Unset -> each model's reference depth."""
+    if n_layers is not None:
+        return {"n_layers": int(n_layers)}
+    env = os.environ.get("BENCH_LAYERS", "")
+    return {"n_layers": int(env)} if env else {}
+
+
+def build_bert_bench(batch=None, seq_len=None, n_layers=None):
     """Build the BERT pretraining step per the BENCH_* env config.
     Returns (exe, program, scope, feed, loss, cfg) — shared by bench.py
     and tools/profile_step.py so the profiled program is exactly the
@@ -137,7 +193,8 @@ def build_bert_bench(batch=None, seq_len=None):
     use_flash = os.environ.get("BENCH_FLASH", "1") == "1"
     mlm = os.environ.get("BENCH_MLM", "0") == "1"
     cfg = transformer.bert_base(dropout=0.1, attn_dropout=0.0,
-                                use_flash=use_flash)
+                                use_flash=use_flash,
+                                **_bench_layers(n_layers))
     # BERT's actual objective: predict the ~15% masked positions, not
     # all T (rounded up to a multiple of 8 for clean TPU tiling)
     n_mask = -(-int(seq_len * 0.15) // 8) * 8
@@ -227,6 +284,7 @@ def bench_bert():
     tokens_per_sec = batch * seq_len / dt
     flops = model_flops_per_token(cfg, seq_len) * batch * seq_len
     mfu = flops / dt / peak_flops_per_chip()
+    _record_bench_stats(flops)
     extra = {"step_ms": round(dt * 1000, 2), "mfu": round(mfu, 4),
              "batch": batch, "seq_len": seq_len,
              "flash": flash_used, "loss": float(np.asarray(lv)),
@@ -255,6 +313,7 @@ def bench_resnet50():
     images_per_sec = batch / dt
     flops = 3 * resnet.flops_per_image() * batch  # fwd + 2x bwd
     mfu = flops / dt / peak_flops_per_chip()
+    _record_bench_stats(flops)
     return {
         "metric": "resnet50_imagenet_images_per_sec_per_chip",
         "value": round(images_per_sec, 1),
@@ -265,7 +324,7 @@ def bench_resnet50():
     }
 
 
-def build_gpt_bench(batch=None, seq_len=None):
+def build_gpt_bench(batch=None, seq_len=None, n_layers=None):
     """GPT-small causal-LM step per the BENCH_* env config (third
     headline workload: exercises the causal flash-kernel path)."""
     import paddle_tpu as fluid
@@ -276,7 +335,8 @@ def build_gpt_bench(batch=None, seq_len=None):
     amp = os.environ.get("BENCH_AMP", "1") == "1"
     use_flash = os.environ.get("BENCH_FLASH", "1") == "1"
     cfg = gpt.gpt_small(dropout=0.1, attn_dropout=0.0,
-                        use_flash=use_flash, max_seq_len=seq_len)
+                        use_flash=use_flash, max_seq_len=seq_len,
+                        **_bench_layers(n_layers))
     main_prog, startup = fluid.Program(), fluid.Program()
     scope = fluid.Scope()
     with fluid.program_guard(main_prog, startup), fluid.scope_guard(scope):
@@ -305,6 +365,7 @@ def bench_gpt():
         - 6 * cfg.n_layers * t_eff * cfg.d_model
     flops = flops_tok * batch * t_eff
     mfu = flops / dt / peak_flops_per_chip()
+    _record_bench_stats(flops)
     return {
         "metric": "gpt_small_pretrain_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 1),
@@ -316,7 +377,8 @@ def bench_gpt():
     }
 
 
-def build_transformer_bench(batch=None, src_len=None, trg_len=None):
+def build_transformer_bench(batch=None, src_len=None, trg_len=None,
+                            n_layers=None):
     """Transformer-big En-De NMT step (BASELINE config 3); same return
     contract as build_bert_bench."""
     import paddle_tpu as fluid
@@ -328,7 +390,8 @@ def build_transformer_bench(batch=None, src_len=None, trg_len=None):
     amp = os.environ.get("BENCH_AMP", "1") == "1"
     use_flash = os.environ.get("BENCH_FLASH", "1") == "1"
     cfg = nmt.transformer_big_nmt(dropout=0.1, attn_dropout=0.0,
-                                  use_flash=use_flash)
+                                  use_flash=use_flash,
+                                  **_bench_layers(n_layers))
     main_prog, startup = fluid.Program(), fluid.Program()
     scope = fluid.Scope()
     with fluid.program_guard(main_prog, startup), fluid.scope_guard(scope):
@@ -359,6 +422,7 @@ def bench_transformer():
     tokens_per_sec = batch * trg_len / dt
     flops = nmt.flops_per_step(cfg, batch, src_len, trg_len)
     mfu = flops / dt / peak_flops_per_chip()
+    _record_bench_stats(flops)
     return {
         "metric": "transformer_big_ende_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 1),
@@ -409,6 +473,7 @@ def bench_deeplab():
     images_per_sec = batch / dt
     flops = 3 * deeplab.flops_per_image(img_hw) * batch  # fwd + 2x bwd
     mfu = flops / dt / peak_flops_per_chip()
+    _record_bench_stats(flops)
     return {
         "metric": "deeplabv3p_cityscapes_images_per_sec_per_chip",
         "value": round(images_per_sec, 1),
@@ -435,27 +500,40 @@ sys.path.insert(0, {root!r})
 os.environ['BENCH_FLASH'] = '0'
 import bench
 import paddle_tpu as fluid
+from paddle_tpu import monitor
+# with FLAGS_enable_monitor inherited from the parent env, the tiny run
+# below accumulates executor step/compile/feed stats in THIS process;
+# the periodic exporter flushes them even if the parent's deadline
+# kills us mid-run, and the explicit snapshot covers the clean exit
+if monitor.enabled() and {log!r}:
+    monitor.start_exporter({log!r}, interval=3.0)
 exe, prog, scope, feed, loss, cfg = bench._CPU_TINY_BUILDS[{model!r}]()
 with fluid.scope_guard(scope):
     dt, lv, stats = bench._timed_steps(exe, prog, feed, loss, 2)
 import math
 assert math.isfinite(float(lv)), 'non-finite loss'
+if monitor.enabled() and {log!r}:
+    monitor.stop_exporter(flush=True)
 print('cpu ok', dt, float(lv))
 """
 
 # tiny-shape builders used by the wedge-path CPU validation: certify
-# the SELECTED model's bench code path, not just BERT's
+# the SELECTED model's bench code path, not just BERT's. Transformer
+# families validate at 2 layers — the layer loop is homogeneous, and a
+# 12-layer fwd+bwd XLA CPU compile alone (~60s) would blow a tight
+# --time-budget before any stats exist.
 _CPU_TINY_BUILDS = {
-    "bert": lambda: build_bert_bench(batch=2, seq_len=64),
+    "bert": lambda: build_bert_bench(batch=2, seq_len=64, n_layers=2),
     "resnet50": lambda: build_resnet50_bench(batch=2),
-    "gpt": lambda: build_gpt_bench(batch=2, seq_len=64),
+    "gpt": lambda: build_gpt_bench(batch=2, seq_len=64, n_layers=2),
     "transformer": lambda: build_transformer_bench(batch=2, src_len=32,
-                                                   trg_len=24),
+                                                   trg_len=24,
+                                                   n_layers=2),
     "deeplab": lambda: build_deeplab_bench(batch=1, img_hw=65),
 }
 
 
-def _probe_backend():
+def _probe_backend(budget_left=None):
     """Decide whether the TPU backend is reachable WITHOUT letting a
     wedged tunnel block bench.py past its deadline.
 
@@ -468,10 +546,16 @@ def _probe_backend():
     itself a known wedge trigger); the orphan is left to finish or
     fail on its own.
 
+    `budget_left` (seconds, from --time-budget) caps the wait so the
+    probe alone can never exhaust the run's budget.
+
     Returns (ok, detail).
     """
-    deadline = time.time() + float(os.environ.get("BENCH_WAIT_TPU_S",
-                                                  "180"))
+    wait = float(os.environ.get("BENCH_WAIT_TPU_S", "180"))
+    if budget_left is not None:
+        # leave at least half the budget for actual benching
+        wait = max(5.0, min(wait, budget_left * 0.5))
+    deadline = time.time() + wait
     attempt = 0
     while True:
         attempt += 1
@@ -492,24 +576,30 @@ def _probe_backend():
                            "deadline (left running, not killed)")
         # failed fast: retry only while a ~20s backoff still fits before
         # the deadline, so we never spawn a probe doomed to be reported
-        # as 'blocked' (and keep the real rc in the failure detail)
-        if time.time() + 20 >= deadline:
+        # as 'blocked' (and keep the real rc in the failure detail).
+        # Under an explicit --time-budget a fast rc!=0 (no TPU runtime
+        # at all) is decisive — backoff retries ride out tunnel flake,
+        # and here they'd only starve the CPU-validate fallback.
+        if budget_left is not None or time.time() + 20 >= deadline:
             return False, (f"backend unavailable: probe exited rc={rc} "
                            f"after {attempt} attempt(s)")
         time.sleep(20)
 
 
-def _cpu_validate(models):
+def _cpu_validate(models, budget_left=None, log_path=""):
     """Run a tiny bench step of each model on CPU, all subprocesses in
     parallel under ONE shared deadline, to certify the bench code paths
     work even when the chip is unreachable. CPU-only children — safe to
     kill at the deadline (no tunnel claim). Returns {model: bool}."""
     root = os.path.dirname(os.path.abspath(__file__))
-    deadline = time.time() + float(
-        os.environ.get("BENCH_CPU_VALIDATE_S", "300"))
+    wait = float(os.environ.get("BENCH_CPU_VALIDATE_S", "300"))
+    if budget_left is not None:
+        wait = max(10.0, min(wait, budget_left))
+    deadline = time.time() + wait
     procs = {}
     for m in dict.fromkeys(models):
-        code = _CPU_VALIDATE_CODE.format(root=root, model=m)
+        code = _CPU_VALIDATE_CODE.format(root=root, model=m,
+                                         log=log_path)
         try:
             procs[m] = subprocess.Popen(
                 [sys.executable, "-c", code],
@@ -549,24 +639,58 @@ def _error_line(model, err, cpu_validated=None):
     return out
 
 
-def main():
+def main(argv=None):
     """Always prints exactly one parseable JSON line per selected
     model, even when the TPU tunnel is wedged or a bench crashes — a
-    missing artifact is strictly worse than an error artifact."""
+    missing artifact is strictly worse than an error artifact. Every
+    result line is ALSO appended to the JSONL log the moment it exists
+    (with monitor snapshots interleaved when FLAGS_enable_monitor),
+    and --time-budget stops the run cleanly between configs before an
+    external `timeout` can kill it mid-config."""
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--time-budget", type=float,
+                    default=float(os.environ.get("BENCH_TIME_BUDGET",
+                                                 "0")),
+                    help="soft wall-clock cap in seconds (0 = none): "
+                         "bench stops cleanly between configs once "
+                         "exceeded, emitting skip lines for the rest")
+    args = ap.parse_args(argv)
+    t_start = time.time()
+    deadline = t_start + args.time_budget if args.time_budget > 0 else None
+
+    def budget_left():
+        return None if deadline is None else deadline - time.time()
+
     model = os.environ.get("BENCH_MODEL", "bert")
     models = {"both": ["bert", "resnet50"],
               "all": ["bert", "resnet50", "gpt", "transformer",
                       "deeplab"]}.get(model, [model])
     models = [m for m in models if m in _METRICS] or ["bert"]
 
-    ok, detail = _probe_backend()
+    log = _log_path()
+    monitor_on = False
+    try:
+        from paddle_tpu import monitor
+        monitor_on = monitor.enabled()
+        if monitor_on:
+            # periodic crash-safe snapshots: even a run killed by the
+            # harness timeout leaves step/compile/feed stats behind
+            monitor.start_exporter(log)
+    except Exception as e:  # noqa: BLE001 — monitor must never kill bench
+        print(f"# monitor unavailable: {e}", file=sys.stderr)
+
+    ok, detail = _probe_backend(budget_left())
     if not ok:
         print(f"# {detail}", file=sys.stderr)
-        cpu_ok = _cpu_validate(models)
+        # children inherit FLAGS_enable_monitor via env and flush their
+        # own snapshots to the shared log (appends are line-atomic)
+        cpu_ok = _cpu_validate(models, budget_left(),
+                               log_path=log if monitor_on else "")
         for m in models:
-            print(json.dumps(_error_line(m, detail,
-                                         cpu_validated=cpu_ok[m])),
-                  flush=True)
+            line = _error_line(m, detail, cpu_validated=cpu_ok[m])
+            print(json.dumps(line), flush=True)
+            _emit(log, {"kind": "bench_result", "ts": time.time(),
+                        **line})
         return
 
     # Persistent compilation cache: repeat sweep configs skip the
@@ -586,12 +710,37 @@ def main():
     fns = {"bert": bench_bert, "resnet50": bench_resnet50,
            "gpt": bench_gpt, "transformer": bench_transformer,
            "deeplab": bench_deeplab}
-    for m in models:
+    prev_elapsed = None
+    for i, m in enumerate(models):
+        left = budget_left()
+        # stop cleanly between configs: skip the rest once the budget
+        # is spent, or when the next config can't plausibly finish in
+        # the time remaining (estimated from the previous config)
+        if left is not None and (
+                left <= 0 or (prev_elapsed is not None
+                              and left < 0.8 * prev_elapsed)):
+            for skip in models[i:]:
+                line = _error_line(
+                    skip, f"skipped: time budget exhausted "
+                          f"({args.time_budget:.0f}s)")
+                print(json.dumps(line), flush=True)
+                _emit(log, {"kind": "bench_result", "ts": time.time(),
+                            **line})
+            break
+        t0 = time.time()
         try:
-            print(json.dumps(fns[m]()), flush=True)
+            line = fns[m]()
         except Exception as e:  # noqa: BLE001 — artifact must exist
-            print(json.dumps(_error_line(m, f"{type(e).__name__}: {e}")),
-                  flush=True)
+            line = _error_line(m, f"{type(e).__name__}: {e}")
+        prev_elapsed = time.time() - t0
+        print(json.dumps(line), flush=True)
+        _emit(log, {"kind": "bench_result", "ts": time.time(), **line})
+        if monitor_on:
+            try:
+                from paddle_tpu import monitor
+                monitor.snapshot_to_jsonl(log)
+            except Exception as e:  # noqa: BLE001
+                print(f"# snapshot failed: {e}", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -41,6 +41,7 @@ from .io_sharded import (save_sharded_persistables,  # noqa: F401
                          load_sharded_persistables)
 from . import dygraph  # noqa: F401
 from . import profiler  # noqa: F401
+from . import monitor  # noqa: F401
 from . import debugger  # noqa: F401
 from . import trainer_desc  # noqa: F401
 from .core import memory  # noqa: F401

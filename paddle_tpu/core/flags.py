@@ -164,6 +164,13 @@ def trace_signature() -> tuple:
     return tuple(f.value for _, f in sorted(_REGISTRY.items()) if f.traced)
 
 
+def flag_handle(name: str) -> _Flag:
+    """The mutable _Flag record for `name` (internal). The monitor's
+    disabled fast path caches this handle so every STAT_* call costs one
+    attribute read instead of a registry lookup."""
+    return _REGISTRY[name]
+
+
 def flag_info() -> List[dict]:
     """All flags with metadata (for docs / debugging)."""
     return [{"name": f.name, "value": f.value, "default": f.default,
@@ -223,6 +230,28 @@ DEFINE_bool(
     "pallas_interpret", False,
     "Force Pallas kernels into interpret mode even on TPU (debugging "
     "numerics; very slow).", traced=True)
+
+DEFINE_bool(
+    "enable_monitor", False,
+    "Enable the runtime stats registry (paddle_tpu/monitor.py): "
+    "executor compile/step/feed timing, reader queue stats, device "
+    "memory gauges. Off = every STAT_* call is a near-zero-cost no-op. "
+    "Reference: the always-on STAT registry of platform/monitor.h, made "
+    "opt-in here because host callbacks are the expensive resource on "
+    "TPU.")
+
+DEFINE_string(
+    "monitor_export_path", "",
+    "Default JSONL file for monitor snapshots (append mode, one JSON "
+    "object per line). Used by monitor.snapshot_to_jsonl / "
+    "start_exporter when no explicit path is given; bench.py and "
+    "tools/profile_step.py write here when set.")
+
+DEFINE_double(
+    "monitor_flush_interval_s", 10.0,
+    "Interval of the background JSONL snapshot exporter "
+    "(monitor.start_exporter). Crash-safety knob: a run killed by an "
+    "external timeout still leaves snapshots this fresh.")
 
 DEFINE_string(
     "profiler_trace_dir", "",

@@ -1,0 +1,71 @@
+"""Version-compat shims over moving jax APIs.
+
+The parallel layer targets the current jax surface (jax.shard_map with
+check_vma/axis_names, jax.lax.pcast); older installs (<=0.4.x) keep
+shard_map in jax.experimental with check_rep and no axis_names, and
+have no pcast. These wrappers let one call site serve both, so the
+package imports (and the non-parallel 95% of it runs) regardless of
+which jax the container bakes in.
+"""
+from __future__ import annotations
+
+__all__ = ["shard_map", "pcast", "axis_size"]
+
+
+def shard_map(fn, mesh, in_specs, out_specs, check_vma=None,
+              axis_names=None):
+    """jax.shard_map when available, else jax.experimental.shard_map.
+
+    Mapping to the old API: check_vma -> check_rep; axis_names={a, ...}
+    -> auto=<every other mesh axis> (the old spelling of "only map
+    these axes"). When falling back with check_vma unset, replication
+    checking is disabled — the old checker predates the varying-type
+    system the new-API callers are written against.
+    """
+    import jax
+    new = getattr(jax, "shard_map", None)
+    if new is not None:
+        kw = {}
+        if check_vma is not None:
+            kw["check_vma"] = check_vma
+        if axis_names is not None:
+            kw["axis_names"] = axis_names
+        return new(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   **kw)
+    from jax.experimental.shard_map import shard_map as old
+    kw = {"check_rep": bool(check_vma) if check_vma is not None
+          else False}
+    if axis_names is not None:
+        auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+        if auto:
+            kw["auto"] = auto
+    sm = old(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+             **kw)
+    if kw.get("auto"):
+        # the old eager impl rule rejects non-empty auto outright
+        # (shard_map.py: `if auto: raise NotImplementedError`); only the
+        # jit lowering path partitions auto axes, so force it
+        sm = jax.jit(sm)
+    return sm
+
+
+def axis_size(axis_name):
+    """jax.lax.axis_size when available; psum(1, axis) otherwise (old
+    jax constant-folds a psum of a Python scalar to the static axis
+    size, so both spellings yield a concrete int inside shard_map)."""
+    import jax
+    f = getattr(jax.lax, "axis_size", None)
+    if f is not None:
+        return f(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
+def pcast(x, axis_name, to):
+    """jax.lax.pcast when available; identity otherwise (pre-varying-type
+    jax has no device-varying cast — with replication checks off the
+    cast is unnecessary)."""
+    import jax
+    f = getattr(jax.lax, "pcast", None)
+    if f is None:
+        return x
+    return f(x, axis_name, to=to)

@@ -72,6 +72,8 @@ def _nan_inf_guard(op_type, name, val, in_control_flow):
     def cb(arr):
         a = np.asarray(arr)
         if not np.isfinite(a).all():
+            from ..monitor import STAT_ADD
+            STAT_ADD("executor.nan_inf_trips")
             raise FloatingPointError(
                 f"Operator {op_type} output {name!r} contains Inf/Nan "
                 f"(FLAGS_check_nan_inf)")
@@ -81,6 +83,8 @@ def _nan_inf_guard(op_type, name, val, in_control_flow):
         def report(arr):
             a = np.asarray(arr)
             if not np.isfinite(a).all():
+                from ..monitor import STAT_ADD
+                STAT_ADD("executor.nan_inf_trips")
                 print(f"FLAGS_check_nan_inf: operator {op_type} output "
                       f"{name!r} contains Inf/Nan (inside control flow; "
                       f"run aborts are only possible at top level)")
@@ -112,8 +116,12 @@ def run_op(op, env, ctx):
         # users see WHICH Program op died, not just a jnp traceback
         shapes = {s: [getattr(v, "shape", "?") for v in vs]
                   for s, vs in ins.items()}
-        e.add_note(f"[operator {op.type!r}] inputs {shapes} -> outputs "
-                   f"{dict(op.outputs)}, attrs {op.attrs}")
+        note = (f"[operator {op.type!r}] inputs {shapes} -> outputs "
+                f"{dict(op.outputs)}, attrs {op.attrs}")
+        if hasattr(e, "add_note"):  # PEP 678, Python >= 3.11
+            e.add_note(note)
+        else:
+            e.__notes__ = [*getattr(e, "__notes__", []), note]
         raise
     check = FLAGS.check_nan_inf
     for slot, names in op.outputs.items():

@@ -13,7 +13,7 @@ from __future__ import annotations
 from typing import Dict, Optional
 
 __all__ = ["device_memory_stats", "scope_memory_stats",
-           "assert_hbm_within"]
+           "assert_hbm_within", "record_device_memory"]
 
 
 def device_memory_stats(device=None) -> Dict[str, int]:
@@ -51,6 +51,25 @@ def scope_memory_stats(scope=None) -> Dict[str, int]:
             host += nbytes
     return {"vars": count, "host_bytes": host, "device_bytes": dev,
             "total_bytes": host + dev}
+
+
+def record_device_memory(device=None) -> Dict[str, int]:
+    """Sample PJRT allocator stats into the monitor as gauges
+    (memory.device_bytes_in_use / peak / limit). The executor calls
+    this once per step when FLAGS_enable_monitor is set, giving the
+    live-HBM-per-step series the reference's scope_buffered_monitor
+    derives from per-scope tensor bytes. No-op when the monitor is
+    disabled or the backend reports no stats (CPU)."""
+    from ..monitor import STAT_SET, enabled
+    if not enabled():
+        return {}
+    s = device_memory_stats(device)
+    for key, stat in (("bytes_in_use", "memory.device_bytes_in_use"),
+                      ("peak_bytes_in_use", "memory.device_peak_bytes"),
+                      ("bytes_limit", "memory.device_bytes_limit")):
+        if key in s:
+            STAT_SET(stat, s[key])
+    return s
 
 
 def assert_hbm_within(fraction: float, device=None) -> Optional[float]:

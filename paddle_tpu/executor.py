@@ -13,6 +13,7 @@ is vars/names, results come back as numpy by default.
 """
 from __future__ import annotations
 
+import time
 from typing import Dict, List, Optional
 
 import jax
@@ -24,6 +25,8 @@ from .core.lowering import LowerCtx, lower_block
 from .core.place import Place, default_place
 from .core.scope import Scope, global_scope
 from .framework import Program, Variable
+from .monitor import STAT_ADD, STAT_OBSERVE, STAT_SET
+from .monitor import enabled as _monitor_on
 
 __all__ = ["Executor", "global_scope", "scope_guard"]
 
@@ -36,6 +39,9 @@ class _CompiledStep:
         self.state_in_names = state_in_names
         self.state_out_names = state_out_names
         self.fetch_names = fetch_names
+        # run count: the first call pays XLA compile (jit is lazy), so
+        # the monitor attributes it separately from steady-state steps
+        self.runs = 0
 
 
 class Executor:
@@ -77,12 +83,16 @@ class Executor:
             run_pserver(program, scope=scope)
             return []
 
+        t_run0 = time.perf_counter()
         step_fn, state, feed_arrays = self._resolve_step(
             program, feed, fetch_list, scope, compiled, use_program_cache)
 
         fp = program.fingerprint()
         step = self._step_counters.get(fp, 0)
         self._step_counters[fp] = step + 1
+
+        first_run = step_fn.runs == 0
+        step_fn.runs += 1
 
         with jax.default_device(self.place.jax_device()):
             fetches, new_state = step_fn.fn(state, feed_arrays,
@@ -91,9 +101,26 @@ class Executor:
         for n, val in new_state.items():
             scope.set(n, val)
 
+        t_fetch0 = time.perf_counter()
         if return_numpy:
-            return [np.asarray(f) for f in fetches]
-        return list(fetches)
+            out = [np.asarray(f) for f in fetches]
+        else:
+            out = list(fetches)
+        if _monitor_on():
+            now = time.perf_counter()
+            # fetch/block time: device sync happens in np.asarray; with
+            # return_numpy=False dispatch is async and this measures ~0
+            STAT_OBSERVE("executor.fetch_block_seconds", now - t_fetch0)
+            STAT_OBSERVE("executor.step_seconds", now - t_run0)
+            if first_run:
+                # lazy-jit compile is paid here: first-call wall time is
+                # the compile + first-execute cost (amortization input
+                # for tools/metrics_report.py)
+                STAT_OBSERVE("executor.compile_first_step_seconds",
+                             now - t_run0)
+            from .core.memory import record_device_memory
+            record_device_memory(self.place.jax_device())
+        return out
 
     # ------------------------------------------------------------------
     def _resolve_step(self, program, feed, fetch_list, scope, compiled,
@@ -118,9 +145,16 @@ class Executor:
         step_fn = self._cache.get(key) if use_program_cache else None
         if step_fn is not None:
             self._cache.move_to_end(key)  # LRU touch
+            STAT_ADD("executor.compile_cache_hit")
         else:
+            STAT_ADD("executor.compile_cache_miss")
+            t0 = time.perf_counter()
             step_fn = self._compile(program, block, feed_arrays,
                                     fetch_names, scope, compiled)
+            # host-side lowering/closure build only — XLA compile itself
+            # is lazy (first call; see executor.compile_first_step_seconds)
+            STAT_OBSERVE("executor.compile_build_seconds",
+                         time.perf_counter() - t0)
             self._cache[key] = step_fn
             if compiled is not None:
                 self._compiled_refs[id(compiled)] = compiled
@@ -128,12 +162,15 @@ class Executor:
             cap = FLAGS.executor_cache_capacity
             while cap > 0 and len(self._cache) > cap:
                 old_key, _ = self._cache.popitem(last=False)
+                STAT_ADD("executor.compile_cache_evictions")
                 # drop the compiled-program strong ref if no other cache
                 # entry still uses it
                 cid = old_key[3]
                 if cid is not None and all(k[3] != cid
                                            for k in self._cache):
                     self._compiled_refs.pop(cid, None)
+            STAT_SET("executor.compile_cache_size", len(self._cache))
+            STAT_SET("executor.compile_cache_capacity", cap)
 
         state = {}
         for n in step_fn.state_in_names:
@@ -146,6 +183,7 @@ class Executor:
         return step_fn, state, feed_arrays
 
     def _prepare_feed(self, block, feed, compiled):
+        t0 = time.perf_counter()
         out = {}
         for name, val in feed.items():
             if isinstance(val, jax.Array):
@@ -199,6 +237,17 @@ class Executor:
                 if arr.ndim >= 2:
                     out[ln] = np.full((arr.shape[0],), arr.shape[1],
                                       np.int64)
+        if _monitor_on():
+            total = host = 0
+            for a in out.values():
+                nb = int(getattr(a, "nbytes", 0) or 0)
+                total += nb
+                if isinstance(a, np.ndarray):
+                    host += nb  # will cross host->device inside the step
+            STAT_ADD("executor.feed_bytes", total)
+            STAT_ADD("executor.feed_host_bytes", host)
+            STAT_OBSERVE("executor.feed_stage_seconds",
+                         time.perf_counter() - t0)
         return out
 
     def _cache_key(self, program, feed_arrays, fetch_names, compiled):

@@ -1,0 +1,395 @@
+"""Process-global runtime stats registry: counters, gauges, histograms.
+
+Reference: platform/monitor.h — a global STAT registry written with
+STAT_ADD/STAT_RESET macros from every subsystem (allocator, RPC,
+executor) and drained by periodic exporters — plus the host-phase
+aggregation half of platform/profiler.cc. Here the same design carries
+the TPU runtime's cost attribution: the executor, reader, and memory
+layers record into this module, and two exporters (append-mode JSONL
+snapshots, Prometheus text format) plus a chrome-trace event dump get
+the data out even when the process is killed mid-run.
+
+Near-zero cost when disabled: every STAT_* entry point checks
+FLAGS_enable_monitor through a cached flag handle (one attribute read)
+before doing any work, so instrumented hot paths cost ~a function call
+when the monitor is off.
+
+Stat names are dotted lowercase (`executor.step_seconds`); the full
+inventory lives in docs/observability.md and is lint-enforced by
+tests/test_observability.py.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, Optional
+
+__all__ = ["STAT_ADD", "STAT_SET", "STAT_OBSERVE", "STAT_RESET",
+           "enabled", "reset_stats", "reset_phases", "get_stats_snapshot",
+           "get_phase_stats", "phase", "push_phase", "pop_phase",
+           "snapshot_to_jsonl", "prometheus_text", "export_prometheus",
+           "export_chrome_tracing", "start_exporter", "stop_exporter",
+           "DEFAULT_TIME_BUCKETS"]
+
+# Fixed histogram buckets (upper bounds, seconds): 100us..120s covers a
+# feed-copy on one end and a cold XLA compile on the other. The overflow
+# bucket is implicit (+inf).
+DEFAULT_TIME_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0)
+
+_LOCK = threading.Lock()
+_COUNTERS: Dict[str, float] = {}
+_GAUGES: Dict[str, float] = {}
+_HISTS: Dict[str, "_Histogram"] = {}
+# Host-phase aggregates (record_event scopes). Separate namespace from
+# the STAT registry: phase names are user-provided annotations, not
+# inventory-controlled stat names.
+_PHASES: Dict[str, Dict[str, float]] = {}
+# Recent phase events for chrome-trace export (bounded ring).
+_EVENTS: "deque" = deque(maxlen=20000)
+_TLS = threading.local()
+
+_flag = None
+
+
+def enabled() -> bool:
+    """FLAGS_enable_monitor, read through a cached flag handle (the
+    disabled fast path: one None-check + one attribute read)."""
+    global _flag
+    f = _flag
+    if f is None:
+        from .core.flags import flag_handle
+        f = _flag = flag_handle("enable_monitor")
+    return f.value
+
+
+class _Histogram:
+    __slots__ = ("buckets", "counts", "count", "sum", "min", "max")
+
+    def __init__(self, buckets):
+        self.buckets = tuple(buckets)
+        self.counts = [0] * (len(self.buckets) + 1)  # +1 overflow
+        self.count = 0
+        self.sum = 0.0
+        self.min = float("inf")
+        self.max = float("-inf")
+
+    def observe(self, v):
+        v = float(v)
+        i = 0
+        for b in self.buckets:
+            if v <= b:
+                break
+            i += 1
+        self.counts[i] += 1
+        self.count += 1
+        self.sum += v
+        if v < self.min:
+            self.min = v
+        if v > self.max:
+            self.max = v
+
+    def percentile(self, q):
+        """Estimate from bucket counts: linear interpolation inside the
+        target bucket; the overflow bucket clamps to the observed max."""
+        if self.count == 0:
+            return None
+        target = q * self.count
+        cum = 0
+        lo = 0.0
+        for i, c in enumerate(self.counts):
+            if cum + c >= target and c > 0:
+                hi = self.buckets[i] if i < len(self.buckets) else self.max
+                frac = (target - cum) / c
+                return min(lo + (hi - lo) * frac, self.max)
+            cum += c
+            lo = self.buckets[i] if i < len(self.buckets) else self.max
+        return self.max
+
+    def to_dict(self):
+        b = {}
+        for i, c in enumerate(self.counts):
+            le = repr(self.buckets[i]) if i < len(self.buckets) else "+inf"
+            b[le] = c
+        return {"count": self.count, "sum": self.sum,
+                "min": self.min if self.count else None,
+                "max": self.max if self.count else None,
+                "p50": self.percentile(0.50),
+                "p95": self.percentile(0.95),
+                "buckets": b}
+
+
+# ---------------------------------------------------------------------------
+# Recording API (the STAT_ADD/STAT_RESET surface of platform/monitor.h)
+# ---------------------------------------------------------------------------
+
+def STAT_ADD(name: str, value=1):
+    """Add to a monotonically-increasing counter (creates on first use)."""
+    if not enabled():
+        return
+    with _LOCK:
+        if name in _GAUGES or name in _HISTS:
+            raise ValueError(f"stat {name!r} is not a counter")
+        _COUNTERS[name] = _COUNTERS.get(name, 0) + value
+
+
+def STAT_SET(name: str, value):
+    """Set a gauge to the latest sampled value."""
+    if not enabled():
+        return
+    with _LOCK:
+        if name in _COUNTERS or name in _HISTS:
+            raise ValueError(f"stat {name!r} is not a gauge")
+        _GAUGES[name] = float(value)
+
+
+def STAT_OBSERVE(name: str, value, buckets=None):
+    """Record one observation into a fixed-bucket histogram. `buckets`
+    (upper bounds, ascending) only applies at first creation; default is
+    DEFAULT_TIME_BUCKETS (seconds-oriented)."""
+    if not enabled():
+        return
+    with _LOCK:
+        if name in _COUNTERS or name in _GAUGES:
+            raise ValueError(f"stat {name!r} is not a histogram")
+        h = _HISTS.get(name)
+        if h is None:
+            h = _HISTS[name] = _Histogram(buckets or DEFAULT_TIME_BUCKETS)
+        h.observe(value)
+
+
+def STAT_RESET(name: Optional[str] = None):
+    """Reset one stat (or every stat when name is None). Reference:
+    monitor.h STAT_RESET."""
+    with _LOCK:
+        if name is None:
+            _COUNTERS.clear()
+            _GAUGES.clear()
+            _HISTS.clear()
+        else:
+            _COUNTERS.pop(name, None)
+            _GAUGES.pop(name, None)
+            _HISTS.pop(name, None)
+
+
+def reset_stats(name: Optional[str] = None):
+    STAT_RESET(name)
+
+
+# ---------------------------------------------------------------------------
+# Host-phase accounting (profiler.record_event feeds this)
+# ---------------------------------------------------------------------------
+
+def push_phase(name: str):
+    stack = getattr(_TLS, "stack", None)
+    if stack is None:
+        stack = _TLS.stack = []
+    # [name, wall-clock start (us), perf start, child time accumulator]
+    stack.append([name, time.time() * 1e6, time.perf_counter(), 0.0])
+
+
+def pop_phase(name: Optional[str] = None):
+    stack = getattr(_TLS, "stack", None)
+    if not stack:
+        return  # unbalanced pop (e.g. reset mid-scope): ignore
+    nm, wall_us, start, child = stack.pop()
+    total = time.perf_counter() - start
+    exclusive = total - child
+    if stack:
+        stack[-1][3] += total
+    with _LOCK:
+        agg = _PHASES.setdefault(
+            nm, {"count": 0, "total_s": 0.0, "exclusive_s": 0.0})
+        agg["count"] += 1
+        agg["total_s"] += total
+        agg["exclusive_s"] += exclusive
+        _EVENTS.append((nm, wall_us, total * 1e6,
+                        threading.get_ident()))
+
+
+@contextlib.contextmanager
+def phase(name: str):
+    """Scoped host-phase timer. Nested scopes accumulate EXCLUSIVE time
+    per phase (a parent's aggregate excludes time spent in children),
+    matching the reference profiler's self-time columns."""
+    push_phase(name)
+    try:
+        yield
+    finally:
+        pop_phase(name)
+
+
+def get_phase_stats() -> Dict[str, Dict[str, float]]:
+    with _LOCK:
+        return {k: dict(v) for k, v in _PHASES.items()}
+
+
+def reset_phases():
+    with _LOCK:
+        _PHASES.clear()
+        _EVENTS.clear()
+
+
+# ---------------------------------------------------------------------------
+# Snapshots + exporters
+# ---------------------------------------------------------------------------
+
+def get_stats_snapshot() -> dict:
+    """Point-in-time copy of every stat + phase aggregate (plain dict,
+    JSON-serializable)."""
+    with _LOCK:
+        return {
+            "ts": time.time(),
+            "pid": os.getpid(),
+            "counters": dict(_COUNTERS),
+            "gauges": dict(_GAUGES),
+            "histograms": {k: h.to_dict() for k, h in _HISTS.items()},
+            "phases": {k: dict(v) for k, v in _PHASES.items()},
+        }
+
+
+def snapshot_to_jsonl(path: Optional[str] = None) -> str:
+    """Append one snapshot line to a JSONL log (crash-safe: each line is
+    flushed + fsynced, so a timed-out run still yields every snapshot
+    written before the kill). Path defaults to FLAGS_monitor_export_path.
+    Returns the path written."""
+    if path is None:
+        from .core.flags import FLAGS
+        path = FLAGS.monitor_export_path
+    if not path:
+        raise ValueError(
+            "no export path: pass one or set FLAGS_monitor_export_path")
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    rec = {"kind": "stats_snapshot", **get_stats_snapshot()}
+    with open(path, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+    return path
+
+
+def prometheus_text() -> str:
+    """Prometheus text exposition format. Dotted stat names become
+    underscore-joined metric names under the paddle_tpu_ prefix."""
+    def mname(name):
+        return "paddle_tpu_" + name.replace(".", "_")
+
+    out = []
+    snap = get_stats_snapshot()
+    for name, v in sorted(snap["counters"].items()):
+        m = mname(name)
+        out.append(f"# TYPE {m} counter")
+        out.append(f"{m} {v}")
+    for name, v in sorted(snap["gauges"].items()):
+        m = mname(name)
+        out.append(f"# TYPE {m} gauge")
+        out.append(f"{m} {v}")
+    for name, h in sorted(snap["histograms"].items()):
+        m = mname(name)
+        out.append(f"# TYPE {m} histogram")
+        cum = 0
+        for le, c in h["buckets"].items():
+            cum += c
+            le_s = le if le == "+inf" else repr(float(le))
+            out.append(f'{m}_bucket{{le="{le_s}"}} {cum}')
+        out.append(f"{m}_sum {h['sum']}")
+        out.append(f"{m}_count {h['count']}")
+    return "\n".join(out) + "\n"
+
+
+def export_prometheus(path: str) -> str:
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(prometheus_text())
+    os.replace(tmp, path)
+    return path
+
+
+def export_chrome_tracing(path: str) -> int:
+    """Dump recorded phase events as chrome://tracing JSON (the format
+    of the reference's tools/timeline.py, and of the native profiler's
+    ptn_profiler_dump — profiler.export_chrome_tracing falls back to
+    this when the native library is unavailable). Returns #events."""
+    with _LOCK:
+        events = list(_EVENTS)
+    pid = os.getpid()
+    trace = {"displayTimeUnit": "ms", "traceEvents": [
+        {"name": nm, "ph": "X", "ts": ts_us, "dur": dur_us,
+         "pid": pid, "tid": tid}
+        for nm, ts_us, dur_us, tid in events]}
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(trace, f)
+    return len(events)
+
+
+# ---------------------------------------------------------------------------
+# Background exporter: periodic JSONL snapshots so even a run the
+# harness timeout-kills leaves a usable log behind (the failure mode
+# that produced BENCH_r05's `parsed: null`).
+# ---------------------------------------------------------------------------
+
+_exporter = None
+_exporter_lock = threading.Lock()
+
+
+class _Exporter(threading.Thread):
+    def __init__(self, path, interval):
+        super().__init__(name="ptn-monitor-exporter", daemon=True)
+        self.path = path
+        self.interval = interval
+        self._stop = threading.Event()
+
+    def run(self):
+        while not self._stop.wait(self.interval):
+            try:
+                snapshot_to_jsonl(self.path)
+            except OSError:
+                pass  # transient FS trouble must not kill the thread
+
+    def stop(self, flush=True):
+        self._stop.set()
+        if flush:
+            try:
+                snapshot_to_jsonl(self.path)
+            except OSError:
+                pass
+
+
+def start_exporter(path: Optional[str] = None,
+                   interval: Optional[float] = None):
+    """Start (or return the running) background JSONL snapshot thread.
+    Defaults: FLAGS_monitor_export_path / FLAGS_monitor_flush_interval_s.
+    """
+    global _exporter
+    from .core.flags import FLAGS
+    path = path or FLAGS.monitor_export_path
+    if not path:
+        raise ValueError(
+            "no export path: pass one or set FLAGS_monitor_export_path")
+    interval = interval or FLAGS.monitor_flush_interval_s
+    with _exporter_lock:
+        if _exporter is not None and _exporter.is_alive():
+            return _exporter
+        _exporter = _Exporter(path, interval)
+        _exporter.start()
+        import atexit
+        atexit.register(stop_exporter)
+        return _exporter
+
+
+def stop_exporter(flush=True):
+    global _exporter
+    with _exporter_lock:
+        if _exporter is not None:
+            _exporter.stop(flush=flush)
+            _exporter = None

@@ -114,11 +114,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *,
 
 def _grid_kw():
     """compiler_params kwargs: bh/q dims parallel, the streamed dim
-    arbitrary (sequential — scratch state persists across it)."""
-    params = pltpu.CompilerParams(dimension_semantics=(
-        pltpu.GridDimensionSemantics.PARALLEL,
-        pltpu.GridDimensionSemantics.PARALLEL,
-        pltpu.GridDimensionSemantics.ARBITRARY))
+    arbitrary (sequential — scratch state persists across it). Old
+    pallas (jax<=0.4.x) spells this TPUCompilerParams with string
+    semantics instead of CompilerParams with the enum."""
+    cp = getattr(pltpu, "CompilerParams", None)
+    if cp is not None:
+        sem = pltpu.GridDimensionSemantics
+        params = cp(dimension_semantics=(
+            sem.PARALLEL, sem.PARALLEL, sem.ARBITRARY))
+    else:
+        params = pltpu.TPUCompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"))
     return {"compiler_params": params}
 
 

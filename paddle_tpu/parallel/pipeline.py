@@ -25,8 +25,9 @@ from typing import Callable
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import PartitionSpec as P
+
+from ..core.jax_compat import pcast, shard_map
 
 from .mesh import get_mesh
 
@@ -88,8 +89,8 @@ def gpipe(stage_fn: Callable, stacked_params, x, *, n_microbatches: int,
         # The carry is device-varying over the pp axis (each stage holds a
         # different activation), so the init must be cast to varying for
         # shard_map's per-axis type check to accept the scan.
-        init = jax.lax.pcast((jnp.zeros_like(x_mb[0]),
-                              jnp.zeros_like(x_mb)), axis, to="varying")
+        init = pcast((jnp.zeros_like(x_mb[0]),
+                      jnp.zeros_like(x_mb)), axis, to="varying")
         (_, outputs), _ = jax.lax.scan(
             body, init, jnp.arange(n_microbatches + n_stages - 1))
         # outputs are only valid on the last stage; replicate across pp

@@ -22,6 +22,8 @@ import math
 
 import jax
 import jax.numpy as jnp
+
+from ..core.jax_compat import axis_size
 from jax.sharding import PartitionSpec as P
 
 NEG_INF = -1e30
@@ -69,7 +71,7 @@ def _lse_merge(o, lse, o_i, lse_i):
 
 
 def _ring_fwd_loop(q, k, v, axis_name, causal, sm_scale):
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     t_local = q.shape[2]
     q_off = idx * t_local
@@ -114,7 +116,7 @@ def _ring_vjp_bwd(axis_name, causal, sm_scale, res, do):
     (a custom-vjp backward is safe from jax's dot-transpose f32
     poisoning; see ops/math.py:_mul)."""
     q, k, v, o, lse = res
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     t_local = q.shape[2]
     q_off = idx * t_local

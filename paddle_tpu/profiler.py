@@ -13,7 +13,7 @@ import jax
 
 __all__ = ["profiler", "start_profiler", "stop_profiler", "reset_profiler",
            "record_event", "cuda_profiler", "enable_host_profiler",
-           "export_chrome_tracing"]
+           "export_chrome_tracing", "host_phase_stats"]
 
 _trace_dir = None
 
@@ -34,7 +34,14 @@ def stop_profiler(sorted_key=None, profile_path=None):
 
 
 def reset_profiler():
-    pass
+    """Reset host-phase aggregates: the monitor's record_event
+    accumulators + event ring, and the native profiler's event buffer
+    when the C++ runtime is built. Reference: platform/profiler.cc
+    ResetProfiler clears the global event vectors."""
+    from .monitor import reset_phases
+    reset_phases()
+    from .native import profiler_reset
+    profiler_reset()
 
 
 @contextlib.contextmanager
@@ -50,12 +57,24 @@ def profiler(state="All", sorted_key=None, profile_path=None,
 @contextlib.contextmanager
 def record_event(name):
     """RecordEvent RAII (profiler.h:81) -> XPlane trace annotation + native
-    host-phase event (native/src/profiler.cc), so the chrome trace merges
-    framework phases with the device timeline like the reference's
-    host+CUPTI merge (device_tracer.cc:58)."""
+    host-phase event (native/src/profiler.cc) + monitor phase aggregate
+    (monitor.phase: nested scopes accumulate EXCLUSIVE time per phase),
+    so the chrome trace merges framework phases with the device timeline
+    like the reference's host+CUPTI merge (device_tracer.cc:58) and
+    host_phase_stats() answers "where does host step time go" without a
+    trace viewer."""
+    from .monitor import phase as _monitor_phase
     from .native import profiler_scope
-    with jax.profiler.TraceAnnotation(name), profiler_scope(name):
+    with jax.profiler.TraceAnnotation(name), profiler_scope(name), \
+            _monitor_phase(name):
         yield
+
+
+def host_phase_stats():
+    """Aggregated record_event phases: {name: {count, total_s,
+    exclusive_s}} since the last reset_profiler()."""
+    from .monitor import get_phase_stats
+    return get_phase_stats()
 
 
 def enable_host_profiler():
@@ -67,9 +86,15 @@ def enable_host_profiler():
 def export_chrome_tracing(path: str) -> bool:
     """Dump recorded host events as chrome://tracing JSON (the reference's
     tools/timeline.py output format). Device-side traces live in the
-    jax.profiler output dir (TensorBoard/Perfetto)."""
+    jax.profiler output dir (TensorBoard/Perfetto). Prefers the native
+    profiler's buffer; when the C++ runtime is unavailable the monitor's
+    phase-event ring (fed by the same record_event scopes) supplies the
+    events, so the merge works in pure-Python deployments too."""
     from .native import profiler_dump
-    return profiler_dump(path) >= 0  # native: -1 = failure, else #events
+    if profiler_dump(path) >= 0:  # native: -1 = failure, else #events
+        return True
+    from .monitor import export_chrome_tracing as _monitor_export
+    return _monitor_export(path) >= 0
 
 
 @contextlib.contextmanager

@@ -11,6 +11,9 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
+
+from .monitor import STAT_ADD, STAT_OBSERVE, STAT_SET
 
 __all__ = ["DataLoader", "PyReader"]
 
@@ -73,9 +76,18 @@ class _GeneratorLoader:
         t = threading.Thread(target=worker, daemon=True)
         t.start()
         while True:
+            # batch-wait time: how long the training thread stalls on
+            # the prefetch queue — the reference's reader-queue starvation
+            # signal (monitor stat reader.batch_wait_seconds). Queue depth
+            # sampled after the get shows remaining prefetch headroom.
+            t0 = time.perf_counter()
             item = q.get()
+            STAT_OBSERVE("reader.batch_wait_seconds",
+                         time.perf_counter() - t0)
+            STAT_SET("reader.queue_depth", q.qsize())
             if item is sentinel:
                 break
+            STAT_ADD("reader.batches")
             yield item
 
     def __call__(self):

@@ -12,6 +12,9 @@ import itertools
 import queue
 import random as _random
 import threading
+import time
+
+from .monitor import STAT_ADD, STAT_OBSERVE, STAT_SET
 
 __all__ = ["cache", "map_readers", "buffered", "compose", "chain",
            "shuffle", "firstn", "xmap_readers", "multiprocess_reader",
@@ -113,11 +116,18 @@ def buffered(reader, size):
         t = threading.Thread(target=fill, daemon=True)
         t.start()
         while True:
+            # same starvation signal as reader.DataLoader: time the
+            # consumer spends blocked on the prefetch queue
+            t0 = time.perf_counter()
             e = q.get()
+            STAT_OBSERVE("reader.batch_wait_seconds",
+                         time.perf_counter() - t0)
+            STAT_SET("reader.queue_depth", q.qsize())
             if e is end:
                 return
             if isinstance(e, _ReaderError):
                 raise e.exc
+            STAT_ADD("reader.batches")
             yield e
     return r
 

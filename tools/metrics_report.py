@@ -1,0 +1,189 @@
+"""Per-phase step-time breakdown from a monitor JSONL log.
+
+Usage:
+    python tools/metrics_report.py <log.jsonl>
+
+Reads the snapshots written by paddle_tpu.monitor (snapshot_to_jsonl /
+start_exporter — bench.py and tools/profile_step.py both produce one)
+plus any interleaved bench_result lines, and prints the table the
+reference extracts from platform/monitor.h stats + print_profiler:
+step-time p50/p95, compile amortization, cache hit rate, feed/fetch/
+reader costs, host-phase exclusive time, and MFU when the run recorded
+the model's per-step flops (bench.model_flops_per_step).
+
+Counters and histograms are cumulative, so the LAST snapshot of a run
+summarizes it; earlier snapshots only add the time axis.
+"""
+from __future__ import annotations
+
+import json
+import sys
+
+
+def _fmt_s(v):
+    if v is None:
+        return "n/a"
+    if v >= 1.0:
+        return f"{v:.2f} s"
+    if v >= 1e-3:
+        return f"{v * 1e3:.2f} ms"
+    return f"{v * 1e6:.0f} us"
+
+
+def _fmt_bytes(v):
+    if v is None:
+        return "n/a"
+    for unit, div in (("GiB", 2**30), ("MiB", 2**20), ("KiB", 2**10)):
+        if v >= div:
+            return f"{v / div:.2f} {unit}"
+    return f"{int(v)} B"
+
+
+def load(path):
+    snapshots, results = [], []
+    with open(path) as f:
+        for ln, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                print(f"# skipping unparseable line {ln}",
+                      file=sys.stderr)
+                continue
+            kind = rec.get("kind")
+            if kind == "stats_snapshot" or "histograms" in rec:
+                snapshots.append(rec)
+            elif kind == "bench_result" or "metric" in rec:
+                results.append(rec)
+    return snapshots, results
+
+
+def _hist(snap, name):
+    return snap.get("histograms", {}).get(name)
+
+
+def report(path, out=sys.stdout):
+    snapshots, results = load(path)
+    w = out.write
+    w(f"runtime stats report — {path}\n")
+    if not snapshots and not results:
+        w("no snapshots or bench results found\n")
+        return 1
+    w(f"snapshots: {len(snapshots)}   bench results: {len(results)}\n")
+    if not snapshots:
+        snap = {"counters": {}, "gauges": {}, "histograms": {},
+                "phases": {}}
+    else:
+        # merge processes: a multi-process run (bench CPU-validate
+        # children) appends one cumulative snapshot per pid — keep the
+        # last snapshot per pid and sum/compare across them would be
+        # wrong for gauges, so report the last snapshot that actually
+        # carries step data, else just the last
+        snap = next((s for s in reversed(snapshots)
+                     if _hist(s, "executor.step_seconds")), snapshots[-1])
+    c = snap.get("counters", {})
+    g = snap.get("gauges", {})
+
+    w("\n-- executor --\n")
+    for label, name in (("step", "executor.step_seconds"),
+                        ("first step (compile+run)",
+                         "executor.compile_first_step_seconds"),
+                        ("compile build", "executor.compile_build_seconds"),
+                        ("feed stage", "executor.feed_stage_seconds"),
+                        ("fetch block", "executor.fetch_block_seconds")):
+        h = _hist(snap, name)
+        if h:
+            w(f"{label:26s} count {h['count']:<6d} "
+              f"p50 {_fmt_s(h['p50']):>10s}  p95 {_fmt_s(h['p95']):>10s}  "
+              f"total {_fmt_s(h['sum'])}\n")
+    hits = c.get("executor.compile_cache_hit", 0)
+    misses = c.get("executor.compile_cache_miss", 0)
+    if hits + misses:
+        rate = hits / (hits + misses)
+        size = g.get("executor.compile_cache_size")
+        cap = g.get("executor.compile_cache_capacity")
+        sz = (f"  size {int(size)}/{int(cap)}"
+              if size is not None and cap is not None else "")
+        w(f"{'compile cache':26s} hits {hits} / misses {misses} "
+          f"(hit rate {rate:.1%}){sz}  "
+          f"evictions {int(c.get('executor.compile_cache_evictions', 0))}"
+          f"\n")
+    steps = (_hist(snap, "executor.step_seconds") or {}).get("count", 0)
+    comp = _hist(snap, "executor.compile_first_step_seconds")
+    if comp and steps:
+        # compile amortization: share of total wall time that went to
+        # first-call (compile-bearing) steps
+        total_step = _hist(snap, "executor.step_seconds")["sum"]
+        if total_step > 0:
+            w(f"{'compile amortization':26s} "
+              f"{comp['sum'] / total_step:.1%} of step wall time in "
+              f"{comp['count']} compile-bearing call(s)\n")
+    fb = c.get("executor.feed_bytes")
+    if fb is not None:
+        per = f" ({_fmt_bytes(fb / steps)}/step)" if steps else ""
+        w(f"{'feed bytes':26s} {_fmt_bytes(fb)}{per}, host-staged "
+          f"{_fmt_bytes(c.get('executor.feed_host_bytes', 0))}\n")
+    trips = c.get("executor.nan_inf_trips")
+    if trips:
+        w(f"{'nan/inf watchdog trips':26s} {int(trips)}\n")
+
+    rb = c.get("reader.batches")
+    rw = _hist(snap, "reader.batch_wait_seconds")
+    if rb or rw:
+        w("\n-- reader --\n")
+        if rw:
+            w(f"{'batch wait':26s} count {rw['count']:<6d} "
+              f"p50 {_fmt_s(rw['p50']):>10s}  "
+              f"p95 {_fmt_s(rw['p95']):>10s}\n")
+        if rb:
+            w(f"{'batches':26s} {int(rb)}   queue depth "
+              f"{g.get('reader.queue_depth', 'n/a')}\n")
+
+    mem = g.get("memory.device_bytes_in_use")
+    if mem is not None:
+        w("\n-- device memory --\n")
+        w(f"{'in use':26s} {_fmt_bytes(mem)}   peak "
+          f"{_fmt_bytes(g.get('memory.device_peak_bytes'))}   limit "
+          f"{_fmt_bytes(g.get('memory.device_bytes_limit'))}\n")
+
+    phases = snap.get("phases") or {}
+    if phases:
+        w("\n-- host phases (record_event, exclusive time) --\n")
+        total_excl = sum(p["exclusive_s"] for p in phases.values()) or 1.0
+        for name, p in sorted(phases.items(),
+                              key=lambda kv: -kv[1]["exclusive_s"]):
+            w(f"{name[:26]:26s} count {p['count']:<6d} "
+              f"total {_fmt_s(p['total_s']):>10s}  "
+              f"excl {_fmt_s(p['exclusive_s']):>10s}  "
+              f"{p['exclusive_s'] / total_excl:5.1%}\n")
+
+    flops = g.get("bench.model_flops_per_step")
+    peak = g.get("bench.peak_flops_per_chip")
+    h = _hist(snap, "executor.step_seconds")
+    if flops and peak and h and h.get("p50"):
+        mfu = flops / h["p50"] / peak
+        w(f"\nMFU: {flops:.3g} flops/step / ({_fmt_s(h['p50'])} p50 "
+          f"step x {peak:.3g} peak) = {mfu:.3f}\n")
+
+    if results:
+        w("\n-- bench results --\n")
+        for r in results:
+            err = f"  [{r['error']}]" if r.get("error") else ""
+            w(f"{r.get('metric', '?'):48s} {r.get('value', 0):>10} "
+              f"{r.get('unit', ''):8s} vs_baseline "
+              f"{r.get('vs_baseline', 0)}{err}\n")
+    return 0
+
+
+def main(argv=None):
+    argv = argv if argv is not None else sys.argv[1:]
+    if not argv or argv[0] in ("-h", "--help"):
+        print(__doc__)
+        return 0 if argv else 2
+    return report(argv[0])
+
+
+if __name__ == "__main__":
+    sys.exit(main())

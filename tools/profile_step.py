@@ -30,7 +30,13 @@ def main():
     model = args[0] if args else "bert"
     import bench
     import paddle_tpu as fluid
-    from paddle_tpu import profiler
+    from paddle_tpu import monitor, profiler
+
+    # a profile run IS a metrics run: turn the monitor on (unless the
+    # user explicitly set the flag) so the same command yields both the
+    # device trace and a JSONL stats snapshot next to it
+    if "FLAGS_enable_monitor" not in os.environ:
+        fluid.set_flags({"FLAGS_enable_monitor": True})
 
     build = bench.build_resnet50_bench if model == "resnet50" \
         else bench.build_bert_bench
@@ -38,6 +44,11 @@ def main():
     trace_dir = "/tmp/paddle_tpu_profile_step"
     with fluid.scope_guard(scope):
         _profile(exe, prog, feed, loss, trace_dir, profiler)
+    if monitor.enabled():
+        log = monitor.snapshot_to_jsonl(
+            os.path.join(trace_dir, "monitor.jsonl"))
+        print(f"# monitor snapshot: {log} "
+              f"(report: python tools/metrics_report.py {log})")
 
 
 def _profile(exe, prog, feed, loss, trace_dir, profiler, steps=5):
