@@ -179,6 +179,22 @@ def _bench_layers(n_layers=None):
     return {"n_layers": int(env)} if env else {}
 
 
+def _bench_flash_blocks():
+    """BENCH_FLASH_BLOCK env -> explicit flash tile attrs on the model
+    config: "512" pins block_q=block_k=512, "512,256" pins q,k
+    separately. Unset -> {} so the op attrs stay absent and the
+    flags/autotuner choose the tile (ops/pallas/autotune.py)."""
+    env = os.environ.get("BENCH_FLASH_BLOCK", "")
+    if not env:
+        return {}
+    parts = [int(p) for p in env.split(",") if p.strip()]
+    if not parts:
+        return {}
+    bq = parts[0]
+    bk = parts[1] if len(parts) > 1 else parts[0]
+    return {"flash_block_q": bq, "flash_block_k": bk}
+
+
 def build_bert_bench(batch=None, seq_len=None, n_layers=None):
     """Build the BERT pretraining step per the BENCH_* env config.
     Returns (exe, program, scope, feed, loss, cfg) — shared by bench.py
@@ -194,6 +210,7 @@ def build_bert_bench(batch=None, seq_len=None, n_layers=None):
     mlm = os.environ.get("BENCH_MLM", "0") == "1"
     cfg = transformer.bert_base(dropout=0.1, attn_dropout=0.0,
                                 use_flash=use_flash,
+                                **_bench_flash_blocks(),
                                 **_bench_layers(n_layers))
     # BERT's actual objective: predict the ~15% masked positions, not
     # all T (rounded up to a multiple of 8 for clean TPU tiling)
@@ -287,7 +304,9 @@ def bench_bert():
     _record_bench_stats(flops)
     extra = {"step_ms": round(dt * 1000, 2), "mfu": round(mfu, 4),
              "batch": batch, "seq_len": seq_len,
-             "flash": flash_used, "loss": float(np.asarray(lv)),
+             "flash": flash_used,
+             "flash_block": os.environ.get("BENCH_FLASH_BLOCK", "auto"),
+             "loss": float(np.asarray(lv)),
              "mlm": os.environ.get("BENCH_MLM", "0"), **stats}
     if probes_ms is not None:
         extra["flash_probe_ms"] = probes_ms
@@ -336,6 +355,7 @@ def build_gpt_bench(batch=None, seq_len=None, n_layers=None):
     use_flash = os.environ.get("BENCH_FLASH", "1") == "1"
     cfg = gpt.gpt_small(dropout=0.1, attn_dropout=0.0,
                         use_flash=use_flash, max_seq_len=seq_len,
+                        **_bench_flash_blocks(),
                         **_bench_layers(n_layers))
     main_prog, startup = fluid.Program(), fluid.Program()
     scope = fluid.Scope()
@@ -391,6 +411,7 @@ def build_transformer_bench(batch=None, src_len=None, trg_len=None,
     use_flash = os.environ.get("BENCH_FLASH", "1") == "1"
     cfg = nmt.transformer_big_nmt(dropout=0.1, attn_dropout=0.0,
                                   use_flash=use_flash,
+                                  **_bench_flash_blocks(),
                                   **_bench_layers(n_layers))
     main_prog, startup = fluid.Program(), fluid.Program()
     scope = fluid.Scope()
@@ -639,6 +660,20 @@ def _error_line(model, err, cpu_validated=None):
     return out
 
 
+def _partial_lines(models, done, reason):
+    """Result lines owed when the run is cut short (SIGTERM from the
+    harness `timeout -k`, etc.): one error line per model that has not
+    printed yet, plus a bench_partial_summary record. Pure function so
+    the signal path is unit-testable (the real handler os._exits)."""
+    done = set(done)
+    lines = [_error_line(m, reason) for m in models if m not in done]
+    summary = {"kind": "bench_partial_summary",
+               "models": list(models),
+               "completed": [m for m in models if m in done],
+               "reason": reason}
+    return lines, summary
+
+
 def main(argv=None):
     """Always prints exactly one parseable JSON line per selected
     model, even when the TPU tunnel is wedged or a bench crashes — a
@@ -668,6 +703,29 @@ def main(argv=None):
     models = [m for m in models if m in _METRICS] or ["bert"]
 
     log = _log_path()
+    done = set()
+
+    def _on_term(signum, frame):
+        # the harness runs bench under `timeout -k`: SIGTERM arrives
+        # first, so flush error lines for every unfinished model plus a
+        # summary before the follow-up SIGKILL — the artifact stays one
+        # parseable line per selected model no matter where we died
+        reason = f"killed: signal {signum} before completion"
+        lines, summary = _partial_lines(models, done, reason)
+        for line in lines:
+            print(json.dumps(line), flush=True)
+            _emit(log, {"kind": "bench_result", "ts": time.time(),
+                        **line})
+        summary["ts"] = time.time()
+        print(json.dumps(summary), flush=True)
+        _emit(log, summary)
+        os._exit(128 + signum)
+
+    try:
+        import signal
+        signal.signal(signal.SIGTERM, _on_term)
+    except (ValueError, OSError):  # non-main thread / exotic platform
+        pass
     monitor_on = False
     try:
         from paddle_tpu import monitor
@@ -691,6 +749,7 @@ def main(argv=None):
             print(json.dumps(line), flush=True)
             _emit(log, {"kind": "bench_result", "ts": time.time(),
                         **line})
+            done.add(m)
         return
 
     # Persistent compilation cache: repeat sweep configs skip the
@@ -726,6 +785,7 @@ def main(argv=None):
                 print(json.dumps(line), flush=True)
                 _emit(log, {"kind": "bench_result", "ts": time.time(),
                             **line})
+                done.add(skip)
             break
         t0 = time.time()
         try:
@@ -735,6 +795,7 @@ def main(argv=None):
         prev_elapsed = time.time() - t0
         print(json.dumps(line), flush=True)
         _emit(log, {"kind": "bench_result", "ts": time.time(), **line})
+        done.add(m)
         if monitor_on:
             try:
                 from paddle_tpu import monitor

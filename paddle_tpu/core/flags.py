@@ -212,19 +212,38 @@ DEFINE_int32(
 
 DEFINE_int32(
     "flash_attention_block_q", 512,
-    "Default q-block tile for the Pallas flash-attention kernel when the "
-    "op attr does not specify one. Multiples of 128 only; clamped to the "
-    "largest divisor of the (padded) sequence. 512 is the measured v5e "
-    "winner at seq 512/1024/2048 — 2x faster fwd+bwd than XLA composed "
-    "attention, where 128 was 2-4x SLOWER (PERF.md r05 attention "
-    "microbench).", traced=True)
+    "Fallback q-block tile for the Pallas flash-attention kernel when "
+    "the op attr is unset AND the autotune cache has no entry for the "
+    "shape (FLAGS_flash_autotune). Multiples of 128 only; clamped to "
+    "the largest divisor of the (padded) sequence. 512 is the measured "
+    "v5e winner at seq 512/1024/2048 — 2x faster fwd+bwd than XLA "
+    "composed attention, where 128 was 2-4x SLOWER (PERF.md r05 "
+    "attention microbench; docs/attention_tuning.md).", traced=True)
 
 DEFINE_int32(
     "flash_attention_block_k", 512,
-    "Default k-block tile for the Pallas flash-attention kernel when the "
-    "op attr does not specify one. Multiples of 128 only; clamped like "
-    "block_q. See flash_attention_block_q for the measured basis.",
-    traced=True)
+    "Fallback k-block tile for the Pallas flash-attention kernel when "
+    "the op attr is unset and the autotune cache misses. Multiples of "
+    "128 only; clamped like block_q. See flash_attention_block_q for "
+    "the measured basis.", traced=True)
+
+DEFINE_string(
+    "flash_autotune", "cached",
+    "Flash-attention tile autotuner mode (ops/pallas/autotune.py): "
+    "'off' = flags/attrs only; 'cached' (default) = consult the "
+    "process memo + persistent JSON cache but never tune (a miss falls "
+    "back to FLAGS_flash_attention_block_{q,k} — CPU/tier-1 runs pay "
+    "one dict lookup, no sweep); 'full' = on a cache miss time the "
+    "{128,256,512} candidate grid on the real device, memoize and "
+    "persist the winner. Interpret/CPU mode never sweeps.", traced=True)
+
+DEFINE_string(
+    "flash_autotune_cache", "",
+    "Path of the persistent flash-tile cache (JSON). Empty = "
+    "flash_autotune.json alongside the JAX compilation cache dir, or "
+    "~/.cache/paddle_tpu when no compilation cache is configured. "
+    "Seed it from real chip time with tools/attn_micro.py "
+    "--emit-cache.")
 
 DEFINE_bool(
     "pallas_interpret", False,

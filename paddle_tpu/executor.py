@@ -182,6 +182,19 @@ class Executor:
             state[n] = v if isinstance(v, jax.Array) else jnp.asarray(v)
         return step_fn, state, feed_arrays
 
+    @staticmethod
+    def _canon_feed_dtype(dt):
+        """The dtype a feed actually has once it reaches the jitted step.
+
+        With x64 disabled (the default here), jnp.asarray/jax.device_put
+        narrow int64->int32 and float64->float32. Casting host arrays to
+        the canonical dtype up front keeps the executable-cache key
+        identical whether a feed arrives as numpy or as a device-resident
+        jax.Array — otherwise the same logical batch keys as 'int64' on
+        the numpy path and 'int32' on the device path and compiles twice.
+        """
+        return np.dtype(jax.dtypes.canonicalize_dtype(dt))
+
     def _prepare_feed(self, block, feed, compiled):
         t0 = time.perf_counter()
         out = {}
@@ -192,7 +205,8 @@ class Executor:
                 # (the TPU analogue of the reference's double-buffered
                 # reader keeping batches device-side, buffered_reader.cc)
                 if block.has_var(name):
-                    want = as_np_dtype(block.var(name).dtype)
+                    want = self._canon_feed_dtype(
+                        as_np_dtype(block.var(name).dtype))
                     if val.dtype != want:
                         val = val.astype(want)  # on-device cast
                 out[name] = val
@@ -208,7 +222,8 @@ class Executor:
                     padded, lengths = val.to_padded(multiple=8)
                     ln = block.program.lod_link.get(name)
                     if ln and block.has_var(ln) and ln not in feed:
-                        out[ln] = np.asarray(lengths, np.int64)
+                        out[ln] = np.asarray(
+                            lengths, self._canon_feed_dtype(np.int64))
                     elif not ln:
                         import warnings
                         warnings.warn(
@@ -221,9 +236,12 @@ class Executor:
                     val = val.numpy_value()
             arr = np.asarray(val)
             if block.has_var(name):
-                want = as_np_dtype(block.var(name).dtype)
-                if arr.dtype != want:
-                    arr = arr.astype(want)
+                want = self._canon_feed_dtype(
+                    as_np_dtype(block.var(name).dtype))
+            else:
+                want = self._canon_feed_dtype(arr.dtype)
+            if arr.dtype != want:
+                arr = arr.astype(want)
             out[name] = arr
         # Dense-feed fallback for ragged-declared vars: a lod_level>0
         # program hard-wires Lengths inputs at build time, but a user may
@@ -236,7 +254,7 @@ class Executor:
                 arr = out[name]
                 if arr.ndim >= 2:
                     out[ln] = np.full((arr.shape[0],), arr.shape[1],
-                                      np.int64)
+                                      self._canon_feed_dtype(np.int64))
         if _monitor_on():
             total = host = 0
             for a in out.values():

@@ -638,15 +638,24 @@ def mul(x, y, x_num_col_dims=1, y_num_col_dims=1, name=None):
     return out
 
 
-def flash_attention(q, k, v, causal=False, sm_scale=None, block_q=128,
-                    block_k=128, attn_dropout=0.0, name=None):
+def flash_attention(q, k, v, causal=False, sm_scale=None, block_q=None,
+                    block_k=None, attn_dropout=0.0, name=None):
     """Fused attention over [b, h, t, d] q/k/v (Pallas kernel,
-    ops/pallas/flash_attention.py; exact fallback when dropout is on)."""
+    ops/pallas/flash_attention.py; exact fallback when dropout is on).
+
+    block_q/block_k=None (the default) OMITS the tile attrs from the op,
+    so FLAGS_flash_attention_block_{q,k} — and the autotune cache when
+    FLAGS_flash_autotune enables it — govern the Pallas tile at lowering
+    time. Pass explicit ints to pin a tile (0 = force the exact path)."""
     helper = LayerHelper("flash_attention", name=name)
     out = helper.create_variable_for_type_inference(q.dtype)
     # is_test present so clone(for_test=True) turns attention dropout off
-    attrs = {"causal": causal, "block_q": block_q, "block_k": block_k,
-             "attn_dropout": float(attn_dropout), "is_test": False}
+    attrs = {"causal": causal, "attn_dropout": float(attn_dropout),
+             "is_test": False}
+    if block_q is not None:
+        attrs["block_q"] = block_q
+    if block_k is not None:
+        attrs["block_k"] = block_k
     if sm_scale is not None:
         attrs["sm_scale"] = float(sm_scale)
     helper.append_op(type="flash_attention",

@@ -21,6 +21,7 @@ import math
 from .. import layers
 from ..framework import ParamAttr
 from ..initializer import Normal
+from . import transformer
 from .transformer import TransformerConfig, _dense
 
 
@@ -61,10 +62,15 @@ def _mha(q_in, kv_in, cfg, prefix, causal):
         k = layers.shard_hint(k, [cfg.dp_axis, cfg.tp_axis, None, None])
         v = layers.shard_hint(v, [cfg.dp_axis, cfg.tp_axis, None, None])
     self_attn = q_in is kv_in
-    bq = min(128, tq) if (cfg.use_flash and self_attn) else 0
+    if cfg.use_flash and self_attn:
+        # unset attrs: the flags/autotuner pick the Pallas tile at
+        # lowering time (transformer._flash_block_attrs semantics)
+        blk = transformer._flash_block_attrs(cfg)
+    else:
+        blk = {"block_q": 0, "block_k": 0}  # exact composed path
     ctx = layers.flash_attention(
         q, k, v, causal=causal, sm_scale=1.0 / math.sqrt(hd),
-        block_q=bq, block_k=bq, attn_dropout=cfg.attn_dropout)
+        attn_dropout=cfg.attn_dropout, **blk)
     ctx = layers.transpose(ctx, [0, 2, 1, 3])
     ctx = layers.reshape(ctx, [b, tq, d])
     return _dense(ctx, d, f"{prefix}.proj", cfg, tp_axis="row")

@@ -25,7 +25,8 @@ class TransformerConfig:
                  n_layers=12, d_ff=3072, max_seq_len=512, dropout=0.1,
                  tp=False, sp=False, dp_axis="dp", tp_axis="tp",
                  sp_axis="sp", use_flash="auto", causal=False,
-                 attn_dropout=None):
+                 attn_dropout=None, flash_block_q=None,
+                 flash_block_k=None):
         self.vocab_size = vocab_size
         self.d_model = d_model
         self.n_heads = n_heads
@@ -40,16 +41,23 @@ class TransformerConfig:
         # attention WEIGHTS is a separate knob: the flash kernel does not
         # implement it, so attn_dropout > 0 forces the composed path
         # (keeping the trained model identical across kernel choices).
-        # "auto" = flash on. Measured on v5e (PERF.md r05 attention
-        # microbench): with the 512-tile defaults the Pallas kernel is
-        # ~2x faster fwd+bwd than XLA composed attention at seq
-        # 512/1024/2048 (e.g. 2.64 vs 5.47 ms at seq 512). The earlier
-        # composed-wins reading (31.7% vs 19.9% MFU on BERT-base) was an
-        # artifact of the old 128-tile default, which loses 2-4x; the
-        # kernel itself takes the exact path below one 128 tile.
+        # "auto" = the seq-length heuristic: flash only beyond seq 1024,
+        # where the O(T^2) composed path starts losing outright. The r05
+        # microbench has blk=512 flash ~2x faster than composed at seq
+        # 512 too (2.64 vs 5.47 ms fwd+bwd) — but the earlier always-on
+        # flip shipped with a hard-coded 128 tile that lost 2-4x
+        # end-to-end (55.5k vs 88.4k tok/s, ADVICE r5-high), so "auto"
+        # stays conservative until an end-to-end run with tuned tiles
+        # confirms the win (docs/attention_tuning.md has the full
+        # history).
         if use_flash == "auto":
-            use_flash = True
+            use_flash = max_seq_len > 1024
         self.use_flash = use_flash
+        # Explicit Pallas tile override (op attrs). None = leave the
+        # attrs unset so FLAGS_flash_attention_block_{q,k} and the
+        # autotune cache (FLAGS_flash_autotune) govern at lowering time.
+        self.flash_block_q = flash_block_q
+        self.flash_block_k = flash_block_k
         self.causal = causal
         self.attn_dropout = dropout if attn_dropout is None else \
             attn_dropout
@@ -94,6 +102,21 @@ def _dense(x, size, name, cfg, act=None, tp_axis=None):
     return out
 
 
+def _flash_block_attrs(cfg):
+    """block_q/block_k kwargs for layers.flash_attention: 0/0 forces the
+    exact composed path when flash is off; explicit config tiles pin the
+    kernel; otherwise empty, leaving tile choice to the flags/autotuner
+    at lowering time."""
+    if not cfg.use_flash:
+        return {"block_q": 0, "block_k": 0}
+    kw = {}
+    if cfg.flash_block_q is not None:
+        kw["block_q"] = int(cfg.flash_block_q)
+    if cfg.flash_block_k is not None:
+        kw["block_k"] = int(cfg.flash_block_k)
+    return kw
+
+
 def _attention(x, cfg, prefix):
     b, t, d = x.shape[0], x.shape[1], cfg.d_model
     h = cfg.n_heads
@@ -113,11 +136,13 @@ def _attention(x, cfg, prefix):
         v = layers.shard_hint(v, [cfg.dp_axis, cfg.tp_axis, None, None])
     # Single op either way: the lowering picks the Pallas tiled kernel or
     # the exact fallback (dropout on / bad tile divisor) — causal mask and
-    # numerics are identical across paths (ops/attention.py).
-    bq = min(128, t) if cfg.use_flash else 0  # 0 = force exact path
+    # numerics are identical across paths (ops/attention.py). Tile attrs
+    # are only written when the config pins them; otherwise they stay
+    # unset so the flag/autotune defaults govern (no hard-coded tile).
     ctxv = layers.flash_attention(
         q, k, v, causal=cfg.causal, sm_scale=1.0 / math.sqrt(hd),
-        block_q=bq, block_k=bq, attn_dropout=cfg.attn_dropout)
+        attn_dropout=cfg.attn_dropout,
+        **_flash_block_attrs(cfg))
     ctxv = layers.transpose(ctxv, [0, 2, 1, 3])
     ctxv = layers.reshape(ctxv, [b, t, d])
     return _dense(ctxv, d, f"{prefix}.proj", cfg, tp_axis="row")

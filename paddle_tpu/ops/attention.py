@@ -12,15 +12,16 @@ from .pallas.flash_attention import flash_attention, reference_attention
 
 @register_op("flash_attention", stateful=True)
 def _flash_attention_op(ctx, ins, attrs):
-    from ..core.flags import FLAGS
     q, k, v = ins["Q"][0], ins["K"][0], ins["V"][0]
     causal = attrs.get("causal", False)
     sm_scale = attrs.get("sm_scale", None)
     dropout = 0.0 if ctx.is_test else attrs.get("attn_dropout", 0.0)
-    # tile sizes: op attr wins; FLAGS_flash_attention_block_{q,k} give the
-    # session default (tunable without rebuilding the program)
-    bq = attrs.get("block_q", FLAGS.flash_attention_block_q)
-    bk = attrs.get("block_k", FLAGS.flash_attention_block_k)
+    # tile sizes: an explicit op attr wins; absent attrs stay None so
+    # the kernel-level default applies — autotuned tiles when the cache
+    # knows this shape, else FLAGS_flash_attention_block_{q,k}
+    # (ops/pallas/autotune.py). block_q=0 requests the exact path.
+    bq = attrs.get("block_q")
+    bk = attrs.get("block_k")
     if bq == 0:  # explicit exact-path request
         out = reference_attention(q, k, v, causal=causal, sm_scale=sm_scale,
                                   dropout=dropout,

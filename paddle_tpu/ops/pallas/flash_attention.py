@@ -399,11 +399,16 @@ def _pick_block(t, want):
     return t
 
 
-def flash_attention(q, k, v, causal=False, sm_scale=None, block_q=128,
-                    block_k=128):
+def flash_attention(q, k, v, causal=False, sm_scale=None, block_q=None,
+                    block_k=None):
     """q, k, v: [batch, heads, T, head_dim] (or [bh, T, d]).
     Returns attention output, same shape/dtype as q. Falls back to the
-    exact naive path when T has no usable tile divisor."""
+    exact naive path when T has no usable tile divisor.
+
+    block_q/block_k=None (the default) delegates tile choice to the
+    autotuner (ops/pallas/autotune.py: memo -> persistent cache ->
+    timed sweep under FLAGS_flash_autotune=full) and, on a miss, to
+    FLAGS_flash_attention_block_{q,k} — no call path pins a tile."""
     orig_shape = q.shape
     if q.ndim == 4:
         b, h, t, d = q.shape
@@ -428,8 +433,23 @@ def flash_attention(q, k, v, causal=False, sm_scale=None, block_q=128,
         q = jnp.pad(q, pad)
         k = jnp.pad(k, pad)
         v = jnp.pad(v, pad)
+    if block_q is None or block_k is None:
+        from ...core.flags import FLAGS
+        from . import autotune
+        tuned = autotune.resolve(t_pad, d, q.dtype, causal)
+        dq, dk = tuned if tuned is not None else (
+            FLAGS.flash_attention_block_q, FLAGS.flash_attention_block_k)
+        if block_q is None:
+            block_q = dq
+        if block_k is None:
+            block_k = dk
     block_q = _pick_block(t_pad, block_q)
     block_k = _pick_block(t_pad, block_k)
+    # trace-time gauges: the tile the compiled program actually runs
+    # (the sweep ledger's "blk512 really means 512" evidence)
+    from ...monitor import STAT_SET
+    STAT_SET("flash.block_q", block_q)
+    STAT_SET("flash.block_k", block_k)
     out = _flash(q, k, v, float(sm_scale), bool(causal), block_q, block_k,
                  t)
     if t_pad != t:

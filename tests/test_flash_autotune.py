@@ -1,0 +1,249 @@
+"""Flash-attention tile autotuner + end-to-end block plumbing.
+
+Covers the lowering-time tile resolution order (explicit op attr >
+autotune cache > FLAGS_flash_attention_block_{q,k}), numerics parity
+across tiles, the persistent JSON cache round trip (including the
+tools/attn_micro.py --emit-cache writer), the monitor counters/gauges,
+and bench.py's partial-results contract. See docs/attention_tuning.md.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, monitor
+from paddle_tpu.core.flags import FLAGS
+from paddle_tpu.ops.pallas import autotune
+from paddle_tpu.ops.pallas.flash_attention import (flash_attention,
+                                                   reference_attention)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+
+@pytest.fixture
+def _restore_flash_flags():
+    prev = {
+        "FLAGS_enable_monitor": FLAGS.enable_monitor,
+        "FLAGS_flash_attention_block_q": FLAGS.flash_attention_block_q,
+        "FLAGS_flash_attention_block_k": FLAGS.flash_attention_block_k,
+        "FLAGS_flash_autotune": FLAGS.flash_autotune,
+        "FLAGS_flash_autotune_cache": FLAGS.flash_autotune_cache,
+    }
+    yield
+    fluid.set_flags(prev)
+    autotune.reset_memo()
+    monitor.STAT_RESET()
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("blk", [8, 16, None])
+def test_flash_parity_across_blocks(blk, causal, _restore_flash_flags):
+    """Tiled kernel == exact attention whatever tile is requested:
+    sub-128 asks are clamped up by _pick_block, None delegates to the
+    flag/autotune default — numerics must not depend on the tile."""
+    fluid.set_flags({"FLAGS_flash_autotune": "off"})
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(2, 256, 8), jnp.float32)
+    k = jnp.asarray(rng.randn(2, 256, 8), jnp.float32)
+    v = jnp.asarray(rng.randn(2, 256, 8), jnp.float32)
+    out = flash_attention(q, k, v, causal=causal, block_q=blk, block_k=blk)
+    ref = reference_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_flags_govern_unset_blocks_and_explicit_attr_wins(
+        _restore_flash_flags):
+    """Regression for the unpinned tile path: with block attrs unset the
+    FLAGS defaults choose the tile; an explicit block_q/block_k beats
+    the flag. Asserted via the trace-time flash.block_{q,k} gauges."""
+    fluid.set_flags({"FLAGS_enable_monitor": True,
+                     "FLAGS_flash_autotune": "off"})
+    monitor.STAT_RESET()
+    rng = np.random.RandomState(1)
+    q = jnp.asarray(rng.randn(2, 256, 8), jnp.float32)
+
+    fluid.set_flags({"FLAGS_flash_attention_block_q": 128,
+                     "FLAGS_flash_attention_block_k": 128})
+    flash_attention(q, q, q)
+    g = monitor.get_stats_snapshot()["gauges"]
+    assert g["flash.block_q"] == 128 and g["flash.block_k"] == 128
+
+    fluid.set_flags({"FLAGS_flash_attention_block_q": 256,
+                     "FLAGS_flash_attention_block_k": 256})
+    flash_attention(q, q, q)
+    g = monitor.get_stats_snapshot()["gauges"]
+    assert g["flash.block_q"] == 256 and g["flash.block_k"] == 256
+
+    # explicit attr wins over the flag
+    flash_attention(q, q, q, block_q=128, block_k=128)
+    g = monitor.get_stats_snapshot()["gauges"]
+    assert g["flash.block_q"] == 128 and g["flash.block_k"] == 128
+
+
+def test_layer_omits_block_attrs_when_unset():
+    """layers.flash_attention must NOT bake a tile into the program when
+    the caller leaves blocks unset (the old min(128, t) pin) — absent
+    attrs are what lets the flags/autotuner govern per process."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        q = layers.data("q", shape=[2, 4, 256, 8], dtype="float32",
+                        append_batch_size=False)
+        layers.flash_attention(q, q, q, causal=False)
+        layers.flash_attention(q, q, q, causal=False, block_q=128,
+                               block_k=128)
+    ops = [op for op in main.global_block().ops
+           if op.type == "flash_attention"]
+    assert len(ops) == 2
+    assert "block_q" not in ops[0].attrs and "block_k" not in ops[0].attrs
+    assert ops[1].attrs["block_q"] == 128 and ops[1].attrs["block_k"] == 128
+
+
+def test_model_configs_carry_no_pinned_tile():
+    """The transformer/nmt model builders must not hard-pin a flash tile
+    unless the config asks for one (flash_block_q/k)."""
+    from paddle_tpu.models import transformer
+
+    cfg = transformer.bert_base(use_flash=True)
+    assert transformer._flash_block_attrs(cfg) == {}
+    cfg = transformer.bert_base(use_flash=True, flash_block_q=512,
+                                flash_block_k=512)
+    assert transformer._flash_block_attrs(cfg) == {"block_q": 512,
+                                                   "block_k": 512}
+    cfg = transformer.bert_base(use_flash=False)
+    assert transformer._flash_block_attrs(cfg) == {"block_q": 0,
+                                                   "block_k": 0}
+    # use_flash="auto" stays on the composed path at bench seq lengths
+    assert not transformer.bert_base(use_flash="auto",
+                                     max_seq_len=512).use_flash
+    assert transformer.bert_base(use_flash="auto",
+                                 max_seq_len=2048).use_flash
+
+
+def test_autotune_cache_roundtrip_and_counters(tmp_path,
+                                               _restore_flash_flags):
+    path = str(tmp_path / "flash_autotune.json")
+    fluid.set_flags({"FLAGS_enable_monitor": True,
+                     "FLAGS_flash_autotune": "cached",
+                     "FLAGS_flash_autotune_cache": path})
+    autotune.reset_memo()
+    monitor.STAT_RESET()
+
+    # miss: no file yet -> flag default governs (resolve returns None)
+    assert autotune.resolve(256, 8, "float32", False) is None
+    c = monitor.get_stats_snapshot()["counters"]
+    assert c.get("flash.autotune_cache_miss") == 1
+
+    key = autotune.cache_key(256, 8, "float32", False)
+    autotune.store({key: {"block_q": 256, "block_k": 128}}, path,
+                   source="test")
+    assert autotune.resolve(256, 8, "float32", False) == (256, 128)
+    # second resolve answers from the process memo
+    assert autotune.resolve(256, 8, "float32", False) == (256, 128)
+    c = monitor.get_stats_snapshot()["counters"]
+    assert c.get("flash.autotune_cache_hit") == 2
+
+    # the stored file is versioned + merge-safe
+    doc = json.load(open(path))
+    assert doc["version"] == autotune.CACHE_VERSION
+    assert doc["entries"][key]["source"] == "test"
+    autotune.store({"other": {"block_q": 512, "block_k": 512}}, path)
+    assert set(autotune.load_cache(path)) == {key, "other"}
+
+    # corrupt file: resolve degrades to a miss, never raises
+    with open(path, "w") as f:
+        f.write("not json{")
+    autotune.reset_memo()
+    assert autotune.load_cache(path) == {}
+    assert autotune.resolve(256, 8, "float32", False) is None
+
+    # off mode skips even the lookup
+    fluid.set_flags({"FLAGS_flash_autotune": "off"})
+    autotune.reset_memo()
+    monitor.STAT_RESET()
+    assert autotune.resolve(256, 8, "float32", False) is None
+    c = monitor.get_stats_snapshot()["counters"]
+    assert "flash.autotune_cache_miss" not in c
+
+    fluid.set_flags({"FLAGS_flash_autotune": "bogus"})
+    with pytest.raises(ValueError):
+        autotune.resolve(256, 8, "float32", False)
+
+
+def test_cached_tile_drives_kernel(tmp_path, _restore_flash_flags):
+    """A persistent-cache entry actually changes the lowered tile when
+    the op leaves blocks unset (gauge evidence), and kernel numerics
+    stay exact."""
+    path = str(tmp_path / "flash_autotune.json")
+    fluid.set_flags({"FLAGS_enable_monitor": True,
+                     "FLAGS_flash_autotune": "cached",
+                     "FLAGS_flash_autotune_cache": path,
+                     "FLAGS_flash_attention_block_q": 256,
+                     "FLAGS_flash_attention_block_k": 256})
+    autotune.store({autotune.cache_key(256, 8, "float32", False):
+                    {"block_q": 128, "block_k": 128}}, path)
+    autotune.reset_memo()
+    monitor.STAT_RESET()
+    rng = np.random.RandomState(2)
+    q = jnp.asarray(rng.randn(2, 256, 8), jnp.float32)
+    out = flash_attention(q, q, q)
+    g = monitor.get_stats_snapshot()["gauges"]
+    assert g["flash.block_q"] == 128 and g["flash.block_k"] == 128
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(reference_attention(q, q, q)),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_attn_micro_emit_cache_roundtrip(tmp_path, _restore_flash_flags):
+    """tools/attn_micro.py --emit-cache writes a cache a fresh cached-mode
+    process resolves from (the one-microbench-tunes-every-process flow)."""
+    path = str(tmp_path / "emitted.json")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "attn_micro.py"),
+         "--seqs", "128", "--bh", "2", "--d", "8", "--blocks", "128",
+         "--iters", "1", "--emit-cache", path],
+        env=env, capture_output=True, text=True, timeout=560)
+    assert out.returncode == 0, out.stderr[-2000:]
+    entries = autotune.load_cache(path)
+    key = autotune.cache_key(128, 8, "bfloat16", False)
+    assert entries[key]["block_q"] == 128
+    assert entries[key]["source"] == "attn_micro"
+
+    fluid.set_flags({"FLAGS_flash_autotune": "cached",
+                     "FLAGS_flash_autotune_cache": path})
+    autotune.reset_memo()
+    assert autotune.resolve(128, 8, "bfloat16", False) == (128, 128)
+
+
+def test_bench_partial_lines_and_flash_block_env(monkeypatch):
+    import bench
+
+    lines, summary = bench._partial_lines(
+        ["bert", "resnet50", "gpt"], {"bert"}, "killed: signal 15")
+    assert [ln["metric"] for ln in lines] == [
+        "resnet50_imagenet_images_per_sec_per_chip",
+        "gpt_small_pretrain_tokens_per_sec_per_chip"]
+    assert all(ln["error"] == "killed: signal 15" and ln["value"] == 0.0
+               for ln in lines)
+    assert summary["kind"] == "bench_partial_summary"
+    assert summary["completed"] == ["bert"]
+    json.dumps([summary, *lines])  # the artifact must stay parseable
+
+    monkeypatch.delenv("BENCH_FLASH_BLOCK", raising=False)
+    assert bench._bench_flash_blocks() == {}
+    monkeypatch.setenv("BENCH_FLASH_BLOCK", "512")
+    assert bench._bench_flash_blocks() == {"flash_block_q": 512,
+                                           "flash_block_k": 512}
+    monkeypatch.setenv("BENCH_FLASH_BLOCK", "512,256")
+    assert bench._bench_flash_blocks() == {"flash_block_q": 512,
+                                           "flash_block_k": 256}

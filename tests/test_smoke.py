@@ -120,6 +120,30 @@ def test_device_array_feed_passthrough():
     np.testing.assert_allclose(out_cast, out_np, rtol=1e-6)
 
 
+def test_int64_feed_dtype_canonicalized_shares_cache():
+    """With x64 off, jax.device_put narrows int64->int32; the numpy feed
+    path must canonicalize to the same dtype so both forms share one
+    executable instead of compiling twice (_canon_feed_dtype)."""
+    import jax
+
+    main, startup = _fresh_programs()
+    with fluid.program_guard(main, startup):
+        ids = layers.data("ids", shape=[4], dtype="int64",
+                          append_batch_size=False)
+        y = layers.scale(layers.cast(ids, "float32"), scale=2.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    iv = np.arange(4, dtype=np.int64)
+    out_np, = exe.run(main, feed={"ids": iv}, fetch_list=[y])
+    n_cached = len(exe._cache)
+    out_dev, = exe.run(main, feed={"ids": jax.device_put(iv)},
+                       fetch_list=[y])
+    np.testing.assert_allclose(out_dev, out_np)
+    assert len(exe._cache) == n_cached, (
+        "int64 numpy feed and its device_put form must key the same "
+        "executable (dtype canonicalization in _prepare_feed)")
+
+
 def test_scope_pool_clear():
     """App-D scope pool: leaked scopes can be bulk-released
     (framework/scope_pool.h semantics) without breaking live ones."""

@@ -54,10 +54,20 @@ def main():
     ap.add_argument("--d", type=int, default=64)
     ap.add_argument("--blocks", default="128,256,512",
                     help="flash block tiles to sweep (q=k)")
+    ap.add_argument("--iters", type=int, default=20,
+                    help="timed iterations per variant")
+    ap.add_argument("--emit-cache", default="",
+                    help="write each seq's winning flash tile into the "
+                         "autotune JSON cache at this path (seeds "
+                         "FLAGS_flash_autotune=cached processes; see "
+                         "ops/pallas/autotune.py)")
     args = ap.parse_args()
+
+    from paddle_tpu.ops.pallas import autotune
 
     d = args.d
     k0 = jax.random.PRNGKey(0)
+    cache_entries = {}
     for t in [int(s) for s in args.seqs.split(",")]:
         # hold tokens ~constant so long-seq rows fit HBM
         bh = args.bh if t <= 512 else max(8, args.bh * 512 // t)
@@ -70,10 +80,11 @@ def main():
                            .astype(jnp.float32))
 
         rows = []
-        fwd = timed(loss_ref, q, k, v)
+        fwd = timed(loss_ref, q, k, v, n=args.iters)
         g = jax.grad(loss_ref, argnums=(0, 1, 2))
         bwd = timed(lambda q, k, v: sum(
-            jnp.sum(x.astype(jnp.float32)) for x in g(q, k, v)), q, k, v)
+            jnp.sum(x.astype(jnp.float32)) for x in g(q, k, v)), q, k, v,
+            n=args.iters)
         rows.append(("xla", None, fwd, bwd))
 
         for blk in [int(b) for b in args.blocks.split(",")]:
@@ -85,11 +96,11 @@ def main():
                     flash_attention(q, k, v, block_q=_blk, block_k=_blk)
                     .astype(jnp.float32))
 
-            fwd = timed(loss_flash, q, k, v)
+            fwd = timed(loss_flash, q, k, v, n=args.iters)
             gf = jax.grad(loss_flash, argnums=(0, 1, 2))
             bwd = timed(lambda q, k, v: sum(
                 jnp.sum(x.astype(jnp.float32)) for x in gf(q, k, v)),
-                q, k, v)
+                q, k, v, n=args.iters)
             rows.append(("flash", blk, fwd, bwd))
 
         best = min(rows, key=lambda r: r[3])
@@ -98,6 +109,21 @@ def main():
             star = "  <- winner" if (name, blk) == best[:2] else ""
             print(f"seq {t} bh {bh}: {tag}: fwd {fwd * 1e3:.2f} ms  "
                   f"fwd+bwd {bwd * 1e3:.2f} ms{star}", flush=True)
+
+        flash_rows = [r for r in rows if r[0] == "flash"]
+        if args.emit_cache and flash_rows:
+            # key by the kernel's padded seq so resolve() finds it
+            blk = min(flash_rows, key=lambda r: r[3])[1]
+            t_pad = -(-t // 128) * 128
+            cache_entries[autotune.cache_key(t_pad, d, "bfloat16",
+                                             False)] = \
+                {"block_q": int(blk), "block_k": int(blk)}
+
+    if args.emit_cache and cache_entries:
+        path = autotune.store(cache_entries, args.emit_cache,
+                              source="attn_micro")
+        print(f"wrote {len(cache_entries)} autotune entries -> {path}",
+              flush=True)
 
 
 if __name__ == "__main__":

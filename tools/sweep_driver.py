@@ -63,9 +63,11 @@ CONFIGS = [
                          "BENCH_MLM": "1"}),
     ("bert_mlm_f1_b64", {"BENCH_FLASH": "1", "BENCH_BATCH": "64",
                          "BENCH_MLM": "1"}),
-    ("bert_f1blk512_b32", {"BENCH_FLASH": "1", "BENCH_BATCH": "32"}),
+    ("bert_f1blk512_b32", {"BENCH_FLASH": "1", "BENCH_BATCH": "32",
+                           "BENCH_FLASH_BLOCK": "512"}),
     ("bert_f1blk512_b16_s1024", {"BENCH_FLASH": "1", "BENCH_BATCH": "16",
-                                 "BENCH_SEQ": "1024"}),
+                                 "BENCH_SEQ": "1024",
+                                 "BENCH_FLASH_BLOCK": "512"}),
     # fresh key: the old resnet50_b64 entry predates the device-staged
     # feed fix (its 10.7 img/s measured the tunnel H2D, not the chip)
     # and must not be re-run into the same series
