@@ -56,6 +56,46 @@ def _emit(log_path, record):
         print(f"# bench log write failed: {e}", file=sys.stderr)
 
 
+def _summary_path() -> str:
+    """The top-level JSON summary artifact (BENCH_SUMMARY env, default
+    bench_summary.json). Unlike the JSONL log this is ONE json.load-able
+    document: written ahead (status "running") before any bench starts
+    and atomically replaced after every result, so the file parses at
+    every instant of the run — including the instant `timeout -k` kills
+    it (the BENCH_r05 rc=124/parsed:null failure mode)."""
+    return os.environ.get("BENCH_SUMMARY", "bench_summary.json")
+
+
+def _write_summary(path, obj):
+    """Atomic replace (tmp + fsync + os.replace): readers never observe
+    a torn or truncated summary."""
+    try:
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(obj, f, indent=1)
+            f.write("\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except OSError as e:
+        print(f"# bench summary write failed: {e}", file=sys.stderr)
+
+
+def _flight_path() -> str:
+    """Crash flight-recorder dump target: BENCH_FLIGHT env >
+    FLAGS_flight_recorder_path > bench_flight.jsonl."""
+    p = os.environ.get("BENCH_FLIGHT")
+    if p:
+        return p
+    try:
+        from paddle_tpu.core.flags import FLAGS
+        if FLAGS.flight_recorder_path:
+            return FLAGS.flight_recorder_path
+    except Exception:  # noqa: BLE001 — path lookup must never kill bench
+        pass
+    return "bench_flight.jsonl"
+
+
 def _record_bench_stats(flops_per_step):
     """Feed the monitor the model's per-step flops + the chip peak so
     tools/metrics_report.py can derive MFU from the step-time histogram
@@ -702,8 +742,37 @@ def main(argv=None):
                       "deeplab"]}.get(model, [model])
     models = [m for m in models if m in _METRICS] or ["bert"]
 
+    # BENCH_PLATFORM=cpu runs the whole bench in-process on the forced
+    # backend (no TPU probe, no CPU-validate subprocesses) — used by the
+    # kill-resilience test and for plumbing work without a chip
+    forced_platform = os.environ.get("BENCH_PLATFORM", "")
+    if forced_platform:
+        try:
+            import jax
+            jax.config.update("jax_platforms", forced_platform)
+        except Exception as e:  # noqa: BLE001 — leave the default backend
+            print(f"# BENCH_PLATFORM={forced_platform} failed: {e}",
+                  file=sys.stderr)
+
     log = _log_path()
+    flight = _flight_path()
+    summary_path = _summary_path()
     done = set()
+    results = []
+    # write-ahead: the artifact parses before the first model starts
+    summary = {"kind": "bench_summary", "status": "running",
+               "models": list(models), "completed": [], "results": [],
+               "ts_start": t_start}
+    _write_summary(summary_path, summary)
+
+    def _finalize_summary(status, reason=None):
+        summary["status"] = status
+        summary["completed"] = [m for m in models if m in done]
+        summary["results"] = results
+        if reason is not None:
+            summary["reason"] = reason
+        summary["ts_end"] = time.time()
+        _write_summary(summary_path, summary)
 
     def _on_term(signum, frame):
         # the harness runs bench under `timeout -k`: SIGTERM arrives
@@ -711,14 +780,22 @@ def main(argv=None):
         # summary before the follow-up SIGKILL — the artifact stays one
         # parseable line per selected model no matter where we died
         reason = f"killed: signal {signum} before completion"
-        lines, summary = _partial_lines(models, done, reason)
+        lines, partial = _partial_lines(models, done, reason)
         for line in lines:
             print(json.dumps(line), flush=True)
             _emit(log, {"kind": "bench_result", "ts": time.time(),
                         **line})
-        summary["ts"] = time.time()
-        print(json.dumps(summary), flush=True)
-        _emit(log, summary)
+            results.append(line)
+        partial["ts"] = time.time()
+        print(json.dumps(partial), flush=True)
+        _emit(log, partial)
+        _finalize_summary("killed", reason=reason)
+        try:
+            from paddle_tpu import monitor
+            monitor.dump_flight_recorder(flight,
+                                         reason=f"signal {signum}")
+        except Exception:  # noqa: BLE001 — dying anyway
+            pass
         os._exit(128 + signum)
 
     try:
@@ -734,10 +811,16 @@ def main(argv=None):
             # periodic crash-safe snapshots: even a run killed by the
             # harness timeout leaves step/compile/feed stats behind
             monitor.start_exporter(log)
+        # post-mortems for crashes the SIGTERM path can't see (unhandled
+        # exceptions); SIGTERM itself stays with _on_term above
+        monitor.install_flight_recorder(flight, on_sigterm=False)
     except Exception as e:  # noqa: BLE001 — monitor must never kill bench
         print(f"# monitor unavailable: {e}", file=sys.stderr)
 
-    ok, detail = _probe_backend(budget_left())
+    if forced_platform:
+        ok, detail = True, f"forced platform {forced_platform}"
+    else:
+        ok, detail = _probe_backend(budget_left())
     if not ok:
         print(f"# {detail}", file=sys.stderr)
         # children inherit FLAGS_enable_monitor via env and flush their
@@ -749,7 +832,9 @@ def main(argv=None):
             print(json.dumps(line), flush=True)
             _emit(log, {"kind": "bench_result", "ts": time.time(),
                         **line})
+            results.append(line)
             done.add(m)
+        _finalize_summary("complete", reason=detail)
         return
 
     # Persistent compilation cache: repeat sweep configs skip the
@@ -785,6 +870,7 @@ def main(argv=None):
                 print(json.dumps(line), flush=True)
                 _emit(log, {"kind": "bench_result", "ts": time.time(),
                             **line})
+                results.append(line)
                 done.add(skip)
             break
         t0 = time.time()
@@ -795,13 +881,22 @@ def main(argv=None):
         prev_elapsed = time.time() - t0
         print(json.dumps(line), flush=True)
         _emit(log, {"kind": "bench_result", "ts": time.time(), **line})
+        results.append(line)
         done.add(m)
+        _finalize_summary("running")  # artifact parses mid-run too
         if monitor_on:
             try:
                 from paddle_tpu import monitor
                 monitor.snapshot_to_jsonl(log)
             except Exception as e:  # noqa: BLE001
                 print(f"# snapshot failed: {e}", file=sys.stderr)
+    _finalize_summary("complete")
+    try:
+        from paddle_tpu import monitor
+        if monitor.flight_records():
+            monitor.dump_flight_recorder(flight, reason="bench complete")
+    except Exception as e:  # noqa: BLE001 — post-mortem is best-effort
+        print(f"# flight recorder dump failed: {e}", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -251,6 +251,42 @@ DEFINE_bool(
     "numerics; very slow).", traced=True)
 
 DEFINE_bool(
+    "op_trace_scopes", True,
+    "Wrap each lowered op's emission in jax.named_scope("
+    "'{op.type}:{block}/{op_idx}') so XPlane device traces, HLO dumps, "
+    "and compiled-HLO op_name metadata attribute back to Program ops "
+    "(reference: platform/profiler.cc per-op RecordEvent). Scopes are "
+    "trace/metadata only — no runtime cost — so the default is on; "
+    "turn off to diff HLO text across op reorderings.", traced=True)
+
+DEFINE_bool(
+    "flight_recorder", True,
+    "Keep a bounded in-memory ring of per-step flight records (step "
+    "index, program, cache hit/miss, timings, stat deltas, NaN "
+    "provenance) that monitor.dump_flight_recorder writes as JSONL on "
+    "unhandled exception / SIGTERM (monitor.install_flight_recorder) "
+    "or on demand. One dict append per step — cheap enough to leave "
+    "on; the black box that turns 'the run died' into 'step N died'.")
+
+DEFINE_int32(
+    "flight_recorder_capacity", 512,
+    "Max records kept in the flight-recorder ring (oldest dropped "
+    "first). 512 steps of context is hours of large-model training "
+    "and a few KB of host memory.")
+
+DEFINE_string(
+    "flight_recorder_path", "",
+    "Default path for monitor.dump_flight_recorder / "
+    "install_flight_recorder when no explicit path is given. Empty = "
+    "flight_recorder.jsonl in the working directory.")
+
+DEFINE_int32(
+    "monitor_http_port", 0,
+    "When > 0, monitor.serve_prometheus() binds a stdlib HTTP scrape "
+    "endpoint on 127.0.0.1:<port> serving prometheus_text() (started "
+    "automatically by monitor.start_exporter). 0 = disabled.")
+
+DEFINE_bool(
     "enable_monitor", False,
     "Enable the runtime stats registry (paddle_tpu/monitor.py): "
     "executor compile/step/feed timing, reader queue stats, device "

@@ -18,6 +18,7 @@ Also here:
 """
 from __future__ import annotations
 
+import contextlib
 from typing import Dict, List
 
 import jax
@@ -60,41 +61,74 @@ def _gather_slot(env, names):
     return vals
 
 
-def _nan_inf_guard(op_type, name, val, in_control_flow):
+def _nan_inf_guard(op, name, val, in_control_flow, op_idx):
     """FLAGS_check_nan_inf: host callback on every float op output
     (reference operator.cc:820-822 checks every output tensor when the
     flag is set). Top level uses an ordered io_callback that RAISES on
     Inf/Nan; inside lax.cond/while_loop sub-blocks ordered effects are
     rejected by JAX, so the guard degrades to jax.debug.callback, which
-    reports loudly but cannot abort the run. Debug mode only."""
+    reports loudly but cannot abort the run. On a trip the full
+    provenance (op type, block/op index, offending output, input var
+    names) goes into the monitor's flight recorder before the raise, so
+    a post-mortem names the op even if the exception text is swallowed
+    by a retry loop. Debug mode only."""
     from jax.experimental import io_callback
 
-    def cb(arr):
+    op_type = op.type
+    block_idx = op.block.idx if getattr(op, "block", None) is not None \
+        else 0
+    in_names = [n for ns in op.inputs.values() for n in ns if n]
+    where = f"block {block_idx}/op {'?' if op_idx is None else op_idx}"
+    msg = (f"Operator {op_type!r} at {where} output {name!r} contains "
+           f"Inf/Nan; op inputs {in_names} (FLAGS_check_nan_inf)")
+
+    def _trip(arr):
         a = np.asarray(arr)
-        if not np.isfinite(a).all():
-            from ..monitor import STAT_ADD
-            STAT_ADD("executor.nan_inf_trips")
-            raise FloatingPointError(
-                f"Operator {op_type} output {name!r} contains Inf/Nan "
-                f"(FLAGS_check_nan_inf)")
+        if np.isfinite(a).all():
+            return False
+        from ..monitor import STAT_ADD, flight_record
+        STAT_ADD("executor.nan_inf_trips")
+        flight_record(
+            "nan_inf", op_type=op_type, block=block_idx,
+            op=(-1 if op_idx is None else op_idx), output=name,
+            inputs=in_names, shape=list(np.shape(a)),
+            n_nonfinite=int(np.size(a) - np.isfinite(a).sum()))
+        return True
+
+    def cb(arr):
+        if _trip(arr):
+            raise FloatingPointError(msg)
         return np.zeros((), np.bool_)
 
     if in_control_flow:
         def report(arr):
-            a = np.asarray(arr)
-            if not np.isfinite(a).all():
-                from ..monitor import STAT_ADD
-                STAT_ADD("executor.nan_inf_trips")
-                print(f"FLAGS_check_nan_inf: operator {op_type} output "
-                      f"{name!r} contains Inf/Nan (inside control flow; "
-                      f"run aborts are only possible at top level)")
+            if _trip(arr):
+                print(f"FLAGS_check_nan_inf: {msg} (inside control "
+                      f"flow; run aborts are only possible at top "
+                      f"level)")
         jax.debug.callback(report, val)
     else:
         io_callback(cb, jax.ShapeDtypeStruct((), np.bool_), val,
                     ordered=True)
 
 
-def run_op(op, env, ctx):
+def _op_scope(op, op_idx):
+    """jax.named_scope('{op.type}:{block}/{op_idx}') around one op's
+    emission (FLAGS_op_trace_scopes): the scope lands in the jaxpr name
+    stack, so HLO op_name metadata, MLIR debug locations, and XPlane
+    traces all attribute back to the Program op — the trace-side half
+    of the reference's per-op RecordEvent (platform/profiler.cc). Ops
+    lowered outside lower_block (shape inference) pass op_idx=None and
+    stay unscoped."""
+    from .flags import FLAGS
+    if op_idx is None or not FLAGS.op_trace_scopes:
+        return contextlib.nullcontext()
+    block_idx = op.block.idx if getattr(op, "block", None) is not None \
+        else 0
+    return jax.named_scope(f"{op.type}:{block_idx}/{op_idx}")
+
+
+def run_op(op, env, ctx, op_idx=None):
     """Execute one op's lowering against env (name -> array)."""
     from .flags import FLAGS
     opdef = REGISTRY.get(op.type)
@@ -107,34 +141,35 @@ def run_op(op, env, ctx):
     # live view of already-materialised vars — lets keep-previous-value
     # semantics (conditional_block false branch) read carried state
     opctx.env = env
-    try:
-        outs = opdef.lower(opctx, ins, op.attrs)
-    except Exception as e:
-        # operator attribution on failures (reference op_call_stack.cc:
-        # PADDLE_ENFORCE appends the Python-level op that emitted the
-        # kernel): name the op, its input slots/shapes, and attrs so
-        # users see WHICH Program op died, not just a jnp traceback
-        shapes = {s: [getattr(v, "shape", "?") for v in vs]
-                  for s, vs in ins.items()}
-        note = (f"[operator {op.type!r}] inputs {shapes} -> outputs "
-                f"{dict(op.outputs)}, attrs {op.attrs}")
-        if hasattr(e, "add_note"):  # PEP 678, Python >= 3.11
-            e.add_note(note)
-        else:
-            e.__notes__ = [*getattr(e, "__notes__", []), note]
-        raise
-    check = FLAGS.check_nan_inf
-    for slot, names in op.outputs.items():
-        if slot not in outs:
-            continue
-        vals = outs[slot]
-        for name, val in zip(names, vals):
-            if name:
-                env[name] = val
-                if check and hasattr(val, "dtype") and \
-                        is_floating(val.dtype):
-                    _nan_inf_guard(op.type, name, val,
-                                   ctx.in_control_flow)
+    with _op_scope(op, op_idx):
+        try:
+            outs = opdef.lower(opctx, ins, op.attrs)
+        except Exception as e:
+            # operator attribution on failures (reference op_call_stack.cc:
+            # PADDLE_ENFORCE appends the Python-level op that emitted the
+            # kernel): name the op, its input slots/shapes, and attrs so
+            # users see WHICH Program op died, not just a jnp traceback
+            shapes = {s: [getattr(v, "shape", "?") for v in vs]
+                      for s, vs in ins.items()}
+            note = (f"[operator {op.type!r}] inputs {shapes} -> outputs "
+                    f"{dict(op.outputs)}, attrs {op.attrs}")
+            if hasattr(e, "add_note"):  # PEP 678, Python >= 3.11
+                e.add_note(note)
+            else:
+                e.__notes__ = [*getattr(e, "__notes__", []), note]
+            raise
+        check = FLAGS.check_nan_inf
+        for slot, names in op.outputs.items():
+            if slot not in outs:
+                continue
+            vals = outs[slot]
+            for name, val in zip(names, vals):
+                if name:
+                    env[name] = val
+                    if check and hasattr(val, "dtype") and \
+                            is_floating(val.dtype):
+                        _nan_inf_guard(op, name, val,
+                                       ctx.in_control_flow, op_idx)
 
 
 class _OpCtx:
@@ -164,16 +199,16 @@ class _OpCtx:
         prev = self._ctx.in_control_flow
         self._ctx.in_control_flow = True
         try:
-            for op in block.ops:
-                run_op(op, env, self._ctx)
+            for i, op in enumerate(block.ops):
+                run_op(op, env, self._ctx, op_idx=i)
         finally:
             self._ctx.in_control_flow = prev
         return env
 
 
 def lower_block(block, env: Dict, ctx: LowerCtx):
-    for op in block.ops:
-        run_op(op, env, ctx)
+    for i, op in enumerate(block.ops):
+        run_op(op, env, ctx, op_idx=i)
     return env
 
 

@@ -27,6 +27,7 @@ from .core.scope import Scope, global_scope
 from .framework import Program, Variable
 from .monitor import STAT_ADD, STAT_OBSERVE, STAT_SET
 from .monitor import enabled as _monitor_on
+from .monitor import flight_step as _flight_step
 
 __all__ = ["Executor", "global_scope", "scope_guard"]
 
@@ -53,6 +54,7 @@ class Executor:
         # executables forever.
         self._cache: "OrderedDict[tuple, _CompiledStep]" = OrderedDict()
         self._step_counters: Dict[str, int] = {}
+        self._last_cache_hit = False
         # Strong refs to CompiledPrograms in the cache: keys use
         # id(compiled), which is only stable while the object is alive.
         self._compiled_refs: Dict[int, object] = {}
@@ -106,8 +108,8 @@ class Executor:
             out = [np.asarray(f) for f in fetches]
         else:
             out = list(fetches)
+        now = time.perf_counter()
         if _monitor_on():
-            now = time.perf_counter()
             # fetch/block time: device sync happens in np.asarray; with
             # return_numpy=False dispatch is async and this measures ~0
             STAT_OBSERVE("executor.fetch_block_seconds", now - t_fetch0)
@@ -120,6 +122,15 @@ class Executor:
                              now - t_run0)
             from .core.memory import record_device_memory
             record_device_memory(self.place.jax_device())
+        # flight recorder (FLAGS_flight_recorder): one bounded-ring
+        # record per completed step — the post-mortem trail dumped on
+        # crash/SIGTERM (monitor.dump_flight_recorder)
+        _flight_step(step=step, program=fp[:12],
+                     cache_hit=self._last_cache_hit,
+                     first_run=first_run,
+                     step_seconds=round(now - t_run0, 6),
+                     fetch_block_seconds=round(now - t_fetch0, 6),
+                     fetches=len(step_fn.fetch_names))
         return out
 
     # ------------------------------------------------------------------
@@ -143,6 +154,7 @@ class Executor:
 
         key = self._cache_key(program, feed_arrays, fetch_names, compiled)
         step_fn = self._cache.get(key) if use_program_cache else None
+        self._last_cache_hit = step_fn is not None
         if step_fn is not None:
             self._cache.move_to_end(key)  # LRU touch
             STAT_ADD("executor.compile_cache_hit")
@@ -363,6 +375,52 @@ class Executor:
             program, feed, fetch_list, scope, compiled)
         return step_fn.fn.lower(state, feed_arrays,
                                 jnp.uint32(0)).as_text()
+
+    def lowered_mlir_debug(self, program=None, feed=None, fetch_list=None,
+                           scope: Optional[Scope] = None) -> str:
+        """StableHLO/MLIR text WITH debug locations: each op carries a
+        loc("...") whose path includes the FLAGS_op_trace_scopes
+        annotation ('{op.type}:{block}/{idx}'), so the pre-optimization
+        dump attributes to Program ops. (Plain as_text() strips
+        locations.)"""
+        from .compiler import CompiledProgram  # local: avoid cycle
+
+        if program is None:
+            from .framework import default_main_program
+            program = default_main_program()
+        compiled = None
+        if isinstance(program, CompiledProgram):
+            compiled = program
+            program = compiled.program
+        scope = scope or global_scope()
+        step_fn, state, feed_arrays = self._resolve_step(
+            program, feed, fetch_list, scope, compiled)
+        ir = step_fn.fn.lower(state, feed_arrays,
+                              jnp.uint32(0)).compiler_ir(
+                                  dialect="stablehlo")
+        return ir.operation.get_asm(enable_debug_info=True)
+
+    def compiled_hlo(self, program=None, feed=None, fetch_list=None,
+                     scope: Optional[Scope] = None) -> str:
+        """Post-optimization HLO text of the jitted step. Every fused
+        instruction carries metadata={op_name="...{op.type}:{blk}/{idx}
+        ..."} (FLAGS_op_trace_scopes), which is the join key
+        tools/op_profile.py uses to attribute XPlane trace events back
+        to framework ops (reference print_profiler's per-op table)."""
+        from .compiler import CompiledProgram  # local: avoid cycle
+
+        if program is None:
+            from .framework import default_main_program
+            program = default_main_program()
+        compiled = None
+        if isinstance(program, CompiledProgram):
+            compiled = program
+            program = compiled.program
+        scope = scope or global_scope()
+        step_fn, state, feed_arrays = self._resolve_step(
+            program, feed, fetch_list, scope, compiled)
+        return step_fn.fn.lower(state, feed_arrays,
+                                jnp.uint32(0)).compile().as_text()
 
     def close(self):
         self._cache.clear()

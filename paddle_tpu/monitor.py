@@ -33,6 +33,10 @@ __all__ = ["STAT_ADD", "STAT_SET", "STAT_OBSERVE", "STAT_RESET",
            "get_phase_stats", "phase", "push_phase", "pop_phase",
            "snapshot_to_jsonl", "prometheus_text", "export_prometheus",
            "export_chrome_tracing", "start_exporter", "stop_exporter",
+           "flight_enabled", "flight_record", "flight_step",
+           "flight_records", "reset_flight_recorder",
+           "dump_flight_recorder", "install_flight_recorder",
+           "serve_prometheus", "stop_prometheus",
            "DEFAULT_TIME_BUCKETS"]
 
 # Fixed histogram buckets (upper bounds, seconds): 100us..120s covers a
@@ -236,6 +240,152 @@ def reset_phases():
 
 
 # ---------------------------------------------------------------------------
+# Flight recorder: a bounded ring of per-step records (step index, cache
+# hit/miss, timings, stat deltas, NaN provenance) kept in memory and
+# dumped as JSONL when the process dies — the crash "black box" the
+# aggregate snapshots cannot provide (a counter says HOW MANY NaN trips;
+# the flight recorder says WHICH op on WHICH step). Gated by
+# FLAGS_flight_recorder (default on: one dict append per step), separate
+# from FLAGS_enable_monitor so post-mortems work on unmonitored runs.
+# ---------------------------------------------------------------------------
+
+_FLIGHT: "deque" = deque()
+_FLIGHT_LOCK = threading.Lock()
+_FLIGHT_PREV_COUNTERS: Dict[str, float] = {}
+_flight_flag = None
+
+
+def flight_enabled() -> bool:
+    """FLAGS_flight_recorder through a cached flag handle (same
+    disabled-fast-path discipline as enabled())."""
+    global _flight_flag
+    f = _flight_flag
+    if f is None:
+        from .core.flags import flag_handle
+        f = _flight_flag = flag_handle("flight_recorder")
+    return f.value
+
+
+def flight_record(kind: str, **fields):
+    """Append one record to the flight-recorder ring (oldest dropped
+    past FLAGS_flight_recorder_capacity). Also counts
+    `executor.flight_records` when the monitor is enabled."""
+    if not flight_enabled():
+        return
+    from .core.flags import FLAGS
+    rec = {"kind": kind, "ts": time.time(), **fields}
+    with _FLIGHT_LOCK:
+        cap = FLAGS.flight_recorder_capacity
+        while cap > 0 and len(_FLIGHT) >= cap:
+            _FLIGHT.popleft()
+        _FLIGHT.append(rec)
+    STAT_ADD("executor.flight_records")
+
+
+def flight_step(**fields):
+    """Record one executor step (Executor.run calls this). When the
+    monitor is enabled the record also carries the delta of every
+    counter since the previous step record, so a post-mortem shows what
+    each step did (bytes fed, cache misses, NaN trips) not just that it
+    ran."""
+    if not flight_enabled():
+        return
+    if enabled():
+        with _LOCK:
+            cur = dict(_COUNTERS)
+        with _FLIGHT_LOCK:
+            prev = dict(_FLIGHT_PREV_COUNTERS)
+            _FLIGHT_PREV_COUNTERS.clear()
+            _FLIGHT_PREV_COUNTERS.update(cur)
+        delta = {k: v - prev.get(k, 0) for k, v in cur.items()
+                 if v != prev.get(k, 0)}
+        if delta:
+            fields["stats_delta"] = delta
+    flight_record("step", **fields)
+
+
+def flight_records() -> list:
+    """Point-in-time copy of the ring (oldest first)."""
+    with _FLIGHT_LOCK:
+        return list(_FLIGHT)
+
+
+def reset_flight_recorder():
+    with _FLIGHT_LOCK:
+        _FLIGHT.clear()
+        _FLIGHT_PREV_COUNTERS.clear()
+
+
+def _default_flight_path() -> str:
+    from .core.flags import FLAGS
+    return FLAGS.flight_recorder_path or "flight_recorder.jsonl"
+
+
+def dump_flight_recorder(path: Optional[str] = None,
+                         reason: str = "explicit") -> str:
+    """Write the ring as JSONL: one `flight_dump` header record, then
+    every ring record oldest-first (so the LAST line is the most recent
+    completed step). Atomic (tmp + rename): a dump interrupted mid-write
+    never leaves a half-written artifact over a previous good one.
+    Returns the path written."""
+    path = path or _default_flight_path()
+    records = flight_records()
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(json.dumps({"kind": "flight_dump", "ts": time.time(),
+                            "pid": os.getpid(), "reason": reason,
+                            "n_records": len(records)}) + "\n")
+        for rec in records:
+            f.write(json.dumps(rec) + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return path
+
+
+def install_flight_recorder(path: Optional[str] = None,
+                            on_sigterm: bool = True):
+    """Dump the flight recorder on unhandled exception (sys.excepthook,
+    chained to the previous hook) and, by default, on SIGTERM (chained
+    to any existing handler; installs an exiting default when none is
+    set). Idempotent per call site in spirit — callers install once at
+    process start (bench.py does)."""
+    import sys
+
+    prev_hook = sys.excepthook
+
+    def hook(tp, val, tb):
+        try:
+            dump_flight_recorder(path, reason=f"unhandled {tp.__name__}")
+        except Exception:  # noqa: BLE001 — the dump must never mask
+            pass           # the original crash
+        prev_hook(tp, val, tb)
+
+    sys.excepthook = hook
+
+    if on_sigterm:
+        import signal
+        prev_term = signal.getsignal(signal.SIGTERM)
+
+        def on_term(signum, frame):
+            try:
+                dump_flight_recorder(path, reason=f"signal {signum}")
+            except Exception:  # noqa: BLE001
+                pass
+            if callable(prev_term):
+                prev_term(signum, frame)
+            else:
+                os._exit(128 + signum)
+
+        try:
+            signal.signal(signal.SIGTERM, on_term)
+        except (ValueError, OSError):
+            pass  # non-main thread / exotic platform
+
+
+# ---------------------------------------------------------------------------
 # Snapshots + exporters
 # ---------------------------------------------------------------------------
 
@@ -313,6 +463,59 @@ def export_prometheus(path: str) -> str:
     return path
 
 
+_http_server = None
+_http_lock = threading.Lock()
+
+
+def serve_prometheus(port: Optional[int] = None):
+    """Tiny stdlib scrape endpoint: GET anything on 127.0.0.1:<port>
+    returns prometheus_text(). port=None reads FLAGS_monitor_http_port
+    (0 = disabled, returns None); an explicit port always serves (0
+    binds an ephemeral port — read it back from server_address).
+    Runs on a daemon thread; counts `monitor.http_scrapes`. Returns the
+    HTTPServer (already-running instance on repeat calls)."""
+    global _http_server
+    if port is None:
+        from .core.flags import FLAGS
+        port = FLAGS.monitor_http_port
+        if not port:
+            return None
+    import http.server
+
+    class _Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            STAT_ADD("monitor.http_scrapes")
+            body = prometheus_text().encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):
+            pass  # scrapes must not spam stderr
+
+    with _http_lock:
+        if _http_server is not None:
+            return _http_server
+        srv = http.server.ThreadingHTTPServer(("127.0.0.1", port),
+                                              _Handler)
+        threading.Thread(target=srv.serve_forever,
+                         name="ptn-monitor-http", daemon=True).start()
+        _http_server = srv
+        return srv
+
+
+def stop_prometheus():
+    global _http_server
+    with _http_lock:
+        if _http_server is not None:
+            _http_server.shutdown()
+            _http_server.server_close()
+            _http_server = None
+
+
 def export_chrome_tracing(path: str) -> int:
     """Dump recorded phase events as chrome://tracing JSON (the format
     of the reference's tools/timeline.py, and of the native profiler's
@@ -377,6 +580,10 @@ def start_exporter(path: Optional[str] = None,
         raise ValueError(
             "no export path: pass one or set FLAGS_monitor_export_path")
     interval = interval or FLAGS.monitor_flush_interval_s
+    try:
+        serve_prometheus()  # FLAGS_monitor_http_port-gated (0 = no-op)
+    except OSError:
+        pass  # port in use must not kill the run being monitored
     with _exporter_lock:
         if _exporter is not None and _exporter.is_alive():
             return _exporter

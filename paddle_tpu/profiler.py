@@ -13,7 +13,8 @@ import jax
 
 __all__ = ["profiler", "start_profiler", "stop_profiler", "reset_profiler",
            "record_event", "cuda_profiler", "enable_host_profiler",
-           "export_chrome_tracing", "host_phase_stats"]
+           "export_chrome_tracing", "host_phase_stats",
+           "parse_hlo_op_map", "extract_op_scope", "summarize_xplane"]
 
 _trace_dir = None
 
@@ -103,7 +104,45 @@ def cuda_profiler(*a, **kw):  # name kept for source compat
         yield
 
 
-def summarize_xplane(trace_dir=None, top=25):
+# The FLAGS_op_trace_scopes annotation emitted by core/lowering._op_scope:
+# '{op.type}:{block}/{op_idx}', where op.type may itself contain '::'
+# (grad::generic). Appears as one path component of HLO op_name metadata
+# and of XPlane name-scope lines; the LAST match in a path is the
+# innermost (most specific) op.
+import re as _re
+
+_SCOPE_RE = _re.compile(r"((?:[A-Za-z0-9_.]|::)+):(\d+)/(\d+)")
+
+
+def extract_op_scope(op_name: str):
+    """The innermost '{type}:{block}/{idx}' annotation in an HLO op_name
+    path, as (op_type, block_idx, op_idx) — or None when the path
+    carries no framework scope (e.g. parameter copies, infeed)."""
+    m = None
+    for m in _SCOPE_RE.finditer(op_name):
+        pass
+    if m is None:
+        return None
+    return m.group(1), int(m.group(2)), int(m.group(3))
+
+
+def parse_hlo_op_map(hlo_text: str):
+    """{hlo instruction name -> op_name metadata} from post-optimization
+    HLO text (Executor.compiled_hlo). XPlane device/host events carry
+    the instruction name (hlo_op stat); joining through this map and
+    extract_op_scope attributes each event to the framework op that
+    emitted it — source-level annotation carried into fused-HLO
+    profiles ("Operator Fusion in XLA", PAPERS.md)."""
+    op_map = {}
+    pat = _re.compile(
+        r"^\s*(?:ROOT\s+)?%?([^\s=]+)\s*=.*?metadata=\{[^}]*?"
+        r"op_name=\"([^\"]+)\"", _re.M)
+    for name, op_name in pat.findall(hlo_text):
+        op_map[name] = op_name
+    return op_map
+
+
+def summarize_xplane(trace_dir=None, top=25, hlo_text=None):
     """Parse the newest .xplane.pb under trace_dir and aggregate DEVICE
     event durations by kernel name + category (the reference's
     print_profiler table, re-expressed for XPlane). Returns a dict:
@@ -111,6 +150,14 @@ def summarize_xplane(trace_dir=None, top=25):
 
     Categories: mxu-fusion, dot/conv, pallas/custom-call, rng,
     collective, infeed/host, copy/layout, fusion, other.
+
+    When `hlo_text` (the compiled HLO of the traced step,
+    Executor.compiled_hlo) is given, each event is additionally
+    attributed to the framework op whose FLAGS_op_trace_scopes
+    annotation its op_name metadata carries, and the result gains
+    "by_framework_op": {scope: {op_type, block, op, calls, device_us,
+    host_us, total_us, min_us, max_us}} with an "(unattributed)" bucket
+    for events outside any scope.
     """
     import glob
     import os
@@ -151,11 +198,28 @@ def summarize_xplane(trace_dir=None, top=25):
     by_cat = defaultdict(float)
     by_op = defaultdict(float)
     total = 0.0
+    # per-framework-op accumulators (hlo_text mode): scope key ->
+    # [calls, device_us, host_us, min_us, max_us]
+    op_map = parse_hlo_op_map(hlo_text) if hlo_text else None
+    by_fw = {}
 
     # runtime bookkeeping spans on host threads, not ops
     _SKIP = ("end: ", "thunkexecutor", "threadpoollistener")
 
-    def accumulate(plane, line):
+    def attribute(name, us, device):
+        op_name = op_map.get(name) or op_map.get(name.lstrip("%"))
+        scope = extract_op_scope(op_name) if op_name else None
+        key = f"{scope[0]}:{scope[1]}/{scope[2]}" if scope \
+            else "(unattributed)"
+        acc = by_fw.get(key)
+        if acc is None:
+            acc = by_fw[key] = [0, 0.0, 0.0, float("inf"), 0.0]
+        acc[0] += 1
+        acc[1 if device else 2] += us
+        acc[3] = min(acc[3], us)
+        acc[4] = max(acc[4], us)
+
+    def accumulate(plane, line, device=True, count=True):
         nonlocal total
         for ev in line.events:
             meta = plane.event_metadata.get(ev.metadata_id)
@@ -164,20 +228,24 @@ def summarize_xplane(trace_dir=None, top=25):
             if any(s in low for s in _SKIP):
                 continue
             us = ev.duration_ps / 1e6
-            by_op[name] += us
-            by_cat[categorize(name)] += us
-            total += us
+            if count:
+                by_op[name] += us
+                by_cat[categorize(name)] += us
+                total += us
+            if op_map is not None:
+                attribute(name, us, device)
 
     # device planes (/device:TPU:N) carry the "XLA Ops" timeline; match
     # it exactly — derived lines ("Framework Ops", name scopes) repeat
     # the same durations and would double-count
-    for plane in space.planes:
-        if "/device" not in plane.name.lower():
-            continue
+    device_planes = [p for p in space.planes
+                     if "/device" in p.name.lower()]
+    for plane in device_planes:
         for line in plane.lines:
             if line.name.lower() in ("xla ops", "ops"):
-                accumulate(plane, line)
-    if total == 0.0:
+                accumulate(plane, line, device=True)
+    have_device = total > 0.0
+    if not have_device:
         # CPU runs have no device plane: fall back to the XLA client's
         # host execution threads so the tool still works for plumbing
         # tests and host-only profiling. Host spans can nest, so this
@@ -185,9 +253,27 @@ def summarize_xplane(trace_dir=None, top=25):
         for plane in space.planes:
             for line in plane.lines:
                 if "xla" in line.name.lower():
-                    accumulate(plane, line)
+                    accumulate(plane, line, device=False)
     top_ops = sorted(by_op.items(), key=lambda kv: -kv[1])[:top]
-    return {"total_us": total,
-            "by_category": dict(sorted(by_cat.items(),
-                                       key=lambda kv: -kv[1])),
-            "top_ops": top_ops}
+    out = {"total_us": total,
+           "by_category": dict(sorted(by_cat.items(),
+                                      key=lambda kv: -kv[1])),
+           "top_ops": top_ops}
+    if op_map is not None:
+        fw = {}
+        for key, (calls, dev_us, host_us, mn, mx) in by_fw.items():
+            scope = extract_op_scope(key)
+            fw[key] = {
+                "op_type": scope[0] if scope else key,
+                "block": scope[1] if scope else -1,
+                "op": scope[2] if scope else -1,
+                "calls": calls,
+                "device_us": dev_us,
+                "host_us": host_us,
+                "total_us": dev_us + host_us,
+                "min_us": mn,
+                "max_us": mx,
+            }
+        out["by_framework_op"] = dict(sorted(
+            fw.items(), key=lambda kv: -kv[1]["total_us"]))
+    return out

@@ -442,8 +442,10 @@ def test_metrics_report_cli(tmp_path):
 
 def test_stat_name_lint():
     """Every stat name recorded in production code matches
-    ^[a-z0-9_.]+$ AND appears in docs/observability.md — the registry
-    cannot silently drift from its documented inventory."""
+    ^[a-z0-9_.]+$ AND appears in docs/observability.md — and, in the
+    other direction, every name in the doc's stat-inventory table is
+    still recorded somewhere in code. The registry and its documented
+    inventory cannot silently drift apart either way."""
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     pat = re.compile(r"STAT_(?:ADD|SET|OBSERVE)\(\s*[\"']([^\"']+)[\"']")
     name_re = re.compile(r"^[a-z0-9_.]+$")
@@ -452,16 +454,333 @@ def test_stat_name_lint():
              os.path.join(repo, "tools"),
              os.path.join(repo, "bench.py")]
     found = set()
+    corpus = []
     for root in roots:
         files = [root] if root.endswith(".py") else [
             os.path.join(dp, f) for dp, _, fs in os.walk(root)
             for f in fs if f.endswith(".py")]
         for path in files:
-            for name in pat.findall(open(path).read()):
+            text = open(path).read()
+            corpus.append(text)
+            for name in pat.findall(text):
                 found.add((name, os.path.relpath(path, repo)))
+    corpus = "\n".join(corpus)
     assert len({n for n, _ in found}) >= 10, sorted(found)
     bad = [(n, p) for n, p in found if not name_re.match(n)]
     assert not bad, f"stat names violate ^[a-z0-9_.]+$: {bad}"
     undocumented = [(n, p) for n, p in found if f"`{n}`" not in inventory]
     assert not undocumented, \
         f"stats missing from docs/observability.md inventory: {undocumented}"
+    # reverse direction: documented inventory rows must still exist in
+    # code (a renamed/deleted stat must drop its doc row too)
+    section = inventory.split("## Stat inventory", 1)[1].split("\n## ", 1)[0]
+    documented = re.findall(r"^\| `([a-z0-9_.]+)` \|", section, re.M)
+    assert len(documented) >= 10, documented
+    # a name passed to STAT_* via a variable (core/memory.py's stat
+    # tuple) still exists as a string literal somewhere in the corpus
+    code_names = {n for n, _ in found}
+    stale = [n for n in documented
+             if n not in code_names
+             and f'"{n}"' not in corpus and f"'{n}'" not in corpus]
+    assert not stale, \
+        f"doc inventory rows no longer recorded anywhere in code: {stale}"
+
+
+# ---------------------------------------------------------------------------
+# Op-level trace attribution, NaN provenance, flight recorder,
+# Prometheus scrape endpoint, bench kill-resilience (ISSUE 3).
+# ---------------------------------------------------------------------------
+
+
+def test_op_trace_scopes_in_compiled_hlo():
+    """FLAGS_op_trace_scopes (default on) stamps every op's emission
+    with '{op_type}:{block}/{op_idx}': the compiled HLO's op_name
+    metadata and the debug StableHLO loc() info both carry it, and
+    turning the flag off removes it (the flag is traced, so the flip
+    recompiles)."""
+    main, startup, loss = _small_program()
+    scope = fluid.Scope()
+    exe = fluid.Executor()
+    feed = {"x": np.ones((4, 3), np.float32),
+            "y": np.zeros((4, 1), np.float32)}
+    scope_pat = re.compile(r'op_name="[^"]*\bmul:0/\d+')
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        hlo = exe.compiled_hlo(main, feed=feed, fetch_list=[loss])
+        assert scope_pat.search(hlo), hlo[:2000]
+        asm = exe.lowered_mlir_debug(main, feed=feed, fetch_list=[loss])
+        assert "loc(" in asm and re.search(r"mul:0/\d+", asm)
+        prev = fluid.FLAGS.op_trace_scopes
+        fluid.set_flags({"FLAGS_op_trace_scopes": False})
+        try:
+            hlo_off = exe.compiled_hlo(main, feed=feed,
+                                       fetch_list=[loss])
+        finally:
+            fluid.set_flags({"FLAGS_op_trace_scopes": prev})
+        assert not scope_pat.search(hlo_off)
+
+
+def test_nan_provenance():
+    """With FLAGS_check_nan_inf, the raised error names the op type,
+    block/op position, output var, and input vars — and a nan_inf
+    record with the same provenance lands in the flight recorder."""
+    from paddle_tpu import monitor
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    prev = fluid.FLAGS.check_nan_inf
+    fluid.set_flags({"FLAGS_check_nan_inf": True})
+    monitor.reset_flight_recorder()
+    try:
+        with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+            x = layers.data("nan_x", shape=[2, 2], dtype="float32",
+                            append_batch_size=False)
+            logged = layers.log(x)
+            loss = layers.mean(logged)
+            exe = fluid.Executor()
+            exe.run(startup)
+            try:
+                exe.run(main, feed={"nan_x": np.zeros((2, 2), np.float32)},
+                        fetch_list=[loss])
+                assert False, "expected a nan/inf trip"
+            except Exception as e:
+                msg = str(e)
+                assert "Operator 'log'" in msg, msg
+                assert "block 0/op" in msg and "Inf/Nan" in msg, msg
+                assert logged.name in msg, msg            # output var
+                assert "'nan_x'" in msg, msg              # input var
+    finally:
+        fluid.set_flags({"FLAGS_check_nan_inf": prev})
+    recs = [r for r in monitor.flight_records() if r["kind"] == "nan_inf"]
+    assert recs, monitor.flight_records()
+    r = recs[0]
+    assert r["op_type"] == "log" and r["block"] == 0
+    assert r["output"] == logged.name and r["inputs"] == ["nan_x"]
+    assert r["shape"] == [2, 2] and r["n_nonfinite"] == 4
+    monitor.reset_flight_recorder()
+
+
+def test_flight_recorder_ring_and_dump(tmp_path):
+    """Bounded ring (FLAGS_flight_recorder_capacity), executor step
+    records, atomic JSONL dump with a flight_dump header, reset."""
+    from paddle_tpu import monitor
+    monitor.reset_flight_recorder()
+    prev_cap = fluid.FLAGS.flight_recorder_capacity
+    fluid.set_flags({"FLAGS_flight_recorder_capacity": 8})
+    try:
+        for i in range(20):
+            monitor.flight_record("probe", i=i)
+        recs = monitor.flight_records()
+        assert len(recs) == 8                      # ring capped
+        assert [r["i"] for r in recs] == list(range(12, 20))  # oldest out
+        assert all(r["kind"] == "probe" and "ts" in r for r in recs)
+    finally:
+        fluid.set_flags({"FLAGS_flight_recorder_capacity": prev_cap})
+    # executor feeds step records
+    main, startup, loss = _small_program()
+    scope = fluid.Scope()
+    exe = fluid.Executor()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        feed = {"x": np.ones((4, 3), np.float32),
+                "y": np.zeros((4, 1), np.float32)}
+        exe.run(main, feed=feed, fetch_list=[loss])
+        exe.run(main, feed=feed, fetch_list=[loss])
+    steps = [r for r in monitor.flight_records() if r["kind"] == "step"]
+    assert len(steps) >= 2
+    assert steps[-1]["cache_hit"] is True and steps[0]["cache_hit"] is False
+    assert steps[-1]["step_seconds"] > 0
+    # with the monitor on, step records carry stats deltas
+    with _monitor_on():
+        with fluid.scope_guard(scope):
+            exe.run(main, feed=feed, fetch_list=[loss])
+        last = monitor.flight_records()[-1]
+        assert last["kind"] == "step"
+        assert last["stats_delta"].get("executor.feed_bytes", 0) > 0
+    path = monitor.dump_flight_recorder(str(tmp_path / "fl.jsonl"),
+                                        reason="unit test")
+    lines = [json.loads(x) for x in open(path)]
+    assert lines[0]["kind"] == "flight_dump"
+    assert lines[0]["reason"] == "unit test"
+    assert lines[0]["n_records"] == len(lines) - 1
+    assert lines[-1]["kind"] == "step"
+    # disabled -> no recording
+    monitor.reset_flight_recorder()
+    prev_fr = fluid.FLAGS.flight_recorder
+    fluid.set_flags({"FLAGS_flight_recorder": False})
+    try:
+        monitor.flight_record("probe", i=0)
+        assert monitor.flight_records() == []
+    finally:
+        fluid.set_flags({"FLAGS_flight_recorder": prev_fr})
+
+
+def test_serve_prometheus_scrape():
+    """monitor.serve_prometheus serves prometheus_text() over HTTP on
+    127.0.0.1 and counts scrapes; port=0 binds an ephemeral port."""
+    import urllib.request
+    with _monitor_on() as monitor:
+        monitor.STAT_ADD("t.scrape_counter", 3)
+        srv = monitor.serve_prometheus(port=0)
+        try:
+            port = srv.server_address[1]
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10
+            ).read().decode()
+            assert "paddle_tpu_t_scrape_counter 3" in body
+            snap = monitor.get_stats_snapshot()
+            assert snap["counters"]["monitor.http_scrapes"] == 1
+            # FLAGS_monitor_http_port=0 (default) means disabled
+            assert fluid.FLAGS.monitor_http_port == 0
+            assert monitor.serve_prometheus(port=None) is None
+        finally:
+            monitor.stop_prometheus()
+
+
+def test_op_profile_attribution(tmp_path):
+    """summarize_xplane(hlo_text=...) attributes trace events back to
+    FRAMEWORK op types (mul, sgd, ...) — not raw HLO names — and
+    tools/op_profile.py's table aggregation orders/percentages them.
+    (Sized like test_xplane_summary: a smaller program executes inline
+    on the calling thread and leaves no XLA trace line to attribute.)"""
+    from paddle_tpu import profiler
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup):
+        x = layers.data("opp_x", shape=[32], dtype="float32")
+        loss = layers.mean(layers.fc(x, size=32))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor()
+    feed = {"opp_x": np.ones((8, 32), np.float32)}
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        exe.run(main, feed=feed, fetch_list=[loss])  # warm/compile
+        hlo = exe.compiled_hlo(main, feed=feed, fetch_list=[loss])
+        d = str(tmp_path / "trace")
+        profiler.start_profiler(output_dir=d)
+        exe.run(main, feed=feed, fetch_list=[loss])
+        profiler.stop_profiler()
+    s = profiler.summarize_xplane(d, hlo_text=hlo)
+    fw = s["by_framework_op"]
+    types = {r["op_type"] for r in fw.values()} - {"(unattributed)"}
+    assert "mul" in types, sorted(types)       # framework name, not HLO
+    assert all(":" not in t or "::" in t for t in types), sorted(types)
+    for key, r in fw.items():
+        if key != "(unattributed)":
+            assert r["calls"] >= 1 and r["total_us"] >= 0
+            assert r["min_us"] <= r["max_us"]
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, os.path.join(repo, "tools"))
+    try:
+        import op_profile
+    finally:
+        sys.path.pop(0)
+    rows = op_profile.op_table_rows(s)
+    assert rows and rows[0]["total_ms"] == max(r["total_ms"] for r in rows)
+    assert abs(sum(r["pct"] for r in rows) - 100.0) < 1.0
+    table = op_profile.render_table(rows, top=10)
+    assert "total ms" in table and "mul" in table
+
+
+def test_validate_bench_json():
+    """tools/validate_bench_json.py accepts good artifacts and rejects
+    the r05 failure shape (driver wrapper with parsed: null)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, os.path.join(repo, "tools"))
+    try:
+        import validate_bench_json as v
+    finally:
+        sys.path.pop(0)
+    good = {"kind": "bench_summary", "status": "complete",
+            "models": ["bert"], "completed": ["bert"],
+            "results": [{"metric": "m", "value": 1.0, "unit": "u",
+                         "vs_baseline": 0.5}],
+            "ts_start": 1.0, "ts_end": 2.0}
+    assert v.validate_summary(good) == []
+    bad = dict(good, status="exploded", results=[{"metric": "m"}])
+    errs = v.validate_summary(bad)
+    assert any("status" in e for e in errs)
+    assert any("missing" in e for e in errs)
+    assert v.validate_wrapper({"cmd": "python bench.py", "rc": 124,
+                               "parsed": None})
+    assert v.validate_wrapper({"cmd": "python bench.py", "rc": 0,
+                               "parsed": {"metric": "x"}}) == []
+
+
+def test_bench_sigterm_leaves_parseable_artifacts(tmp_path):
+    """Kill a live CPU bench run mid-measurement with SIGTERM: the
+    summary JSON must parse (status killed, one result line per model)
+    and the flight-recorder JSONL must exist with a flight_dump header
+    and the final completed step as its last record — the r05
+    rc=124/parsed:null failure can't recur."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    summary_path = tmp_path / "summary.json"
+    flight_path = tmp_path / "flight.jsonl"
+    log_path = tmp_path / "log.jsonl"
+    env = dict(os.environ,
+               BENCH_PLATFORM="cpu", BENCH_MODEL="bert",
+               BENCH_LAYERS="2", BENCH_BATCH="2", BENCH_SEQ="64",
+               BENCH_FLASH="0", BENCH_STEPS="2000000",
+               BENCH_SUMMARY=str(summary_path),
+               BENCH_FLIGHT=str(flight_path),
+               BENCH_LOG=str(log_path),
+               FLAGS_enable_monitor="1",
+               FLAGS_monitor_flush_interval_s="0.5")
+    p = subprocess.Popen([sys.executable,
+                          os.path.join(repo, "bench.py")],
+                         cwd=str(tmp_path), env=env,
+                         stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                         text=True)
+    try:
+        # wait until the exporter has flushed proof of completed steps,
+        # then kill mid-measurement (compile ~15s on CPU; generous cap)
+        deadline = time.time() + 240
+        steps_seen = 0
+        while time.time() < deadline and steps_seen < 3:
+            if p.poll() is not None:
+                out, err = p.communicate()
+                assert False, f"bench exited early rc={p.returncode}\n" \
+                              f"{out}\n{err}"
+            time.sleep(0.5)
+            try:
+                for line in open(log_path):
+                    try:
+                        rec = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    h = rec.get("histograms", {}).get(
+                        "executor.step_seconds")
+                    if h:
+                        steps_seen = max(steps_seen, h["count"])
+            except OSError:
+                continue
+        assert steps_seen >= 3, "no steps observed before deadline"
+        p.send_signal(15)
+        out, err = p.communicate(timeout=60)
+    finally:
+        if p.poll() is None:
+            p.kill()
+            p.communicate()
+    assert p.returncode == 143, f"rc={p.returncode}\n{out}\n{err}"
+    # stdout: one parseable result line per model + a partial summary
+    stdout_lines = [json.loads(x) for x in out.splitlines() if x.strip()]
+    assert any(r.get("metric") and "killed" in r.get("error", "")
+               for r in stdout_lines), out
+    # summary artifact parses and is valid per the validator
+    sys.path.insert(0, os.path.join(repo, "tools"))
+    try:
+        import validate_bench_json as v
+    finally:
+        sys.path.pop(0)
+    summary = json.load(open(summary_path))
+    assert v.validate_summary(summary) == [], summary
+    assert summary["status"] == "killed"
+    assert summary["models"] == ["bert"] and summary["completed"] == []
+    # flight recorder: header + records, last record = final step
+    assert v.validate_jsonl(str(flight_path)) == []
+    recs = [json.loads(x) for x in open(flight_path)]
+    assert recs[0]["kind"] == "flight_dump"
+    assert recs[0]["reason"] == "signal 15"
+    step_recs = [r for r in recs if r["kind"] == "step"]
+    assert step_recs, recs
+    assert recs[-1]["kind"] == "step"
+    assert recs[-1]["step"] == max(r["step"] for r in step_recs)

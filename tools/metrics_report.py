@@ -40,7 +40,7 @@ def _fmt_bytes(v):
 
 
 def load(path):
-    snapshots, results = [], []
+    snapshots, results, op_profiles = [], [], []
     with open(path) as f:
         for ln, line in enumerate(f, 1):
             line = line.strip()
@@ -57,7 +57,9 @@ def load(path):
                 snapshots.append(rec)
             elif kind == "bench_result" or "metric" in rec:
                 results.append(rec)
-    return snapshots, results
+            elif kind == "op_profile":
+                op_profiles.append(rec)
+    return snapshots, results, op_profiles
 
 
 def _hist(snap, name):
@@ -65,10 +67,10 @@ def _hist(snap, name):
 
 
 def report(path, out=sys.stdout):
-    snapshots, results = load(path)
+    snapshots, results, op_profiles = load(path)
     w = out.write
     w(f"runtime stats report — {path}\n")
-    if not snapshots and not results:
+    if not snapshots and not results and not op_profiles:
         w("no snapshots or bench results found\n")
         return 1
     w(f"snapshots: {len(snapshots)}   bench results: {len(results)}\n")
@@ -166,6 +168,23 @@ def report(path, out=sys.stdout):
         mfu = flops / h["p50"] / peak
         w(f"\nMFU: {flops:.3g} flops/step / ({_fmt_s(h['p50'])} p50 "
           f"step x {peak:.3g} peak) = {mfu:.3f}\n")
+
+    if op_profiles:
+        # cumulative like the snapshots: the LAST op_profile record
+        # (tools/op_profile.py appends one per invocation) is the run's
+        p = op_profiles[-1]
+        rows = p.get("rows", [])
+        w(f"\n-- op profile ({p.get('model', '?')}, per framework op "
+          f"type, top 15 by total time) --\n")
+        for r in rows[:15]:
+            w(f"{r.get('op', '?')[:26]:26s} calls {r.get('calls', 0):<6d} "
+              f"total {r.get('total_ms', 0):>9.3f} ms  "
+              f"avg {r.get('avg_ms', 0):>8.3f} ms  "
+              f"dev {r.get('device_ms', 0):>8.3f} ms  "
+              f"{r.get('pct', 0):5.1f}%\n")
+        if len(rows) > 15:
+            w(f"... {len(rows) - 15} more row(s) — full table: "
+              f"python tools/op_profile.py\n")
 
     if results:
         w("\n-- bench results --\n")

@@ -1,0 +1,192 @@
+"""Per-op profile table: framework op types, not raw HLO names.
+
+Usage:
+    python tools/op_profile.py [bert|resnet50|gpt|transformer|deeplab]
+                               [--cpu] [--tiny] [--steps N] [--top N]
+                               [--by-op] [--log PATH]
+
+Builds the selected bench model (same BENCH_* env config as bench.py),
+captures a jax.profiler trace around a few steps PLUS the step's
+compiled HLO — whose per-instruction op_name metadata carries the
+FLAGS_op_trace_scopes annotations '{op.type}:{block}/{idx}' emitted by
+core/lowering — then joins trace events back to framework ops via
+profiler.summarize_xplane(hlo_text=...) and prints the reference
+print_profiler-style op table: calls, total/avg/min/max ms split
+device/host, % of step, sorted by total. `--by-op` keeps one row per op
+instance (block/idx) instead of aggregating per type. With --log (or
+FLAGS_monitor_export_path set) the rows are also appended as an
+{"kind": "op_profile"} JSONL record, which tools/metrics_report.py
+renders as its own section.
+
+This is the capability match for the reference's platform/profiler.cc
+per-op RecordEvent + print_profiler table: fused-HLO profiles are
+unreadable without source-level annotation carried into the trace
+("Operator Fusion in XLA", PAPERS.md).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+
+def op_table_rows(summary, by_op=False):
+    """Flatten summarize_xplane's "by_framework_op" dict into table rows
+    (dicts, JSON-serializable), aggregated per op TYPE unless by_op.
+    Rows sorted by total time descending; pct is share of the summed
+    attributed time."""
+    fw = summary.get("by_framework_op") or {}
+    agg = {}
+    for key, r in fw.items():
+        k = key if by_op else r["op_type"]
+        a = agg.get(k)
+        if a is None:
+            a = agg[k] = {"op": k, "calls": 0, "device_us": 0.0,
+                          "host_us": 0.0, "total_us": 0.0,
+                          "min_us": float("inf"), "max_us": 0.0}
+        a["calls"] += r["calls"]
+        a["device_us"] += r["device_us"]
+        a["host_us"] += r["host_us"]
+        a["total_us"] += r["total_us"]
+        a["min_us"] = min(a["min_us"], r["min_us"])
+        a["max_us"] = max(a["max_us"], r["max_us"])
+    total = sum(a["total_us"] for a in agg.values()) or 1.0
+    rows = []
+    for a in sorted(agg.values(), key=lambda a: -a["total_us"]):
+        rows.append({
+            "op": a["op"],
+            "calls": a["calls"],
+            "total_ms": round(a["total_us"] / 1e3, 4),
+            "avg_ms": round(a["total_us"] / a["calls"] / 1e3, 4),
+            "min_ms": round(a["min_us"] / 1e3, 4),
+            "max_ms": round(a["max_us"] / 1e3, 4),
+            "device_ms": round(a["device_us"] / 1e3, 4),
+            "host_ms": round(a["host_us"] / 1e3, 4),
+            "pct": round(100.0 * a["total_us"] / total, 2),
+        })
+    return rows
+
+
+def render_table(rows, top=40):
+    """The reference print_profiler layout for the rows above."""
+    lines = [f"{'op':32s} {'calls':>6s} {'total ms':>10s} {'avg ms':>9s} "
+             f"{'min ms':>9s} {'max ms':>9s} {'device ms':>10s} "
+             f"{'host ms':>9s} {'%':>6s}"]
+    lines.append("-" * len(lines[0]))
+    for r in rows[:top]:
+        lines.append(
+            f"{r['op'][:32]:32s} {r['calls']:>6d} {r['total_ms']:>10.3f} "
+            f"{r['avg_ms']:>9.3f} {r['min_ms']:>9.3f} {r['max_ms']:>9.3f} "
+            f"{r['device_ms']:>10.3f} {r['host_ms']:>9.3f} "
+            f"{r['pct']:>5.1f}%")
+    if len(rows) > top:
+        lines.append(f"... {len(rows) - top} more row(s)")
+    return "\n".join(lines)
+
+
+def profile_model(model="bert", steps=5, tiny=False,
+                  trace_dir="/tmp/paddle_tpu_op_profile"):
+    """Build + warm the model, capture compiled HLO and an XPlane trace
+    of `steps` async steps, and return summarize_xplane's dict with
+    "by_framework_op". Same build path as bench.py so the profiled
+    program is exactly the benchmarked one."""
+    import numpy as np
+
+    import bench
+    import paddle_tpu as fluid
+    from paddle_tpu import profiler
+
+    if tiny:
+        build = bench._CPU_TINY_BUILDS[model]
+    else:
+        build = {"bert": bench.build_bert_bench,
+                 "resnet50": bench.build_resnet50_bench,
+                 "gpt": bench.build_gpt_bench,
+                 "transformer": bench.build_transformer_bench,
+                 "deeplab": bench.build_deeplab_bench}[model]
+    exe, prog, scope, feed, loss, _ = build()
+    with fluid.scope_guard(scope):
+        # warm up + compile outside the trace
+        exe.run(prog, feed=feed, fetch_list=[loss])
+        hlo = exe.compiled_hlo(prog, feed=feed, fetch_list=[loss])
+        profiler.start_profiler(output_dir=trace_dir)
+        x = None
+        for _ in range(steps):
+            x, = exe.run(prog, feed=feed, fetch_list=[loss],
+                         return_numpy=False)
+        np.asarray(x)  # drain before stopping the trace
+        profiler.stop_profiler()
+    summary = profiler.summarize_xplane(trace_dir, hlo_text=hlo)
+    summary["steps"] = steps
+    return summary
+
+
+def _log_rows(path, model, rows):
+    rec = {"kind": "op_profile", "model": model, "rows": rows}
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    with open(path, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+    return path
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="per-framework-op profile table")
+    ap.add_argument("model", nargs="?", default="bert",
+                    choices=["bert", "resnet50", "gpt", "transformer",
+                             "deeplab"])
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the CPU backend (plumbing checks)")
+    ap.add_argument("--tiny", action="store_true",
+                    help="use bench.py's 2-layer tiny-shape builder")
+    ap.add_argument("--steps", type=int, default=5)
+    ap.add_argument("--top", type=int, default=40)
+    ap.add_argument("--by-op", action="store_true",
+                    help="one row per op instance (block/idx), not per "
+                         "op type")
+    ap.add_argument("--log", default="",
+                    help="append rows as an op_profile JSONL record "
+                         "(default: FLAGS_monitor_export_path if set)")
+    args = ap.parse_args(argv)
+
+    if args.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    summary = profile_model(args.model, steps=args.steps,
+                            tiny=args.tiny)
+    rows = op_table_rows(summary, by_op=args.by_op)
+    if not rows:
+        print("no framework-op attribution found — is "
+              "FLAGS_op_trace_scopes on?", file=sys.stderr)
+        return 1
+    attributed = [r for r in rows if r["op"] != "(unattributed)"]
+    print(f"op profile — {args.model}, {summary['steps']} steps, "
+          f"{summary['total_us'] / 1e3:.2f} ms total, "
+          f"{len(attributed)} framework op "
+          f"{'instances' if args.by_op else 'types'} attributed")
+    print(render_table(rows, top=args.top))
+
+    log = args.log
+    if not log:
+        try:
+            from paddle_tpu.core.flags import FLAGS
+            log = FLAGS.monitor_export_path
+        except Exception:  # noqa: BLE001 — logging is best-effort
+            log = ""
+    if log:
+        _log_rows(log, args.model, rows)
+        print(f"# rows appended to {log} "
+              f"(report: python tools/metrics_report.py {log})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

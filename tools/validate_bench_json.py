@@ -1,0 +1,128 @@
+"""Validate bench artifacts: summary JSON, JSONL logs, driver wrappers.
+
+Usage:
+    python tools/validate_bench_json.py FILE [FILE ...]
+
+Checks, per file (type auto-detected from content):
+
+* bench_summary.json (bench.py's write-ahead atomic summary): the file
+  json.load-s, kind == "bench_summary", status is one of
+  running/complete/killed, models is a list of names, and every entry
+  in results carries the metric/value/unit/vs_baseline contract the
+  driver greps for.
+* *.jsonl (monitor export / bench log / flight recorder): EVERY
+  non-empty line parses as a JSON object.
+* driver BENCH_rNN.json wrappers ({"n", "cmd", "rc", "tail",
+  "parsed"}): parsed must be non-null — the exact invariant the r05
+  rc=124 artifact violated.
+
+Exits 0 when every file passes, 1 otherwise, listing each failure on
+stderr. Importable: validate_file(path) -> list of error strings.
+"""
+from __future__ import annotations
+
+import json
+import sys
+
+_RESULT_KEYS = ("metric", "value", "unit", "vs_baseline")
+_STATUSES = ("running", "complete", "killed")
+
+
+def validate_summary(obj, where="summary"):
+    errs = []
+    if obj.get("kind") != "bench_summary":
+        errs.append(f"{where}: kind != 'bench_summary' "
+                    f"(got {obj.get('kind')!r})")
+    if obj.get("status") not in _STATUSES:
+        errs.append(f"{where}: status {obj.get('status')!r} not in "
+                    f"{_STATUSES}")
+    models = obj.get("models")
+    if not isinstance(models, list) or not all(
+            isinstance(m, str) for m in models):
+        errs.append(f"{where}: models must be a list of names")
+    results = obj.get("results")
+    if not isinstance(results, list):
+        errs.append(f"{where}: results must be a list")
+        results = []
+    for i, r in enumerate(results):
+        if not isinstance(r, dict):
+            errs.append(f"{where}: results[{i}] is not an object")
+            continue
+        missing = [k for k in _RESULT_KEYS if k not in r]
+        if missing:
+            errs.append(f"{where}: results[{i}] missing {missing}")
+    if obj.get("status") != "running" and "ts_end" not in obj:
+        errs.append(f"{where}: finished summary lacks ts_end")
+    return errs
+
+
+def validate_wrapper(obj, where="wrapper"):
+    errs = []
+    missing = [k for k in ("cmd", "rc", "parsed") if k not in obj]
+    if missing:
+        errs.append(f"{where}: driver wrapper missing {missing}")
+    if obj.get("parsed") is None:
+        errs.append(f"{where}: parsed is null (rc={obj.get('rc')}) — "
+                    f"run left no parseable result")
+    return errs
+
+
+def validate_jsonl(path):
+    errs = []
+    with open(path) as f:
+        for ln, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError as e:
+                errs.append(f"{path}:{ln}: unparseable line ({e})")
+                continue
+            if not isinstance(rec, dict):
+                errs.append(f"{path}:{ln}: line is not a JSON object")
+    return errs
+
+
+def validate_file(path):
+    """Auto-detect the artifact type and return a list of error
+    strings (empty = valid)."""
+    try:
+        with open(path) as f:
+            text = f.read()
+    except OSError as e:
+        return [f"{path}: unreadable ({e})"]
+    if not text.strip():
+        return [f"{path}: empty"]
+    # whole-file JSON first; fall back to line-by-line JSONL
+    try:
+        obj = json.loads(text)
+    except json.JSONDecodeError:
+        return validate_jsonl(path)
+    if not isinstance(obj, dict):
+        return [f"{path}: top-level JSON is not an object"]
+    if obj.get("kind") == "bench_summary":
+        return validate_summary(obj, where=path)
+    if "parsed" in obj and "cmd" in obj:
+        return validate_wrapper(obj, where=path)
+    # a single-record JSONL (e.g. one snapshot) is also fine
+    return []
+
+
+def main(argv=None):
+    argv = argv if argv is not None else sys.argv[1:]
+    if not argv or argv[0] in ("-h", "--help"):
+        print(__doc__)
+        return 0 if argv else 2
+    errs = []
+    for path in argv:
+        errs.extend(validate_file(path))
+    for e in errs:
+        print(f"INVALID: {e}", file=sys.stderr)
+    if not errs:
+        print(f"ok: {len(argv)} artifact(s) valid")
+    return 1 if errs else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
