@@ -50,6 +50,7 @@ from .dataset import DatasetFactory  # noqa: F401
 from . import contrib  # noqa: F401
 from . import datasets  # noqa: F401
 from . import inference  # noqa: F401
+from . import serving  # noqa: F401
 from . import reader_decorator  # noqa: F401
 from . import transpiler  # noqa: F401
 from .transpiler import (DistributeTranspiler,  # noqa: F401

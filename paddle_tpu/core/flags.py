@@ -308,6 +308,38 @@ DEFINE_double(
     "(monitor.start_exporter). Crash-safety knob: a run killed by an "
     "external timeout still leaves snapshots this fresh.")
 
+DEFINE_int32(
+    "serving_max_batch_size", 8,
+    "Default EngineConfig.max_batch_size: the most request rows the "
+    "serving engine coalesces into one padded batch (must fit the "
+    "largest batch bucket). Serving analogue of the reference "
+    "predictor pool size.")
+
+DEFINE_int32(
+    "serving_max_wait_us", 2000,
+    "Default EngineConfig.max_wait_us: how long (microseconds) a "
+    "partially-filled batch may wait for co-batchable requests before "
+    "the worker flushes it. The latency/throughput dial of the dynamic "
+    "batcher.")
+
+DEFINE_int32(
+    "serving_queue_capacity", 256,
+    "Default EngineConfig.queue_capacity: max request rows pending in "
+    "the dynamic batcher before submissions are rejected with "
+    "QueueFullError (backpressure instead of unbounded queueing).")
+
+DEFINE_double(
+    "serving_default_timeout_ms", 1000.0,
+    "Default EngineConfig.default_timeout_ms: per-request deadline "
+    "applied when a submission does not carry its own; a request still "
+    "queued past it fails with DeadlineExceededError. 0 = no deadline.")
+
+DEFINE_int32(
+    "serving_http_port", 0,
+    "Default EngineConfig.http_port for serving.serve(): the port of "
+    "the JSON front end (/v1/predict, /healthz, /metrics). 0 binds an "
+    "ephemeral port.")
+
 DEFINE_string(
     "profiler_trace_dir", "",
     "When set, fluid.profiler writes chrome-trace/XPlane dumps here by "

@@ -55,6 +55,11 @@ class Executor:
         self._cache: "OrderedDict[tuple, _CompiledStep]" = OrderedDict()
         self._step_counters: Dict[str, int] = {}
         self._last_cache_hit = False
+        # per-instance mirror of the global compile-cache counters: the
+        # serving engine's warmup contract ("zero post-warmup compiles")
+        # is about THIS executor, not every executor in the process
+        self._cache_hits = 0
+        self._cache_misses = 0
         # Strong refs to CompiledPrograms in the cache: keys use
         # id(compiled), which is only stable while the object is alive.
         self._compiled_refs: Dict[int, object] = {}
@@ -157,8 +162,10 @@ class Executor:
         self._last_cache_hit = step_fn is not None
         if step_fn is not None:
             self._cache.move_to_end(key)  # LRU touch
+            self._cache_hits += 1
             STAT_ADD("executor.compile_cache_hit")
         else:
+            self._cache_misses += 1
             STAT_ADD("executor.compile_cache_miss")
             t0 = time.perf_counter()
             step_fn = self._compile(program, block, feed_arrays,
@@ -421,6 +428,13 @@ class Executor:
             program, feed, fetch_list, scope, compiled)
         return step_fn.fn.lower(state, feed_arrays,
                                 jnp.uint32(0)).compile().as_text()
+
+    def cache_stats(self) -> Dict[str, int]:
+        """Per-instance executable-cache counters (the global
+        executor.compile_cache_* stats aggregate every Executor in the
+        process; warmup-coverage checks need this one's)."""
+        return {"hits": self._cache_hits, "misses": self._cache_misses,
+                "size": len(self._cache)}
 
     def close(self):
         self._cache.clear()

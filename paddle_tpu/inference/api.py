@@ -123,12 +123,27 @@ class ZeroCopyTensor:
 
 
 class AnalysisPredictor:
-    def __init__(self, config: AnalysisConfig):
+    def __init__(self, config: AnalysisConfig, _share_from=None):
         from ..core.scope import Scope
         from ..executor import Executor
         from .. import io as fio
 
         self.config = config
+        if _share_from is not None:
+            # clone(): share the loaded program, the scope holding the
+            # weights, and the Executor (and thereby its executable
+            # cache) — the reference predictor clone shares the
+            # optimized program and weights the same way. Only the
+            # ZeroCopy staging dicts are per-clone.
+            self._scope = _share_from._scope
+            self._exe = _share_from._exe
+            self._program = _share_from._program
+            self._feed_names = list(_share_from._feed_names)
+            self._fetch_names = list(_share_from._fetch_names)
+            self._fetch_vars = _share_from._fetch_vars
+            self._inputs: Dict[str, np.ndarray] = {}
+            self._outputs: Dict[str, np.ndarray] = {}
+            return
         self._scope = Scope()
         self._exe = Executor()
         d = config.model_dir()
@@ -161,11 +176,17 @@ class AnalysisPredictor:
         for i, t in enumerate(inputs):
             name = t.name or self._feed_names[i]
             feed[name] = t.data
-        outs = self._exe.run(self._program, feed=feed,
-                             fetch_list=self._fetch_names,
-                             scope=self._scope)
+        outs = self.run_dict(feed)
         return [PaddleTensor(o, n)
                 for o, n in zip(outs, self._fetch_names)]
+
+    def run_dict(self, feed: Dict[str, np.ndarray]) -> List[np.ndarray]:
+        """Dict-feed entry point (the serving engine's worker path):
+        {input name: ndarray} -> fetch outputs in get_output_names()
+        order."""
+        return self._exe.run(self._program, feed=feed,
+                             fetch_list=self._fetch_names,
+                             scope=self._scope)
 
     # -- ZeroCopy path --------------------------------------------------
     def get_input_names(self):
@@ -188,7 +209,12 @@ class AnalysisPredictor:
             self._outputs[n] = np.asarray(o)
 
     def clone(self):
-        return AnalysisPredictor(self.config)
+        """A predictor over the SAME loaded program, weights and
+        compiled-executable cache (reference analysis_predictor.cc
+        Clone shares the optimized program + scope). Clones re-read
+        nothing from disk and a shape either predictor already served
+        is a cache hit for the other."""
+        return AnalysisPredictor(self.config, _share_from=self)
 
     def program(self):
         return self._program
@@ -209,7 +235,17 @@ class AnalysisPredictor:
         params = {n: jnp.asarray(self._scope.get(n))
                   for n in self._scope.names()}
         fetch_names = self._fetch_names
-        feed_names = sorted(example_feed)
+        # Positional order of the exported callable follows the
+        # predictor's declared feed order (NOT sorted(example_feed):
+        # sorting silently permuted inputs for callers feeding
+        # positionally after deserialization).
+        missing = [n for n in self._feed_names if n not in example_feed]
+        extra = [n for n in example_feed if n not in self._feed_names]
+        if missing or extra:
+            raise ValueError(
+                f"example_feed must cover exactly the model inputs "
+                f"{self._feed_names}; missing {missing}, extra {extra}")
+        feed_names = list(self._feed_names)
 
         def fn(*feeds):
             env = dict(params)

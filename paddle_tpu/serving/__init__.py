@@ -1,0 +1,29 @@
+"""Serving subsystem: dynamic batching + shape-bucketed warmup + HTTP.
+
+Reference: the reference framework's dedicated inference/serving layer
+(predictor pools, request queues, service front ends). The TPU-native
+redesign centers on XLA's whole-program, shape-specialized compilation:
+naive serving recompiles on every novel (batch, seq) shape, so the
+engine quantizes all traffic onto a fixed bucket ladder
+(`BucketLadder`), coalesces concurrent requests into padded batches
+(`DynamicBatcher`), and precompiles every ladder cell before accepting
+traffic (`ServingEngine.warmup`). A stdlib HTTP front end
+(`serving.http.serve`) exposes /v1/predict, /healthz and /metrics.
+
+Quick start::
+
+    from paddle_tpu.serving import EngineConfig, ServingEngine, serve
+    cfg = EngineConfig(model_dir, max_batch_size=8, seq_buckets=(32, 64))
+    srv = serve(ServingEngine(cfg), port=8000)   # warms up, then binds
+
+See docs/serving.md for the architecture and the full stat inventory.
+"""
+from .batcher import (BucketLadder, DeadlineExceededError,  # noqa: F401
+                      DynamicBatcher, EngineClosedError, QueueFullError,
+                      ServingError)
+from .engine import EngineConfig, ServingEngine  # noqa: F401
+from .http import ServingHTTPServer, serve  # noqa: F401
+
+__all__ = ["BucketLadder", "DynamicBatcher", "EngineConfig",
+           "ServingEngine", "ServingHTTPServer", "serve", "ServingError",
+           "QueueFullError", "DeadlineExceededError", "EngineClosedError"]

@@ -1,0 +1,149 @@
+"""Stdlib HTTP front end for ServingEngine.
+
+Endpoints (JSON over ThreadingHTTPServer — each client connection gets
+its own handler thread, which blocks in `engine.predict` so the dynamic
+batcher sees genuine concurrency):
+
+- ``POST /v1/predict``  body ``{"inputs": {name: nested list},
+  "timeout_ms": optional}`` -> ``{"outputs": {name: nested list},
+  "shapes": {...}}``; 400 malformed, 503 queue-full/closed (the
+  backpressure status clients should retry with backoff), 504 deadline.
+- ``GET /healthz``      -> 200 ``{"status": "ok"}`` once the engine is
+  warmed and ready, 503 before/after.
+- ``GET /metrics``      -> the same Prometheus text the monitor's scrape
+  endpoint serves (monitor.prometheus_text), so one port serves both
+  traffic and observability.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from typing import Optional
+
+import numpy as np
+
+from ..monitor import STAT_ADD, prometheus_text
+from .batcher import (DeadlineExceededError, EngineClosedError,
+                      QueueFullError)
+from .engine import ServingEngine
+
+__all__ = ["ServingHTTPServer", "serve"]
+
+
+class ServingHTTPServer:
+    """Owns the listening socket + serve_forever thread. `port=0` binds
+    an ephemeral port (read it back from `.port` — tests do)."""
+
+    def __init__(self, engine: ServingEngine, port: int = 0,
+                 host: str = "127.0.0.1"):
+        import http.server
+
+        eng = engine
+
+        class _Handler(http.server.BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def _reply(self, code: int, payload: dict):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                STAT_ADD("serving.http_requests")
+                if self.path.startswith("/healthz"):
+                    if eng.ready:
+                        self._reply(200, {"status": "ok"})
+                    else:
+                        self._reply(503, {"status": "not ready"})
+                elif self.path.startswith("/metrics"):
+                    body = prometheus_text().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                else:
+                    self._reply(404, {"error": f"no route {self.path}"})
+
+            def do_POST(self):
+                STAT_ADD("serving.http_requests")
+                if not self.path.startswith("/v1/predict"):
+                    self._reply(404, {"error": f"no route {self.path}"})
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(length) or b"{}")
+                    inputs = req["inputs"]
+                    if not isinstance(inputs, dict) or not inputs:
+                        raise ValueError(
+                            "'inputs' must be a non-empty object")
+                    feed = {str(k): np.asarray(v)
+                            for k, v in inputs.items()}
+                except (KeyError, ValueError, TypeError,
+                        json.JSONDecodeError) as e:
+                    self._reply(400, {"error": f"bad request: {e}"})
+                    return
+                try:
+                    outs = eng.predict(
+                        feed, timeout_ms=req.get("timeout_ms"))
+                except QueueFullError as e:
+                    self._reply(503, {"error": str(e),
+                                      "retryable": True})
+                    return
+                except DeadlineExceededError as e:
+                    self._reply(504, {"error": str(e)})
+                    return
+                except EngineClosedError as e:
+                    self._reply(503, {"error": str(e),
+                                      "retryable": False})
+                    return
+                except ValueError as e:
+                    self._reply(400, {"error": f"bad request: {e}"})
+                    return
+                names = eng.output_names()
+                self._reply(200, {
+                    "outputs": {n: o.tolist()
+                                for n, o in zip(names, outs)},
+                    "shapes": {n: list(o.shape)
+                               for n, o in zip(names, outs)},
+                })
+
+            def log_message(self, *args):
+                pass  # request logging goes through the monitor, not
+                # stderr
+
+        self.engine = engine
+        self._srv = http.server.ThreadingHTTPServer((host, port),
+                                                    _Handler)
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        name="ptn-serving-http",
+                                        daemon=True)
+        self._thread.start()
+
+    @property
+    def port(self) -> int:
+        return self._srv.server_address[1]
+
+    @property
+    def url(self) -> str:
+        host, port = self._srv.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def close(self):
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+def serve(engine: ServingEngine,
+          port: Optional[int] = None) -> ServingHTTPServer:
+    """Start the engine (if not already started) and expose it over
+    HTTP. port=None reads EngineConfig.http_port (itself defaulted from
+    FLAGS_serving_http_port; 0 binds an ephemeral port)."""
+    engine.start()
+    if port is None:
+        port = engine.config.http_port
+    return ServingHTTPServer(engine, port=port)

@@ -81,3 +81,72 @@ def test_config_tensorrt_gated(saved_model):
     config = AnalysisConfig(saved_model[0])
     with pytest.raises(NotImplementedError):
         config.enable_tensorrt_engine(workspace_size=1 << 20)
+
+
+def test_clone_shares_weights_and_compile_cache(saved_model):
+    """clone() must NOT re-read the model from disk: it shares the
+    loaded program, the weight scope, and the executor — so a shape the
+    parent already served is a cache hit for the clone."""
+    d, xb, want = saved_model
+    p1 = create_paddle_predictor(AnalysisConfig(d))
+    p1.run_dict({"img": xb})
+    assert p1.clone()._scope is p1._scope
+    assert p1.clone()._exe is p1._exe
+    assert p1.clone()._program is p1._program
+
+    p2 = p1.clone()
+    before = p1._exe.cache_stats()
+    out, = p2.run_dict({"img": xb})
+    after = p1._exe.cache_stats()
+    assert after["misses"] == before["misses"], \
+        "clone re-compiled a shape its parent already served"
+    assert after["hits"] == before["hits"] + 1
+    np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-6)
+
+    # per-clone ZeroCopy staging stays independent
+    p2.get_input_tensor("img").copy_from_cpu(xb)
+    assert "img" not in p1._inputs
+
+
+def test_stablehlo_export_feed_order(saved_model, tmp_path):
+    """Regression: export_stablehlo must order positional args by the
+    model's declared feed order, not sorted(example_feed). The inputs
+    here are named so sorted order REVERSES declaration order, and the
+    computation is asymmetric (2*z + 3*a), so a swap changes values."""
+    from jax import export as jexport
+
+    d = str(tmp_path / "two_input_model")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        z = layers.data("z_first", shape=[8], dtype="float32")
+        a = layers.data("a_second", shape=[8], dtype="float32")
+        out = layers.elementwise_add(layers.scale(z, scale=2.0),
+                                     layers.scale(a, scale=3.0))
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        fluid.io.save_inference_model(d, ["z_first", "a_second"],
+                                      [out], exe, main_program=main)
+
+    predictor = create_paddle_predictor(AnalysisConfig(d))
+    assert predictor.get_input_names() == ["z_first", "a_second"]
+    rng = np.random.RandomState(1)
+    zb = rng.randn(2, 8).astype(np.float32)
+    ab = rng.randn(2, 8).astype(np.float32)
+
+    path = str(tmp_path / "two_input.stablehlo")
+    predictor.export_stablehlo(path, {"a_second": ab, "z_first": zb})
+    with open(path, "rb") as f:
+        exported = jexport.deserialize(f.read())
+    # positional call order == declared feed order, NOT sorted order
+    got, = exported.call(zb, ab)
+    np.testing.assert_allclose(np.asarray(got), 2.0 * zb + 3.0 * ab,
+                               rtol=1e-5, atol=1e-5)
+
+    # the feed must cover the declared inputs exactly
+    with pytest.raises(ValueError, match="z_first"):
+        predictor.export_stablehlo(path, {"a_second": ab})
+    with pytest.raises(ValueError, match="bogus"):
+        predictor.export_stablehlo(
+            path, {"a_second": ab, "z_first": zb, "bogus": ab})

@@ -40,7 +40,7 @@ def _fmt_bytes(v):
 
 
 def load(path):
-    snapshots, results, op_profiles = [], [], []
+    snapshots, results, op_profiles, loadgens = [], [], [], []
     with open(path) as f:
         for ln, line in enumerate(f, 1):
             line = line.strip()
@@ -59,7 +59,9 @@ def load(path):
                 results.append(rec)
             elif kind == "op_profile":
                 op_profiles.append(rec)
-    return snapshots, results, op_profiles
+            elif kind == "serving_loadgen":
+                loadgens.append(rec)
+    return snapshots, results, op_profiles, loadgens
 
 
 def _hist(snap, name):
@@ -67,10 +69,11 @@ def _hist(snap, name):
 
 
 def report(path, out=sys.stdout):
-    snapshots, results, op_profiles = load(path)
+    snapshots, results, op_profiles, loadgens = load(path)
     w = out.write
     w(f"runtime stats report — {path}\n")
-    if not snapshots and not results and not op_profiles:
+    if not snapshots and not results and not op_profiles \
+            and not loadgens:
         w("no snapshots or bench results found\n")
         return 1
     w(f"snapshots: {len(snapshots)}   bench results: {len(results)}\n")
@@ -149,6 +152,50 @@ def report(path, out=sys.stdout):
         w(f"{'in use':26s} {_fmt_bytes(mem)}   peak "
           f"{_fmt_bytes(g.get('memory.device_peak_bytes'))}   limit "
           f"{_fmt_bytes(g.get('memory.device_bytes_limit'))}\n")
+
+    sreq = c.get("serving.requests")
+    sb = _hist(snap, "serving.batch_size")
+    if sreq or sb or loadgens:
+        w("\n-- serving --\n")
+        if sreq:
+            w(f"{'requests':26s} {int(sreq)}   rejected "
+              f"{int(c.get('serving.rejected', 0))}   timeouts "
+              f"{int(c.get('serving.timeouts', 0))}   batches "
+              f"{int(c.get('serving.batches', 0))}\n")
+        if sb and sb["count"]:
+            w(f"{'batch size':26s} count {sb['count']:<6d} "
+              f"p50 {sb['p50']:.1f}  p95 {sb['p95']:.1f}  "
+              f"mean {sb['sum'] / sb['count']:.2f}\n")
+        for label, name in (("queue wait", "serving.queue_wait_ms"),
+                            ("e2e latency", "serving.e2e_ms")):
+            h = _hist(snap, name)
+            if h and h["count"]:
+                w(f"{label:26s} count {h['count']:<6d} "
+                  f"p50 {h['p50']:.2f} ms  p95 {h['p95']:.2f} ms\n")
+        pw = _hist(snap, "serving.pad_waste_frac")
+        if pw and pw["count"]:
+            w(f"{'pad waste':26s} mean "
+              f"{pw['sum'] / pw['count']:.1%} of padded elements\n")
+        wu = c.get("serving.warmup_shapes")
+        if wu:
+            wh = _hist(snap, "serving.warmup_seconds") or {}
+            w(f"{'warmup':26s} {int(wu)} ladder shape(s), total "
+              f"{_fmt_s(wh.get('sum'))}\n")
+        for r in loadgens:
+            lat = r.get("latency_ms") or {}
+            cache = r.get("cache") or {}
+            extra = ""
+            if "post_warmup_compiles" in cache:
+                extra = (f"  post-warmup compiles "
+                         f"{cache['post_warmup_compiles']}")
+            elif "serial_compiles" in cache:
+                extra = f"  compiles {cache['serial_compiles']}"
+            w(f"loadgen[{r.get('mode', '?')}]{'':12s} "
+              f"{r.get('requests', 0)} req  "
+              f"{r.get('throughput_rps', 0)} rps  "
+              f"p50 {lat.get('p50')} ms  p95 {lat.get('p95')} ms  "
+              f"p99 {lat.get('p99')} ms  errors {r.get('errors', 0)}"
+              f"{extra}\n")
 
     phases = snap.get("phases") or {}
     if phases:

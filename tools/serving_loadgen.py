@@ -1,0 +1,335 @@
+"""Load generator for the serving engine: throughput + latency JSONL.
+
+Two targets:
+
+* **in-process** (default): builds a tiny CPU model (or loads
+  --model-dir), starts a warmed ServingEngine, and drives it directly —
+  the CPU smoke bench behind the acceptance criteria (zero post-warmup
+  compiles; batched > serial throughput).
+* **HTTP** (--url): POSTs /v1/predict at an already-running front end.
+
+Two arrival disciplines:
+
+* **closed loop** (default): --concurrency workers each keep exactly one
+  request in flight (classic closed-loop load; throughput is
+  concurrency / mean latency).
+* **open loop** (--rate R): requests are launched on a fixed-rate
+  schedule regardless of completions, the discipline that actually
+  exposes queueing collapse (rejections surface as `errors`).
+
+Each run appends one `{"kind": "serving_loadgen", ...}` record to --out
+(JSONL, schema enforced by tools/validate_bench_json.py) and prints it;
+tools/metrics_report.py renders these records as a serving section.
+--compare-serial additionally runs the same request set through a bare
+single-request predictor and emits a second record (mode
+"serial_baseline") plus a speedup line. --check-compiles asserts the
+executor cache-miss counter stayed flat after warmup (exit 3 when it
+moved).
+
+Usage:
+    python tools/serving_loadgen.py --requests 200 --concurrency 8 \
+        --compare-serial --check-compiles --out loadgen.jsonl
+    python tools/serving_loadgen.py --url http://127.0.0.1:8000 \
+        --rate 50 --duration 10
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _percentile(sorted_ms, q):
+    if not sorted_ms:
+        return None
+    i = min(len(sorted_ms) - 1, max(0, int(q * len(sorted_ms)) - 1))
+    return round(sorted_ms[i], 3)
+
+
+def summarize(kind_mode, latencies_s, errors, duration_s, config):
+    lat = sorted(v * 1e3 for v in latencies_s)
+    n = len(lat)
+    return {
+        "kind": "serving_loadgen",
+        "mode": kind_mode,
+        "requests": n,
+        "errors": errors,
+        "duration_s": round(duration_s, 4),
+        "throughput_rps": round(n / duration_s, 2) if duration_s else 0.0,
+        "latency_ms": {
+            "mean": round(sum(lat) / n, 3) if n else None,
+            "p50": _percentile(lat, 0.50),
+            "p95": _percentile(lat, 0.95),
+            "p99": _percentile(lat, 0.99),
+            "max": round(lat[-1], 3) if n else None,
+        },
+        "config": config,
+    }
+
+
+def build_tiny_model(tmpdir, feat=6):
+    """Save the classifier the serving tests use: x[b, t, feat] ->
+    reduce_sum over t -> fc -> softmax (seq-pad invariant, so bucket
+    padding is checkable against unpadded references)."""
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[-1, -1, feat], dtype="float32",
+                        append_batch_size=False)
+        s = layers.reduce_sum(x, dim=1)
+        h = layers.fc(s, size=16, act="relu")
+        pred = layers.fc(h, size=4, act="softmax")
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        fluid.io.save_inference_model(tmpdir, ["x"], [pred], exe,
+                                      main_program=main)
+    return tmpdir
+
+
+def make_requests(n, seq_buckets, feat, seed=0):
+    """Mixed-shape single-row requests with lengths drawn from the
+    bucket ladder's covered range."""
+    rng = np.random.RandomState(seed)
+    hi = max(seq_buckets)
+    return [{"x": rng.randn(1, int(rng.randint(1, hi + 1)),
+                            feat).astype(np.float32)}
+            for _ in range(n)]
+
+
+class _EngineTarget:
+    def __init__(self, engine):
+        self.engine = engine
+
+    def call(self, feed, timeout_ms):
+        self.engine.predict(feed, timeout_ms=timeout_ms)
+
+
+class _HTTPTarget:
+    def __init__(self, url):
+        self.url = url.rstrip("/")
+
+    def call(self, feed, timeout_ms):
+        import urllib.request
+        body = json.dumps(
+            {"inputs": {k: v.tolist() for k, v in feed.items()},
+             "timeout_ms": timeout_ms}).encode()
+        req = urllib.request.Request(
+            self.url + "/v1/predict", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            resp.read()
+
+
+def run_closed(target, requests, concurrency, timeout_ms):
+    latencies, errors = [], [0]
+    lock = threading.Lock()
+    it = iter(requests)
+
+    def worker():
+        while True:
+            with lock:
+                feed = next(it, None)
+            if feed is None:
+                return
+            t0 = time.perf_counter()
+            try:
+                target.call(feed, timeout_ms)
+                dt = time.perf_counter() - t0
+                with lock:
+                    latencies.append(dt)
+            except Exception:  # noqa: BLE001 — rejected/timed-out
+                with lock:     # requests are the load signal, not a bug
+                    errors[0] += 1
+
+    threads = [threading.Thread(target=worker) for _ in range(concurrency)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return latencies, errors[0], time.perf_counter() - t0
+
+
+def run_open(target, requests, rate, timeout_ms):
+    """Fixed-rate arrivals: every 1/rate seconds a new request launches
+    on its own thread whether or not earlier ones finished."""
+    latencies, errors = [], [0]
+    lock = threading.Lock()
+    threads = []
+
+    def one(feed):
+        t0 = time.perf_counter()
+        try:
+            target.call(feed, timeout_ms)
+            dt = time.perf_counter() - t0
+            with lock:
+                latencies.append(dt)
+        except Exception:  # noqa: BLE001
+            with lock:
+                errors[0] += 1
+
+    interval = 1.0 / rate
+    t_start = time.perf_counter()
+    for i, feed in enumerate(requests):
+        due = t_start + i * interval
+        delay = due - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        th = threading.Thread(target=one, args=(feed,))
+        th.start()
+        threads.append(th)
+    for th in threads:
+        th.join()
+    return latencies, errors[0], time.perf_counter() - t_start
+
+
+def run_serial_baseline(predictor, requests):
+    """Single-request dispatch, no batching — the throughput floor the
+    batched engine must beat."""
+    latencies = []
+    t0 = time.perf_counter()
+    for feed in requests:
+        t1 = time.perf_counter()
+        predictor.run_dict(feed)
+        latencies.append(time.perf_counter() - t1)
+    return latencies, 0, time.perf_counter() - t0
+
+
+def emit(rec, out_path):
+    print(json.dumps(rec))
+    if out_path:
+        d = os.path.dirname(os.path.abspath(out_path))
+        os.makedirs(d, exist_ok=True)
+        with open(out_path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--url", help="drive a running HTTP front end "
+                                  "instead of an in-process engine")
+    ap.add_argument("--model-dir", help="saved inference model for the "
+                                        "in-process engine (default: "
+                                        "build a tiny classifier)")
+    ap.add_argument("--requests", type=int, default=200)
+    ap.add_argument("--duration", type=float, default=0.0,
+                    help="open-loop only: cap the run; 0 = run the "
+                         "request count")
+    ap.add_argument("--concurrency", type=int, default=8)
+    ap.add_argument("--rate", type=float, default=0.0,
+                    help="open-loop arrivals per second (0 = closed "
+                         "loop)")
+    ap.add_argument("--max-batch-size", type=int, default=8)
+    ap.add_argument("--max-wait-us", type=int, default=2000)
+    ap.add_argument("--seq-buckets", default="8,16,32",
+                    help="comma-separated seq bucket ladder")
+    ap.add_argument("--timeout-ms", type=float, default=10000.0)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--no-warmup", action="store_true",
+                    help="skip the bucket-ladder warmup pass (baseline "
+                         "for the compile-count comparison)")
+    ap.add_argument("--compare-serial", action="store_true")
+    ap.add_argument("--check-compiles", action="store_true",
+                    help="exit 3 if the engine executor compiled "
+                         "anything after warmup")
+    ap.add_argument("--out", help="append JSONL records here")
+    args = ap.parse_args(argv)
+
+    seq_buckets = tuple(int(s) for s in args.seq_buckets.split(","))
+    feat = 6
+    reqs = make_requests(args.requests, seq_buckets, feat, args.seed)
+    common = {"concurrency": args.concurrency, "rate": args.rate,
+              "max_batch_size": args.max_batch_size,
+              "max_wait_us": args.max_wait_us,
+              "seq_buckets": list(seq_buckets),
+              "warmup": not args.no_warmup}
+
+    rc = 0
+    if args.url:
+        target = _HTTPTarget(args.url)
+        if args.rate > 0:
+            if args.duration > 0:
+                reqs = reqs[:max(1, int(args.rate * args.duration))]
+            lat, errs, dur = run_open(target, reqs, args.rate,
+                                      args.timeout_ms)
+            rec = summarize("open", lat, errs, dur, common)
+        else:
+            lat, errs, dur = run_closed(target, reqs, args.concurrency,
+                                        args.timeout_ms)
+            rec = summarize("closed", lat, errs, dur, common)
+        emit(rec, args.out)
+        return rc
+
+    from paddle_tpu.serving import EngineConfig, ServingEngine
+
+    model_dir = args.model_dir or build_tiny_model(
+        tempfile.mkdtemp(prefix="serving_loadgen_"), feat)
+    cfg = EngineConfig(model_dir,
+                       max_batch_size=args.max_batch_size,
+                       max_wait_us=args.max_wait_us,
+                       queue_capacity=max(64, args.concurrency * 8),
+                       default_timeout_ms=args.timeout_ms,
+                       seq_buckets=seq_buckets,
+                       warmup=not args.no_warmup)
+    engine = ServingEngine(cfg)
+    engine.start()
+    misses_after_warmup = engine.cache_stats()["misses"]
+
+    target = _EngineTarget(engine)
+    if args.rate > 0:
+        if args.duration > 0:
+            reqs = reqs[:max(1, int(args.rate * args.duration))]
+        lat, errs, dur = run_open(target, reqs, args.rate,
+                                  args.timeout_ms)
+        rec = summarize("open", lat, errs, dur, common)
+    else:
+        lat, errs, dur = run_closed(target, reqs, args.concurrency,
+                                    args.timeout_ms)
+        rec = summarize("closed", lat, errs, dur, common)
+    stats = engine.cache_stats()
+    rec["cache"] = {"misses_after_warmup": misses_after_warmup,
+                    "misses_total": stats["misses"],
+                    "post_warmup_compiles":
+                        stats["misses"] - misses_after_warmup}
+    emit(rec, args.out)
+
+    if args.compare_serial:
+        ref = engine.predictor.clone()  # shares weights + compile cache
+        misses_before_serial = engine.cache_stats()["misses"]
+        slat, serrs, sdur = run_serial_baseline(ref, reqs)
+        srec = summarize("serial_baseline", slat, serrs, sdur, common)
+        # the batcher-off baseline feeds RAW shapes, so every novel
+        # (1, seq) pair is a fresh XLA specialization — the recompile
+        # pathology the bucket ladder exists to prevent
+        srec["cache"] = {"serial_compiles":
+                         engine.cache_stats()["misses"]
+                         - misses_before_serial}
+        emit(srec, args.out)
+        if srec["throughput_rps"]:
+            speedup = rec["throughput_rps"] / srec["throughput_rps"]
+            print(f"# batched/serial speedup: {speedup:.2f}x")
+
+    engine.stop()
+    if args.check_compiles and rec["cache"]["post_warmup_compiles"] > 0:
+        print(f"FAIL: {rec['cache']['post_warmup_compiles']} compiles "
+              f"after warmup (warmup={not args.no_warmup})",
+              file=sys.stderr)
+        rc = 3
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

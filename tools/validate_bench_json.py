@@ -11,7 +11,10 @@ Checks, per file (type auto-detected from content):
   in results carries the metric/value/unit/vs_baseline contract the
   driver greps for.
 * *.jsonl (monitor export / bench log / flight recorder): EVERY
-  non-empty line parses as a JSON object.
+  non-empty line parses as a JSON object; lines with kind ==
+  "serving_loadgen" (tools/serving_loadgen.py) additionally carry the
+  mode/requests/duration_s/throughput_rps/latency_ms{p50,p95,p99}
+  contract the serving report section reads.
 * driver BENCH_rNN.json wrappers ({"n", "cmd", "rc", "tail",
   "parsed"}): parsed must be non-null — the exact invariant the r05
   rc=124 artifact violated.
@@ -67,6 +70,39 @@ def validate_wrapper(obj, where="wrapper"):
     return errs
 
 
+_LOADGEN_PCTS = ("p50", "p95", "p99")
+
+
+def validate_loadgen(obj, where="loadgen"):
+    """Schema of one tools/serving_loadgen.py record."""
+    errs = []
+    if not isinstance(obj.get("mode"), str):
+        errs.append(f"{where}: mode must be a string "
+                    f"(got {obj.get('mode')!r})")
+    for key in ("requests", "errors", "duration_s", "throughput_rps"):
+        if not isinstance(obj.get(key), (int, float)) \
+                or isinstance(obj.get(key), bool):
+            errs.append(f"{where}: {key} must be numeric "
+                        f"(got {obj.get(key)!r})")
+    lat = obj.get("latency_ms")
+    if not isinstance(lat, dict):
+        errs.append(f"{where}: latency_ms must be an object")
+    else:
+        for q in _LOADGEN_PCTS:
+            v = lat.get(q)
+            # None is legal only for a run that completed zero requests
+            if v is None and obj.get("requests"):
+                errs.append(f"{where}: latency_ms.{q} missing with "
+                            f"requests > 0")
+            elif v is not None and (not isinstance(v, (int, float))
+                                    or isinstance(v, bool)):
+                errs.append(f"{where}: latency_ms.{q} must be numeric "
+                            f"(got {v!r})")
+    if not isinstance(obj.get("config"), dict):
+        errs.append(f"{where}: config must be an object")
+    return errs
+
+
 def validate_jsonl(path):
     errs = []
     with open(path) as f:
@@ -81,6 +117,8 @@ def validate_jsonl(path):
                 continue
             if not isinstance(rec, dict):
                 errs.append(f"{path}:{ln}: line is not a JSON object")
+            elif rec.get("kind") == "serving_loadgen":
+                errs.extend(validate_loadgen(rec, where=f"{path}:{ln}"))
     return errs
 
 
