@@ -1,0 +1,34 @@
+"""Static program verifier: shape/dtype inference + graph lints.
+
+Reference analogue: the reference framework validates every ProgramDesc
+op-by-op at build time — InferShape/InferVarType in framework/operator.cc
+and op_desc.cc, plus the IR pass checks under framework/ir/. paddle_tpu
+infers shapes op-by-op at append time (framework.Block.append_op ->
+lowering.infer_op_shapes) but until now had no whole-program check: a
+malformed program surfaced as an opaque JAX traceback deep inside
+core/lowering.py, or as a wasted XLA compile in a serving warmup.
+
+This package checks a Program with ZERO device work:
+
+- `shape_infer`: propagate (shape, dtype) through every op via
+  jax.eval_shape over the registered lowering (abstract evaluation only;
+  nothing is compiled or executed), with registry-level `abstract_eval`
+  rules for control-flow ops and an opaque set for host/RPC/LoD-array
+  ops that cannot abstract-eval.
+- `verifier`: dataflow lints (use-before-def, dead ops, write-after-
+  write, inplace aliasing hazards, sub-block consistency, registry and
+  version checks) + the executor/serving pre-compile gate driven by
+  FLAGS_program_verify=off|warn|error.
+
+Every diagnostic carries a stable rule ID (PTVnnn), a severity, and
+provenance in the same "{op_type}:{block}/{op_idx}" format the op trace
+scopes use (FLAGS_op_trace_scopes), so a verifier finding and a profiler
+trace row name the same op. CLI: tools/program_lint.py. Rule catalog:
+docs/static_analysis.md.
+"""
+from .diagnostics import (Diagnostic, ProgramVerificationError, RULES,
+                          VerifyResult)
+from .verifier import verify_gate, verify_program
+
+__all__ = ["Diagnostic", "VerifyResult", "ProgramVerificationError",
+           "RULES", "verify_program", "verify_gate"]

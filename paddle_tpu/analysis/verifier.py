@@ -1,0 +1,413 @@
+"""Graph lints + the FLAGS_program_verify pre-compile gate.
+
+`verify_program` is the pure entry point (CLI, tests); `verify_gate` is
+the memoized wrapper Executor.run and ServingEngine.warmup call so a
+program is verified once per (fingerprint, feeds, fetches) and never
+again — the expensive half (abstract evaluation of every lowering,
+shape_infer.py) is additionally memoized by fingerprint alone, so
+re-running one program with different fetch lists only repeats the cheap
+graph walks.
+
+Rule catalog: diagnostics.RULES / docs/static_analysis.md.
+"""
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Dict, Iterable, Optional
+
+from ..core.registry import REGISTRY
+from ..monitor import STAT_ADD
+from .diagnostics import VerifyResult
+from .shape_infer import OPAQUE_OPS, declared_spec, infer_program_specs
+
+__all__ = ["verify_program", "verify_gate"]
+
+# Ops whose execution is the point (host effects), so dead-op
+# reachability never flags them even when nothing reads their outputs.
+_SIDE_EFFECT_OPS = frozenset({
+    "print", "save", "save_combine", "load", "load_combine",
+    "feed", "fetch", "read", "create_custom_reader", "py_func",
+    "send", "recv", "prefetch", "fetch_barrier", "send_barrier",
+    "checkpoint_notify", "geo_sgd_send", "distributed_notify",
+    "listen_and_serv", "fl_listen_and_serv", "delete_var",
+    "push_box_sparse", "gen_nccl_id", "c_gen_nccl_id", "c_comm_init",
+    "c_comm_init_all", "c_sync_calc_stream", "c_sync_comm_stream",
+})
+
+# Control-flow ops that legitimately re-write a var another op already
+# wrote (branch merge / carry patterns) — excluded from write-after-write.
+_MERGE_OPS = frozenset({
+    "conditional_block", "conditional_block_infer", "while",
+    "select_input", "merge_lod_tensor", "assign", "recurrent",
+})
+
+_CTRL_FLOW_SUB_BLOCK = ("while", "conditional_block",
+                        "conditional_block_infer", "recurrent",
+                        "recompute_segment")
+
+
+def _op_names(op, which) -> Iterable[str]:
+    d = op.inputs if which == "in" else op.outputs
+    return [n for ns in d.values() for n in ns if n]
+
+
+def verify_program(program, feed_names: Optional[Iterable[str]] = None,
+                   fetch_names: Optional[Iterable[str]] = None,
+                   op_versions: Optional[Dict[str, int]] = None,
+                   check_shapes: bool = True,
+                   _core: Optional[VerifyResult] = None) -> VerifyResult:
+    """Statically verify `program`; no compilation, no device work.
+
+    feed_names: vars supplied at run time (beyond is_data/persistable
+    vars) — counted as available for the dataflow lints and checked to
+    exist (PTV030). fetch_names: enables dead-op reachability (PTV012)
+    and the fetch-materialisation check (PTV031). op_versions: a saved
+    program's {op type: version} map, checked against the registry
+    (PTV002). check_shapes=False skips the abstract-evaluation pass.
+    """
+    feed_set = {str(n) for n in (feed_names or ())}
+    fetch_list = [str(n) for n in (fetch_names or ())]
+
+    result = VerifyResult()
+    if _core is not None:
+        result.extend(_core)
+    else:
+        result.extend(_verify_core(program, check_shapes))
+
+    if op_versions:
+        _lint_versions(op_versions, result)
+    _lint_io(program, feed_set, fetch_list, result)
+    if fetch_list:
+        _lint_dead_ops(program, fetch_list, result)
+    _lint_unused_outputs(program, fetch_list, result)
+    return result
+
+
+def _verify_core(program, check_shapes=True) -> VerifyResult:
+    """The feed/fetch-independent findings (memoizable by fingerprint)."""
+    result = VerifyResult()
+    for block in program.blocks:
+        _lint_block(program, block, result)
+    if check_shapes:
+        infer_program_specs(program, result)
+    return result
+
+
+# ---------------------------------------------------------------------------
+# per-block dataflow lints
+# ---------------------------------------------------------------------------
+
+def _available_at_entry(program, block):
+    """Vars readable before any op of `block` runs: the whole ancestor
+    scope chain (sub-blocks are entered mid-parent, and shapes are
+    static, so the parent's full symbol table is a sound
+    over-approximation) plus local persistables/data vars."""
+    avail = set()
+    blk = block
+    while blk is not None:
+        if blk is block:
+            avail |= {n for n, v in blk.vars.items()
+                      if v.persistable or v.is_data}
+        else:
+            avail |= set(blk.vars)
+        blk = blk.parent
+    return avail
+
+
+def _lint_block(program, block, result):
+    avail = _available_at_entry(program, block)
+    last_write = {}   # var -> (op_idx, op_type, is_merge_or_inplace)
+    inplace_aliases = []  # (op_idx, op_type, var)
+
+    for op_idx, op in enumerate(block.ops):
+        opdef = REGISTRY._ops.get(op.type)
+        if opdef is None:
+            import difflib
+            close = difflib.get_close_matches(
+                op.type, list(REGISTRY._ops), n=3, cutoff=0.6)
+            hint = ("; did you mean " +
+                    ", ".join(repr(c) for c in close) + "?") if close \
+                else ""
+            result.add("PTV001",
+                       f"op type {op.type!r} has no registered "
+                       f"lowering{hint}",
+                       op_type=op.type, block=block.idx, op_idx=op_idx)
+
+        ins = list(_op_names(op, "in"))
+        outs = list(_op_names(op, "out"))
+
+        for name in ins:
+            var = block._find_var_recursive(name)
+            if var is None:
+                result.add("PTV010",
+                           f"input {name!r} is not declared in block "
+                           f"{block.idx} or any ancestor",
+                           op_type=op.type, block=block.idx,
+                           op_idx=op_idx, var=name)
+            elif name not in avail and name not in outs:
+                result.add("PTV011",
+                           f"input {name!r} is read before any op "
+                           f"produces it (not persistable, not a data "
+                           f"var, not fed)",
+                           op_type=op.type, block=block.idx,
+                           op_idx=op_idx, var=name)
+            # inplace-alias hazard: a later read of a var an inplace op
+            # aliased means donation may have already clobbered it
+            for w_idx, w_type, w_var in inplace_aliases:
+                if name == w_var:
+                    result.add("PTV015",
+                               f"{w_var!r} was updated in place by "
+                               f"{w_type!r} (op {w_idx}) but is read "
+                               f"again here — the buffer may be donated"
+                               f"/overwritten",
+                               op_type=op.type, block=block.idx,
+                               op_idx=op_idx, var=name)
+            if name in last_write:
+                last_write.pop(name, None)
+
+        is_inplace = bool(opdef is not None and opdef.inplace)
+        is_merge = op.type in _MERGE_OPS
+        for name in outs:
+            var = block._find_var_recursive(name)
+            persistable = bool(var is not None and var.persistable)
+            prev = last_write.get(name)
+            if prev is not None and not persistable \
+                    and not (is_inplace or is_merge):
+                p_idx, p_type, p_soft = prev
+                if not p_soft:
+                    result.add("PTV014",
+                               f"{name!r} written by {p_type!r} (op "
+                               f"{p_idx}) is overwritten before "
+                               f"anything reads it",
+                               op_type=op.type, block=block.idx,
+                               op_idx=op_idx, var=name)
+            last_write[name] = (op_idx, op.type,
+                                is_inplace or is_merge or persistable)
+            avail.add(name)
+            if is_inplace and name in ins:
+                inplace_aliases.append((op_idx, op.type, name))
+
+        if op.type in _CTRL_FLOW_SUB_BLOCK:
+            _lint_sub_block(program, block, op, op_idx, result)
+
+
+def _lint_sub_block(program, block, op, op_idx, result):
+    def bad(msg):
+        result.add("PTV040", msg, op_type=op.type, block=block.idx,
+                   op_idx=op_idx)
+
+    sb = op.attrs.get("sub_block")
+    if isinstance(sb, dict):  # {"__block__": idx} serialized form
+        sb = sb.get("__block__")
+    if not isinstance(sb, int) or not (0 < sb < len(program.blocks)):
+        bad(f"sub_block attr {op.attrs.get('sub_block')!r} does not "
+            f"name a block of this program "
+            f"({len(program.blocks)} blocks)")
+        return
+    sub = program.blocks[sb]
+    for attr in ("output_vars", "carried_vars", "input_vars"):
+        for name in op.attrs.get(attr, []) or []:
+            if sub._find_var_recursive(name) is None:
+                bad(f"{attr} entry {name!r} is not declared in "
+                    f"sub-block {sb} or its ancestors")
+    cond = op.attrs.get("condition")
+    if op.type == "while" and cond \
+            and sub._find_var_recursive(cond) is None:
+        bad(f"condition var {cond!r} is not declared in sub-block "
+            f"{sb} or its ancestors")
+
+
+# ---------------------------------------------------------------------------
+# program-level lints
+# ---------------------------------------------------------------------------
+
+def _lint_versions(saved: Dict[str, int], result):
+    for t, v in saved.items():
+        if REGISTRY.has(t) and int(v) > REGISTRY.get(t).version:
+            result.add("PTV002",
+                       f"saved program uses {t!r} v{v} but this build "
+                       f"supports v{REGISTRY.get(t).version}",
+                       op_type=t)
+
+
+def _lint_io(program, feed_set, fetch_list, result):
+    gb = program.global_block()
+    for name in sorted(feed_set):
+        if not gb.has_var(name):
+            result.add("PTV030",
+                       f"feed {name!r} does not name a var of the "
+                       f"program", var=name)
+    if not fetch_list:
+        return
+    produced = {n for op in gb.ops for n in _op_names(op, "out")}
+    for name in fetch_list:
+        var = gb._find_var_recursive(name)
+        if var is None:
+            result.add("PTV031",
+                       f"fetch target {name!r} does not name a var of "
+                       f"the program", var=name)
+        elif name not in produced and not var.persistable \
+                and not var.is_data and name not in feed_set:
+            result.add("PTV031",
+                       f"fetch target {name!r} is never produced in the "
+                       f"global block (sub-block values do not surface)",
+                       var=name)
+
+
+def _op_is_anchored(op, block):
+    """Ops kept live regardless of fetch reachability: host effects,
+    in-place state updates, writes to persistable vars, opless sinks."""
+    if op.type in _SIDE_EFFECT_OPS:
+        return True
+    opdef = REGISTRY._ops.get(op.type)
+    if opdef is not None and opdef.inplace:
+        return True
+    outs = list(_op_names(op, "out"))
+    if not outs:
+        return True
+    for n in outs:
+        v = block._find_var_recursive(n)
+        if v is not None and v.persistable:
+            return True
+    return False
+
+
+def _lint_dead_ops(program, fetch_list, result):
+    block = program.global_block()
+    needed = set(fetch_list)
+    # lengths companions are read implicitly by the feed path
+    needed |= set(program.lod_link.values())
+    for op_idx in reversed(range(len(block.ops))):
+        op = block.ops[op_idx]
+        outs = _op_names(op, "out")
+        live = _op_is_anchored(op, block) or any(n in needed
+                                                 for n in outs)
+        if live:
+            needed |= set(_op_names(op, "in"))
+            # sub-block reads count: condition/carried vars resolve
+            # against the parent scope too
+            for attr in ("input_vars", "carried_vars", "condition"):
+                v = op.attrs.get(attr)
+                if isinstance(v, str):
+                    needed.add(v)
+                elif isinstance(v, (list, tuple)):
+                    needed |= {str(x) for x in v}
+            if op.type in _CTRL_FLOW_SUB_BLOCK:
+                sb = op.attrs.get("sub_block")
+                if isinstance(sb, int) and 0 < sb < len(program.blocks):
+                    for sop in program.blocks[sb].ops:
+                        needed |= set(_op_names(sop, "in"))
+        else:
+            result.add("PTV012",
+                       f"no path from its outputs {outs} to the fetch "
+                       f"targets — op never affects a fetched value",
+                       op_type=op.type, block=block.idx, op_idx=op_idx)
+
+
+def _lint_unused_outputs(program, fetch_list, result):
+    reads = set(fetch_list)
+    reads |= set(program.lod_link.values())
+    for blk in program.blocks:
+        for op in blk.ops:
+            reads |= set(_op_names(op, "in"))
+            for attr in ("input_vars", "carried_vars", "condition",
+                         "output_vars"):
+                v = op.attrs.get(attr)
+                if isinstance(v, str):
+                    reads.add(v)
+                elif isinstance(v, (list, tuple)):
+                    reads |= {str(x) for x in v}
+    for blk in program.blocks:
+        for op_idx, op in enumerate(blk.ops):
+            if op.type in _SIDE_EFFECT_OPS or op.type in OPAQUE_OPS:
+                continue
+            outs = list(_op_names(op, "out"))
+            if len(outs) < 2:
+                # single-output dead ops are PTV012's job; flagging every
+                # unfetched tail value would be noise
+                continue
+            for name in outs:
+                v = blk._find_var_recursive(name)
+                if v is not None and (v.persistable or v.is_data):
+                    continue
+                if name not in reads:
+                    result.add("PTV013",
+                               f"output {name!r} is never read, "
+                               f"fetched, or persisted (auxiliary "
+                               f"output that could be dropped)",
+                               op_type=op.type, block=blk.idx,
+                               op_idx=op_idx, var=name)
+
+
+# ---------------------------------------------------------------------------
+# the pre-compile gate (Executor.run / ServingEngine.warmup)
+# ---------------------------------------------------------------------------
+
+_MEMO_LOCK = threading.Lock()
+_CORE_MEMO: "OrderedDict[str, VerifyResult]" = OrderedDict()
+_GATE_MEMO: "OrderedDict[tuple, VerifyResult]" = OrderedDict()
+_MEMO_CAP = 256
+
+
+def _memo_put(memo, key, val):
+    memo[key] = val
+    while len(memo) > _MEMO_CAP:
+        memo.popitem(last=False)
+
+
+def reset_memo():
+    """Drop gate memoization (tests; after re-registering ops)."""
+    with _MEMO_LOCK:
+        _CORE_MEMO.clear()
+        _GATE_MEMO.clear()
+
+
+def verify_gate(program, feed_names=None, fetch_names=None,
+                where="executor") -> Optional[VerifyResult]:
+    """The FLAGS_program_verify gate: off | warn (default) | error.
+
+    Runs verify_program once per (program fingerprint, feed names,
+    fetch names) and memoizes; in 'error' mode error-severity findings
+    raise ProgramVerificationError — BEFORE any executable is built or
+    cached, so Executor.cache_stats() shows zero misses for a rejected
+    program. In 'warn' mode findings surface as a single summarized
+    warnings.warn per program."""
+    from ..core.flags import FLAGS
+    mode = FLAGS.program_verify
+    if mode == "off":
+        return None
+    if mode not in ("warn", "error"):
+        raise ValueError(
+            f"FLAGS_program_verify={mode!r}: expected 'off', 'warn' or "
+            f"'error'")
+
+    fp = program.fingerprint()
+    key = (fp, tuple(sorted(str(n) for n in (feed_names or ()))),
+           tuple(str(n) for n in (fetch_names or ())))
+    with _MEMO_LOCK:
+        res = _GATE_MEMO.get(key)
+        core = _CORE_MEMO.get(fp)
+    fresh = res is None
+    if fresh:
+        if core is None:
+            core = _verify_core(program)
+            with _MEMO_LOCK:
+                _memo_put(_CORE_MEMO, fp, core)
+        res = verify_program(program, feed_names=key[1],
+                             fetch_names=key[2], _core=core)
+        with _MEMO_LOCK:
+            _memo_put(_GATE_MEMO, key, res)
+        STAT_ADD("analysis.programs_verified")
+        if res.errors():
+            STAT_ADD("analysis.findings_error", len(res.errors()))
+        if res.warnings():
+            STAT_ADD("analysis.findings_warn", len(res.warnings()))
+    if mode == "error":
+        res.raise_if_errors()
+    elif fresh and res.findings:
+        import warnings
+        warnings.warn(f"[{where}] {res.summary()} "
+                      f"(FLAGS_program_verify=warn; see "
+                      f"docs/static_analysis.md)")
+    return res

@@ -259,6 +259,18 @@ DEFINE_bool(
     "trace/metadata only — no runtime cost — so the default is on; "
     "turn off to diff HLO text across op reorderings.", traced=True)
 
+DEFINE_string(
+    "program_verify", "warn",
+    "Static program verification (paddle_tpu/analysis) before the "
+    "executor or serving engine spends a compile: 'off' = skip; 'warn' "
+    "(default) = verify once per (program fingerprint, feeds, fetches) "
+    "and surface findings as one summarized warning; 'error' = raise "
+    "ProgramVerificationError on error-severity findings — with "
+    "'{op_type}:{block}/{op_idx}' provenance — before any executable "
+    "is built or cached. Zero device work either way: shape/dtype "
+    "inference runs jax.eval_shape over each op's lowering. Rule "
+    "catalog: docs/static_analysis.md; CLI: tools/program_lint.py.")
+
 DEFINE_bool(
     "flight_recorder", True,
     "Keep a bounded in-memory ring of per-step flight records (step "

@@ -131,7 +131,9 @@ def _op_scope(op, op_idx):
 def run_op(op, env, ctx, op_idx=None):
     """Execute one op's lowering against env (name -> array)."""
     from .flags import FLAGS
-    opdef = REGISTRY.get(op.type)
+    blk = op.block.idx if getattr(op, "block", None) is not None else 0
+    opdef = REGISTRY.get(
+        op.type, where=f"{blk}/{'?' if op_idx is None else op_idx}")
     ins = {}
     for slot, names in op.inputs.items():
         vals = _gather_slot(env, names)

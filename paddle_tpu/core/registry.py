@@ -46,6 +46,13 @@ class OpDef:
     # checked when loading saved programs/checkpoints (the reference's
     # op_compatible_info.h version gating).
     version: int = 1
+    # Optional static shape rule for the analysis verifier
+    # (paddle_tpu/analysis/shape_infer.py): fn(op, in_specs, block) ->
+    # {out var name: ((shape with -1 dyn dims), dtype name)}. Only needed
+    # for ops whose lowering cannot run under jax.eval_shape (control
+    # flow over sub-blocks, host callbacks); pure lowerings get shape
+    # inference for free.
+    abstract_eval: Optional[Callable] = None
 
 
 class OpRegistry:
@@ -58,13 +65,24 @@ class OpRegistry:
         self._ops[opdef.type] = opdef
         return opdef
 
-    def get(self, op_type: str) -> OpDef:
+    def get(self, op_type: str, where: Optional[str] = None) -> OpDef:
+        """Look up an OpDef; `where` ("{block}/{op_idx}") names the
+        originating program op when the lookup happens during lowering,
+        so an unregistered-op failure points at the op, not just the
+        type. Near-miss suggestions cover the typo case."""
         try:
             return self._ops[op_type]
         except KeyError:
+            import difflib
+            close = difflib.get_close_matches(
+                op_type, list(self._ops), n=3, cutoff=0.6)
+            hint = ("; did you mean " +
+                    ", ".join(repr(c) for c in close) + "?") if close \
+                else ""
+            at = f" (at block/op {where})" if where else ""
             raise NotImplementedError(
                 f"op {op_type!r} has no registered TPU lowering "
-                f"({len(self._ops)} ops registered)"
+                f"({len(self._ops)} ops registered{hint}){at}"
             ) from None
 
     def has(self, op_type: str) -> bool:
@@ -79,7 +97,7 @@ REGISTRY = OpRegistry()
 
 def register_op(op_type, *, nondiff_inputs=(), nondiff_outputs=(), stateful=False,
                 manual_grad=None, custom_grad_maker=None, inplace=False,
-                version=1):
+                version=1, abstract_eval=None):
     """Decorator: @register_op("mul") def _mul(ctx, ins, attrs): ..."""
 
     def deco(fn):
@@ -89,7 +107,24 @@ def register_op(op_type, *, nondiff_inputs=(), nondiff_outputs=(), stateful=Fals
             nondiff_outputs=tuple(nondiff_outputs),
             stateful=stateful, manual_grad=manual_grad,
             custom_grad_maker=custom_grad_maker, inplace=inplace,
-            version=version))
+            version=version, abstract_eval=abstract_eval))
+        return fn
+
+    return deco
+
+
+def register_abstract_eval(op_type):
+    """Attach a static shape rule to an already-registered op:
+
+        @register_abstract_eval("while")
+        def _while_specs(op, in_specs, block): ...
+
+    Used by ops whose lowering cannot abstract-eval (control flow,
+    host callbacks) so the analysis verifier can still propagate
+    (shape, dtype) through them."""
+
+    def deco(fn):
+        REGISTRY.get(op_type).abstract_eval = fn
         return fn
 
     return deco

@@ -157,6 +157,14 @@ class Executor:
         from .parallel.recompute import expose_fetch_vars
         expose_fetch_vars(program, fetch_names)
 
+        # Static verification gate (FLAGS_program_verify, default warn):
+        # memoized per (fingerprint, feeds, fetches); in error mode a
+        # malformed program raises HERE — before the cache records a
+        # miss or any executable is built (paddle_tpu/analysis).
+        from .analysis import verify_gate
+        verify_gate(program, feed_names=feed_arrays.keys(),
+                    fetch_names=fetch_names, where="executor")
+
         key = self._cache_key(program, feed_arrays, fetch_names, compiled)
         step_fn = self._cache.get(key) if use_program_cache else None
         self._last_cache_hit = step_fn is not None
@@ -217,6 +225,7 @@ class Executor:
     def _prepare_feed(self, block, feed, compiled):
         t0 = time.perf_counter()
         out = {}
+        ragged_fed = set()  # names padded from a LoDTensor feed
         for name, val in feed.items():
             if isinstance(val, jax.Array):
                 # device-resident feed: hand it to the jitted step as-is
@@ -239,6 +248,7 @@ class Executor:
                     # a multiple of 8 so varying batch max-lengths don't
                     # churn the per-shape executable cache.
                     padded, lengths = val.to_padded(multiple=8)
+                    ragged_fed.add(name)
                     ln = block.program.lod_link.get(name)
                     if ln and block.has_var(ln) and ln not in feed:
                         out[ln] = np.asarray(
@@ -274,6 +284,28 @@ class Executor:
                 if arr.ndim >= 2:
                     out[ln] = np.full((arr.shape[0],), arr.shape[1],
                                       self._canon_feed_dtype(np.int64))
+        # Rank validation: a wrong-rank feed otherwise surfaces as an
+        # opaque XLA broadcast/shape error deep inside the lowering
+        # (reference: the feed_op's dim check). Dims may differ (-1
+        # batch/seq), rank may not. LoD vars are exempt: a ragged feed
+        # is padded to (batch, T, ...) on purpose, which differs from
+        # the declared per-timestep shape.
+        lod_names = (set(block.program.lod_link)
+                     | set(block.program.lod_link.values()) | ragged_fed)
+        for name, arr in out.items():
+            if name in lod_names or not block.has_var(name):
+                continue
+            var = block.var(name)
+            declared = var.shape
+            if not declared or getattr(var, "lod_level", 0):
+                continue  # unknown shape / LoD-ragged — nothing to check
+            got = tuple(getattr(arr, "shape", ()))
+            if len(got) != len(declared):
+                raise ValueError(
+                    f"feed {name!r}: fed array has rank {len(got)} "
+                    f"(shape {list(got)}) but the program declares "
+                    f"rank {len(declared)} (shape {list(declared)}); "
+                    f"reshape the feed or fix the data layer")
         if _monitor_on():
             total = host = 0
             for a in out.values():

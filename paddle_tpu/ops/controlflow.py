@@ -178,3 +178,54 @@ def _tensor_array_to_tensor(ctx, ins, attrs):
     return {"Out": [jnp.concatenate(parts, axis=axis)],
             "OutIndex": [jnp.asarray([p.shape[axis] for p in parts],
                                      jnp.int32)]}
+
+
+# ---------------------------------------------------------------------------
+# Static shape rules for the analysis verifier (analysis/shape_infer.py).
+# These ops lower over sub-blocks, so the generic jax.eval_shape path
+# either cannot run them or would re-trace the whole body; the rule
+# states the invariant directly: control-flow outputs keep the specs of
+# the vars they carry (XLA While/Cond shape invariance).
+# ---------------------------------------------------------------------------
+
+def _sub_block_of(op, block):
+    sb = op.attrs.get("sub_block")
+    if isinstance(sb, dict):
+        sb = sb.get("__block__")
+    blocks = block.program.blocks
+    if isinstance(sb, int) and 0 < sb < len(blocks):
+        return blocks[sb]
+    return None
+
+
+def _carry_out_specs(op, in_specs, block):
+    """Out[i] takes the spec of attrs['output_vars'][i]: the carried /
+    branch-written inner var — same name, same (static) shape. Falls
+    back to the declared spec of either the inner or the outer var."""
+    from ..analysis.shape_infer import declared_spec
+
+    sub = _sub_block_of(op, block)
+    out = {}
+    inner_names = op.attrs.get("output_vars", []) or []
+    outer_names = op.outputs.get("Out", [])
+    for outer, inner in zip(outer_names, inner_names):
+        if not outer:
+            continue
+        spec = in_specs.get(inner)
+        if spec is None and sub is not None:
+            v = sub._find_var_recursive(inner)
+            if v is not None:
+                spec = declared_spec(v)
+        if spec is None:
+            v = block._find_var_recursive(outer)
+            if v is not None:
+                spec = declared_spec(v)
+        if spec is not None:
+            out[outer] = spec
+    return out
+
+
+from ..core.registry import register_abstract_eval  # noqa: E402
+
+register_abstract_eval("while")(_carry_out_specs)
+register_abstract_eval("conditional_block")(_carry_out_specs)

@@ -156,6 +156,15 @@ class ServingEngine:
         """Run one dummy batch per ladder cell so every reachable shape
         lands in the Executor's executable cache before traffic.
         Returns the number of shapes warmed."""
+        # Static verification BEFORE spending any compiles
+        # (FLAGS_program_verify): in error mode a malformed model is
+        # rejected at load — cache_stats() still shows zero misses —
+        # instead of failing mid-traffic after minutes of warmup.
+        from ..analysis import verify_gate
+        verify_gate(self.predictor.program(),
+                    feed_names=self.predictor.get_input_names(),
+                    fetch_names=self.predictor.get_output_names(),
+                    where="serving.warmup")
         spec = self._feed_spec()
         shapes = self.warmup_shapes()
         for bb, sb in shapes:

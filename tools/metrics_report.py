@@ -40,7 +40,7 @@ def _fmt_bytes(v):
 
 
 def load(path):
-    snapshots, results, op_profiles, loadgens = [], [], [], []
+    snapshots, results, op_profiles, loadgens, lints = [], [], [], [], []
     with open(path) as f:
         for ln, line in enumerate(f, 1):
             line = line.strip()
@@ -61,7 +61,9 @@ def load(path):
                 op_profiles.append(rec)
             elif kind == "serving_loadgen":
                 loadgens.append(rec)
-    return snapshots, results, op_profiles, loadgens
+            elif kind == "program_lint":
+                lints.append(rec)
+    return snapshots, results, op_profiles, loadgens, lints
 
 
 def _hist(snap, name):
@@ -69,11 +71,11 @@ def _hist(snap, name):
 
 
 def report(path, out=sys.stdout):
-    snapshots, results, op_profiles, loadgens = load(path)
+    snapshots, results, op_profiles, loadgens, lints = load(path)
     w = out.write
     w(f"runtime stats report — {path}\n")
     if not snapshots and not results and not op_profiles \
-            and not loadgens:
+            and not loadgens and not lints:
         w("no snapshots or bench results found\n")
         return 1
     w(f"snapshots: {len(snapshots)}   bench results: {len(results)}\n")
@@ -232,6 +234,24 @@ def report(path, out=sys.stdout):
         if len(rows) > 15:
             w(f"... {len(rows) - 15} more row(s) — full table: "
               f"python tools/op_profile.py\n")
+
+    if lints:
+        # one record per linted model (tools/program_lint.py --out)
+        w("\n-- program lint (static verifier, "
+          "docs/static_analysis.md) --\n")
+        for r in lints:
+            c = r.get("counts", {})
+            status = "OK  " if r.get("ok") else "FAIL"
+            w(f"{status} {r.get('model', '?'):40s} "
+              f"{c.get('error', 0)} error(s), "
+              f"{c.get('warn', 0)} warning(s)\n")
+            for f in r.get("findings", [])[:10]:
+                w(f"  {f.get('rule', '?')} {f.get('severity', '?'):5s} "
+                  f"{f.get('where', '?')}: {f.get('message', '')}\n")
+            extra = len(r.get("findings", [])) - 10
+            if extra > 0:
+                w(f"  ... {extra} more finding(s) — full list: "
+                  f"python tools/program_lint.py {r.get('model', '')}\n")
 
     if results:
         w("\n-- bench results --\n")

@@ -1,0 +1,197 @@
+"""Lint saved programs / inference models with the static verifier.
+
+Usage:
+    python tools/program_lint.py MODEL [MODEL ...] [options]
+    python tools/program_lint.py --self-check
+
+MODEL is any of:
+  * an inference-model directory (holds __model__.json — the
+    io.save_inference_model layout; feed/fetch names come from it),
+  * a .pdmodel / program-JSON file (io.save layout or Program.to_json).
+
+Options:
+  --jsonl         print one kind="program_lint" JSON record per model to
+                  stdout instead of the text report
+  --out PATH      additionally append the JSONL records to PATH (the
+                  format tools/metrics_report.py renders and
+                  tools/validate_bench_json.py checks)
+  --no-shapes     skip the abstract-evaluation pass (graph lints only;
+                  much faster on very large programs)
+  --strict        exit 1 on warnings too, not just errors
+  --self-check    lint two bundled in-process example programs (one
+                  known-good, one with seeded defects) and exit 0 iff
+                  the verifier classifies both correctly — the repo's
+                  CI self-lint
+
+Exit codes: 0 = no error findings (no warnings either under --strict),
+1 = findings, 2 = usage / unreadable model.
+
+Each JSONL record:
+    {"kind": "program_lint", "model": ..., "ok": bool,
+     "counts": {"error": E, "warn": W},
+     "findings": [{"rule", "severity", "where", "message", "var"?}]}
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _load_program_dict(path):
+    """-> (program_dict, feed_names, fetch_names, label) or raises
+    ValueError with a usable message."""
+    if os.path.isdir(path):
+        model = os.path.join(path, "__model__.json")
+        if not os.path.exists(model):
+            raise ValueError(f"{path}: no __model__.json in directory")
+        with open(model) as f:
+            d = json.load(f)
+        return (d["program"], d.get("feed_names", []),
+                d.get("fetch_names", []), path)
+    with open(path) as f:
+        d = json.load(f)
+    if "program" in d:  # __model__.json passed directly
+        return (d["program"], d.get("feed_names", []),
+                d.get("fetch_names", []), path)
+    if "blocks" in d:  # Program.to_json / .pdmodel
+        return d, [], [], path
+    raise ValueError(f"{path}: neither an inference __model__.json nor "
+                     f"a program JSON")
+
+
+def lint_path(path, check_shapes=True):
+    """Lint one model path -> (record dict, VerifyResult|None)."""
+    from paddle_tpu.analysis import verify_program
+    from paddle_tpu.framework import Program
+
+    prog_dict, feeds, fetches, label = _load_program_dict(path)
+    # Pull the saved op-version map out so incompatibilities become
+    # PTV002 findings instead of the from_dict RuntimeError.
+    prog_dict = dict(prog_dict)
+    op_versions = prog_dict.pop("op_versions", {})
+    program = Program.from_dict(dict(prog_dict, op_versions={}))
+    result = verify_program(program, feed_names=feeds,
+                            fetch_names=fetches,
+                            op_versions=op_versions,
+                            check_shapes=check_shapes)
+    rec = {"kind": "program_lint", "model": label}
+    rec.update(result.to_dict())
+    return rec, result
+
+
+def _print_text(rec, out=sys.stdout):
+    c = rec["counts"]
+    status = "OK" if rec["ok"] else "FAIL"
+    out.write(f"{status:4s} {rec['model']}  "
+              f"({c['error']} error(s), {c['warn']} warning(s))\n")
+    for f in rec["findings"]:
+        var = f" [{f['var']}]" if f.get("var") else ""
+        out.write(f"  {f['rule']} {f['severity']:5s} {f['where']}"
+                  f"{var}: {f['message']}\n")
+
+
+def self_check() -> int:
+    """Build one known-good and one seeded-defect program in process and
+    verify the classifier gets both right. The repo CI runs this."""
+    from paddle_tpu import Program, program_guard, layers
+    from paddle_tpu.analysis import verify_program
+    from paddle_tpu.framework import Operator
+
+    # -- known-good: tiny inference graph ------------------------------
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = layers.data(name="x", shape=[6], dtype="float32")
+        h = layers.fc(x, size=4, act="relu")
+        out = layers.softmax(h)
+    good = verify_program(main, feed_names=["x"],
+                          fetch_names=[out.name])
+    if good.errors():
+        print("self-check FAILED: known-good program has errors:",
+              *good.errors(), sep="\n  ", file=sys.stderr)
+        return 1
+
+    # -- seeded defects: each must be caught ---------------------------
+    bad = Program()
+    blk = bad.global_block()
+    blk.create_var(name="a", shape=[2, 3], dtype="float32",
+                   is_data=True)
+    blk.create_var(name="b", shape=[2, 3], dtype="float32")
+    blk.create_var(name="c", shape=[9, 9], dtype="float32")
+    # PTV001: unregistered op type
+    blk.ops.append(Operator(blk, "reluu", {"X": ["a"]}, {"Out": ["b"]}))
+    # PTV010: reads an undeclared var
+    blk.ops.append(Operator(blk, "relu", {"X": ["ghost"]},
+                            {"Out": ["b"]}))
+    # PTV020: declared shape contradicts the inferred one
+    blk.ops.append(Operator(blk, "relu", {"X": ["a"]}, {"Out": ["c"]}))
+    res = verify_program(bad)
+    want = {"PTV001", "PTV010", "PTV020"}
+    got = {d.rule for d in res.findings}
+    if not want <= got:
+        print(f"self-check FAILED: seeded defects {sorted(want - got)} "
+              f"not detected (got {sorted(got)})", file=sys.stderr)
+        return 1
+    print(f"self-check ok: clean program clean, seeded defects "
+          f"{sorted(want)} all detected")
+    return 0
+
+
+def main(argv=None):
+    argv = list(argv if argv is not None else sys.argv[1:])
+    if not argv or argv[0] in ("-h", "--help"):
+        print(__doc__)
+        return 0 if argv else 2
+    if "--self-check" in argv:
+        return self_check()
+
+    as_jsonl = "--jsonl" in argv
+    strict = "--strict" in argv
+    check_shapes = "--no-shapes" not in argv
+    out_path = None
+    paths = []
+    it = iter(argv)
+    for a in it:
+        if a == "--out":
+            out_path = next(it, None)
+            if out_path is None:
+                print("--out needs a path", file=sys.stderr)
+                return 2
+        elif a in ("--jsonl", "--strict", "--no-shapes"):
+            continue
+        else:
+            paths.append(a)
+    if not paths:
+        print("no models given", file=sys.stderr)
+        return 2
+
+    records = []
+    failed = False
+    for path in paths:
+        try:
+            rec, result = lint_path(path, check_shapes=check_shapes)
+        except (ValueError, OSError, KeyError,
+                json.JSONDecodeError) as e:
+            print(f"INVALID: {path}: {e}", file=sys.stderr)
+            return 2
+        records.append(rec)
+        if rec["counts"]["error"] or (strict and rec["counts"]["warn"]):
+            failed = True
+        if as_jsonl:
+            print(json.dumps(rec))
+        else:
+            _print_text(rec)
+    if out_path:
+        with open(out_path, "a") as f:
+            for rec in records:
+                f.write(json.dumps(rec) + "\n")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

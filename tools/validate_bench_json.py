@@ -14,7 +14,9 @@ Checks, per file (type auto-detected from content):
   non-empty line parses as a JSON object; lines with kind ==
   "serving_loadgen" (tools/serving_loadgen.py) additionally carry the
   mode/requests/duration_s/throughput_rps/latency_ms{p50,p95,p99}
-  contract the serving report section reads.
+  contract the serving report section reads; lines with kind ==
+  "program_lint" (tools/program_lint.py) carry the model/ok/counts/
+  findings contract the lint report section reads.
 * driver BENCH_rNN.json wrappers ({"n", "cmd", "rc", "tail",
   "parsed"}): parsed must be non-null — the exact invariant the r05
   rc=124 artifact violated.
@@ -103,6 +105,52 @@ def validate_loadgen(obj, where="loadgen"):
     return errs
 
 
+_LINT_SEVERITIES = ("error", "warn")
+
+
+def validate_program_lint(obj, where="program_lint"):
+    """Schema of one tools/program_lint.py record."""
+    errs = []
+    if not isinstance(obj.get("model"), str):
+        errs.append(f"{where}: model must be a string "
+                    f"(got {obj.get('model')!r})")
+    if not isinstance(obj.get("ok"), bool):
+        errs.append(f"{where}: ok must be a bool")
+    counts = obj.get("counts")
+    if not isinstance(counts, dict):
+        errs.append(f"{where}: counts must be an object")
+        counts = {}
+    for key in ("error", "warn"):
+        v = counts.get(key)
+        if not isinstance(v, int) or isinstance(v, bool):
+            errs.append(f"{where}: counts.{key} must be an int "
+                        f"(got {v!r})")
+    findings = obj.get("findings")
+    if not isinstance(findings, list):
+        errs.append(f"{where}: findings must be a list")
+        findings = []
+    for i, f in enumerate(findings):
+        if not isinstance(f, dict):
+            errs.append(f"{where}: findings[{i}] is not an object")
+            continue
+        missing = [k for k in ("rule", "severity", "where", "message")
+                   if not isinstance(f.get(k), str)]
+        if missing:
+            errs.append(f"{where}: findings[{i}] missing/non-string "
+                        f"{missing}")
+        sev = f.get("severity")
+        if isinstance(sev, str) and sev not in _LINT_SEVERITIES:
+            errs.append(f"{where}: findings[{i}].severity {sev!r} not "
+                        f"in {_LINT_SEVERITIES}")
+    # ok must agree with the error count the driver gates on
+    if isinstance(obj.get("ok"), bool) and isinstance(
+            counts.get("error"), int):
+        if obj["ok"] != (counts["error"] == 0):
+            errs.append(f"{where}: ok={obj['ok']} contradicts "
+                        f"counts.error={counts['error']}")
+    return errs
+
+
 def validate_jsonl(path):
     errs = []
     with open(path) as f:
@@ -119,6 +167,9 @@ def validate_jsonl(path):
                 errs.append(f"{path}:{ln}: line is not a JSON object")
             elif rec.get("kind") == "serving_loadgen":
                 errs.extend(validate_loadgen(rec, where=f"{path}:{ln}"))
+            elif rec.get("kind") == "program_lint":
+                errs.extend(validate_program_lint(
+                    rec, where=f"{path}:{ln}"))
     return errs
 
 
