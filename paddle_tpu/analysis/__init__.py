@@ -30,5 +30,15 @@ from .diagnostics import (Diagnostic, ProgramVerificationError, RULES,
                           VerifyResult)
 from .verifier import verify_gate, verify_program
 
+
+def optimize_gate(program, feed_names=None, fetch_names=None,
+                  where="executor"):
+    """Memoized FLAGS_graph_opt_level pipeline (analysis/passes) —
+    lazy import so `import paddle_tpu.analysis` stays cheap."""
+    from .passes import optimize_gate as _gate
+    return _gate(program, feed_names=feed_names,
+                 fetch_names=fetch_names, where=where)
+
+
 __all__ = ["Diagnostic", "VerifyResult", "ProgramVerificationError",
-           "RULES", "verify_program", "verify_gate"]
+           "RULES", "verify_program", "verify_gate", "optimize_gate"]

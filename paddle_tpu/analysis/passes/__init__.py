@@ -1,0 +1,39 @@
+"""Program-IR optimization passes ahead of lowering.
+
+Reference analogue: BuildStrategy::Apply's ~20 graph passes
+(framework/details/build_strategy.cc). Here the program IS the IR
+(framework.Program), so a pass is a Python rewrite over a verified
+clone, gated by FLAGS_graph_opt_level:
+
+  0 — off: compile the program exactly as built.
+  1 — default: dead-op elimination (the PTV012 walk as a rewrite),
+      constant folding (registered lowerings evaluated on host), CSE
+      (value numbering on (op_type, attrs, input versions)).
+  2 — adds elementwise-chain fusion (consecutive chains merge into one
+      fused_elementwise op replaying the originals bit-exactly, with a
+      shared-jax.named_scope fallback) and the inplace/donation
+      planner (PTV015 alias analysis → per-var jax.jit donation of
+      hazard-free optimizer state).
+
+Every rewrite must preserve bit-exact observable outputs (the parity
+sweep in tests/test_graph_passes.py), and the optimized program must
+re-verify clean with error semantics before it replaces the original.
+Pipeline runs are memoized per (fingerprint, level, feeds, fetches) —
+optimize_gate — and surface as analysis.pass_* monitor stats.
+Catalog + flag semantics: docs/graph_passes.md.
+"""
+from .base import (Pass, PassContext, PassManager, default_passes,
+                   optimize_gate, optimize_program, reset_memo)
+from .constant_fold import FOLDABLE_OPS, ConstantFolding
+from .cse import CommonSubexprElimination
+from .dce import DeadOpElimination
+from .donation import DonationPlanner
+from .fusion import FUSABLE_OPS, ElementwiseFusionScopes
+
+__all__ = [
+    "Pass", "PassContext", "PassManager", "default_passes",
+    "optimize_program", "optimize_gate", "reset_memo",
+    "DeadOpElimination", "ConstantFolding", "CommonSubexprElimination",
+    "ElementwiseFusionScopes", "DonationPlanner",
+    "FOLDABLE_OPS", "FUSABLE_OPS",
+]

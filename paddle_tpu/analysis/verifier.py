@@ -19,37 +19,14 @@ from typing import Dict, Iterable, Optional
 from ..core.registry import REGISTRY
 from ..monitor import STAT_ADD
 from .diagnostics import VerifyResult
+from .graph_utils import (CTRL_FLOW_SUB_BLOCK as _CTRL_FLOW_SUB_BLOCK,
+                          SIDE_EFFECT_OPS as _SIDE_EFFECT_OPS,
+                          attr_read_names, available_at_entry,
+                          live_op_mask, op_names as _op_names,
+                          scan_block_hazards)
 from .shape_infer import OPAQUE_OPS, declared_spec, infer_program_specs
 
 __all__ = ["verify_program", "verify_gate"]
-
-# Ops whose execution is the point (host effects), so dead-op
-# reachability never flags them even when nothing reads their outputs.
-_SIDE_EFFECT_OPS = frozenset({
-    "print", "save", "save_combine", "load", "load_combine",
-    "feed", "fetch", "read", "create_custom_reader", "py_func",
-    "send", "recv", "prefetch", "fetch_barrier", "send_barrier",
-    "checkpoint_notify", "geo_sgd_send", "distributed_notify",
-    "listen_and_serv", "fl_listen_and_serv", "delete_var",
-    "push_box_sparse", "gen_nccl_id", "c_gen_nccl_id", "c_comm_init",
-    "c_comm_init_all", "c_sync_calc_stream", "c_sync_comm_stream",
-})
-
-# Control-flow ops that legitimately re-write a var another op already
-# wrote (branch merge / carry patterns) — excluded from write-after-write.
-_MERGE_OPS = frozenset({
-    "conditional_block", "conditional_block_infer", "while",
-    "select_input", "merge_lod_tensor", "assign", "recurrent",
-})
-
-_CTRL_FLOW_SUB_BLOCK = ("while", "conditional_block",
-                        "conditional_block_infer", "recurrent",
-                        "recompute_segment")
-
-
-def _op_names(op, which) -> Iterable[str]:
-    d = op.inputs if which == "in" else op.outputs
-    return [n for ns in d.values() for n in ns if n]
 
 
 def verify_program(program, feed_names: Optional[Iterable[str]] = None,
@@ -98,27 +75,8 @@ def _verify_core(program, check_shapes=True) -> VerifyResult:
 # per-block dataflow lints
 # ---------------------------------------------------------------------------
 
-def _available_at_entry(program, block):
-    """Vars readable before any op of `block` runs: the whole ancestor
-    scope chain (sub-blocks are entered mid-parent, and shapes are
-    static, so the parent's full symbol table is a sound
-    over-approximation) plus local persistables/data vars."""
-    avail = set()
-    blk = block
-    while blk is not None:
-        if blk is block:
-            avail |= {n for n, v in blk.vars.items()
-                      if v.persistable or v.is_data}
-        else:
-            avail |= set(blk.vars)
-        blk = blk.parent
-    return avail
-
-
 def _lint_block(program, block, result):
-    avail = _available_at_entry(program, block)
-    last_write = {}   # var -> (op_idx, op_type, is_merge_or_inplace)
-    inplace_aliases = []  # (op_idx, op_type, var)
+    avail = available_at_entry(program, block)
 
     for op_idx, op in enumerate(block.ops):
         opdef = REGISTRY._ops.get(op.type)
@@ -152,44 +110,29 @@ def _lint_block(program, block, result):
                            f"var, not fed)",
                            op_type=op.type, block=block.idx,
                            op_idx=op_idx, var=name)
-            # inplace-alias hazard: a later read of a var an inplace op
-            # aliased means donation may have already clobbered it
-            for w_idx, w_type, w_var in inplace_aliases:
-                if name == w_var:
-                    result.add("PTV015",
-                               f"{w_var!r} was updated in place by "
-                               f"{w_type!r} (op {w_idx}) but is read "
-                               f"again here — the buffer may be donated"
-                               f"/overwritten",
-                               op_type=op.type, block=block.idx,
-                               op_idx=op_idx, var=name)
-            if name in last_write:
-                last_write.pop(name, None)
-
-        is_inplace = bool(opdef is not None and opdef.inplace)
-        is_merge = op.type in _MERGE_OPS
         for name in outs:
-            var = block._find_var_recursive(name)
-            persistable = bool(var is not None and var.persistable)
-            prev = last_write.get(name)
-            if prev is not None and not persistable \
-                    and not (is_inplace or is_merge):
-                p_idx, p_type, p_soft = prev
-                if not p_soft:
-                    result.add("PTV014",
-                               f"{name!r} written by {p_type!r} (op "
-                               f"{p_idx}) is overwritten before "
-                               f"anything reads it",
-                               op_type=op.type, block=block.idx,
-                               op_idx=op_idx, var=name)
-            last_write[name] = (op_idx, op.type,
-                                is_inplace or is_merge or persistable)
             avail.add(name)
-            if is_inplace and name in ins:
-                inplace_aliases.append((op_idx, op.type, name))
 
         if op.type in _CTRL_FLOW_SUB_BLOCK:
             _lint_sub_block(program, block, op, op_idx, result)
+
+    # WAW / inplace-alias findings come from the shared scan the
+    # donation planner also consumes (analysis/graph_utils.py) — lint
+    # and rewrite must agree on what is hazardous.
+    waw, alias_reads, _ = scan_block_hazards(block)
+    for op_idx, op_type, name, p_idx, p_type in waw:
+        result.add("PTV014",
+                   f"{name!r} written by {p_type!r} (op {p_idx}) is "
+                   f"overwritten before anything reads it",
+                   op_type=op_type, block=block.idx, op_idx=op_idx,
+                   var=name)
+    for op_idx, op_type, name, w_idx, w_type in alias_reads:
+        result.add("PTV015",
+                   f"{name!r} was updated in place by {w_type!r} (op "
+                   f"{w_idx}) but is read again here — the buffer may "
+                   f"be donated/overwritten",
+                   op_type=op_type, block=block.idx, op_idx=op_idx,
+                   var=name)
 
 
 def _lint_sub_block(program, block, op, op_idx, result):
@@ -255,50 +198,15 @@ def _lint_io(program, feed_set, fetch_list, result):
                        var=name)
 
 
-def _op_is_anchored(op, block):
-    """Ops kept live regardless of fetch reachability: host effects,
-    in-place state updates, writes to persistable vars, opless sinks."""
-    if op.type in _SIDE_EFFECT_OPS:
-        return True
-    opdef = REGISTRY._ops.get(op.type)
-    if opdef is not None and opdef.inplace:
-        return True
-    outs = list(_op_names(op, "out"))
-    if not outs:
-        return True
-    for n in outs:
-        v = block._find_var_recursive(n)
-        if v is not None and v.persistable:
-            return True
-    return False
-
-
 def _lint_dead_ops(program, fetch_list, result):
+    # shared walk: the False entries here are exactly what the DCE pass
+    # removes (analysis/passes/dce.py)
     block = program.global_block()
-    needed = set(fetch_list)
-    # lengths companions are read implicitly by the feed path
-    needed |= set(program.lod_link.values())
-    for op_idx in reversed(range(len(block.ops))):
-        op = block.ops[op_idx]
-        outs = _op_names(op, "out")
-        live = _op_is_anchored(op, block) or any(n in needed
-                                                 for n in outs)
-        if live:
-            needed |= set(_op_names(op, "in"))
-            # sub-block reads count: condition/carried vars resolve
-            # against the parent scope too
-            for attr in ("input_vars", "carried_vars", "condition"):
-                v = op.attrs.get(attr)
-                if isinstance(v, str):
-                    needed.add(v)
-                elif isinstance(v, (list, tuple)):
-                    needed |= {str(x) for x in v}
-            if op.type in _CTRL_FLOW_SUB_BLOCK:
-                sb = op.attrs.get("sub_block")
-                if isinstance(sb, int) and 0 < sb < len(program.blocks):
-                    for sop in program.blocks[sb].ops:
-                        needed |= set(_op_names(sop, "in"))
-        else:
+    mask = live_op_mask(program, fetch_list)
+    for op_idx, live in enumerate(mask):
+        if not live:
+            op = block.ops[op_idx]
+            outs = _op_names(op, "out")
             result.add("PTV012",
                        f"no path from its outputs {outs} to the fetch "
                        f"targets — op never affects a fetched value",
@@ -311,13 +219,9 @@ def _lint_unused_outputs(program, fetch_list, result):
     for blk in program.blocks:
         for op in blk.ops:
             reads |= set(_op_names(op, "in"))
-            for attr in ("input_vars", "carried_vars", "condition",
-                         "output_vars"):
-                v = op.attrs.get(attr)
-                if isinstance(v, str):
-                    reads.add(v)
-                elif isinstance(v, (list, tuple)):
-                    reads |= {str(x) for x in v}
+            reads |= attr_read_names(
+                op, ("input_vars", "carried_vars", "condition",
+                     "output_vars"))
     for blk in program.blocks:
         for op_idx, op in enumerate(blk.ops):
             if op.type in _SIDE_EFFECT_OPS or op.type in OPAQUE_OPS:

@@ -271,6 +271,19 @@ DEFINE_string(
     "inference runs jax.eval_shape over each op's lowering. Rule "
     "catalog: docs/static_analysis.md; CLI: tools/program_lint.py.")
 
+DEFINE_int32(
+    "graph_opt_level", 1,
+    "Program-IR optimization before lowering (analysis/passes): 0 = "
+    "compile the program as built; 1 (default) = dead-op elimination, "
+    "constant folding, and CSE on a verified clone; 2 adds elementwise-"
+    "chain fusion (consecutive chains merge into one fused_elementwise "
+    "op, falling back to a shared jax.named_scope when a merge gate "
+    "fails) and the inplace/donation planner (per-var "
+    "jax.jit donation of hazard-free optimizer state). The optimized "
+    "program must re-verify clean (error semantics) before it replaces "
+    "the original, and it is what the executable cache is keyed on. "
+    "Catalog: docs/graph_passes.md.", traced=True)
+
 DEFINE_bool(
     "flight_recorder", True,
     "Keep a bounded in-memory ring of per-step flight records (step "

@@ -119,13 +119,25 @@ def _op_scope(op, op_idx):
     traces all attribute back to the Program op — the trace-side half
     of the reference's per-op RecordEvent (platform/profiler.cc). Ops
     lowered outside lower_block (shape inference) pass op_idx=None and
-    stay unscoped."""
+    stay unscoped.
+
+    Ops the fusion-scope pass tagged (op._fusion_group, set at
+    FLAGS_graph_opt_level=2 by analysis/passes/fusion.py) share a
+    'ewfuseN/' scope prefix, so a whole elementwise chain lands under
+    one name-stack entry — one fusion candidate for XLA instead of N
+    disjoint scopes. The group scope is emitted even with trace scopes
+    off: it exists for the compiler, not just the profiler."""
     from .flags import FLAGS
-    if op_idx is None or not FLAGS.op_trace_scopes:
+    if op_idx is None:
         return contextlib.nullcontext()
+    group = getattr(op, "_fusion_group", None)
+    if not FLAGS.op_trace_scopes:
+        return (jax.named_scope(group) if group
+                else contextlib.nullcontext())
     block_idx = op.block.idx if getattr(op, "block", None) is not None \
         else 0
-    return jax.named_scope(f"{op.type}:{block_idx}/{op_idx}")
+    prefix = f"{group}/" if group else ""
+    return jax.named_scope(f"{prefix}{op.type}:{block_idx}/{op_idx}")
 
 
 def run_op(op, env, ctx, op_idx=None):

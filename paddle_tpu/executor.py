@@ -35,14 +35,43 @@ from .core.scope import scope_guard  # re-export  # noqa: E402
 
 
 class _CompiledStep:
-    def __init__(self, fn, state_in_names, state_out_names, fetch_names):
+    def __init__(self, fn, state_in_names, state_out_names, fetch_names,
+                 donate_names=None):
         self.fn = fn
         self.state_in_names = state_in_names
         self.state_out_names = state_out_names
         self.fetch_names = fetch_names
+        # donation-planner result (FLAGS_graph_opt_level=2): the subset
+        # of state vars the jit donates; None = legacy whole-dict donate
+        self.donate_names = donate_names
         # run count: the first call pays XLA compile (jit is lazy), so
         # the monitor attributes it separately from steady-state steps
         self.runs = 0
+
+
+class _PlannedDonateStep:
+    """Adapter keeping the (state, feeds, step) call surface while the
+    underlying jit takes (donated_state, pinned_state, feeds, step)
+    with donate_argnums=(0,) — the donation planner's per-var split
+    (analysis/passes/donation.py)."""
+
+    def __init__(self, jit_fn, donate_names):
+        self._fn = jit_fn
+        self._donate = frozenset(donate_names)
+
+    def _split(self, state):
+        donated = {n: v for n, v in state.items() if n in self._donate}
+        pinned = {n: v for n, v in state.items()
+                  if n not in self._donate}
+        return donated, pinned
+
+    def __call__(self, state, feeds, step_idx):
+        donated, pinned = self._split(state)
+        return self._fn(donated, pinned, feeds, step_idx)
+
+    def lower(self, state, feeds, step_idx):
+        donated, pinned = self._split(state)
+        return self._fn.lower(donated, pinned, feeds, step_idx)
 
 
 class Executor:
@@ -164,6 +193,19 @@ class Executor:
         from .analysis import verify_gate
         verify_gate(program, feed_names=feed_arrays.keys(),
                     fetch_names=fetch_names, where="executor")
+
+        # Graph-optimization pipeline (FLAGS_graph_opt_level, default 1):
+        # DCE/fold/CSE (+fusion scopes/donation at 2) on a verified
+        # clone, memoized per (fingerprint, level, feeds, fetches). The
+        # OPTIMIZED program keys the cache and feeds _compile, so every
+        # artifact surface (run/HLO dumps) sees the same rewrite
+        # (paddle_tpu/analysis/passes).
+        from .analysis import optimize_gate
+        program, _ = optimize_gate(program,
+                                   feed_names=feed_arrays.keys(),
+                                   fetch_names=fetch_names,
+                                   where="executor")
+        block = program.global_block()
 
         key = self._cache_key(program, feed_arrays, fetch_names, compiled)
         step_fn = self._cache.get(key) if use_program_cache else None
@@ -354,6 +396,21 @@ class Executor:
                            (produced_global | set(state_in)))
         seed = program.random_seed
 
+        # Donation plan (analysis/passes/donation.py, graph_opt_level=2):
+        # donate only the hazard-free inplace-updated subset of state,
+        # pin the rest, and drop never-written pinned vars from the
+        # returned state so XLA emits no output copy for them at all.
+        # Every donated input must come back as an output, else its
+        # scope buffer is invalidated with no replacement.
+        donate_plan = getattr(program, "_donation_plan", None)
+        donate_names = None
+        if compiled is None and donate_plan is not None:
+            state_out = sorted(n for n in state_out
+                               if n in produced_global)
+            donate_names = frozenset(
+                n for n in state_in
+                if n in donate_plan and n in set(state_out))
+
         mesh = compiled.mesh() if compiled is not None and \
             compiled._is_data_parallel else None
 
@@ -389,9 +446,19 @@ class Executor:
         if compiled is not None:
             fn = compiled.build_jit(step, state_in, feed_arrays,
                                     state_out_names=state_out)
+        elif donate_names is not None:
+            def planned_step(donated_state, pinned_state, feeds,
+                             step_idx):
+                merged = dict(pinned_state)
+                merged.update(donated_state)
+                return step(merged, feeds, step_idx)
+            fn = _PlannedDonateStep(
+                jax.jit(planned_step, donate_argnums=(0,)),
+                donate_names)
         else:
             fn = jax.jit(step, donate_argnums=(0,))
-        return _CompiledStep(fn, state_in, state_out, fetch_names)
+        return _CompiledStep(fn, state_in, state_out, fetch_names,
+                             donate_names=donate_names)
 
     def lowered_stablehlo(self, program=None, feed=None, fetch_list=None,
                           scope: Optional[Scope] = None) -> str:

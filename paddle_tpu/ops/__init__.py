@@ -30,6 +30,7 @@ from . import rnn_fused  # noqa: F401
 from . import detection_extra  # noqa: F401
 from . import parity_final  # noqa: F401
 from . import straggler_ops  # noqa: F401
+from . import fused  # noqa: F401
 
 
 def registered_types():

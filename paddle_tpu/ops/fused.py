@@ -1,0 +1,46 @@
+"""fused_elementwise: one op that replays a merged elementwise chain.
+
+Emitted exclusively by the level-2 fusion pass
+(analysis/passes/fusion.py) — never by layer builders. The pass
+splices a maximal run of consecutive pure elementwise ops into a
+single op whose `sub_ops` attr carries the original op descriptors
+(type, attrs, slot wiring, stable id). Lowering replays each sub-op's
+*registered lowering* in the original order against a local env, so
+the emitted jnp calls — and therefore the numerics — are bit-identical
+to the unfused chain.
+
+Every sub-op output remains an output of the fused op: backward's
+grad::generic ops read chain intermediates as plain block inputs
+(core/lowering.generic_grad_lower re-lowers the forward from its own
+inputs), so intermediates must stay materialized. XLA prunes the
+unread ones after fusion; the Program-level win is N ops -> 1.
+"""
+from ..core.registry import OpDef, REGISTRY
+
+__all__ = []
+
+
+def fused_elementwise_lower(ctx, ins, attrs):
+    from ..core.lowering import _FakeOp, _OpCtx
+
+    env = dict(zip(attrs["x_names"], ins.get("X", [])))
+    for sub in attrs["sub_ops"]:
+        opdef = REGISTRY.get(sub["type"])
+        sub_ins = {slot: [env[n] for n in names if n]
+                   for slot, names in sub["inputs"].items()}
+        # _FakeOp carries the sub-op's original id so ctx.rng matches
+        # the unfused program bit-for-bit (FUSABLE_OPS are all
+        # stateless, but the invariant is free to keep).
+        fake = _FakeOp(sub["type"], sub["attrs"], sub["id"], ctx)
+        outs = opdef.lower(_OpCtx(ctx._ctx, fake), sub_ins, sub["attrs"])
+        for slot, names in sub["outputs"].items():
+            if slot not in outs:
+                continue
+            for name, val in zip(names, outs[slot]):
+                if name:
+                    env[name] = val
+    return {"Out": [env[n] for n in attrs["out_names"]]}
+
+
+REGISTRY.register(OpDef(type="fused_elementwise",
+                        lower=fused_elementwise_lower))

@@ -24,3 +24,10 @@ jax.config.update("jax_platforms", "cpu")
 
 assert len(jax.devices()) == 8 and jax.devices()[0].platform == "cpu", \
     "tests require the 8-device virtual CPU mesh"
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: exhaustive sweeps excluded from the tier-1 run "
+        "(-m 'not slow')")

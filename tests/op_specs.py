@@ -467,6 +467,9 @@ skip("get_places", "host device-enumeration helper")
 skip("fake_init", "PS-mode placeholder init; no computation")
 skip("grad::generic", "internal vjp grad dispatcher; exercised by every "
                       "check_grad in this sweep")
+skip("fused_elementwise", "emitted only by the level-2 fusion pass; "
+                          "bit-exact replay covered by "
+                          "tests/test_graph_passes.py parity sweeps")
 skip("split_selected_rows", "SelectedRows compat view; covered in "
                             "test_parity_ops.py")
 skip("merge_selected_rows", "SelectedRows compat view; covered in "

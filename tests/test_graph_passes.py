@@ -1,0 +1,691 @@
+"""Graph optimization pass pipeline (paddle_tpu/analysis/passes):
+golden per-pass fixtures, the clone/re-verify/fail-open protocol, the
+FLAGS_graph_opt_level gate in Executor/ServingEngine, and the bit-exact
+parity contract across optimization levels on the bench model builders.
+
+Pass catalog: docs/graph_passes.md.
+"""
+from __future__ import annotations
+
+import io
+import json
+import os
+import re
+import subprocess
+import sys
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.analysis.passes import (CommonSubexprElimination,
+                                        ConstantFolding,
+                                        DeadOpElimination, FOLDABLE_OPS,
+                                        Pass, PassManager,
+                                        optimize_gate, optimize_program,
+                                        reset_memo)
+from paddle_tpu.framework import Operator
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _tools(module):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        return __import__(module)
+    finally:
+        sys.path.pop(0)
+
+
+def _op_types(program):
+    return [op.type for op in program.global_block().ops]
+
+
+def _raw_program(var_specs, op_specs):
+    prog = fluid.Program()
+    blk = prog.global_block()
+    for name, kw in var_specs:
+        blk.create_var(name=name, **kw)
+    for op_type, ins, outs, attrs in op_specs:
+        blk.ops.append(Operator(blk, op_type, ins, outs, attrs))
+    return prog
+
+
+def _run(prog, feed, fetch, startup=None, level=None):
+    """Execute `prog` under FLAGS_graph_opt_level=level -> numpy list."""
+    prev = fluid.FLAGS.graph_opt_level
+    if level is not None:
+        fluid.set_flags({"FLAGS_graph_opt_level": level})
+    try:
+        scope = fluid.Scope()
+        exe = fluid.Executor()
+        with fluid.scope_guard(scope):
+            if startup is not None:
+                exe.run(startup)
+            return exe.run(prog, feed=feed, fetch_list=fetch)
+    finally:
+        fluid.set_flags({"FLAGS_graph_opt_level": prev})
+
+
+# ---------------------------------------------------------------------------
+# level semantics
+# ---------------------------------------------------------------------------
+
+def test_level0_returns_program_untouched():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[4], dtype="float32")
+        y = layers.relu(x)
+    opt, report = optimize_program(main, feed_names=["x"],
+                                   fetch_names=[y.name], level=0)
+    assert opt is main
+    assert report["passes"] == []
+    assert report["ops_before"] == report["ops_after"]
+
+
+def test_level1_never_tags_fusion_or_plans_donation():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[4], dtype="float32")
+        y = layers.relu(layers.scale(layers.relu(x), scale=2.0))
+    opt, report = optimize_program(main, feed_names=["x"],
+                                   fetch_names=[y.name], level=1)
+    assert {p["name"] for p in report["passes"]} == \
+        {"dead_op_elim", "constant_fold", "cse"}
+    assert not any(getattr(op, "_fusion_group", None)
+                   for op in opt.global_block().ops)
+    assert getattr(opt, "_donation_plan", None) is None
+
+
+def test_pipeline_never_mutates_the_original_program():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[4], dtype="float32")
+        c = layers.fill_constant(shape=[4], dtype="float32", value=2.0)
+        y = layers.elementwise_add(x, layers.scale(c, scale=3.0))
+        _dead = layers.scale(y, scale=9.0)
+    before = _op_types(main)
+    fp = main.fingerprint()
+    opt, report = optimize_program(main, feed_names=["x"],
+                                   fetch_names=[y.name], level=2)
+    assert opt is not main
+    assert _op_types(main) == before
+    assert main.fingerprint() == fp
+    assert report["ops_after"] < report["ops_before"]
+
+
+# ---------------------------------------------------------------------------
+# dead-op elimination
+# ---------------------------------------------------------------------------
+
+def test_dce_removes_dead_op():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[4], dtype="float32")
+        y = layers.relu(x)
+        dead = layers.scale(y, scale=9.0)
+    opt, report = optimize_program(main, feed_names=["x"],
+                                   fetch_names=[y.name], level=1)
+    dce = next(p for p in report["passes"] if p["name"] == "dead_op_elim")
+    assert dce["removed"] == 1
+    assert not any(dead.name in op.outputs.get("Out", ())
+                   for op in opt.global_block().ops)
+    # the dead op's result var no longer appears anywhere
+    assert report["vars_eliminated"] >= 1
+
+
+def test_dce_anchors_side_effect_ops_and_their_grads():
+    """A host-RPC pull and the grad::generic that performs its sparse
+    PUSH must stay live even though nothing downstream reads them —
+    the regression mode of test_distributed's PS-mode training."""
+    from paddle_tpu.analysis.graph_utils import live_op_mask
+    prog = _raw_program(
+        [("ids", dict(is_data=True, shape=[6], dtype="int64")),
+         ("w", dict(shape=[1], dtype="float32")),
+         ("rows", dict(shape=[6, 3], dtype="float32")),
+         ("loss", dict(shape=[1], dtype="float32")),
+         ("w_g", dict(shape=[1], dtype="float32"))],
+        [("distributed_lookup_table", {"Ids": ["ids"], "W": ["w"]},
+          {"Outputs": ["rows"]},
+          {"endpoints": ["h:1"], "emb_dim": 3, "table_name": "t"}),
+         ("mean", {"X": ["rows"]}, {"Out": ["loss"]}, {}),
+         ("grad::generic", {"Ids": ["ids"], "W": ["w"]},
+          {"W@GRAD": ["w_g"]},
+          {"fwd_type": "distributed_lookup_table", "fwd_attrs": {},
+           "fwd_in_slots": {}, "fwd_out_slots": {},
+           "fwd_out_grad_mask": {}, "fwd_id": 0})])
+    # nothing fetches w_g, yet every op must stay live
+    assert all(live_op_mask(prog, ["loss"]))
+
+
+def test_dce_declines_without_a_fetch_list():
+    """No fetch list means 'run for side effects' (startup programs):
+    reachability is undefined, so DCE must keep everything."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[4], dtype="float32")
+        layers.relu(x)
+    opt, report = optimize_program(main, feed_names=["x"],
+                                   fetch_names=[], level=1)
+    dce = next(p for p in report["passes"] if p["name"] == "dead_op_elim")
+    assert dce["removed"] == 0
+    assert len(opt.global_block().ops) == len(main.global_block().ops)
+
+
+# ---------------------------------------------------------------------------
+# constant folding
+# ---------------------------------------------------------------------------
+
+def test_constant_fold_fill_scale_chain():
+    """fill_constant(2.0) -> scale(x3) collapses to one assign_value
+    carrying 6.0 — evaluated through the registered lowerings, so the
+    folded value is the bit pattern the device would have produced."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[4], dtype="float32")
+        c = layers.fill_constant(shape=[4], dtype="float32", value=2.0)
+        c2 = layers.scale(c, scale=3.0)
+        y = layers.elementwise_add(x, c2)
+    opt, report = optimize_program(main, feed_names=["x"],
+                                   fetch_names=[y.name], level=1)
+    fold = next(p for p in report["passes"]
+                if p["name"] == "constant_fold")
+    assert fold["folded"] == 2 and fold["materialized"] == 1
+    types = _op_types(opt)
+    assert "fill_constant" not in types and "scale" not in types
+    av = [op for op in opt.global_block().ops
+          if op.type == "assign_value"]
+    assert len(av) == 1
+    np.testing.assert_array_equal(av[0].attrs["values"],
+                                  np.full((4,), 6.0, np.float32))
+    # executed results agree bit-exactly with the unoptimized program
+    feed = {"x": np.arange(8, dtype=np.float32).reshape(2, 4)}
+    r0, = _run(main, feed, [y.name], level=0)
+    r1, = _run(main, feed, [y.name], level=1)
+    assert np.array_equal(r0, r1)
+
+
+def test_constant_fold_double_write_keeps_each_definition():
+    """A var written twice by folded ops must materialize each
+    definition's OWN value at its def site — readers of the first def
+    see the first value, the final fetch sees the last."""
+    f32_4 = dict(shape=[4], dtype="float32")
+    prog = _raw_program(
+        [("c", dict(**f32_4)), ("u", dict(**f32_4)),
+         ("v", dict(**f32_4))],
+        [("fill_constant",
+          {}, {"Out": ["c"]},
+          {"shape": [4], "dtype": "float32", "value": 1.0}),
+         ("scale", {"X": ["c"]}, {"Out": ["u"]}, {"scale": 2.0}),
+         ("fill_constant",
+          {}, {"Out": ["c"]},
+          {"shape": [4], "dtype": "float32", "value": 5.0}),
+         ("scale", {"X": ["c"]}, {"Out": ["v"]}, {"scale": 2.0})])
+    opt, report = optimize_program(prog, feed_names=[],
+                                   fetch_names=["u", "v", "c"], level=1)
+    assert not report.get("rejected")
+    u, v, c = _run(opt, {}, ["u", "v", "c"], level=0)
+    np.testing.assert_array_equal(u, np.full((4,), 2.0, np.float32))
+    np.testing.assert_array_equal(v, np.full((4,), 10.0, np.float32))
+    np.testing.assert_array_equal(c, np.full((4,), 5.0, np.float32))
+
+
+def test_constant_fold_whitelist_excludes_reductions():
+    """Bit-exactness gate: accumulation-order-sensitive ops must never
+    be in the fold whitelist."""
+    for banned in ("reduce_sum", "reduce_mean", "matmul", "mul",
+                   "softmax", "mean", "sum"):
+        assert banned not in FOLDABLE_OPS
+
+
+# ---------------------------------------------------------------------------
+# common-subexpression elimination
+# ---------------------------------------------------------------------------
+
+def test_cse_dedupes_identical_pure_ops():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[4], dtype="float32")
+        a = layers.relu(x)
+        b = layers.relu(x)  # identical computation
+        z = layers.elementwise_add(a, b)
+    opt, report = optimize_program(main, feed_names=["x"],
+                                   fetch_names=[z.name], level=1)
+    cse = next(p for p in report["passes"] if p["name"] == "cse")
+    assert cse["deduped"] == 1
+    assert _op_types(opt).count("relu") == 1
+    # the survivor's add now reads the SAME var twice
+    add = next(op for op in opt.global_block().ops
+               if op.type == "elementwise_add")
+    assert add.inputs["X"] == add.inputs["Y"]
+    feed = {"x": np.arange(-4, 4, dtype=np.float32).reshape(2, 4)}
+    r0, = _run(main, feed, [z.name], level=0)
+    r1, = _run(main, feed, [z.name], level=1)
+    assert np.array_equal(r0, r1)
+
+
+def test_cse_never_touches_stateful_ops():
+    """Two uniform_random ops are two independent draws — deduping
+    them would change the numerics."""
+    f32_4 = dict(shape=[4], dtype="float32")
+    attrs = {"shape": [4], "dtype": "float32", "min": 0.0, "max": 1.0}
+    prog = _raw_program(
+        [("a", dict(**f32_4)), ("b", dict(**f32_4)),
+         ("z", dict(**f32_4))],
+        [("uniform_random", {}, {"Out": ["a"]}, dict(attrs)),
+         ("uniform_random", {}, {"Out": ["b"]}, dict(attrs)),
+         ("elementwise_add", {"X": ["a"], "Y": ["b"]},
+          {"Out": ["z"]}, {})])
+    opt, report = optimize_program(prog, feed_names=[],
+                                   fetch_names=["z"], level=1)
+    cse = next(p for p in report["passes"] if p["name"] == "cse")
+    assert cse["deduped"] == 0
+    assert _op_types(opt).count("uniform_random") == 2
+
+
+def test_cse_redefinition_cannot_redirect_reads():
+    """An op whose output is later redefined must never become a CSE
+    source: renaming a duplicate's readers to it would make them read
+    the REDEFINED value."""
+    f32_4 = dict(shape=[4], dtype="float32")
+    prog = _raw_program(
+        [("x", dict(is_data=True, **f32_4)), ("a", dict(**f32_4)),
+         ("b", dict(**f32_4))],
+        [("relu", {"X": ["x"]}, {"Out": ["a"]}, {}),
+         ("relu", {"X": ["x"]}, {"Out": ["b"]}, {}),   # dup of op0
+         ("tanh", {"X": ["x"]}, {"Out": ["a"]}, {})])  # redefines a
+    opt, report = optimize_program(prog, feed_names=["x"],
+                                   fetch_names=["a", "b"], level=1)
+    assert not report.get("rejected")
+    feed = {"x": np.array([-1.0, 0.5, 2.0, -3.0], np.float32)}
+    a, b = _run(opt, feed, ["a", "b"], level=0)
+    np.testing.assert_array_equal(b, np.maximum(feed["x"], 0.0))
+    np.testing.assert_allclose(a, np.tanh(feed["x"]), rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# elementwise fusion scopes
+# ---------------------------------------------------------------------------
+
+def _chain_program():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[4], dtype="float32")
+        t = layers.scale(x, scale=2.0)
+        u = layers.relu(t)
+        v = layers.elementwise_add(u, u)
+        loss = layers.reduce_sum(v)
+    return main, startup, loss
+
+
+def test_fusion_merges_maximal_elementwise_chains():
+    main, startup, loss = _chain_program()
+    opt, report = optimize_program(main, feed_names=["x"],
+                                   fetch_names=[loss.name], level=2)
+    fus = next(p for p in report["passes"]
+               if p["name"] == "fusion_scopes")
+    assert fus["groups"] == 1 and fus["fused_ops"] == 3
+    assert fus["merged"] == 1
+    assert report["ops_after"] == report["ops_before"] - 2
+    fused = [op for op in opt.global_block().ops
+             if op.type == "fused_elementwise"]
+    assert len(fused) == 1
+    fop = fused[0]
+    assert [s["type"] for s in fop.attrs["sub_ops"]] == \
+        ["scale", "relu", "elementwise_add"]
+    # every chain intermediate stays materialized (backward reads them)
+    assert len(fop.outputs["Out"]) == 3
+    assert getattr(fop, "_fusion_group", None) == "ewfuse0"
+    # the reduction is NOT elementwise and stays out of the fused op
+    red = next(op for op in opt.global_block().ops
+               if op.type == "reduce_sum")
+    assert getattr(red, "_fusion_group", None) is None
+    # the scope label is an annotation, not a serialized attr
+    assert "ewfuse" not in opt.to_json()
+    # and the replayed chain is bit-exact against the unfused program
+    feed = {"x": np.array([[-1.0, 0.5, 2.0, -3.0]], np.float32)}
+    base, = _run(main, feed, [loss.name], startup=startup, level=0)
+    opt_v, = _run(main, feed, [loss.name], startup=startup, level=2)
+    np.testing.assert_array_equal(np.asarray(base), np.asarray(opt_v))
+
+
+def test_fusion_falls_back_to_tags_when_a_merge_gate_fails():
+    """A run whose attrs can't round-trip through JSON (np scalar) must
+    not merge — it degrades to the shared _fusion_group annotation."""
+    prog = _raw_program(
+        [("x", dict(is_data=True, shape=[4], dtype="float32")),
+         ("a", dict(shape=[4], dtype="float32")),
+         ("b", dict(shape=[4], dtype="float32"))],
+        [("scale", {"X": ["x"]}, {"Out": ["a"]},
+          {"scale": np.float32(2.0), "bias": 0.0,
+           "bias_after_scale": True}),
+         ("relu", {"X": ["a"]}, {"Out": ["b"]}, {})])
+    opt, report = optimize_program(prog, feed_names=["x"],
+                                   fetch_names=["b"], level=2)
+    assert not report.get("rejected")
+    fus = next(p for p in report["passes"]
+               if p["name"] == "fusion_scopes")
+    assert fus["groups"] == 1 and fus["merged"] == 0
+    ops = opt.global_block().ops
+    assert [op.type for op in ops] == ["scale", "relu"]
+    assert [getattr(op, "_fusion_group", None) for op in ops] == \
+        ["ewfuse0", "ewfuse0"]
+
+
+def test_fusion_scope_lands_in_compiled_hlo():
+    """At level 2 the compiled executable's op_name metadata carries
+    the ewfuse<N>/ scope prefix — the chain presents to XLA (and to
+    profiles) as one named unit."""
+    main, startup, loss = _chain_program()
+    prev = fluid.FLAGS.graph_opt_level
+    fluid.set_flags({"FLAGS_graph_opt_level": 2})
+    try:
+        scope = fluid.Scope()
+        exe = fluid.Executor()
+        feed = {"x": np.ones((2, 4), np.float32)}
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            hlo = exe.compiled_hlo(main, feed=feed,
+                                   fetch_list=[loss.name])
+        assert re.search(r'op_name="[^"]*ewfuse0/', hlo), hlo[:2000]
+    finally:
+        fluid.set_flags({"FLAGS_graph_opt_level": prev})
+
+
+# ---------------------------------------------------------------------------
+# donation planner
+# ---------------------------------------------------------------------------
+
+def _sgd_program():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[4], dtype="float32")
+        y = layers.fc(x, size=1)
+        loss = layers.reduce_mean(y)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return main, startup, loss
+
+
+def test_donation_planner_targets_inplace_state():
+    main, _, loss = _sgd_program()
+    opt, report = optimize_program(main, feed_names=["x"],
+                                   fetch_names=[loss.name], level=2)
+    don = next(p for p in report["passes"]
+               if p["name"] == "donation_plan")
+    plan = getattr(opt, "_donation_plan", frozenset())
+    assert don["donated_vars"] == len(plan) >= 2  # fc w + b at least
+    assert don["donated_bytes"] > 0
+    block = opt.global_block()
+    for name in plan:
+        assert block.var(name).persistable
+
+
+def test_executor_compiles_with_planned_donation():
+    """End-to-end at level 2: the training executable splits donated
+    vs pinned state and records which buffers it donates."""
+    main, startup, loss = _sgd_program()
+    weight = next(n for n, v in main.global_block().vars.items()
+                  if v.persistable and ".w_" in n)
+    prev = fluid.FLAGS.graph_opt_level
+    fluid.set_flags({"FLAGS_graph_opt_level": 2})
+    try:
+        scope = fluid.Scope()
+        exe = fluid.Executor()
+        feed = {"x": np.ones((2, 4), np.float32)}
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            for _ in range(3):  # donation must survive repeated steps
+                lv, = exe.run(main, feed=feed, fetch_list=[loss.name])
+        donating = [s for s in exe._cache.values()
+                    if getattr(s, "donate_names", None)]
+        assert donating, "no cached executable has a donation plan"
+        assert any(weight in s.donate_names for s in donating)
+        assert np.isfinite(np.asarray(lv)).all()
+    finally:
+        fluid.set_flags({"FLAGS_graph_opt_level": prev})
+
+
+# ---------------------------------------------------------------------------
+# the re-verify fail-open protocol and the memoized gate
+# ---------------------------------------------------------------------------
+
+class _BreakingPass(Pass):
+    """Deliberately corrupts dataflow: re-verification must catch it
+    and the pipeline must fall back to the original program."""
+
+    name = "break_dataflow"
+    min_level = 1
+
+    def run(self, program, ctx):
+        blk = program.global_block()
+        blk.ops.append(Operator(blk, "relu", {"X": ["__ghost__"]},
+                                {"Out": [blk.ops[0].outputs["Out"][0]]}))
+        program._fp_cache = None
+        return {}
+
+
+def test_reverify_rejects_broken_rewrite_and_fails_open():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[4], dtype="float32")
+        y = layers.relu(x)
+    pm = PassManager([_BreakingPass()])
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        out, report = pm.run(main, feed_names=["x"],
+                             fetch_names=[y.name], level=1)
+    assert out is main  # fail-open: original survives
+    assert report.get("rejected") is True
+    assert report["ops_after"] == report["ops_before"]
+    assert any("re-verification" in str(w.message) for w in caught)
+
+
+def test_optimize_gate_memoizes_per_fingerprint_and_level():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[4], dtype="float32")
+        y = layers.relu(layers.relu(x))
+    prev = fluid.FLAGS.graph_opt_level
+    fluid.set_flags({"FLAGS_graph_opt_level": 1})
+    reset_memo()
+    try:
+        p1, r1 = optimize_gate(main, feed_names=["x"],
+                               fetch_names=[y.name])
+        p2, r2 = optimize_gate(main, feed_names=["x"],
+                               fetch_names=[y.name])
+        assert p1 is p2 and r1 is r2  # served from the memo
+        reset_memo()
+        p3, _ = optimize_gate(main, feed_names=["x"],
+                              fetch_names=[y.name])
+        assert p3 is not p1  # fresh pipeline run after reset
+        fluid.set_flags({"FLAGS_graph_opt_level": 0})
+        p0, rep0 = optimize_gate(main, feed_names=["x"],
+                                 fetch_names=[y.name])
+        assert p0 is main and rep0 is None
+    finally:
+        fluid.set_flags({"FLAGS_graph_opt_level": prev})
+        reset_memo()
+
+
+# ---------------------------------------------------------------------------
+# bit-exact parity + op-count reduction on the bench builders
+# ---------------------------------------------------------------------------
+
+def _builder_losses(build, level, steps=2):
+    """Fresh build + executor at the given opt level -> loss sequence
+    (np arrays). Builders are deterministic (seeded init, per-op-id
+    PRNG), so cross-level runs are comparable bit-for-bit."""
+    prev = fluid.FLAGS.graph_opt_level
+    fluid.set_flags({"FLAGS_graph_opt_level": level})
+    try:
+        exe, prog, scope, feed, loss, _cfg = build()
+        out = []
+        with fluid.scope_guard(scope):
+            for _ in range(steps):
+                lv, = exe.run(prog, feed=feed, fetch_list=[loss])
+                out.append(np.asarray(lv))
+        exe.close()
+        return out
+    finally:
+        fluid.set_flags({"FLAGS_graph_opt_level": prev})
+
+
+def _tiny_builds():
+    sys.path.insert(0, REPO)
+    os.environ.setdefault("BENCH_FLASH", "0")
+    import bench
+    return bench._CPU_TINY_BUILDS
+
+
+@pytest.mark.parametrize("model", ["gpt", "transformer"])
+def test_headline_builders_bit_exact_and_smaller(model):
+    """Acceptance: on the GPT and transformer bench programs the full
+    pipeline (level 2) is bit-exact vs level 0 AND measurably reduces
+    the op count."""
+    build = _tiny_builds()[model]
+    l0 = _builder_losses(build, 0)
+    l2 = _builder_losses(build, 2)
+    for a, b in zip(l0, l2):
+        assert np.array_equal(a, b), (model, l0, l2)
+    # measured op-count reduction on the real training program
+    exe, prog, scope, feed, loss, _cfg = build()
+    exe.close()
+    _, report = optimize_program(prog, feed_names=list(feed),
+                                 fetch_names=[loss.name], level=2)
+    assert not report.get("rejected")
+    assert report["ops_after"] < report["ops_before"], report
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("model", ["bert", "resnet50", "gpt",
+                                   "transformer", "deeplab"])
+def test_all_builders_bit_exact_across_all_levels(model):
+    build = _tiny_builds()[model]
+    base = _builder_losses(build, 0)
+    for level in (1, 2):
+        got = _builder_losses(build, level)
+        for a, b in zip(base, got):
+            assert np.array_equal(a, b), (model, level, base, got)
+
+
+@pytest.mark.slow
+def test_registry_wide_pipeline_reverifies_clean():
+    """Every op OP_TEST_MATRIX certifies as passing goes through the
+    full pipeline without tripping the re-verification gate."""
+    from op_specs import SKIPS, SPECS
+    import test_op_sweep as sweep
+
+    matrix = json.load(open(os.path.join(REPO, "OP_TEST_MATRIX.json")))
+    ops = [op for op, rec in matrix["ops"].items()
+           if rec.get("status") == "pass"
+           and op in SPECS and op not in SKIPS]
+    assert len(ops) > 250
+    bad = {}
+    for op in ops:
+        main, feeds, out_map, _direct, _ = sweep._build_program(
+            op, SPECS[op])
+        fetch = [nm for names in out_map.values() for nm in names]
+        _, report = optimize_program(main, feed_names=list(feeds),
+                                     fetch_names=fetch, level=2)
+        if report.get("rejected"):
+            bad[op] = report
+    assert not bad, f"{len(bad)} op(s) rejected by re-verify: " \
+                    f"{sorted(bad)[:10]}"
+
+
+# ---------------------------------------------------------------------------
+# serving gate
+# ---------------------------------------------------------------------------
+
+def test_serving_warmup_primes_the_gate_once(tmp_path):
+    from paddle_tpu.analysis.passes import base as base_mod
+    from paddle_tpu.serving import EngineConfig, ServingEngine
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[4], dtype="float32")
+        out = layers.softmax(layers.fc(x, size=3))
+    scope = fluid.Scope()
+    exe = fluid.Executor()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        fluid.io.save_inference_model(str(tmp_path), ["x"], [out], exe,
+                                      main_program=main)
+    prev = fluid.FLAGS.graph_opt_level
+    fluid.set_flags({"FLAGS_graph_opt_level": 1})
+    reset_memo()
+    try:
+        cfg = EngineConfig(model_dir=str(tmp_path), max_batch_size=4,
+                           warmup=True)
+        engine = ServingEngine(cfg).start()
+        try:
+            # one memo entry covers the WHOLE warmup ladder
+            assert len(base_mod._OPT_MEMO) == 1
+            r = engine.predict({"x": np.ones((2, 4), np.float32)})
+            assert r[0].shape == (2, 3)
+        finally:
+            engine.stop()
+    finally:
+        fluid.set_flags({"FLAGS_graph_opt_level": prev})
+        reset_memo()
+
+
+# ---------------------------------------------------------------------------
+# CLI + artifact schema
+# ---------------------------------------------------------------------------
+
+def test_program_lint_optimize_cli_end_to_end(tmp_path):
+    """--optimize emits a kind="graph_opt" record that the artifact
+    validator accepts and metrics_report renders."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[4], dtype="float32")
+        c = layers.fill_constant(shape=[4], dtype="float32", value=2.0)
+        y = layers.elementwise_add(x, layers.scale(c, scale=3.0))
+        out = layers.softmax(y)
+    scope = fluid.Scope()
+    exe = fluid.Executor()
+    model_dir = str(tmp_path / "model")
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        fluid.io.save_inference_model(model_dir, ["x"], [out], exe,
+                                      main_program=main)
+    log = str(tmp_path / "lint.jsonl")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "program_lint.py"),
+         model_dir, "--optimize", "--jsonl", "--out", log],
+        capture_output=True, text=True,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert r.returncode == 0, r.stdout + r.stderr
+    recs = [json.loads(ln) for ln in r.stdout.splitlines() if ln.strip()]
+    kinds = [rec["kind"] for rec in recs]
+    assert kinds == ["program_lint", "graph_opt"]
+    opt = recs[1]
+    assert opt["opt_level"] == 2
+    assert opt["ops_after"] < opt["ops_before"]
+    assert any(p["name"] == "constant_fold" and p["folded"] >= 2
+               for p in opt["passes"])
+    # schema + rendering
+    assert _tools("validate_bench_json").validate_file(log) == []
+    buf = io.StringIO()
+    rc = _tools("metrics_report").report(log, out=buf)
+    text = buf.getvalue()
+    assert rc == 0 and "graph optimization" in text \
+        and "constant_fold" in text
+
+
+def test_validate_graph_opt_schema():
+    validate = _tools("validate_bench_json").validate_graph_opt
+    good = {"kind": "graph_opt", "model": "m", "opt_level": 2,
+            "ops_before": 10, "ops_after": 8, "vars_eliminated": 1,
+            "passes": [{"name": "cse", "ops_before": 10,
+                        "ops_after": 8, "seconds": 0.01,
+                        "deduped": 2}]}
+    assert validate(good) == []
+    assert validate({"kind": "graph_opt"})  # everything missing
+    grew = dict(good, ops_after=12)
+    assert any("exceeds" in e for e in validate(grew))
+    bad_pass = dict(good, passes=[{"name": 3}])
+    assert validate(bad_pass)

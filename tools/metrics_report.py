@@ -40,7 +40,8 @@ def _fmt_bytes(v):
 
 
 def load(path):
-    snapshots, results, op_profiles, loadgens, lints = [], [], [], [], []
+    snapshots, results, op_profiles = [], [], []
+    loadgens, lints, graph_opts = [], [], []
     with open(path) as f:
         for ln, line in enumerate(f, 1):
             line = line.strip()
@@ -63,7 +64,10 @@ def load(path):
                 loadgens.append(rec)
             elif kind == "program_lint":
                 lints.append(rec)
-    return snapshots, results, op_profiles, loadgens, lints
+            elif kind == "graph_opt":
+                graph_opts.append(rec)
+    return (snapshots, results, op_profiles, loadgens, lints,
+            graph_opts)
 
 
 def _hist(snap, name):
@@ -71,11 +75,12 @@ def _hist(snap, name):
 
 
 def report(path, out=sys.stdout):
-    snapshots, results, op_profiles, loadgens, lints = load(path)
+    (snapshots, results, op_profiles, loadgens, lints,
+     graph_opts) = load(path)
     w = out.write
     w(f"runtime stats report — {path}\n")
     if not snapshots and not results and not op_profiles \
-            and not loadgens and not lints:
+            and not loadgens and not lints and not graph_opts:
         w("no snapshots or bench results found\n")
         return 1
     w(f"snapshots: {len(snapshots)}   bench results: {len(results)}\n")
@@ -252,6 +257,28 @@ def report(path, out=sys.stdout):
             if extra > 0:
                 w(f"  ... {extra} more finding(s) — full list: "
                   f"python tools/program_lint.py {r.get('model', '')}\n")
+
+    if graph_opts:
+        # one record per optimized model (tools/program_lint.py
+        # --optimize --out, or the analysis/passes PassManager report)
+        w("\n-- graph optimization (analysis/passes, "
+          "docs/graph_passes.md) --\n")
+        for r in graph_opts:
+            ops_b, ops_a = r.get("ops_before", 0), r.get("ops_after", 0)
+            pct = (f" (-{(ops_b - ops_a) / ops_b:.1%})"
+                   if ops_b and ops_a < ops_b else "")
+            status = "REJ " if r.get("rejected") else "opt "
+            w(f"{status} {r.get('model', '?'):40s} level="
+              f"{r.get('opt_level', '?')}  ops {ops_b} -> {ops_a}{pct}"
+              f"  vars_eliminated={r.get('vars_eliminated', 0)}\n")
+            for p in r.get("passes", []):
+                detail = " ".join(
+                    f"{k}={v}" for k, v in p.items()
+                    if k not in ("name", "ops_before", "ops_after",
+                                 "seconds"))
+                w(f"  {p.get('name', '?'):<16s} "
+                  f"{p.get('ops_before', 0):>5d} -> "
+                  f"{p.get('ops_after', 0):<5d} {detail}\n")
 
     if results:
         w("\n-- bench results --\n")

@@ -18,6 +18,13 @@ Options:
   --no-shapes     skip the abstract-evaluation pass (graph lints only;
                   much faster on very large programs)
   --strict        exit 1 on warnings too, not just errors
+  --optimize      additionally run the graph-optimization pipeline
+                  (paddle_tpu/analysis/passes) on each model and print
+                  a pass-by-pass table (op count before/after, vars
+                  eliminated, constants folded); emits one extra
+                  kind="graph_opt" JSONL record per model
+  --opt-level N   pipeline level for --optimize (default 2 = all five
+                  passes; matches FLAGS_graph_opt_level semantics)
   --self-check    lint two bundled in-process example programs (one
                   known-good, one with seeded defects) and exit 0 iff
                   the verifier classifies both correctly — the repo's
@@ -30,6 +37,10 @@ Each JSONL record:
     {"kind": "program_lint", "model": ..., "ok": bool,
      "counts": {"error": E, "warn": W},
      "findings": [{"rule", "severity", "where", "message", "var"?}]}
+and with --optimize additionally:
+    {"kind": "graph_opt", "model": ..., "opt_level": L,
+     "ops_before": N, "ops_after": M, "vars_eliminated": V,
+     "passes": [{"name", "ops_before", "ops_after", "seconds", ...}]}
 """
 from __future__ import annotations
 
@@ -83,6 +94,40 @@ def lint_path(path, check_shapes=True):
     rec = {"kind": "program_lint", "model": label}
     rec.update(result.to_dict())
     return rec, result
+
+
+def optimize_path(path, level=2):
+    """Run the graph-optimization pipeline on one model path ->
+    kind="graph_opt" record (the PassManager report plus model/kind)."""
+    from paddle_tpu.analysis.passes import optimize_program
+    from paddle_tpu.framework import Program
+
+    prog_dict, feeds, fetches, label = _load_program_dict(path)
+    prog_dict = dict(prog_dict)
+    prog_dict.pop("op_versions", None)
+    program = Program.from_dict(dict(prog_dict, op_versions={}))
+    _, report = optimize_program(program, feed_names=feeds,
+                                 fetch_names=fetches, level=level)
+    rec = {"kind": "graph_opt", "model": label}
+    rec.update(report)
+    return rec
+
+
+def _print_opt_text(rec, out=sys.stdout):
+    status = "REJECTED" if rec.get("rejected") else "opt"
+    out.write(f"{status} {rec['model']}  level={rec['opt_level']}  "
+              f"ops {rec['ops_before']} -> {rec['ops_after']}  "
+              f"vars_eliminated={rec['vars_eliminated']}\n")
+    passes = rec.get("passes", [])
+    if not passes:
+        return
+    out.write(f"  {'pass':<16s} {'before':>6s} {'after':>6s}  detail\n")
+    for p in passes:
+        detail = " ".join(
+            f"{k}={v}" for k, v in p.items()
+            if k not in ("name", "ops_before", "ops_after", "seconds"))
+        out.write(f"  {p['name']:<16s} {p['ops_before']:>6d} "
+                  f"{p['ops_after']:>6d}  {detail}\n")
 
 
 def _print_text(rec, out=sys.stdout):
@@ -153,6 +198,8 @@ def main(argv=None):
     as_jsonl = "--jsonl" in argv
     strict = "--strict" in argv
     check_shapes = "--no-shapes" not in argv
+    optimize = "--optimize" in argv
+    opt_level = 2
     out_path = None
     paths = []
     it = iter(argv)
@@ -162,7 +209,14 @@ def main(argv=None):
             if out_path is None:
                 print("--out needs a path", file=sys.stderr)
                 return 2
-        elif a in ("--jsonl", "--strict", "--no-shapes"):
+        elif a == "--opt-level":
+            lvl = next(it, None)
+            try:
+                opt_level = int(lvl)
+            except (TypeError, ValueError):
+                print("--opt-level needs an integer", file=sys.stderr)
+                return 2
+        elif a in ("--jsonl", "--strict", "--no-shapes", "--optimize"):
             continue
         else:
             paths.append(a)
@@ -186,6 +240,18 @@ def main(argv=None):
             print(json.dumps(rec))
         else:
             _print_text(rec)
+        if optimize:
+            try:
+                opt_rec = optimize_path(path, level=opt_level)
+            except (ValueError, OSError, KeyError,
+                    json.JSONDecodeError) as e:
+                print(f"INVALID: {path}: {e}", file=sys.stderr)
+                return 2
+            records.append(opt_rec)
+            if as_jsonl:
+                print(json.dumps(opt_rec))
+            else:
+                _print_opt_text(opt_rec)
     if out_path:
         with open(out_path, "a") as f:
             for rec in records:

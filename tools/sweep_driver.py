@@ -75,6 +75,21 @@ CONFIGS = [
                               "BENCH_BATCH": "64"}),
     ("profile", None),  # special-cased below
     ("gpt_b32", {"BENCH_MODEL": "gpt", "BENCH_BATCH": "32"}),
+    # graph-opt A/B pairs (FLAGS_graph_opt_level, analysis/passes):
+    # same model+batch at level 0 (pipeline off) vs level 2 (full
+    # pipeline incl. fusion scopes + donation planner). The bench
+    # extras record ops_pre_opt/ops_post_opt, so the pair quantifies
+    # both the op-count reduction and any step-time delta.
+    ("gpt_opt0_b32", {"BENCH_MODEL": "gpt", "BENCH_BATCH": "32",
+                      "FLAGS_graph_opt_level": "0"}),
+    ("gpt_opt2_b32", {"BENCH_MODEL": "gpt", "BENCH_BATCH": "32",
+                      "FLAGS_graph_opt_level": "2"}),
+    ("transformer_opt0_b32", {"BENCH_MODEL": "transformer",
+                              "BENCH_BATCH": "32",
+                              "FLAGS_graph_opt_level": "0"}),
+    ("transformer_opt2_b32", {"BENCH_MODEL": "transformer",
+                              "BENCH_BATCH": "32",
+                              "FLAGS_graph_opt_level": "2"}),
     ("bert_f1_b16_s1024", {"BENCH_FLASH": "1", "BENCH_BATCH": "16",
                            "BENCH_SEQ": "1024"}),
     ("bert_f0_b16_s1024", {"BENCH_FLASH": "0", "BENCH_BATCH": "16",

@@ -16,7 +16,10 @@ Checks, per file (type auto-detected from content):
   mode/requests/duration_s/throughput_rps/latency_ms{p50,p95,p99}
   contract the serving report section reads; lines with kind ==
   "program_lint" (tools/program_lint.py) carry the model/ok/counts/
-  findings contract the lint report section reads.
+  findings contract the lint report section reads; lines with kind ==
+  "graph_opt" (tools/program_lint.py --optimize) carry the model/
+  opt_level/ops_before/ops_after/vars_eliminated/passes contract the
+  graph-optimization report section reads.
 * driver BENCH_rNN.json wrappers ({"n", "cmd", "rc", "tail",
   "parsed"}): parsed must be non-null — the exact invariant the r05
   rc=124 artifact violated.
@@ -151,6 +154,46 @@ def validate_program_lint(obj, where="program_lint"):
     return errs
 
 
+def validate_graph_opt(obj, where="graph_opt"):
+    """Schema of one tools/program_lint.py --optimize record (the
+    analysis/passes PassManager report)."""
+    errs = []
+    if not isinstance(obj.get("model"), str):
+        errs.append(f"{where}: model must be a string "
+                    f"(got {obj.get('model')!r})")
+    for key in ("opt_level", "ops_before", "ops_after",
+                "vars_eliminated"):
+        v = obj.get(key)
+        if not isinstance(v, int) or isinstance(v, bool):
+            errs.append(f"{where}: {key} must be an int (got {v!r})")
+    passes = obj.get("passes")
+    if not isinstance(passes, list):
+        errs.append(f"{where}: passes must be a list")
+        passes = []
+    for i, p in enumerate(passes):
+        if not isinstance(p, dict):
+            errs.append(f"{where}: passes[{i}] is not an object")
+            continue
+        if not isinstance(p.get("name"), str):
+            errs.append(f"{where}: passes[{i}].name must be a string")
+        for key in ("ops_before", "ops_after"):
+            v = p.get(key)
+            if not isinstance(v, int) or isinstance(v, bool):
+                errs.append(f"{where}: passes[{i}].{key} must be an "
+                            f"int (got {v!r})")
+        if not isinstance(p.get("seconds"), (int, float)) \
+                or isinstance(p.get("seconds"), bool):
+            errs.append(f"{where}: passes[{i}].seconds must be "
+                        f"numeric")
+    # passes only shrink the op list — a growing program means a bug
+    if isinstance(obj.get("ops_before"), int) \
+            and isinstance(obj.get("ops_after"), int) \
+            and obj["ops_after"] > obj["ops_before"]:
+        errs.append(f"{where}: ops_after={obj['ops_after']} exceeds "
+                    f"ops_before={obj['ops_before']}")
+    return errs
+
+
 def validate_jsonl(path):
     errs = []
     with open(path) as f:
@@ -169,6 +212,9 @@ def validate_jsonl(path):
                 errs.extend(validate_loadgen(rec, where=f"{path}:{ln}"))
             elif rec.get("kind") == "program_lint":
                 errs.extend(validate_program_lint(
+                    rec, where=f"{path}:{ln}"))
+            elif rec.get("kind") == "graph_opt":
+                errs.extend(validate_graph_opt(
                     rec, where=f"{path}:{ln}"))
     return errs
 
