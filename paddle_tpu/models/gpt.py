@@ -16,7 +16,8 @@ from .. import layers
 from . import transformer
 
 __all__ = ["gpt_small", "gpt_medium", "build_train", "greedy_generate",
-           "build_decode_step", "kv_generate", "beam_generate"]
+           "DecodeStep", "build_decode_step", "kv_generate",
+           "beam_generate"]
 
 
 def gpt_small(**kw):
@@ -38,13 +39,10 @@ def gpt_medium(**kw):
     return gpt_small(**kw)
 
 
-def _sample(step_logits, temperature, rng):
-    if temperature and temperature > 0.0:
-        p = step_logits / temperature
-        p = np.exp(p - p.max())
-        p /= p.sum()
-        return int(rng.choice(len(p), p=p))
-    return int(step_logits.argmax())
+def _sample(step_logits, temperature, rng, top_k=0):
+    from . import sampling
+    return sampling.sample_token(step_logits, temperature=temperature,
+                                 top_k=top_k, rng=rng)
 
 
 def build_train(cfg, batch, seq_len, lr=3e-4, amp=False,
@@ -114,20 +112,63 @@ def greedy_generate(exe, program, tokens_var, logits_var, prompt,
     return out
 
 
-def build_decode_step(cfg, batch, max_seq):
-    """Incremental decoding graph: ONE token in, next-token logits out,
-    per-layer K/V caches carried as persistable state (donated by the
-    Executor, so updates are in-place at the XLA buffer level). O(T)
-    per generated token instead of greedy_generate's O(T^2) full
-    re-forward.
+class DecodeStep:
+    """Handle on one multi-slot decode-step program.
+
+    Iterates as the historical `(token_var, logits_var, cache_names)`
+    3-tuple, and additionally exposes the per-slot control feeds the
+    continuous-batching engine drives:
+
+    * `reset_var` — `slot_reset` [batch] float32 feed; 1.0 zeroes that
+      slot's K/V cache rows and position counter IN-GRAPH this step
+      (no host-side zero upload).
+    * `active_var` — `slot_active` [batch] float32 feed; 0.0 mutes a
+      slot: no cache write, position frozen, its logits are junk to
+      ignore.
+    """
+
+    def __init__(self, token_var, logits_var, cache_names, reset_var,
+                 active_var, batch, max_seq, state_prefix):
+        self.token_var = token_var
+        self.logits_var = logits_var
+        self.cache_names = cache_names
+        self.reset_var = reset_var
+        self.active_var = active_var
+        self.batch = batch
+        self.max_seq = max_seq
+        self.state_prefix = state_prefix
+        self.pos_name = cache_names[0]
+
+    def __iter__(self):
+        return iter((self.token_var, self.logits_var, self.cache_names))
+
+
+def build_decode_step(cfg, batch, max_seq, state_prefix=""):
+    """Incremental decoding graph: ONE token per slot in, next-token
+    logits out, per-layer K/V caches carried as persistable state
+    (donated by the Executor, so updates are in-place at the XLA buffer
+    level). O(T) per generated token instead of greedy_generate's
+    O(T^2) full re-forward.
+
+    Multi-slot: each of the `batch` rows is an independent decode slot
+    with its own position (`decode_pos` is a per-slot [batch] vector)
+    and its own cache region, so a continuous-batching scheduler can
+    admit/evict requests between steps — the Orca iteration-level
+    scheduling model — while every step runs the SAME fixed-shape
+    executable (one compile for the serving lifetime). Two extra
+    float32 [batch] feeds control the slots: `slot_reset` (1.0 zeroes
+    the slot's cache + position in-graph before this step's write) and
+    `slot_active` (0.0 freezes the slot entirely).
 
     Weight names match the training graph (layer_i.att.*, layer_i.ln*,
     word_emb, lm_head.w), so running this program in the same scope as
-    a trained model shares parameters by construction.
+    a trained model shares parameters by construction. `state_prefix`
+    prefixes only the STATE names (decode_pos, cache_k/v) so two decode
+    graphs of different batch sizes can share one trained scope without
+    colliding; weight names stay unprefixed/shared.
 
-    Returns (token_var, logits_var, cache_names): feed `token_var`
-    [batch, 1] int64; `cache_names` lists every state var to zero when
-    starting a new sequence (kv_generate does this via the scope)."""
+    Returns a `DecodeStep` — unpacks as the historical
+    (token_var, logits_var, cache_names) 3-tuple."""
     from ..framework import ParamAttr
     from ..initializer import Normal
     import math as _math
@@ -136,33 +177,57 @@ def build_decode_step(cfg, batch, max_seq):
     hd = d // h
     token = layers.data("step_token", shape=[batch, 1], dtype="int64",
                         append_batch_size=False)
-    pos = layers.create_global_var([1], 0, "int64", persistable=True,
-                                   name="decode_pos")
-    cache_names = ["decode_pos"]
+    reset = layers.data("slot_reset", shape=[batch], dtype="float32",
+                        append_batch_size=False)
+    active = layers.data("slot_active", shape=[batch], dtype="float32",
+                         append_batch_size=False)
+    pos = layers.create_global_var([batch], 0, "int64", persistable=True,
+                                   name=f"{state_prefix}decode_pos")
+    cache_names = [pos.name]
+
+    # slot gates, computed once and broadcast everywhere:
+    #   keep_slot  [B]  0.0 where the slot resets (wipes cache + pos)
+    #   pos0       [B]  effective per-slot position after reset
+    keep_slot = layers.scale(reset, scale=-1.0, bias=1.0)
+    pos0 = layers.elementwise_mul(pos, layers.cast(keep_slot, "int64"))
 
     x = layers.embedding(token, size=[cfg.vocab_size, d],
                          param_attr=ParamAttr(name="word_emb",
                                               initializer=Normal(0.0,
                                                                  0.02)))
-    # position encoding at the current position: build the full
-    # sinusoid table from a zero sequence, then gather row `pos`
+    # the embedding lookup squeezes the trailing length-1 dim ([B, d]);
+    # pin the [B, 1, d] layout explicitly — at batch 1 broadcasting hid
+    # this, at B > 1 it would silently grow a bogus seq dim
+    x = layers.reshape(x, [batch, 1, d])
+    # position encoding at each slot's current position: build the full
+    # sinusoid table from a zero sequence, then gather one row per slot
     zeros_seq = layers.fill_constant([1, max_seq, d], "float32", 0.0)
     pe_table = layers.add_position_encoding(zeros_seq, alpha=1.0,
                                             beta=1.0)
-    pe_row = layers.gather(layers.reshape(pe_table, [max_seq, d]), pos)
-    x = layers.elementwise_add(x, layers.reshape(pe_row, [1, 1, d]))
+    pe_rows = layers.gather(layers.reshape(pe_table, [max_seq, d]),
+                            pos0)                       # [B, d]
+    x = layers.elementwise_add(x, layers.reshape(pe_rows,
+                                                 [batch, 1, d]))
 
-    # masks over the cache length
+    # per-slot causal mask over the cache length: row b keeps cache
+    # positions <= pos0[b] (including this step's write at pos0[b])
     steps_f = layers.cast(layers.range(0, max_seq, 1, "int64"), "float32")
-    pos_f = layers.cast(pos, "float32")
     keep = layers.cast(
-        layers.less_equal(steps_f, layers.expand_as(pos_f, steps_f)),
-        "float32")                               # [max_seq] 1 for <= pos
-    neg = layers.scale(keep, scale=1e30, bias=-1e30)  # 0 keep, -1e30 drop
-    onehot = layers.reshape(
-        layers.one_hot(layers.reshape(pos, [1, 1]), max_seq),
-        [1, 1, max_seq, 1])
-    inv_onehot = layers.scale(onehot, scale=-1.0, bias=1.0)
+        layers.less_equal(layers.reshape(steps_f, [1, max_seq]),
+                          layers.reshape(layers.cast(pos0, "float32"),
+                                         [batch, 1])),
+        "float32")                                      # [B, maxT]
+    neg4 = layers.reshape(layers.scale(keep, scale=1e30, bias=-1e30),
+                          [batch, 1, 1, max_seq])   # 0 keep, -1e30 drop
+
+    # per-slot one-hot write gate at pos0, gated by slot_active so a
+    # muted slot's cache rows stay untouched
+    onehot = layers.elementwise_mul(
+        layers.one_hot(layers.reshape(pos0, [batch, 1]), max_seq),
+        layers.reshape(active, [batch, 1]))             # [B, maxT]
+    oh4 = layers.reshape(onehot, [batch, 1, max_seq, 1])
+    inv_oh4 = layers.scale(oh4, scale=-1.0, bias=1.0)
+    keep4 = layers.reshape(keep_slot, [batch, 1, 1, 1])
 
     def dense(z, size, name, act=None):
         # transformer._dense is the single source of truth for the
@@ -183,25 +248,28 @@ def build_decode_step(cfg, batch, max_seq):
 
         ck = layers.create_global_var([batch, h, max_seq, hd], 0.0,
                                       "float32", persistable=True,
-                                      name=f"{pre}.cache_k")
+                                      name=f"{state_prefix}{pre}.cache_k")
         cv = layers.create_global_var([batch, h, max_seq, hd], 0.0,
                                       "float32", persistable=True,
-                                      name=f"{pre}.cache_v")
+                                      name=f"{state_prefix}{pre}.cache_v")
         cache_names += [ck.name, cv.name]
+        # reset wipe, then one-hot write of this step's k/v at pos0:
+        #   new = (cache * keep_slot) * (1 - onehot) + k * onehot
         ck_new = layers.elementwise_add(
-            layers.elementwise_mul(ck, inv_onehot),
-            layers.elementwise_mul(k, onehot))
+            layers.elementwise_mul(layers.elementwise_mul(ck, keep4),
+                                   inv_oh4),
+            layers.elementwise_mul(k, oh4))
         cv_new = layers.elementwise_add(
-            layers.elementwise_mul(cv, inv_onehot),
-            layers.elementwise_mul(v, onehot))
+            layers.elementwise_mul(layers.elementwise_mul(cv, keep4),
+                                   inv_oh4),
+            layers.elementwise_mul(v, oh4))
         layers.assign(ck_new, output=ck)
         layers.assign(cv_new, output=cv)
 
         scores = layers.scale(
             layers.matmul(q, ck_new, transpose_y=True),
             scale=1.0 / _math.sqrt(hd))              # [B, H, 1, maxT]
-        scores = layers.elementwise_add(
-            scores, layers.reshape(neg, [1, 1, 1, max_seq]))
+        scores = layers.elementwise_add(scores, neg4)
         probs = layers.softmax(scores)
         ctxv = layers.matmul(probs, cv_new)          # [B, H, 1, hd]
         ctxv = layers.reshape(
@@ -221,20 +289,53 @@ def build_decode_step(cfg, batch, max_seq):
                        param_attr=ParamAttr(name="lm_head.w",
                                             initializer=Normal(0.0, 0.02)),
                        bias_attr=False)
-    layers.increment(pos, value=1.0)
-    return token, logits, cache_names
+    # advance only the active slots (a muted slot's position is frozen)
+    pos_next = layers.elementwise_add(pos0,
+                                      layers.cast(active, "int64"))
+    layers.assign(pos_next, output=pos)
+    return DecodeStep(token, logits, cache_names, reset, active, batch,
+                      max_seq, state_prefix)
+
+
+def _ensure_decode_state(scope, blk, cache_names):
+    """Make every decode state var exist in `scope` with the graph's
+    shape (zeros). Returns True when any var had to be materialized
+    host-side — the fallback path; an existing right-shaped var is left
+    alone because the in-graph `slot_reset` wipe supersedes host
+    zeroing. Never runs the decode startup program (it would re-init
+    the trained weights the scope shares)."""
+    from ..core.dtypes import as_np_dtype
+    created = False
+    for name in cache_names:
+        v = blk.var(name)
+        shape = tuple(abs(int(s)) for s in v.shape)
+        cur = scope.find_var(name) if scope.has(name) else None
+        if cur is None or tuple(np.shape(cur)) != shape:
+            scope.set(name, np.zeros(shape, as_np_dtype(v.dtype)))
+            created = True
+    return created
 
 
 def kv_generate(exe, scope, decode_prog, token_var, logits_var,
                 cache_names, prompt, max_new_tokens, temperature=0.0,
-                seed=0):
+                seed=0, top_k=0, stream_cb=None):
     """Autoregressive generation over the KV-cache decode step: feed
     the prompt token by token (prefill), then sample/argmax the
-    continuation. Caches (and the position counter) are created/zeroed
-    directly in the scope — do NOT run the decode program's startup in
-    a trained scope, it would re-initialize the shared weights."""
+    continuation.
+
+    State reset happens IN-GRAPH: the first step feeds slot_reset=1,
+    which zeroes the cache rows and position counters on device —
+    no B*H*max_seq*hd zero upload per call. Host-side zero
+    materialization survives only as the fallback for state vars that
+    do not exist in the scope yet (the Executor requires persistable
+    state to be initialised; running the decode startup would re-init
+    the shared trained weights, so the caches are seeded directly).
+
+    `stream_cb(token_id)` (optional) fires after each generated token —
+    the serial-baseline hook the generation loadgen uses for TTFT /
+    inter-token timing. `top_k` > 0 restricts sampling to the k highest
+    logits (see models/sampling.py)."""
     import paddle_tpu as fluid
-    from ..core.dtypes import as_np_dtype
 
     if not len(prompt):
         raise ValueError("kv_generate: prompt must be non-empty")
@@ -249,14 +350,28 @@ def kv_generate(exe, scope, decode_prog, token_var, logits_var,
             f"kv_generate: prompt ({len(prompt)}) + max_new_tokens "
             f"({max_new_tokens}) needs {need} cache slots but the decode "
             f"graph was built with max_seq={max_seq}")
+    multi_slot = (blk.has_var("slot_reset")
+                  and blk.has_var("slot_active"))
+    ones = np.ones(batch, np.float32)
+    zeros = np.zeros(batch, np.float32)
+    state = {"first": True}
     with fluid.scope_guard(scope):
-        for name in cache_names:
-            v = blk.var(name)
-            shape = [abs(int(s)) for s in v.shape]
-            scope.set(name, np.zeros(shape, as_np_dtype(v.dtype)))
+        _ensure_decode_state(scope, blk, cache_names)
+        if not multi_slot:
+            # legacy single-slot graph: no in-graph reset — zero
+            # everything host-side like the original implementation
+            from ..core.dtypes import as_np_dtype
+            for name in cache_names:
+                v = blk.var(name)
+                shape = [abs(int(s)) for s in v.shape]
+                scope.set(name, np.zeros(shape, as_np_dtype(v.dtype)))
 
         def step(tok):
             feed = {token_var.name: np.full((batch, 1), tok, np.int64)}
+            if multi_slot:
+                feed["slot_reset"] = ones if state["first"] else zeros
+                feed["slot_active"] = ones
+                state["first"] = False
             out, = exe.run(decode_prog, feed=feed,
                            fetch_list=[logits_var])
             return np.asarray(out)[0, 0]
@@ -266,8 +381,10 @@ def kv_generate(exe, scope, decode_prog, token_var, logits_var,
         out = []
         cur = int(prompt[-1])
         for _ in range(max_new_tokens):
-            cur = _sample(step(cur), temperature, rng)
+            cur = _sample(step(cur), temperature, rng, top_k=top_k)
             out.append(cur)
+            if stream_cb is not None:
+                stream_cb(cur)
         return out
 
 

@@ -1,0 +1,54 @@
+"""Host-side token sampling shared by every autoregressive decoder.
+
+One function, one contract: `sample_token` turns a single position's
+logits row into a token id. It is the single source of truth for
+`gpt.kv_generate`, `gpt.greedy_generate` and the serving
+`GenerationEngine`, so a request replayed serially and a request decoded
+inside the multi-slot continuous batch draw EXACTLY the same host-side
+sampling path (bit-exact parity is a test contract,
+tests/test_generation.py).
+
+The reference framework samples inside the graph (sampling_id_op /
+topk-based beam ops); here sampling stays on the host because the decode
+step is one fixed-shape XLA executable shared by every request — the
+per-request temperature/top-k knobs must not specialize (and recompile)
+the graph.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["sample_token"]
+
+
+def sample_token(step_logits, temperature=0.0, top_k=0, rng=None):
+    """Pick the next token id from one position's logits.
+
+    temperature <= 0 is greedy argmax (no rng draw, fully
+    deterministic). With temperature > 0, softmax-with-temperature
+    sampling via `rng` (a np.random.RandomState; required then).
+    top_k > 0 restricts either mode to the k highest logits — the
+    classic fan-out cap that keeps sampled generations from wandering
+    into the distribution's tail.
+    """
+    logits = np.asarray(step_logits)
+    if logits.ndim != 1:
+        raise ValueError(
+            f"sample_token expects one position's logits row, got shape "
+            f"{logits.shape}")
+    if top_k and 0 < int(top_k) < logits.shape[0]:
+        k = int(top_k)
+        keep = np.argpartition(-logits, k - 1)[:k]
+        masked = np.full_like(logits, -np.inf)
+        masked[keep] = logits[keep]
+        logits = masked
+    if temperature and temperature > 0.0:
+        if rng is None:
+            raise ValueError(
+                "sample_token: temperature sampling needs an explicit "
+                "rng (np.random.RandomState) for reproducibility")
+        p = logits / temperature
+        p = np.exp(p - p.max())
+        p /= p.sum()
+        return int(rng.choice(len(p), p=p))
+    return int(logits.argmax())

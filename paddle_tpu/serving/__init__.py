@@ -8,7 +8,14 @@ engine quantizes all traffic onto a fixed bucket ladder
 (`BucketLadder`), coalesces concurrent requests into padded batches
 (`DynamicBatcher`), and precompiles every ladder cell before accepting
 traffic (`ServingEngine.warmup`). A stdlib HTTP front end
-(`serving.http.serve`) exposes /v1/predict, /healthz and /metrics.
+(`serving.http.serve`) exposes /v1/predict, /v1/generate, /healthz and
+/metrics.
+
+Autoregressive LLM traffic goes through `GenerationEngine`
+(serving/generation.py): Orca-style continuous batching over the
+multi-slot KV-cache decode step of models/gpt.py — requests join and
+leave a running decode batch between steps, with the whole serving
+lifetime covered by ONE compiled executable.
 
 Quick start::
 
@@ -22,8 +29,11 @@ from .batcher import (BucketLadder, DeadlineExceededError,  # noqa: F401
                       DynamicBatcher, EngineClosedError, QueueFullError,
                       ServingError)
 from .engine import EngineConfig, ServingEngine  # noqa: F401
+from .generation import (GenerationEngine, GenerationRequest,  # noqa: F401
+                         SlotManager)
 from .http import ServingHTTPServer, serve  # noqa: F401
 
 __all__ = ["BucketLadder", "DynamicBatcher", "EngineConfig",
            "ServingEngine", "ServingHTTPServer", "serve", "ServingError",
-           "QueueFullError", "DeadlineExceededError", "EngineClosedError"]
+           "QueueFullError", "DeadlineExceededError", "EngineClosedError",
+           "GenerationEngine", "GenerationRequest", "SlotManager"]

@@ -1,0 +1,446 @@
+"""Continuous-batching generation: slot-based KV-cache decode serving.
+
+Reference: the reference framework ships autoregressive inference as
+while_op beam-search decoders inside the graph — one request per
+invocation. Serving LLM traffic needs the Orca model instead:
+iteration-level scheduling, where the scheduler re-decides the batch
+composition BETWEEN decode steps, so a finished request's slot is handed
+to a queued request immediately rather than waiting for the whole batch
+to finish.
+
+On TPU the constraint that shapes this design is XLA shape
+specialization: the decode step must be ONE fixed-shape executable for
+the engine's whole lifetime. `models/gpt.py:build_decode_step` therefore
+carries a per-slot `decode_pos` vector plus `slot_reset`/`slot_active`
+feeds: a new request joins a running batch by feeding reset=1 on its
+slot (the graph zeroes that slot's K/V rows in-device — no host zero
+upload, no recompile), and an empty slot rides along muted with
+active=0. Admission, prefill (prompt tokens stepped through the same
+graph), sampling (host-side, models/sampling.py), eviction and
+re-admission all happen without ever presenting XLA a novel shape —
+`Executor.cache_stats()` misses stay frozen after the single warmup
+compile, the same zero-post-warmup-compile contract `ServingEngine`
+keeps for encoder traffic.
+
+Queueing reuses the `batcher.py` vocabulary: bounded queue with
+`QueueFullError` backpressure, per-request deadlines failing with
+`DeadlineExceededError`, `EngineClosedError` + drain semantics on
+shutdown, `_Response` future handles.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from ..monitor import STAT_ADD, STAT_OBSERVE, STAT_SET
+from ..monitor import enabled as _monitor_on
+from .batcher import (DeadlineExceededError, EngineClosedError,
+                      FRACTION_BUCKETS, MS_BUCKETS, QueueFullError,
+                      _Response)
+
+__all__ = ["GenerationRequest", "SlotManager", "GenerationEngine"]
+
+
+class GenerationRequest:
+    """One generation job: prompt in, up to `max_new_tokens` out.
+
+    `temperature`/`top_k` select the sampling policy (see
+    models/sampling.py; temperature 0 = greedy, fully deterministic
+    given `seed`). `eos_id` stops the request early when sampled.
+    `timeout_ms` is a wall-clock deadline covering queue wait AND
+    decode; None falls back to the engine default. `stream_cb(token_id)`
+    fires from the engine thread after every generated token — the
+    streaming hook (and the loadgen's TTFT/inter-token probe).
+    """
+
+    __slots__ = ("prompt", "max_new_tokens", "temperature", "top_k",
+                 "eos_id", "timeout_ms", "seed", "stream_cb")
+
+    def __init__(self, prompt: Sequence[int], max_new_tokens: int,
+                 temperature: float = 0.0, top_k: int = 0,
+                 eos_id: Optional[int] = None,
+                 timeout_ms: Optional[float] = None, seed: int = 0,
+                 stream_cb: Optional[Callable[[int], None]] = None):
+        self.prompt = [int(t) for t in prompt]
+        if not self.prompt:
+            raise ValueError("GenerationRequest: prompt must be "
+                             "non-empty")
+        self.max_new_tokens = int(max_new_tokens)
+        if self.max_new_tokens < 1:
+            raise ValueError("GenerationRequest: max_new_tokens must "
+                             "be >= 1")
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.eos_id = None if eos_id is None else int(eos_id)
+        self.timeout_ms = timeout_ms
+        self.seed = int(seed)
+        self.stream_cb = stream_cb
+
+
+class SlotManager:
+    """Free-list over the decode graph's B slots.
+
+    Owned by the engine worker thread (admission and eviction both
+    happen between steps on that thread), so no internal locking.
+    """
+
+    def __init__(self, n_slots: int):
+        if n_slots < 1:
+            raise ValueError("SlotManager: need at least one slot")
+        self.n_slots = int(n_slots)
+        self._free = list(range(self.n_slots - 1, -1, -1))  # pop() -> 0 first
+
+    def acquire(self) -> Optional[int]:
+        """Lowest free slot index, or None when fully occupied."""
+        return self._free.pop() if self._free else None
+
+    def release(self, slot: int):
+        if slot in self._free or not 0 <= slot < self.n_slots:
+            raise ValueError(f"SlotManager: bad release of slot {slot}")
+        self._free.append(slot)
+        self._free.sort(reverse=True)
+
+    def free_count(self) -> int:
+        return len(self._free)
+
+    def active_count(self) -> int:
+        return self.n_slots - len(self._free)
+
+
+class _SlotState:
+    """Per-occupied-slot decode progress (worker-thread private)."""
+
+    __slots__ = ("req", "response", "fed", "cur", "generated", "rng",
+                 "needs_reset", "deadline", "t_submit", "t_prev_token",
+                 "ttft_ms")
+
+    def __init__(self, req: GenerationRequest, response: _Response,
+                 deadline: Optional[float], t_submit: float):
+        self.req = req
+        self.response = response
+        self.fed = 0                  # tokens already stepped
+        self.cur = req.prompt[0]      # next token to feed
+        self.generated: List[int] = []
+        self.rng = np.random.RandomState(req.seed)
+        self.needs_reset = True       # feed slot_reset=1 on first step
+        self.deadline = deadline
+        self.t_submit = t_submit
+        self.t_prev_token: Optional[float] = None
+        self.ttft_ms: Optional[float] = None
+
+
+class _Queued:
+    __slots__ = ("req", "response", "deadline", "t_submit")
+
+    def __init__(self, req, response, deadline, t_submit):
+        self.req = req
+        self.response = response
+        self.deadline = deadline
+        self.t_submit = t_submit
+
+
+class GenerationEngine:
+    """Iteration-level (continuous-batching) generation service.
+
+    Construct with a trained `scope` (weights under the training-graph
+    names) and the model's TransformerConfig; the engine builds its own
+    `max_slots`-wide decode program whose STATE names carry
+    `state_prefix`, so it can share the scope with training graphs or a
+    serial batch=1 decode graph without collision. Lifecycle mirrors
+    `ServingEngine`: `start()` (state init + one warmup step = the one
+    compile of the engine's lifetime), `submit`/`generate` from any
+    thread, `stop(drain=True)`.
+    """
+
+    def __init__(self, cfg, scope, exe=None,
+                 max_slots: Optional[int] = None,
+                 max_seq: Optional[int] = None,
+                 queue_capacity: Optional[int] = None,
+                 default_timeout_ms: Optional[float] = None,
+                 state_prefix: str = "gen."):
+        import paddle_tpu as fluid
+        from ..core.flags import FLAGS
+        from ..models import gpt
+
+        self.cfg = cfg
+        self.scope = scope
+        self.exe = exe if exe is not None else fluid.Executor()
+        self.max_slots = int(max_slots if max_slots is not None
+                             else FLAGS.serving_max_batch_size)
+        self.max_seq = int(max_seq if max_seq is not None
+                           else cfg.max_seq_len)
+        self.queue_capacity = int(queue_capacity
+                                  if queue_capacity is not None
+                                  else FLAGS.serving_queue_capacity)
+        self.default_timeout_ms = (
+            default_timeout_ms if default_timeout_ms is not None
+            else FLAGS.serving_default_timeout_ms)
+        # the decode-step program; its startup is never run (it would
+        # re-init the shared trained weights) — state is seeded by
+        # _ensure_decode_state in start()
+        self._prog = fluid.Program()
+        self._startup = fluid.Program()
+        with fluid.program_guard(self._prog, self._startup):
+            self.step = gpt.build_decode_step(
+                cfg, batch=self.max_slots, max_seq=self.max_seq,
+                state_prefix=state_prefix)
+        self._slots = SlotManager(self.max_slots)
+        self._state: List[Optional[_SlotState]] = \
+            [None] * self.max_slots
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._queue: List[_Queued] = []
+        self._closed = False
+        self._draining = True
+        self._worker: Optional[threading.Thread] = None
+        self._ready = threading.Event()
+        self._warm_misses: Optional[int] = None
+
+    # -- lifecycle -------------------------------------------------------
+    def init_scope(self):
+        """Run the decode program's startup to give the scope FRESH
+        random weights. Only for scratch scopes (loadgen, smoke tests):
+        on a scope holding trained parameters this would wipe them —
+        trained deployments skip this and let `start()` seed just the
+        decode state."""
+        self.exe.run(self._startup, scope=self.scope)
+        return self
+
+    def start(self):
+        """Seed the decode state, run one warmup step (the single
+        compile of the engine's lifetime — all slots muted), then start
+        the worker thread."""
+        if self._worker is not None:
+            return self
+        from ..models import gpt
+        blk = self._prog.global_block()
+        gpt._ensure_decode_state(self.scope, blk, self.step.cache_names)
+        self._run_step(np.zeros((self.max_slots, 1), np.int64),
+                       reset=np.ones(self.max_slots, np.float32),
+                       active=np.zeros(self.max_slots, np.float32))
+        self._warm_misses = self.cache_stats()["misses"]
+        self._closed = False
+        self._worker = threading.Thread(target=self._worker_loop,
+                                        name="ptn-generation-worker",
+                                        daemon=True)
+        self._worker.start()
+        self._ready.set()
+        return self
+
+    def stop(self, drain: bool = True,
+             timeout: Optional[float] = 30.0):
+        """Reject new submissions; drain=True finishes queued + active
+        requests first, drain=False fails them with EngineClosedError."""
+        self._ready.clear()
+        with self._cond:
+            self._closed = True
+            self._draining = drain
+            self._cond.notify_all()
+        if self._worker is not None:
+            self._worker.join(timeout)
+            self._worker = None
+
+    @property
+    def ready(self) -> bool:
+        return self._ready.is_set()
+
+    def cache_stats(self):
+        """The executor's per-instance executable-cache counters; after
+        `start()` the `misses` count must never move again — the
+        zero-post-warmup-compile acceptance check
+        (tools/serving_loadgen.py --generate --check-compiles)."""
+        return self.exe.cache_stats()
+
+    def post_warmup_compiles(self) -> int:
+        if self._warm_misses is None:
+            return 0
+        return self.cache_stats()["misses"] - self._warm_misses
+
+    # -- request path ----------------------------------------------------
+    def submit(self, req: GenerationRequest) -> _Response:
+        """Enqueue; returns a future handle whose `.result()` blocks for
+        ``{"tokens", "finish_reason", "ttft_ms", "e2e_ms"}``."""
+        need = len(req.prompt) + req.max_new_tokens - 1
+        if need > self.max_seq:
+            raise ValueError(
+                f"request needs {need} cache positions but the engine "
+                f"was built with max_seq={self.max_seq}")
+        timeout_ms = req.timeout_ms if req.timeout_ms is not None \
+            else self.default_timeout_ms
+        now = time.perf_counter()
+        deadline = now + timeout_ms / 1e3 if timeout_ms else None
+        resp = _Response()
+        with self._cond:
+            if self._closed:
+                raise EngineClosedError("generation engine is shut down")
+            if len(self._queue) >= self.queue_capacity:
+                STAT_ADD("serving.gen_rejected")
+                raise QueueFullError(
+                    f"generation queue at capacity "
+                    f"({len(self._queue)}/{self.queue_capacity})")
+            self._queue.append(_Queued(req, resp, deadline, now))
+            STAT_ADD("serving.gen_requests")
+            STAT_SET("serving.gen_queue_depth", len(self._queue))
+            self._cond.notify_all()
+        return resp
+
+    def generate(self, prompt: Sequence[int], max_new_tokens: int,
+                 **kw) -> dict:
+        """Blocking submit+wait convenience."""
+        return self.submit(GenerationRequest(
+            prompt, max_new_tokens, **kw)).result()
+
+    # -- decode step -----------------------------------------------------
+    def _run_step(self, tokens, reset, active):
+        out, = self.exe.run(
+            self._prog,
+            feed={self.step.token_var.name: tokens,
+                  self.step.reset_var.name: reset,
+                  self.step.active_var.name: active},
+            fetch_list=[self.step.logits_var],
+            scope=self.scope)
+        return np.asarray(out)
+
+    # -- worker ----------------------------------------------------------
+    def _expire_queued_locked(self, now) -> List[_Queued]:
+        dead = [q for q in self._queue
+                if q.deadline is not None and now >= q.deadline]
+        if dead:
+            self._queue = [q for q in self._queue if q not in dead]
+        return dead
+
+    def _finish(self, st: _SlotState, reason: str):
+        now = time.perf_counter()
+        st.response._complete({
+            "tokens": list(st.generated),
+            "finish_reason": reason,
+            "ttft_ms": st.ttft_ms,
+            "e2e_ms": (now - st.t_submit) * 1e3,
+        })
+        if _monitor_on():
+            STAT_OBSERVE("serving.gen_e2e_ms",
+                         (now - st.t_submit) * 1e3, buckets=MS_BUCKETS)
+
+    def _worker_loop(self):
+        # deferred: paddle_tpu/__init__ imports serving before the
+        # models package exists, so this cannot be a module-level import
+        from ..models import sampling
+        B = self.max_slots
+        while True:
+            expired: List[_Queued] = []
+            failed: List[_Queued] = []
+            exit_loop = False
+            with self._cond:
+                now = time.perf_counter()
+                expired = self._expire_queued_locked(now)
+                if self._closed and not self._draining:
+                    failed = self._queue
+                    self._queue = []
+                # admit queued requests into free slots (iteration-level
+                # scheduling: this runs BETWEEN decode steps, so a slot
+                # freed by the previous step is reusable right now)
+                while self._queue and self._slots.free_count():
+                    q = self._queue.pop(0)
+                    slot = self._slots.acquire()
+                    self._state[slot] = _SlotState(
+                        q.req, q.response, q.deadline, q.t_submit)
+                active_idx = [i for i in range(B)
+                              if self._state[i] is not None]
+                STAT_SET("serving.gen_queue_depth", len(self._queue))
+                STAT_SET("serving.gen_active_slots", len(active_idx))
+                if not active_idx:
+                    if self._closed and not self._queue:
+                        exit_loop = True
+                    elif not (self._closed and not self._draining):
+                        self._cond.wait(0.05)
+            for q in expired:
+                STAT_ADD("serving.gen_timeouts")
+                q.response._complete(error=DeadlineExceededError(
+                    "generation request waited past its deadline"))
+            for q in failed:
+                q.response._complete(error=EngineClosedError(
+                    "generation engine shut down before the request "
+                    "ran"))
+            if self._closed and not self._draining:
+                # fail whatever is mid-decode and exit
+                for i in range(B):
+                    st = self._state[i]
+                    if st is not None:
+                        st.response._complete(error=EngineClosedError(
+                            "generation engine shut down mid-decode"))
+                        self._state[i] = None
+                        self._slots.release(i)
+                break
+            if exit_loop:
+                break
+            if not active_idx:
+                continue
+
+            # ---- one decode step over the full fixed-shape batch ----
+            now = time.perf_counter()
+            tokens = np.zeros((B, 1), np.int64)
+            reset = np.zeros(B, np.float32)
+            active = np.zeros(B, np.float32)
+            stepped: List[int] = []
+            for i in active_idx:
+                st = self._state[i]
+                if st.deadline is not None and now >= st.deadline:
+                    STAT_ADD("serving.gen_timeouts")
+                    st.response._complete(
+                        error=DeadlineExceededError(
+                            "generation deadline passed mid-decode"))
+                    self._state[i] = None
+                    self._slots.release(i)
+                    continue
+                tokens[i, 0] = st.cur
+                reset[i] = 1.0 if st.needs_reset else 0.0
+                active[i] = 1.0
+                stepped.append(i)
+            if not stepped:
+                continue
+            logits = self._run_step(tokens, reset, active)
+            STAT_ADD("serving.gen_steps")
+            if _monitor_on():
+                STAT_OBSERVE("serving.gen_slot_occupancy",
+                             len(stepped) / float(B),
+                             buckets=FRACTION_BUCKETS)
+
+            # ---- per-slot bookkeeping (sampling, streaming, finish) --
+            t_step = time.perf_counter()
+            for i in stepped:
+                st = self._state[i]
+                st.needs_reset = False
+                st.fed += 1
+                prompt = st.req.prompt
+                if st.fed < len(prompt):
+                    st.cur = prompt[st.fed]     # still prefilling
+                    continue
+                tok = sampling.sample_token(
+                    logits[i, 0], temperature=st.req.temperature,
+                    top_k=st.req.top_k, rng=st.rng)
+                st.generated.append(tok)
+                STAT_ADD("serving.gen_tokens")
+                if len(st.generated) == 1:
+                    st.ttft_ms = (t_step - st.t_submit) * 1e3
+                    if _monitor_on():
+                        STAT_OBSERVE("serving.gen_ttft_ms", st.ttft_ms,
+                                     buckets=MS_BUCKETS)
+                elif _monitor_on() and st.t_prev_token is not None:
+                    STAT_OBSERVE("serving.gen_inter_token_ms",
+                                 (t_step - st.t_prev_token) * 1e3,
+                                 buckets=MS_BUCKETS)
+                st.t_prev_token = t_step
+                if st.req.stream_cb is not None:
+                    st.req.stream_cb(tok)
+                done_eos = (st.req.eos_id is not None
+                            and tok == st.req.eos_id)
+                if done_eos or len(st.generated) >= \
+                        st.req.max_new_tokens:
+                    self._finish(st, "eos" if done_eos else "length")
+                    self._state[i] = None
+                    self._slots.release(i)
+                else:
+                    st.cur = tok

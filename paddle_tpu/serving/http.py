@@ -1,15 +1,21 @@
-"""Stdlib HTTP front end for ServingEngine.
+"""Stdlib HTTP front end for ServingEngine / GenerationEngine.
 
 Endpoints (JSON over ThreadingHTTPServer — each client connection gets
-its own handler thread, which blocks in `engine.predict` so the dynamic
-batcher sees genuine concurrency):
+its own handler thread, which blocks in `engine.predict` /
+`gen_engine.generate` so the batching layers see genuine concurrency):
 
 - ``POST /v1/predict``  body ``{"inputs": {name: nested list},
   "timeout_ms": optional}`` -> ``{"outputs": {name: nested list},
   "shapes": {...}}``; 400 malformed, 503 queue-full/closed (the
   backpressure status clients should retry with backoff), 504 deadline.
-- ``GET /healthz``      -> 200 ``{"status": "ok"}`` once the engine is
-  warmed and ready, 503 before/after.
+- ``POST /v1/generate`` body ``{"prompt": [token ids],
+  "max_new_tokens": n, "temperature"/"top_k"/"eos_id"/"seed"/
+  "timeout_ms": optional}`` -> ``{"tokens": [...], "finish_reason":
+  "length"|"eos", "ttft_ms", "e2e_ms"}`` from the continuous-batching
+  GenerationEngine; same 400/503/504 error mapping. 404 when the server
+  was started without a generation engine.
+- ``GET /healthz``      -> 200 ``{"status": "ok"}`` once every attached
+  engine is warmed and ready, 503 before/after.
 - ``GET /metrics``      -> the same Prometheus text the monitor's scrape
   endpoint serves (monitor.prometheus_text), so one port serves both
   traffic and observability.
@@ -32,13 +38,22 @@ __all__ = ["ServingHTTPServer", "serve"]
 
 class ServingHTTPServer:
     """Owns the listening socket + serve_forever thread. `port=0` binds
-    an ephemeral port (read it back from `.port` — tests do)."""
+    an ephemeral port (read it back from `.port` — tests do).
 
-    def __init__(self, engine: ServingEngine, port: int = 0,
-                 host: str = "127.0.0.1"):
+    Attach a `ServingEngine` (/v1/predict), a `GenerationEngine`
+    (/v1/generate), or both on one port; an absent engine's route
+    answers 404."""
+
+    def __init__(self, engine: Optional[ServingEngine] = None,
+                 port: int = 0, host: str = "127.0.0.1",
+                 gen_engine=None):
         import http.server
 
+        if engine is None and gen_engine is None:
+            raise ValueError("ServingHTTPServer needs an engine and/or "
+                             "a gen_engine")
         eng = engine
+        gen = gen_engine
 
         class _Handler(http.server.BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
@@ -54,7 +69,8 @@ class ServingHTTPServer:
             def do_GET(self):
                 STAT_ADD("serving.http_requests")
                 if self.path.startswith("/healthz"):
-                    if eng.ready:
+                    if all(e.ready for e in (eng, gen)
+                           if e is not None):
                         self._reply(200, {"status": "ok"})
                     else:
                         self._reply(503, {"status": "not ready"})
@@ -71,7 +87,11 @@ class ServingHTTPServer:
 
             def do_POST(self):
                 STAT_ADD("serving.http_requests")
-                if not self.path.startswith("/v1/predict"):
+                if self.path.startswith("/v1/generate"):
+                    self._generate()
+                    return
+                if not self.path.startswith("/v1/predict") \
+                        or eng is None:
                     self._reply(404, {"error": f"no route {self.path}"})
                     return
                 try:
@@ -112,6 +132,45 @@ class ServingHTTPServer:
                                for n, o in zip(names, outs)},
                 })
 
+            def _generate(self):
+                from .generation import GenerationRequest
+                if gen is None:
+                    self._reply(404, {"error": "no generation engine "
+                                               "attached"})
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(length) or b"{}")
+                    greq = GenerationRequest(
+                        prompt=req["prompt"],
+                        max_new_tokens=req["max_new_tokens"],
+                        temperature=req.get("temperature", 0.0),
+                        top_k=req.get("top_k", 0),
+                        eos_id=req.get("eos_id"),
+                        timeout_ms=req.get("timeout_ms"),
+                        seed=req.get("seed", 0))
+                except (KeyError, ValueError, TypeError,
+                        json.JSONDecodeError) as e:
+                    self._reply(400, {"error": f"bad request: {e}"})
+                    return
+                try:
+                    out = gen.submit(greq).result()
+                except QueueFullError as e:
+                    self._reply(503, {"error": str(e),
+                                      "retryable": True})
+                    return
+                except DeadlineExceededError as e:
+                    self._reply(504, {"error": str(e)})
+                    return
+                except EngineClosedError as e:
+                    self._reply(503, {"error": str(e),
+                                      "retryable": False})
+                    return
+                except ValueError as e:
+                    self._reply(400, {"error": f"bad request: {e}"})
+                    return
+                self._reply(200, out)
+
             def log_message(self, *args):
                 pass  # request logging goes through the monitor, not
                 # stderr
@@ -138,12 +197,17 @@ class ServingHTTPServer:
         self._srv.server_close()
 
 
-def serve(engine: ServingEngine,
-          port: Optional[int] = None) -> ServingHTTPServer:
-    """Start the engine (if not already started) and expose it over
-    HTTP. port=None reads EngineConfig.http_port (itself defaulted from
+def serve(engine: Optional[ServingEngine] = None,
+          port: Optional[int] = None,
+          gen_engine=None) -> ServingHTTPServer:
+    """Start the engine(s) (if not already started) and expose them
+    over HTTP. port=None reads EngineConfig.http_port when a
+    ServingEngine is attached (itself defaulted from
     FLAGS_serving_http_port; 0 binds an ephemeral port)."""
-    engine.start()
+    if engine is not None:
+        engine.start()
+    if gen_engine is not None:
+        gen_engine.start()
     if port is None:
-        port = engine.config.http_port
-    return ServingHTTPServer(engine, port=port)
+        port = engine.config.http_port if engine is not None else 0
+    return ServingHTTPServer(engine, port=port, gen_engine=gen_engine)

@@ -1,0 +1,403 @@
+"""Continuous-batching generation tests: sampling helper, SlotManager,
+multi-slot decode parity against serial kv_generate (including
+join-mid-flight admission), graph-opt-level invariance, the /v1/generate
+HTTP route, and the generation loadgen JSONL schema + report rendering.
+
+The trained model is the tests/test_models.py cyclic-successor task
+(token t is followed by (t + 1) % vocab), so greedy continuations are
+known exactly and any numerical or scheduling divergence between the
+serial and continuous-batching decode paths shows up as a wrong token,
+not a tolerance failure.
+"""
+import io
+import json
+import os
+import sys
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.models import gpt, sampling
+from paddle_tpu.serving import (DeadlineExceededError, GenerationEngine,
+                                GenerationRequest, QueueFullError,
+                                SlotManager, serve)
+
+VOCAB, SEQ = 16, 12
+
+
+@pytest.fixture(scope="module")
+def trained():
+    """Tiny GPT trained on the cyclic-successor task; returns
+    (cfg, scope, exe).  Greedy continuation of [a, b, c] is
+    [(c+1) % VOCAB, (c+2) % VOCAB, ...]."""
+    cfg = gpt.gpt_small(vocab_size=VOCAB, d_model=32, n_heads=4,
+                        n_layers=2, d_ff=64, max_seq_len=SEQ,
+                        dropout=0.0, use_flash=False)
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        loss, logits, tokens = gpt.build_train(cfg, batch=8, seq_len=SEQ,
+                                               lr=5e-3)
+        exe = fluid.Executor()
+        exe.run(startup)
+        base = np.arange(SEQ) % VOCAB
+        toks = np.stack([(base + i) % VOCAB for i in range(8)]) \
+            .astype(np.int64)
+        for _ in range(40):
+            exe.run(main, feed={"tokens": toks}, fetch_list=[loss])
+    return cfg, scope, exe
+
+
+def _serial_decode(cfg):
+    """Fresh batch=1 decode program with UNPREFIXED state names (no
+    collision with a gen.-prefixed engine sharing the scope)."""
+    dec_main, dec_start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(dec_main, dec_start):
+        step = gpt.build_decode_step(cfg, batch=1, max_seq=SEQ)
+    return dec_main, step
+
+
+def _kv(exe, scope, dec_main, step, prompt, max_new, **kw):
+    return gpt.kv_generate(exe, scope, dec_main, step.token_var,
+                           step.logits_var, step.cache_names,
+                           prompt=prompt, max_new_tokens=max_new, **kw)
+
+
+# ---------------------------------------------------------------------------
+# sampling helper (models/sampling.py)
+# ---------------------------------------------------------------------------
+
+def test_sample_token_greedy_is_argmax():
+    logits = np.array([0.1, 2.0, -1.0, 1.9], np.float32)
+    assert sampling.sample_token(logits) == 1
+    assert sampling.sample_token(logits, temperature=0.0, top_k=2) == 1
+
+
+def test_sample_token_top_k_masks_tail():
+    # with top_k=2 only ids {1, 3} are eligible; at any temperature the
+    # sampled id must come from that set
+    logits = np.array([0.0, 5.0, 1.0, 4.0], np.float32)
+    rng = np.random.RandomState(0)
+    got = {sampling.sample_token(logits, temperature=1.0, top_k=2,
+                                 rng=rng) for _ in range(64)}
+    assert got <= {1, 3} and 1 in got
+
+
+def test_sample_token_temperature_deterministic_per_seed():
+    logits = np.random.RandomState(3).randn(VOCAB).astype(np.float32)
+    a = [sampling.sample_token(logits, temperature=0.8,
+                               rng=np.random.RandomState(7))
+         for _ in range(5)]
+    b = [sampling.sample_token(logits, temperature=0.8,
+                               rng=np.random.RandomState(7))
+         for _ in range(5)]
+    assert a == b
+    # temperature -> 0 concentrates on the argmax
+    assert sampling.sample_token(logits, temperature=1e-4,
+                                 rng=np.random.RandomState(0)) == \
+        int(np.argmax(logits))
+
+
+def test_sample_token_validation():
+    with pytest.raises(ValueError):
+        sampling.sample_token(np.zeros((2, 3), np.float32))
+    with pytest.raises(ValueError):
+        sampling.sample_token(np.zeros(4, np.float32), temperature=1.0)
+
+
+# ---------------------------------------------------------------------------
+# SlotManager / GenerationRequest
+# ---------------------------------------------------------------------------
+
+def test_slot_manager_lowest_first_and_release():
+    m = SlotManager(3)
+    assert [m.acquire() for _ in range(3)] == [0, 1, 2]
+    assert m.acquire() is None and m.free_count() == 0
+    m.release(1)
+    assert m.active_count() == 2 and m.acquire() == 1
+    m.release(2)
+    m.release(0)
+    assert m.acquire() == 0    # lowest free slot wins again
+    with pytest.raises(ValueError):
+        m.release(2)           # double release
+    with pytest.raises(ValueError):
+        m.release(99)
+    with pytest.raises(ValueError):
+        SlotManager(0)
+
+
+def test_generation_request_validation():
+    with pytest.raises(ValueError):
+        GenerationRequest([], 4)
+    with pytest.raises(ValueError):
+        GenerationRequest([1], 0)
+    r = GenerationRequest(np.array([1, 2], np.int64), 3, eos_id=7)
+    assert r.prompt == [1, 2] and r.eos_id == 7
+
+
+# ---------------------------------------------------------------------------
+# kv_generate: graph-opt-level invariance (satellite 3)
+# ---------------------------------------------------------------------------
+
+def test_kv_generate_bit_exact_across_graph_opt_levels(trained):
+    """The optimization pipeline (DCE/fold/CSE/fusion) must not change
+    a single sampled token: decode at FLAGS_graph_opt_level 0 and 2
+    from identical state must agree bit-exactly."""
+    cfg, scope, _ = trained
+    dec_main, step = _serial_decode(cfg)
+    prev = fluid.FLAGS.graph_opt_level
+    outs = {}
+    try:
+        for lvl in (0, 2):
+            fluid.set_flags({"FLAGS_graph_opt_level": lvl})
+            exe = fluid.Executor()   # fresh executable cache per level
+            outs[lvl] = _kv(exe, scope, dec_main, step,
+                            prompt=[0, 1, 2], max_new=7)
+    finally:
+        fluid.set_flags({"FLAGS_graph_opt_level": prev})
+    assert outs[0] == outs[2], outs
+    assert outs[0] == [(3 + i) % VOCAB for i in range(7)]
+
+
+# ---------------------------------------------------------------------------
+# GenerationEngine vs serial kv_generate (tentpole parity)
+# ---------------------------------------------------------------------------
+
+def test_engine_matches_serial_kv_generate(trained):
+    """3 mixed-length requests over 2 slots (forces eviction + re-
+    admission) must produce EXACTLY the serial kv_generate tokens, with
+    zero post-warmup compiles."""
+    cfg, scope, exe = trained
+    prompts = [([0, 1, 2], 5), ([5, 6], 5), ([1, 2, 3, 4], 4)]
+    dec_main, step = _serial_decode(cfg)
+    want = [_kv(exe, scope, dec_main, step, p, n) for p, n in prompts]
+
+    eng = GenerationEngine(cfg, scope, exe=fluid.Executor(),
+                           max_slots=2, max_seq=SEQ)
+    eng.start()
+    try:
+        resps = [eng.submit(GenerationRequest(p, n)) for p, n in prompts]
+        got = [r.result(timeout=30.0)["tokens"] for r in resps]
+        assert got == want, (got, want)
+        assert eng.post_warmup_compiles() == 0, eng.cache_stats()
+    finally:
+        eng.stop()
+    assert not eng.ready
+
+
+def test_engine_join_mid_flight_matches_serial(trained):
+    """A request admitted from another request's stream callback (i.e.
+    joining the batch while decode is mid-flight) must neither perturb
+    the running slot nor be perturbed by it."""
+    cfg, scope, exe = trained
+    dec_main, step = _serial_decode(cfg)
+    want_a = _kv(exe, scope, dec_main, step, [0, 1, 2], 6)
+    want_b = _kv(exe, scope, dec_main, step, [7, 8], 4)
+
+    eng = GenerationEngine(cfg, scope, exe=fluid.Executor(),
+                           max_slots=2, max_seq=SEQ)
+    eng.start()
+    try:
+        later = []
+
+        def cb(tok):
+            if not later:   # first generated token of A -> admit B
+                later.append(eng.submit(GenerationRequest([7, 8], 4)))
+
+        resp_a = eng.submit(GenerationRequest([0, 1, 2], 6,
+                                              stream_cb=cb))
+        got_a = resp_a.result(timeout=30.0)["tokens"]
+        got_b = later[0].result(timeout=30.0)["tokens"]
+        assert got_a == want_a and got_b == want_b
+        assert eng.post_warmup_compiles() == 0, eng.cache_stats()
+    finally:
+        eng.stop()
+
+
+def test_engine_eos_and_result_metadata(trained):
+    cfg, scope, exe = trained
+    dec_main, step = _serial_decode(cfg)
+    full = _kv(exe, scope, dec_main, step, [0, 1], 6)
+    eos = full[2]   # stop after the 3rd generated token
+    eng = GenerationEngine(cfg, scope, exe=fluid.Executor(),
+                           max_slots=2, max_seq=SEQ)
+    eng.start()
+    try:
+        out = eng.generate([0, 1], 6, eos_id=eos)
+        assert out["tokens"] == full[:3]
+        assert out["finish_reason"] == "eos"
+        assert out["ttft_ms"] > 0 and out["e2e_ms"] >= out["ttft_ms"]
+        out2 = eng.generate([0, 1], 4)
+        assert out2["finish_reason"] == "length"
+        assert len(out2["tokens"]) == 4
+    finally:
+        eng.stop()
+
+
+def test_engine_backpressure_and_capacity_validation(trained):
+    cfg, scope, _ = trained
+    eng = GenerationEngine(cfg, scope, exe=fluid.Executor(),
+                           max_slots=1, max_seq=SEQ, queue_capacity=1)
+    # not started: submissions queue up, nothing drains
+    eng.submit(GenerationRequest([1], 2))
+    with pytest.raises(QueueFullError):
+        eng.submit(GenerationRequest([2], 2))
+    # prompt + max_new - 1 must fit in the KV cache
+    with pytest.raises(ValueError):
+        eng.submit(GenerationRequest(list(range(8)), SEQ))
+
+
+def test_engine_deadline_fails_queued_request(trained):
+    cfg, scope, _ = trained
+    eng = GenerationEngine(cfg, scope, exe=fluid.Executor(),
+                           max_slots=1, max_seq=SEQ)
+    eng.start()
+    try:
+        # saturate the single slot with a long request, then queue one
+        # with a deadline far shorter than the occupant's runtime
+        slow = eng.submit(GenerationRequest([0, 1], 8))
+        fast = eng.submit(GenerationRequest([3], 2, timeout_ms=0.01))
+        with pytest.raises(DeadlineExceededError):
+            fast.result(timeout=30.0)
+        assert len(slow.result(timeout=30.0)["tokens"]) == 8
+    finally:
+        eng.stop()
+
+
+# ---------------------------------------------------------------------------
+# HTTP front end: /v1/generate
+# ---------------------------------------------------------------------------
+
+def _post(url, obj):
+    req = urllib.request.Request(
+        url, data=json.dumps(obj).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        body = e.read().decode()
+        try:
+            return e.code, json.loads(body)
+        except ValueError:
+            return e.code, body
+
+
+def test_http_generate_route(trained):
+    cfg, scope, exe = trained
+    dec_main, step = _serial_decode(cfg)
+    want = _kv(exe, scope, dec_main, step, [0, 1, 2], 5)
+
+    eng = GenerationEngine(cfg, scope, exe=fluid.Executor(),
+                           max_slots=2, max_seq=SEQ)
+    srv = serve(gen_engine=eng, port=0)   # starts the engine too
+    try:
+        url = srv.url
+        with urllib.request.urlopen(url + "/healthz", timeout=10) as r:
+            assert r.status == 200
+        code, body = _post(url + "/v1/generate",
+                           {"prompt": [0, 1, 2], "max_new_tokens": 5})
+        assert code == 200, body
+        assert body["tokens"] == want
+        assert body["finish_reason"] == "length"
+        code, _ = _post(url + "/v1/generate", {"prompt": []})
+        assert code == 400
+        # no encoder engine behind this server
+        code, _ = _post(url + "/v1/predict", {"inputs": {}})
+        assert code == 404
+    finally:
+        srv.close()
+        eng.stop()
+
+
+# ---------------------------------------------------------------------------
+# Loadgen schema + metrics report (satellite 6)
+# ---------------------------------------------------------------------------
+
+def _load_tool(name):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, os.path.join(repo, "tools"))
+    try:
+        return __import__(name)
+    finally:
+        sys.path.pop(0)
+
+
+def test_generation_loadgen_schema_and_speedup(tmp_path, capsys):
+    loadgen = _load_tool("serving_loadgen")
+    v = _load_tool("validate_bench_json")
+    out = str(tmp_path / "gen.jsonl")
+    rc = loadgen.main(["--generate", "--slots", "4", "--requests", "12",
+                       "--max-new-tokens", "6", "--compare-serial",
+                       "--check-compiles", "--out", out])
+    capsys.readouterr()
+    assert rc == 0, "--check-compiles saw a post-warmup compile"
+    assert v.validate_file(out) == []
+    recs = [json.loads(ln) for ln in open(out) if ln.strip()]
+    assert [r["mode"] for r in recs] == ["closed", "serial_baseline"]
+    cont, ser = recs
+    assert cont["requests"] == 12 and cont["errors"] == 0
+    assert cont["tokens"] == 12 * 6
+    assert cont["cache"]["post_warmup_compiles"] == 0
+    for q in ("p50", "p95", "p99"):
+        assert isinstance(cont["ttft_ms"][q], float)
+        assert isinstance(cont["latency_ms"][q], float)
+    # the acceptance headline: continuous batching beats serial decode
+    assert cont["tokens_per_s"] > ser["tokens_per_s"], (cont, ser)
+
+    bad = dict(cont)
+    bad["ttft_ms"] = {"p50": 1.0}
+    assert any("ttft_ms.p95" in e
+               for e in v.validate_generation_loadgen(bad))
+    bad2 = dict(cont, tokens_per_s="fast")
+    assert any("tokens_per_s" in e
+               for e in v.validate_generation_loadgen(bad2))
+
+
+def test_metrics_report_renders_generation_section(trained, tmp_path):
+    metrics_report = _load_tool("metrics_report")
+    from paddle_tpu import monitor
+    cfg, scope, _ = trained
+    prev = fluid.FLAGS.enable_monitor
+    fluid.set_flags({"FLAGS_enable_monitor": True})
+    monitor.reset_stats()
+    log = str(tmp_path / "gen_stats.jsonl")
+    try:
+        eng = GenerationEngine(cfg, scope, exe=fluid.Executor(),
+                               max_slots=2, max_seq=SEQ)
+        eng.start()
+        try:
+            eng.generate([0, 1, 2], 4)
+            eng.generate([5, 6], 3)
+        finally:
+            eng.stop()
+        snap = monitor.get_stats_snapshot()
+        c = snap["counters"]
+        assert c["serving.gen_requests"] == 2
+        assert c["serving.gen_tokens"] == 7
+        assert c["serving.gen_steps"] >= 1
+        assert "serving.gen_ttft_ms" in snap["histograms"]
+        assert "serving.gen_e2e_ms" in snap["histograms"]
+        monitor.snapshot_to_jsonl(log)
+    finally:
+        monitor.reset_stats()
+        fluid.set_flags({"FLAGS_enable_monitor": prev})
+    with open(log, "a") as f:
+        f.write(json.dumps({
+            "kind": "generation_loadgen", "mode": "closed",
+            "requests": 2, "errors": 0, "duration_s": 0.1,
+            "throughput_rps": 20.0, "tokens": 7, "tokens_per_s": 70.0,
+            "latency_ms": {"p50": 2.0, "p95": 3.0, "p99": 3.0},
+            "ttft_ms": {"p50": 1.0, "p95": 1.5, "p99": 1.5},
+            "inter_token_ms": {"p50": 0.5, "p95": 0.7, "p99": 0.7},
+            "config": {}, "cache": {"post_warmup_compiles": 0}}) + "\n")
+    buf = io.StringIO()
+    rc = metrics_report.report(log, out=buf)
+    out = buf.getvalue()
+    assert rc == 0
+    assert "-- generation (continuous batching)" in out
+    assert "genload[closed]" in out
+    assert "post-warmup compiles 0" in out
+    assert "ttft" in out and "inter-token" in out

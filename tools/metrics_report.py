@@ -42,6 +42,7 @@ def _fmt_bytes(v):
 def load(path):
     snapshots, results, op_profiles = [], [], []
     loadgens, lints, graph_opts = [], [], []
+    gen_loadgens = []
     with open(path) as f:
         for ln, line in enumerate(f, 1):
             line = line.strip()
@@ -62,12 +63,14 @@ def load(path):
                 op_profiles.append(rec)
             elif kind == "serving_loadgen":
                 loadgens.append(rec)
+            elif kind == "generation_loadgen":
+                gen_loadgens.append(rec)
             elif kind == "program_lint":
                 lints.append(rec)
             elif kind == "graph_opt":
                 graph_opts.append(rec)
     return (snapshots, results, op_profiles, loadgens, lints,
-            graph_opts)
+            graph_opts, gen_loadgens)
 
 
 def _hist(snap, name):
@@ -76,11 +79,12 @@ def _hist(snap, name):
 
 def report(path, out=sys.stdout):
     (snapshots, results, op_profiles, loadgens, lints,
-     graph_opts) = load(path)
+     graph_opts, gen_loadgens) = load(path)
     w = out.write
     w(f"runtime stats report — {path}\n")
     if not snapshots and not results and not op_profiles \
-            and not loadgens and not lints and not graph_opts:
+            and not loadgens and not lints and not graph_opts \
+            and not gen_loadgens:
         w("no snapshots or bench results found\n")
         return 1
     w(f"snapshots: {len(snapshots)}   bench results: {len(results)}\n")
@@ -203,6 +207,44 @@ def report(path, out=sys.stdout):
               f"p50 {lat.get('p50')} ms  p95 {lat.get('p95')} ms  "
               f"p99 {lat.get('p99')} ms  errors {r.get('errors', 0)}"
               f"{extra}\n")
+
+    greq = c.get("serving.gen_requests")
+    gtok = c.get("serving.gen_tokens")
+    if greq or gtok or gen_loadgens:
+        w("\n-- generation (continuous batching) --\n")
+        if greq:
+            w(f"{'requests':26s} {int(greq)}   rejected "
+              f"{int(c.get('serving.gen_rejected', 0))}   timeouts "
+              f"{int(c.get('serving.gen_timeouts', 0))}   steps "
+              f"{int(c.get('serving.gen_steps', 0))}   tokens "
+              f"{int(gtok or 0)}\n")
+        occ = _hist(snap, "serving.gen_slot_occupancy")
+        if occ and occ["count"]:
+            w(f"{'slot occupancy':26s} mean "
+              f"{occ['sum'] / occ['count']:.1%} of slots per step\n")
+        for label, name in (("ttft", "serving.gen_ttft_ms"),
+                            ("inter-token", "serving.gen_inter_token_ms"),
+                            ("e2e latency", "serving.gen_e2e_ms")):
+            h = _hist(snap, name)
+            if h and h["count"]:
+                w(f"{label:26s} count {h['count']:<6d} "
+                  f"p50 {h['p50']:.2f} ms  p95 {h['p95']:.2f} ms\n")
+        for r in gen_loadgens:
+            ttft = r.get("ttft_ms") or {}
+            inter = r.get("inter_token_ms") or {}
+            cache = r.get("cache") or {}
+            extra = ""
+            if "post_warmup_compiles" in cache:
+                extra = (f"  post-warmup compiles "
+                         f"{cache['post_warmup_compiles']}")
+            label = f"genload[{r.get('mode', '?')}]"
+            w(f"{label:26s} "
+              f"{r.get('requests', 0)} req  "
+              f"{r.get('tokens', 0)} tok  "
+              f"{r.get('tokens_per_s', 0)} tok/s  "
+              f"ttft p99 {ttft.get('p99')} ms  "
+              f"inter-token p99 {inter.get('p99')} ms  "
+              f"errors {r.get('errors', 0)}{extra}\n")
 
     phases = snap.get("phases") or {}
     if phases:

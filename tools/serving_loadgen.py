@@ -1,12 +1,25 @@
 """Load generator for the serving engine: throughput + latency JSONL.
 
+Two workloads:
+
+* **encoder** (default): fixed-shape predict requests through the
+  dynamic batcher (`kind="serving_loadgen"` records).
+* **generation** (--generate): autoregressive decode requests with
+  mixed prompt lengths and staggered admission through the
+  continuous-batching `GenerationEngine`
+  (`kind="generation_loadgen"` records carrying tokens/s, TTFT and
+  inter-token latency percentiles). --compare-serial replays the same
+  request set through serial per-request `gpt.kv_generate` — the
+  throughput floor continuous batching must beat.
+
 Two targets:
 
 * **in-process** (default): builds a tiny CPU model (or loads
   --model-dir), starts a warmed ServingEngine, and drives it directly —
   the CPU smoke bench behind the acceptance criteria (zero post-warmup
   compiles; batched > serial throughput).
-* **HTTP** (--url): POSTs /v1/predict at an already-running front end.
+* **HTTP** (--url): POSTs /v1/predict (or /v1/generate with
+  --generate) at an already-running front end.
 
 Two arrival disciplines:
 
@@ -31,6 +44,8 @@ Usage:
         --compare-serial --check-compiles --out loadgen.jsonl
     python tools/serving_loadgen.py --url http://127.0.0.1:8000 \
         --rate 50 --duration 10
+    python tools/serving_loadgen.py --generate --requests 24 \
+        --slots 4 --max-new-tokens 8 --compare-serial --check-compiles
 """
 from __future__ import annotations
 
@@ -208,6 +223,229 @@ def run_serial_baseline(predictor, requests):
     return latencies, 0, time.perf_counter() - t0
 
 
+def _lat_summary(values_s):
+    """{"mean", "p50", "p95", "p99", "max"} in ms (None when empty)."""
+    lat = sorted(v * 1e3 for v in values_s)
+    n = len(lat)
+    return {
+        "mean": round(sum(lat) / n, 3) if n else None,
+        "p50": _percentile(lat, 0.50),
+        "p95": _percentile(lat, 0.95),
+        "p99": _percentile(lat, 0.99),
+        "max": round(lat[-1], 3) if n else None,
+    }
+
+
+def summarize_generation(mode, latencies_s, ttfts_s, inter_s, tokens,
+                         errors, duration_s, config):
+    """One kind="generation_loadgen" record (schema enforced by
+    tools/validate_bench_json.py)."""
+    n = len(latencies_s)
+    return {
+        "kind": "generation_loadgen",
+        "mode": mode,
+        "requests": n,
+        "errors": errors,
+        "duration_s": round(duration_s, 4),
+        "throughput_rps": round(n / duration_s, 2) if duration_s
+        else 0.0,
+        "tokens": int(tokens),
+        "tokens_per_s": round(tokens / duration_s, 2) if duration_s
+        else 0.0,
+        "latency_ms": _lat_summary(latencies_s),
+        "ttft_ms": _lat_summary(ttfts_s),
+        "inter_token_ms": _lat_summary(inter_s),
+        "config": config,
+    }
+
+
+def make_gen_requests(n, vocab, max_prompt, max_new_tokens, seed=0):
+    """Mixed prompt lengths in [1, max_prompt] — with staggered
+    admission this is exactly the traffic that would recompile a
+    shape-naive decode path."""
+    rng = np.random.RandomState(seed)
+    return [{"prompt": rng.randint(0, vocab,
+                                   size=rng.randint(
+                                       1, max_prompt + 1)).tolist(),
+             "max_new_tokens": int(max_new_tokens),
+             "seed": int(seed + i)}
+            for i, _ in enumerate(range(n))]
+
+
+class _GenStats:
+    """Thread-safe TTFT / inter-token / token-count accumulators shared
+    by the per-request calls of one run."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.ttfts = []
+        self.inter = []
+        self.tokens = 0
+
+    def record(self, t_submit, token_times, n_tokens):
+        with self.lock:
+            if token_times:
+                self.ttfts.append(token_times[0] - t_submit)
+                self.inter.extend(b - a for a, b in
+                                  zip(token_times, token_times[1:]))
+            self.tokens += n_tokens
+
+
+class _GenEngineTarget:
+    """Drives an in-process GenerationEngine; per-token timestamps come
+    from the engine's stream_cb."""
+
+    def __init__(self, engine, stats):
+        self.engine = engine
+        self.stats = stats
+
+    def call(self, req, timeout_ms):
+        from paddle_tpu.serving import GenerationRequest
+        times = []
+        t0 = time.perf_counter()
+        resp = self.engine.submit(GenerationRequest(
+            req["prompt"], req["max_new_tokens"], seed=req["seed"],
+            timeout_ms=timeout_ms,
+            stream_cb=lambda tok: times.append(time.perf_counter())))
+        out = resp.result(timeout=(timeout_ms or 30000.0) / 1e3 + 30.0)
+        self.stats.record(t0, times, len(out["tokens"]))
+
+
+class _GenHTTPTarget:
+    """POSTs /v1/generate; no token stream over plain HTTP, so TTFT
+    comes from the engine-reported ttft_ms in the response."""
+
+    def __init__(self, url, stats):
+        self.url = url.rstrip("/")
+        self.stats = stats
+
+    def call(self, req, timeout_ms):
+        import urllib.request
+        body = json.dumps({"prompt": req["prompt"],
+                           "max_new_tokens": req["max_new_tokens"],
+                           "seed": req["seed"],
+                           "timeout_ms": timeout_ms}).encode()
+        r = urllib.request.Request(
+            self.url + "/v1/generate", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(r, timeout=60) as resp:
+            out = json.load(resp)
+        with self.stats.lock:
+            if out.get("ttft_ms") is not None:
+                self.stats.ttfts.append(out["ttft_ms"] / 1e3)
+            self.stats.tokens += len(out.get("tokens", ()))
+
+
+def run_serial_generation(exe, scope, prog, step, reqs):
+    """Serial per-request kv_generate over a batch=1 decode graph
+    sharing the engine's scope — the no-continuous-batching floor."""
+    from paddle_tpu.models import gpt
+    stats = _GenStats()
+    latencies = []
+    t0 = time.perf_counter()
+    for req in reqs:
+        times = []
+        t1 = time.perf_counter()
+        out = gpt.kv_generate(
+            exe, scope, prog, step.token_var, step.logits_var,
+            step.cache_names, req["prompt"], req["max_new_tokens"],
+            seed=req["seed"],
+            stream_cb=lambda tok: times.append(time.perf_counter()))
+        latencies.append(time.perf_counter() - t1)
+        stats.record(t1, times, len(out))
+    return stats, latencies, time.perf_counter() - t0
+
+
+def run_generation(args):
+    """The --generate workload: continuous-batching engine (or HTTP
+    front end) under closed/open-loop generation traffic, optional
+    serial kv_generate baseline, optional compile-count gate."""
+    reqs = make_gen_requests(args.requests, args.vocab, args.max_prompt,
+                             args.max_new_tokens, args.seed)
+    common = {"concurrency": args.concurrency, "rate": args.rate,
+              "slots": args.slots, "max_prompt": args.max_prompt,
+              "max_new_tokens": args.max_new_tokens,
+              "max_seq": args.max_seq, "vocab": args.vocab}
+
+    if args.url:
+        stats = _GenStats()
+        target = _GenHTTPTarget(args.url, stats)
+        if args.rate > 0:
+            if args.duration > 0:
+                reqs = reqs[:max(1, int(args.rate * args.duration))]
+            lat, errs, dur = run_open(target, reqs, args.rate,
+                                      args.timeout_ms)
+            mode = "open"
+        else:
+            lat, errs, dur = run_closed(target, reqs, args.concurrency,
+                                        args.timeout_ms)
+            mode = "closed"
+        emit(summarize_generation(mode, lat, stats.ttfts, stats.inter,
+                                  stats.tokens, errs, dur, common),
+             args.out)
+        return 0
+
+    import paddle_tpu as fluid
+    from paddle_tpu.models import gpt
+    from paddle_tpu.serving import GenerationEngine
+
+    cfg = gpt.gpt_small(vocab_size=args.vocab, d_model=32, n_heads=4,
+                        n_layers=2, d_ff=64, max_seq_len=args.max_seq,
+                        dropout=0.0, use_flash=False)
+    scope = fluid.Scope()
+    engine = GenerationEngine(cfg, scope, max_slots=args.slots,
+                              max_seq=args.max_seq,
+                              default_timeout_ms=args.timeout_ms)
+    engine.init_scope()   # scratch weights: loadgen measures the
+    engine.start()        # serving path, not model quality
+    misses_after_warmup = engine.cache_stats()["misses"]
+
+    stats = _GenStats()
+    target = _GenEngineTarget(engine, stats)
+    if args.rate > 0:
+        if args.duration > 0:
+            reqs = reqs[:max(1, int(args.rate * args.duration))]
+        lat, errs, dur = run_open(target, reqs, args.rate,
+                                  args.timeout_ms)
+        mode = "open"
+    else:
+        lat, errs, dur = run_closed(target, reqs, args.concurrency,
+                                    args.timeout_ms)
+        mode = "closed"
+    rec = summarize_generation(mode, lat, stats.ttfts, stats.inter,
+                               stats.tokens, errs, dur, common)
+    post = engine.post_warmup_compiles()
+    rec["cache"] = {"misses_after_warmup": misses_after_warmup,
+                    "misses_total": engine.cache_stats()["misses"],
+                    "post_warmup_compiles": post}
+    emit(rec, args.out)
+
+    if args.compare_serial:
+        # batch=1 decode graph, default (unprefixed) state names: no
+        # collision with the engine's "gen." state, weights shared
+        dec_main, dec_start = fluid.Program(), fluid.Program()
+        with fluid.program_guard(dec_main, dec_start):
+            step1 = gpt.build_decode_step(cfg, batch=1,
+                                          max_seq=args.max_seq)
+        sstats, slat, sdur = run_serial_generation(
+            engine.exe, scope, dec_main, step1, reqs)
+        srec = summarize_generation(
+            "serial_baseline", slat, sstats.ttfts, sstats.inter,
+            sstats.tokens, 0, sdur, common)
+        emit(srec, args.out)
+        if srec["tokens_per_s"]:
+            speedup = rec["tokens_per_s"] / srec["tokens_per_s"]
+            print(f"# continuous/serial tokens-per-second speedup: "
+                  f"{speedup:.2f}x")
+
+    engine.stop()
+    if args.check_compiles and post > 0:
+        print(f"FAIL: {post} compiles after generation warmup",
+              file=sys.stderr)
+        return 3
+    return 0
+
+
 def emit(rec, out_path):
     print(json.dumps(rec))
     if out_path:
@@ -246,7 +484,23 @@ def main(argv=None):
                     help="exit 3 if the engine executor compiled "
                          "anything after warmup")
     ap.add_argument("--out", help="append JSONL records here")
+    ap.add_argument("--generate", action="store_true",
+                    help="generation workload through the "
+                         "continuous-batching GenerationEngine")
+    ap.add_argument("--slots", type=int, default=4,
+                    help="generation decode slots (the fixed batch of "
+                         "the one compiled decode step)")
+    ap.add_argument("--max-new-tokens", type=int, default=8)
+    ap.add_argument("--max-prompt", type=int, default=8,
+                    help="prompts are drawn with mixed lengths in "
+                         "[1, max-prompt]")
+    ap.add_argument("--max-seq", type=int, default=32,
+                    help="generation KV-cache length")
+    ap.add_argument("--vocab", type=int, default=64)
     args = ap.parse_args(argv)
+
+    if args.generate:
+        return run_generation(args)
 
     seq_buckets = tuple(int(s) for s in args.seq_buckets.split(","))
     feat = 6

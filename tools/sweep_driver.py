@@ -74,6 +74,11 @@ CONFIGS = [
     ("resnet50_b64_devfeed", {"BENCH_MODEL": "resnet50",
                               "BENCH_BATCH": "64"}),
     ("profile", None),  # special-cased below
+    # continuous-batching generation serving (serving_loadgen
+    # --generate --compare-serial): the ledger entry records tokens/s,
+    # TTFT/inter-token p99 and the continuous-vs-serial speedup, and
+    # --check-compiles makes a post-warmup recompile a hard failure
+    ("gen_loadgen_s4", None),  # special-cased below
     ("gpt_b32", {"BENCH_MODEL": "gpt", "BENCH_BATCH": "32"}),
     # graph-opt A/B pairs (FLAGS_graph_opt_level, analysis/passes):
     # same model+batch at level 0 (pipeline off) vs level 2 (full
@@ -259,6 +264,39 @@ def run_special(key):
         ok = p.returncode == 0 and p.stdout.strip()
         return (p.stdout.strip(), None) if ok else (None, p.stdout[-300:] +
                                                     p.stderr[-200:])
+    if key == "gen_loadgen_s4":
+        out_path = f"/tmp/gen_loadgen_{ROUND}.jsonl"
+        p = subprocess.run(
+            [sys.executable, "tools/serving_loadgen.py", "--generate",
+             "--slots", "4", "--requests", "16", "--compare-serial",
+             "--check-compiles", "--out", out_path],
+            cwd=REPO, capture_output=True, text=True, timeout=1800)
+        if p.returncode != 0:
+            # rc 3 = post-warmup recompile: a real regression, not a
+            # tunnel flake — surface the tail so the ledger records it
+            return None, (f"rc={p.returncode}: "
+                          + (p.stdout + p.stderr)[-300:])
+        recs = []
+        try:
+            with open(out_path) as f:
+                recs = [json.loads(ln) for ln in f if ln.strip()]
+        except (OSError, ValueError) as e:
+            return None, f"unreadable {out_path}: {e}"
+        cont = next((r for r in recs
+                     if r.get("kind") == "generation_loadgen"
+                     and r.get("mode") != "serial_baseline"), None)
+        if cont is None or not cont.get("tokens_per_s"):
+            return None, "no generation_loadgen record with tokens_per_s"
+        speedup = next((ln for ln in p.stdout.splitlines()
+                        if "speedup" in ln), "")
+        return {"metric": "gen_tokens_per_s",
+                "value": cont["tokens_per_s"], "unit": "tok/s",
+                "ttft_p99_ms": (cont.get("ttft_ms") or {}).get("p99"),
+                "inter_token_p99_ms":
+                    (cont.get("inter_token_ms") or {}).get("p99"),
+                "post_warmup_compiles":
+                    (cont.get("cache") or {}).get("post_warmup_compiles"),
+                "speedup_note": speedup.lstrip("# ").strip()}, None
     if key == "profile":
         p = subprocess.run([sys.executable, "tools/profile_step.py"],
                            cwd=REPO, capture_output=True, text=True,

@@ -15,11 +15,14 @@ Checks, per file (type auto-detected from content):
   "serving_loadgen" (tools/serving_loadgen.py) additionally carry the
   mode/requests/duration_s/throughput_rps/latency_ms{p50,p95,p99}
   contract the serving report section reads; lines with kind ==
-  "program_lint" (tools/program_lint.py) carry the model/ok/counts/
-  findings contract the lint report section reads; lines with kind ==
-  "graph_opt" (tools/program_lint.py --optimize) carry the model/
-  opt_level/ops_before/ops_after/vars_eliminated/passes contract the
-  graph-optimization report section reads.
+  "generation_loadgen" (tools/serving_loadgen.py --generate) carry
+  that plus tokens/tokens_per_s and ttft_ms/inter_token_ms percentile
+  objects (the generation report section's contract); lines with
+  kind == "program_lint" (tools/program_lint.py) carry the
+  model/ok/counts/findings contract the lint report section reads;
+  lines with kind == "graph_opt" (tools/program_lint.py --optimize)
+  carry the model/opt_level/ops_before/ops_after/vars_eliminated/
+  passes contract the graph-optimization report section reads.
 * driver BENCH_rNN.json wrappers ({"n", "cmd", "rc", "tail",
   "parsed"}): parsed must be non-null — the exact invariant the r05
   rc=124 artifact violated.
@@ -102,6 +105,43 @@ def validate_loadgen(obj, where="loadgen"):
             elif v is not None and (not isinstance(v, (int, float))
                                     or isinstance(v, bool)):
                 errs.append(f"{where}: latency_ms.{q} must be numeric "
+                            f"(got {v!r})")
+    if not isinstance(obj.get("config"), dict):
+        errs.append(f"{where}: config must be an object")
+    return errs
+
+
+def validate_generation_loadgen(obj, where="generation_loadgen"):
+    """Schema of one tools/serving_loadgen.py --generate record."""
+    errs = []
+    if not isinstance(obj.get("mode"), str):
+        errs.append(f"{where}: mode must be a string "
+                    f"(got {obj.get('mode')!r})")
+    for key in ("requests", "errors", "duration_s", "throughput_rps",
+                "tokens", "tokens_per_s"):
+        if not isinstance(obj.get(key), (int, float)) \
+                or isinstance(obj.get(key), bool):
+            errs.append(f"{where}: {key} must be numeric "
+                        f"(got {obj.get(key)!r})")
+    # latency_ms needs its percentiles whenever requests completed;
+    # ttft_ms whenever tokens were generated; inter_token_ms may be
+    # all-null even on a successful run (requests of one token have no
+    # inter-token gap), so only its TYPE is enforced
+    for field, need in (("latency_ms", bool(obj.get("requests"))),
+                        ("ttft_ms", bool(obj.get("tokens"))),
+                        ("inter_token_ms", False)):
+        hist = obj.get(field)
+        if not isinstance(hist, dict):
+            errs.append(f"{where}: {field} must be an object")
+            continue
+        for q in _LOADGEN_PCTS:
+            v = hist.get(q)
+            if v is None and need:
+                errs.append(f"{where}: {field}.{q} missing on a run "
+                            f"with completed work")
+            elif v is not None and (not isinstance(v, (int, float))
+                                    or isinstance(v, bool)):
+                errs.append(f"{where}: {field}.{q} must be numeric "
                             f"(got {v!r})")
     if not isinstance(obj.get("config"), dict):
         errs.append(f"{where}: config must be an object")
@@ -210,6 +250,9 @@ def validate_jsonl(path):
                 errs.append(f"{path}:{ln}: line is not a JSON object")
             elif rec.get("kind") == "serving_loadgen":
                 errs.extend(validate_loadgen(rec, where=f"{path}:{ln}"))
+            elif rec.get("kind") == "generation_loadgen":
+                errs.extend(validate_generation_loadgen(
+                    rec, where=f"{path}:{ln}"))
             elif rec.get("kind") == "program_lint":
                 errs.extend(validate_program_lint(
                     rec, where=f"{path}:{ln}"))
