@@ -51,6 +51,7 @@ from . import contrib  # noqa: F401
 from . import datasets  # noqa: F401
 from . import inference  # noqa: F401
 from . import serving  # noqa: F401
+from . import resilience  # noqa: F401
 from . import reader_decorator  # noqa: F401
 from . import transpiler  # noqa: F401
 from .transpiler import (DistributeTranspiler,  # noqa: F401

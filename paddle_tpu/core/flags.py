@@ -370,6 +370,58 @@ DEFINE_string(
     "When set, fluid.profiler writes chrome-trace/XPlane dumps here by "
     "default. Reference: FLAGS profile_path (flags.cc).")
 
+DEFINE_string(
+    "fault_spec", "",
+    "Deterministic fault-injection spec (paddle_tpu/resilience/"
+    "faults.py): comma-separated kind:param list, e.g. "
+    "'step_nan:p=0.01,slow_step:ms=500,transient_fail:p=0.02,"
+    "preempt_at:step=40'. Empty (default) = injection disabled, zero "
+    "overhead. Grammar and semantics: docs/resilience.md.")
+
+DEFINE_int32(
+    "fault_seed", 0,
+    "Seed of the fault-injection RNG. Decisions derive from (seed, "
+    "site, per-site invocation counter), so a given spec+seed injects "
+    "the same faults at the same steps regardless of timing or thread "
+    "interleaving.")
+
+DEFINE_int32(
+    "retry_max_attempts", 3,
+    "Default RetryPolicy attempt budget (paddle_tpu/resilience/"
+    "retry.py): total tries, first included. Transient faults "
+    "(TransientFault and friends) retry up to this many times with "
+    "jittered exponential backoff; poison errors (ValueError, "
+    "verification failures) never retry.")
+
+DEFINE_double(
+    "retry_base_ms", 10.0,
+    "Default RetryPolicy base backoff (milliseconds): attempt n sleeps "
+    "~base * 2^(n-1), jittered, capped by FLAGS_retry_max_ms.")
+
+DEFINE_double(
+    "retry_max_ms", 1000.0,
+    "Default RetryPolicy backoff cap (milliseconds).")
+
+DEFINE_int32(
+    "serving_breaker_threshold", 5,
+    "Circuit breaker (paddle_tpu/resilience/breaker.py): consecutive "
+    "batch-execution failures before the serving/generation breaker "
+    "trips CLOSED -> OPEN and submissions shed with OverloadedError "
+    "(HTTP 503 + Retry-After). 0 disables the breaker.")
+
+DEFINE_double(
+    "serving_breaker_cooldown_ms", 1000.0,
+    "How long an OPEN breaker sheds load before admitting half-open "
+    "probe traffic. A successful probe closes the breaker; a failed "
+    "one re-opens it for another cooldown.")
+
+DEFINE_bool(
+    "serving_nan_guard", True,
+    "Serving engine output hygiene: verify every batch's float outputs "
+    "are finite before scattering them to clients; a non-finite batch "
+    "is treated as a transient fault (retried via RetryPolicy, then "
+    "failed) instead of being served as a wrong answer.")
+
 # ---------------------------------------------------------------------------
 # Reference-flag compat surface (App. C parity target:
 # platform/flags.cc:33-449 + the read_env_flags whitelist in

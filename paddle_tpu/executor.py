@@ -130,9 +130,31 @@ class Executor:
         first_run = step_fn.runs == 0
         step_fn.runs += 1
 
-        with jax.default_device(self.place.jax_device()):
-            fetches, new_state = step_fn.fn(state, feed_arrays,
-                                            jnp.uint32(step))
+        # Fault injection (FLAGS_fault_spec; paddle_tpu/resilience).
+        # Empty spec = one cached None-check. An injected TransientFault
+        # fires BEFORE device dispatch, so retrying here is donation-safe
+        # (the scope still holds valid pre-step buffers); real dispatch
+        # errors are NOT retried at this level — a failed dispatch may
+        # have invalidated donated state.
+        from .resilience.faults import injector as _fault_injector
+        inj = _fault_injector()
+        if inj is None:
+            with jax.default_device(self.place.jax_device()):
+                fetches, new_state = step_fn.fn(state, feed_arrays,
+                                                jnp.uint32(step))
+        else:
+            from .resilience.faults import TransientFault
+            from .resilience.retry import RetryPolicy
+
+            def _dispatch():
+                inj.pre_step("executor", step=step)
+                with jax.default_device(self.place.jax_device()):
+                    return step_fn.fn(state, feed_arrays,
+                                      jnp.uint32(step))
+
+            policy = RetryPolicy(is_retryable=lambda e: isinstance(
+                e, TransientFault))
+            fetches, new_state = policy.call(_dispatch)
 
         for n, val in new_state.items():
             scope.set(n, val)
@@ -140,6 +162,11 @@ class Executor:
         t_fetch0 = time.perf_counter()
         if return_numpy:
             out = [np.asarray(f) for f in fetches]
+            if inj is not None:
+                # step_nan corrupts only these host-side copies — the
+                # device state written back above stays clean, so a
+                # caller-level re-run of the same step is a valid cure
+                inj.corrupt_fetches("executor", out)
         else:
             out = list(fetches)
         now = time.perf_counter()

@@ -30,6 +30,42 @@ def _var_path(dirname, name):
     return os.path.join(dirname, name.replace("/", "%2F"))
 
 
+# Atomic write helpers: every checkpoint artifact is written to a
+# pid-suffixed temp file, fsynced, then os.replace-d over the target, so
+# a crash or preemption mid-save can never leave a half-written file
+# that a later load_* accepts — the reader sees either the previous
+# complete checkpoint or the new complete one. The file-object form of
+# np.save/np.savez is deliberate: the string-path form appends
+# .npy/.npz to the name, which is how save() used to write
+# `x.pdparams.npz` while load() read `x.pdparams`.
+
+def atomic_np_save(path: str, arr) -> None:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        np.save(f, arr)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def atomic_np_savez(path: str, blob: dict) -> None:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        np.savez(f, **blob)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def atomic_write_text(path: str, text: str) -> None:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(text)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
 def save_vars(executor, dirname, main_program=None, vars=None,
               predicate=None, filename=None):
     from .framework import default_main_program
@@ -44,12 +80,12 @@ def save_vars(executor, dirname, main_program=None, vars=None,
         for v in vars:
             if scope.has(v.name):
                 blob[v.name] = scope.get_numpy(v.name)
-        np.savez(os.path.join(dirname, filename), **blob)
+        atomic_np_savez(os.path.join(dirname, filename), blob)
         return
     for v in vars:
         if scope.has(v.name):
-            np.save(_var_path(dirname, v.name) + ".npy",
-                    scope.get_numpy(v.name))
+            atomic_np_save(_var_path(dirname, v.name) + ".npy",
+                           scope.get_numpy(v.name))
 
 
 def _is_persistable(v: Variable):
@@ -140,9 +176,9 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
     os.makedirs(dirname, exist_ok=True)
     meta = {"program": pruned.to_dict(), "feed_names": list(feeded_var_names),
             "fetch_names": fetch_names}
-    with open(os.path.join(dirname, model_filename or "__model__.json"),
-              "w") as f:
-        json.dump(meta, f)
+    atomic_write_text(
+        os.path.join(dirname, model_filename or "__model__.json"),
+        json.dumps(meta))
     if not program_only:
         save_persistables(executor, dirname, pruned,
                           filename=params_filename)
@@ -166,13 +202,15 @@ def save(program, model_path):
     blob = {v.name: scope.get_numpy(v.name)
             for v in program.list_vars()
             if v.persistable and scope.has(v.name)}
-    np.savez(model_path + ".pdparams", **blob)
-    with open(model_path + ".pdmodel", "w") as f:
-        f.write(program.to_json())
+    atomic_np_savez(model_path + ".pdparams", blob)
+    atomic_write_text(model_path + ".pdmodel", program.to_json())
 
 
 def load(program, model_path, executor=None):
-    blob = np.load(model_path + ".pdparams")
+    path = model_path + ".pdparams"
+    if not os.path.exists(path) and os.path.exists(path + ".npz"):
+        path += ".npz"  # checkpoint written before the atomic rewrite
+    blob = np.load(path)
     scope = global_scope()
     for name in blob.files:
         scope.set(name, blob[name])

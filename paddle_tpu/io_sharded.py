@@ -25,6 +25,7 @@ import numpy as np
 
 from .core.scope import global_scope
 from .framework import Program, op_version_map, check_op_versions
+from .io import atomic_np_save, atomic_write_text
 
 __all__ = ["save_sharded_persistables", "load_sharded_persistables"]
 
@@ -90,8 +91,8 @@ def save_sharded_persistables(executor, dirname, main_program=None,
                     continue  # replica of an already-saved shard
                 seen.add(index)
                 fn = _shard_file(v.name, f"{proc}_{k}")
-                np.save(os.path.join(dirname, fn),
-                        np.asarray(shard.data))
+                atomic_np_save(os.path.join(dirname, fn),
+                               np.asarray(shard.data))
                 entry["shards"].append({"file": fn,
                                         "index": [list(i) for i in index]})
         else:
@@ -99,18 +100,23 @@ def save_sharded_persistables(executor, dirname, main_program=None,
             entry["shape"] = list(a.shape)
             entry["dtype"] = str(a.dtype)
             fn = _shard_file(v.name, f"{proc}_0")
-            np.save(os.path.join(dirname, fn), a)
+            atomic_np_save(os.path.join(dirname, fn), a)
             entry["shards"].append(
                 {"file": fn,
                  "index": [[0, int(s)] for s in a.shape]})
         manifest["vars"][v.name] = entry
 
     # process 0 owns the manifest (single-host: always process 0);
-    # multi-host runs merge shard lists per process file then combine
+    # multi-host runs merge shard lists per process file then combine.
+    # The manifest commits the checkpoint, so it goes LAST and
+    # atomically: a crash anywhere above leaves the previous manifest
+    # (and the previous complete checkpoint it describes) intact —
+    # freshly-renamed orphan shards are harmless until a manifest
+    # references them.
     mpath = os.path.join(dirname, _MANIFEST if proc == 0
                          else f"manifest.{proc}.json")
-    with open(mpath, "w") as f:
-        json.dump(manifest, f, indent=1, sort_keys=True)
+    atomic_write_text(mpath,
+                      json.dumps(manifest, indent=1, sort_keys=True))
     return manifest
 
 

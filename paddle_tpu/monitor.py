@@ -350,11 +350,15 @@ def install_flight_recorder(path: Optional[str] = None,
     """Dump the flight recorder on unhandled exception (sys.excepthook,
     chained to the previous hook) and, by default, on SIGTERM (chained
     to any existing handler; installs an exiting default when none is
-    set). Idempotent per call site in spirit — callers install once at
-    process start (bench.py does)."""
+    set). Idempotent: a repeat install REPLACES the hook this module
+    installed earlier (unwrapping to the original previous handler)
+    instead of chaining to itself, so the dump is emitted exactly once
+    per event no matter how many subsystems call this."""
     import sys
 
     prev_hook = sys.excepthook
+    if getattr(prev_hook, "_ptn_flight_hook", False):
+        prev_hook = prev_hook._ptn_prev
 
     def hook(tp, val, tb):
         try:
@@ -363,11 +367,15 @@ def install_flight_recorder(path: Optional[str] = None,
             pass           # the original crash
         prev_hook(tp, val, tb)
 
+    hook._ptn_flight_hook = True
+    hook._ptn_prev = prev_hook
     sys.excepthook = hook
 
     if on_sigterm:
         import signal
         prev_term = signal.getsignal(signal.SIGTERM)
+        if getattr(prev_term, "_ptn_flight_hook", False):
+            prev_term = prev_term._ptn_prev
 
         def on_term(signum, frame):
             try:
@@ -378,6 +386,9 @@ def install_flight_recorder(path: Optional[str] = None,
                 prev_term(signum, frame)
             else:
                 os._exit(128 + signum)
+
+        on_term._ptn_flight_hook = True
+        on_term._ptn_prev = prev_term
 
         try:
             signal.signal(signal.SIGTERM, on_term)

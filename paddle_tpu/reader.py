@@ -18,6 +18,15 @@ from .monitor import STAT_ADD, STAT_OBSERVE, STAT_SET
 __all__ = ["DataLoader", "PyReader"]
 
 
+class _WorkerError:
+    """Envelope carrying a prefetch-worker exception to the consumer."""
+
+    __slots__ = ("exc",)
+
+    def __init__(self, exc):
+        self.exc = exc
+
+
 class _GeneratorLoader:
     def __init__(self, feed_list, capacity, iterable, return_list,
                  use_double_buffer=True):
@@ -62,14 +71,19 @@ class _GeneratorLoader:
     # -- iteration with prefetch ----------------------------------------
     def __iter__(self):
         from .core.flags import FLAGS
+        from .resilience.faults import injector as _fault_injector
         q: "queue.Queue" = queue.Queue(
             maxsize=self.capacity or FLAGS.reader_queue_depth)
         sentinel = object()
 
         def worker():
+            # a generator exception must surface on the training
+            # thread, not vanish as a silently-truncated epoch
             try:
                 for item in self._gen():
                     q.put(item)
+            except BaseException as e:  # noqa: BLE001
+                q.put(_WorkerError(e))
             finally:
                 q.put(sentinel)
 
@@ -87,6 +101,11 @@ class _GeneratorLoader:
             STAT_SET("reader.queue_depth", q.qsize())
             if item is sentinel:
                 break
+            if isinstance(item, _WorkerError):
+                raise item.exc
+            inj = _fault_injector()
+            if inj is not None:
+                inj.pre_step("reader")
             STAT_ADD("reader.batches")
             yield item
 

@@ -18,11 +18,17 @@ from .monitor import STAT_ADD, STAT_OBSERVE, STAT_SET
 
 __all__ = ["cache", "map_readers", "buffered", "compose", "chain",
            "shuffle", "firstn", "xmap_readers", "multiprocess_reader",
-           "batch", "ComposeNotAligned"]
+           "batch", "ComposeNotAligned", "ReaderWorkerDied"]
 
 
 class ComposeNotAligned(ValueError):
     pass
+
+
+class ReaderWorkerDied(RuntimeError):
+    """A multiprocess_reader worker exited without finishing its stream
+    (OOM-kill, SIGKILL, crash) — raised in the consumer instead of
+    hanging forever on a queue that will never fill."""
 
 
 def cache(reader):
@@ -197,10 +203,88 @@ def xmap_readers(mapper, reader, process_num, buffer_size,
     return r
 
 
-def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
-    """API-compatible stand-in running the readers in threads: jax's
-    runtime does not survive fork(), the reference's mechanism."""
-    return buffered(chain(*readers), queue_size)
+def _mp_worker(reader, q, idx):
+    """Module-level so the spawn context can pickle it. Protocol:
+    ("item", sample)* then ("end", idx); an exception sends
+    ("error", idx, exc) instead of the end sentinel."""
+    try:
+        for e in reader():
+            q.put(("item", e))
+    except BaseException as exc:  # noqa: BLE001 — ship it to the consumer
+        try:
+            q.put(("error", idx, exc))
+        except Exception:  # unpicklable exception: send its repr
+            q.put(("error", idx, RuntimeError(repr(exc))))
+        return
+    q.put(("end", idx))
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000,
+                        get_timeout_s=1.0):
+    """Run each reader in its own OS process (spawn context — jax's
+    runtime does not survive fork()), multiplexed onto one bounded
+    queue. Samples interleave in arrival order (`use_pipe` is accepted
+    for reference API compatibility; the transport is always a
+    multiprocessing queue).
+
+    Every queue read is bounded by ``get_timeout_s``; on timeout the
+    consumer checks worker liveness and raises :class:`ReaderWorkerDied`
+    naming the exit code when a worker vanished without its end
+    sentinel — the alternative is a training loop blocked forever on a
+    queue no one will ever fill."""
+    import multiprocessing as mp
+    readers = list(readers)
+    if not readers:
+        raise ValueError("multiprocess_reader: need at least one reader")
+
+    def r():
+        ctx = mp.get_context("spawn")
+        q = ctx.Queue(queue_size)
+        procs = [ctx.Process(target=_mp_worker, args=(rd, q, i),
+                             daemon=True)
+                 for i, rd in enumerate(readers)]
+        for p in procs:
+            p.start()
+        live = set(range(len(procs)))
+        try:
+            while live:
+                t0 = time.perf_counter()
+                try:
+                    msg = q.get(timeout=get_timeout_s)
+                except queue.Empty:
+                    for i in sorted(live):
+                        p = procs[i]
+                        if p.is_alive():
+                            continue
+                        if p.exitcode == 0:
+                            # clean exit whose sentinel we somehow
+                            # missed: treat the stream as finished
+                            live.discard(i)
+                            continue
+                        STAT_ADD("reader.worker_deaths")
+                        raise ReaderWorkerDied(
+                            f"multiprocess_reader worker {i} died with "
+                            f"exit code {p.exitcode} before finishing "
+                            f"its stream")
+                    continue
+                STAT_OBSERVE("reader.batch_wait_seconds",
+                             time.perf_counter() - t0)
+                kind = msg[0]
+                if kind == "end":
+                    live.discard(msg[1])
+                elif kind == "error":
+                    raise msg[2]
+                else:
+                    STAT_ADD("reader.batches")
+                    yield msg[1]
+        finally:
+            for p in procs:
+                if p.is_alive():
+                    p.terminate()
+            for p in procs:
+                p.join(timeout=2.0)
+            q.close()
+    return r
 
 
 def batch(reader, batch_size, drop_last=False):

@@ -26,8 +26,8 @@ Quick start::
 See docs/serving.md for the architecture and the full stat inventory.
 """
 from .batcher import (BucketLadder, DeadlineExceededError,  # noqa: F401
-                      DynamicBatcher, EngineClosedError, QueueFullError,
-                      ServingError)
+                      DynamicBatcher, EngineClosedError, OverloadedError,
+                      QueueFullError, ServingError)
 from .engine import EngineConfig, ServingEngine  # noqa: F401
 from .generation import (GenerationEngine, GenerationRequest,  # noqa: F401
                          SlotManager)
@@ -36,4 +36,5 @@ from .http import ServingHTTPServer, serve  # noqa: F401
 __all__ = ["BucketLadder", "DynamicBatcher", "EngineConfig",
            "ServingEngine", "ServingHTTPServer", "serve", "ServingError",
            "QueueFullError", "DeadlineExceededError", "EngineClosedError",
-           "GenerationEngine", "GenerationRequest", "SlotManager"]
+           "OverloadedError", "GenerationEngine", "GenerationRequest",
+           "SlotManager"]

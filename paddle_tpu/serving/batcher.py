@@ -27,8 +27,9 @@ from ..monitor import STAT_ADD, STAT_OBSERVE, STAT_SET
 from ..monitor import enabled as _monitor_on
 
 __all__ = ["ServingError", "QueueFullError", "DeadlineExceededError",
-           "EngineClosedError", "BucketLadder", "DynamicBatcher",
-           "MS_BUCKETS", "FRACTION_BUCKETS", "BATCH_BUCKETS_HIST"]
+           "EngineClosedError", "OverloadedError", "BucketLadder",
+           "DynamicBatcher", "MS_BUCKETS", "FRACTION_BUCKETS",
+           "BATCH_BUCKETS_HIST"]
 
 # Histogram bucket sets for the serving.* stats (milliseconds and
 # fractions — the monitor default is seconds-oriented).
@@ -53,6 +54,16 @@ class DeadlineExceededError(ServingError):
 
 class EngineClosedError(ServingError):
     """Submitted to (or pending in) a batcher that has shut down."""
+
+
+class OverloadedError(ServingError):
+    """Shed by an OPEN circuit breaker (paddle_tpu/resilience/
+    breaker.py): the backend is failing, retry after `retry_after_s`.
+    HTTP maps this to 503 with a Retry-After header."""
+
+    def __init__(self, msg: str, retry_after_s: float = 0.0):
+        super().__init__(msg)
+        self.retry_after_s = float(retry_after_s)
 
 
 class BucketLadder:

@@ -20,8 +20,13 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..monitor import STAT_ADD, STAT_OBSERVE
+from ..resilience.breaker import CLOSED, HALF_OPEN, OPEN, CircuitBreaker
+from ..resilience.faults import TransientFault
+from ..resilience.faults import injector as _fault_injector
+from ..resilience.retry import RetryPolicy, is_transient
 from .batcher import (BATCH_BUCKETS_HIST, BucketLadder, DynamicBatcher,
-                      EngineClosedError, FRACTION_BUCKETS)
+                      EngineClosedError, FRACTION_BUCKETS,
+                      OverloadedError)
 
 __all__ = ["EngineConfig", "ServingEngine"]
 
@@ -109,6 +114,12 @@ class ServingEngine:
         self._ready = threading.Event()
         self._stopping = False
         self._warmed_shapes: List[tuple] = []
+        # resilience: transient batch failures retry invisibly; repeated
+        # failures trip the breaker and submissions shed with
+        # OverloadedError until a half-open probe succeeds
+        self._breaker = CircuitBreaker(name="serving")
+        self._retry = RetryPolicy()
+        self._state = "warming"  # warming -> ready -> stopped
 
     # -- shape spec ------------------------------------------------------
     def _feed_spec(self) -> Dict[str, Tuple[tuple, str]]:
@@ -203,6 +214,7 @@ class ServingEngine:
         worker thread(s) and mark the engine ready."""
         if self._workers:
             return self
+        self._state = "warming"
         if self.config.warmup:
             self.warmup()
         self._stopping = False
@@ -212,6 +224,7 @@ class ServingEngine:
                                  daemon=True)
             w.start()
             self._workers.append(w)
+        self._state = "ready"
         self._ready.set()
         return self
 
@@ -219,6 +232,7 @@ class ServingEngine:
         """Shut down: reject new submissions, then either finish queued
         requests (drain=True) or fail them, and join the workers."""
         self._ready.clear()
+        self._state = "stopped"
         self._stopping = True
         self._batcher.close(drain=drain)
         for w in self._workers:
@@ -229,10 +243,34 @@ class ServingEngine:
     def ready(self) -> bool:
         return self._ready.is_set()
 
+    @property
+    def breaker(self) -> CircuitBreaker:
+        return self._breaker
+
+    def health(self) -> Dict[str, object]:
+        """Load-balancer health view: ``state`` is one of warming /
+        ready / degraded (half-open probing) / open (shedding) /
+        stopped, plus the raw breaker state and the Retry-After
+        seconds while open. /healthz serves this."""
+        if self._state != "ready":
+            return {"state": self._state, "breaker": self._breaker.state,
+                    "retry_after_s": 0.0}
+        b = self._breaker.state
+        state = {OPEN: "open", HALF_OPEN: "degraded",
+                 CLOSED: "ready"}[b]
+        return {"state": state, "breaker": b,
+                "retry_after_s": self._breaker.retry_after_s()}
+
     # -- request path ----------------------------------------------------
     def submit(self, feed: Dict[str, np.ndarray],
                timeout_ms: Optional[float] = None):
-        """Enqueue; returns a response handle (`.result()` blocks)."""
+        """Enqueue; returns a response handle (`.result()` blocks).
+        Raises OverloadedError while the circuit breaker is OPEN
+        (load shedding: don't queue work the backend cannot do)."""
+        if not self._breaker.allow():
+            raise OverloadedError(
+                "serving backend is unhealthy (circuit breaker open)",
+                retry_after_s=self._breaker.retry_after_s())
         return self._batcher.submit(feed, timeout_ms=timeout_ms)
 
     def predict(self, feed: Dict[str, np.ndarray],
@@ -252,6 +290,33 @@ class ServingEngine:
         return self.predictor._exe.cache_stats()
 
     # -- worker ----------------------------------------------------------
+    def _execute(self, feed):
+        """One dispatch attempt: fault hook, device run, output
+        hygiene. A non-finite float output (FLAGS_serving_nan_guard)
+        raises TransientFault — the executor's device state is
+        untouched by a host-side corruption, so re-running the same
+        feed is a valid cure and the RetryPolicy wrapping this call
+        turns a glitched batch into a clean answer instead of a wrong
+        one."""
+        inj = _fault_injector()
+        if inj is not None:
+            inj.pre_step("serving")
+        with self._infer_lock:
+            outputs = self.predictor.run_dict(feed)
+        if inj is not None:
+            outputs = list(outputs)
+            inj.corrupt_fetches("serving", outputs)
+        from ..core.flags import FLAGS
+        if FLAGS.serving_nan_guard:
+            for o in outputs:
+                o = np.asarray(o)
+                if np.issubdtype(o.dtype, np.floating) and o.size \
+                        and not np.all(np.isfinite(o)):
+                    STAT_ADD("resilience.nan_batches_retried")
+                    raise TransientFault(
+                        "non-finite value in batch outputs")
+        return outputs
+
     def _worker_loop(self):
         while True:
             batch = self._batcher.next_batch(timeout=0.1)
@@ -261,16 +326,21 @@ class ServingEngine:
                 continue
             try:
                 feed, bucket, waste = batch.build_feed(self._ladder)
-                with self._infer_lock:
-                    outputs = self.predictor.run_dict(feed)
+                outputs = self._retry.call(self._execute, feed)
                 STAT_ADD("serving.batches")
                 STAT_OBSERVE("serving.batch_size", batch.rows,
                              buckets=BATCH_BUCKETS_HIST)
                 STAT_OBSERVE("serving.pad_waste_frac", waste,
                              buckets=FRACTION_BUCKETS)
                 batch.scatter(outputs)
+                self._breaker.record_success()
             except Exception as e:  # noqa: BLE001 — a poison batch must
                 # fail ITS requests, not kill the worker thread
+                if is_transient(e):
+                    # exhausted-retry transients mean the backend is
+                    # sick; poison (bad request) is the client's fault
+                    # and must not trip the breaker
+                    self._breaker.record_failure()
                 batch.fail(e if isinstance(e, EngineClosedError)
                            else RuntimeError(f"batch execution failed: "
                                              f"{e!r}"))

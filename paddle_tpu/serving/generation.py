@@ -37,9 +37,13 @@ import numpy as np
 
 from ..monitor import STAT_ADD, STAT_OBSERVE, STAT_SET
 from ..monitor import enabled as _monitor_on
+from ..resilience.breaker import CLOSED, HALF_OPEN, OPEN, CircuitBreaker
+from ..resilience.faults import TransientFault
+from ..resilience.faults import injector as _fault_injector
+from ..resilience.retry import RetryPolicy, is_transient
 from .batcher import (DeadlineExceededError, EngineClosedError,
-                      FRACTION_BUCKETS, MS_BUCKETS, QueueFullError,
-                      _Response)
+                      FRACTION_BUCKETS, MS_BUCKETS, OverloadedError,
+                      QueueFullError, _Response)
 
 __all__ = ["GenerationRequest", "SlotManager", "GenerationEngine"]
 
@@ -198,6 +202,14 @@ class GenerationEngine:
         self._worker: Optional[threading.Thread] = None
         self._ready = threading.Event()
         self._warm_misses: Optional[int] = None
+        # resilience: a failed decode step fails the requests that were
+        # mid-step (their KV state is unreplayable) but never the
+        # worker; repeated failures trip the breaker and submissions
+        # shed with OverloadedError
+        self._breaker = CircuitBreaker(name="generation")
+        self._step_retry = RetryPolicy(
+            is_retryable=lambda e: isinstance(e, TransientFault))
+        self._engine_state = "warming"  # warming -> ready -> stopped
 
     # -- lifecycle -------------------------------------------------------
     def init_scope(self):
@@ -227,6 +239,7 @@ class GenerationEngine:
                                         name="ptn-generation-worker",
                                         daemon=True)
         self._worker.start()
+        self._engine_state = "ready"
         self._ready.set()
         return self
 
@@ -235,6 +248,7 @@ class GenerationEngine:
         """Reject new submissions; drain=True finishes queued + active
         requests first, drain=False fails them with EngineClosedError."""
         self._ready.clear()
+        self._engine_state = "stopped"
         with self._cond:
             self._closed = True
             self._draining = drain
@@ -246,6 +260,22 @@ class GenerationEngine:
     @property
     def ready(self) -> bool:
         return self._ready.is_set()
+
+    @property
+    def breaker(self) -> CircuitBreaker:
+        return self._breaker
+
+    def health(self) -> dict:
+        """Same shape as ServingEngine.health(): state warming / ready
+        / degraded / open / stopped + breaker detail (for /healthz)."""
+        if self._engine_state != "ready":
+            return {"state": self._engine_state,
+                    "breaker": self._breaker.state, "retry_after_s": 0.0}
+        b = self._breaker.state
+        state = {OPEN: "open", HALF_OPEN: "degraded",
+                 CLOSED: "ready"}[b]
+        return {"state": state, "breaker": b,
+                "retry_after_s": self._breaker.retry_after_s()}
 
     def cache_stats(self):
         """The executor's per-instance executable-cache counters; after
@@ -272,6 +302,10 @@ class GenerationEngine:
             else self.default_timeout_ms
         now = time.perf_counter()
         deadline = now + timeout_ms / 1e3 if timeout_ms else None
+        if not self._breaker.allow():
+            raise OverloadedError(
+                "generation backend is unhealthy (circuit breaker "
+                "open)", retry_after_s=self._breaker.retry_after_s())
         resp = _Response()
         with self._cond:
             if self._closed:
@@ -401,7 +435,54 @@ class GenerationEngine:
                 stepped.append(i)
             if not stepped:
                 continue
-            logits = self._run_step(tokens, reset, active)
+
+            def _attempt():
+                inj = _fault_injector()
+                if inj is not None:
+                    inj.pre_step("generation")
+                return self._run_step(tokens, reset, active)
+
+            try:
+                # only the injector's pre-dispatch TransientFault is
+                # retryable: once the real step ran, the KV cache
+                # advanced and a replay would double-step the slots
+                logits = self._step_retry.call(_attempt)
+            except Exception as e:  # noqa: BLE001 — worker must survive
+                if is_transient(e):
+                    self._breaker.record_failure()
+                STAT_ADD("resilience.gen_step_failures")
+                for i in stepped:
+                    st = self._state[i]
+                    st.response._complete(error=RuntimeError(
+                        f"decode step failed: {e!r}"))
+                    self._state[i] = None
+                    self._slots.release(i)
+                continue
+            self._breaker.record_success()
+            inj = _fault_injector()
+            if inj is not None:
+                # step_nan at site=generation corrupts only the host
+                # logits copy; the device KV state is untouched
+                arrs = [logits]
+                if inj.corrupt_fetches("generation", arrs):
+                    logits = arrs[0]
+            from ..core.flags import FLAGS
+            if FLAGS.serving_nan_guard:
+                bad = [i for i in stepped
+                       if not np.all(np.isfinite(logits[i, 0]))]
+                if bad:
+                    self._breaker.record_failure()
+                    STAT_ADD("resilience.gen_step_failures")
+                    for i in bad:
+                        st = self._state[i]
+                        st.response._complete(error=RuntimeError(
+                            "non-finite logits (cannot replay a "
+                            "stateful decode step)"))
+                        self._state[i] = None
+                        self._slots.release(i)
+                    stepped = [i for i in stepped if i not in bad]
+                    if not stepped:
+                        continue
             STAT_ADD("serving.gen_steps")
             if _monitor_on():
                 STAT_OBSERVE("serving.gen_slot_occupancy",

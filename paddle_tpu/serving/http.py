@@ -14,8 +14,12 @@ its own handler thread, which blocks in `engine.predict` /
   "length"|"eos", "ttft_ms", "e2e_ms"}`` from the continuous-batching
   GenerationEngine; same 400/503/504 error mapping. 404 when the server
   was started without a generation engine.
-- ``GET /healthz``      -> 200 ``{"status": "ok"}`` once every attached
-  engine is warmed and ready, 503 before/after.
+- ``GET /healthz``      -> aggregated engine health. 200 with
+  ``{"state": "ok"|"degraded", ...}`` while every attached engine is
+  ready (degraded = some circuit breaker is half-open and probing);
+  503 with ``{"state": "warming"|"open"|"stopped", ...}`` otherwise —
+  ``warming`` until warmup() completes, ``open`` (plus a
+  ``Retry-After`` header) while a breaker is shedding load.
 - ``GET /metrics``      -> the same Prometheus text the monitor's scrape
   endpoint serves (monitor.prometheus_text), so one port serves both
   traffic and observability.
@@ -30,8 +34,20 @@ import numpy as np
 
 from ..monitor import STAT_ADD, prometheus_text
 from .batcher import (DeadlineExceededError, EngineClosedError,
-                      QueueFullError)
+                      OverloadedError, QueueFullError)
 from .engine import ServingEngine
+
+# severity order for aggregating per-engine health states into one
+# /healthz verdict (worst wins); ok/degraded answer 200, the rest 503
+_STATE_RANK = {"ready": 0, "degraded": 1, "warming": 2, "open": 3,
+               "stopped": 4}
+
+
+def _retry_after_hdr(e: OverloadedError):
+    s = getattr(e, "retry_after_s", 0.0) or 0.0
+    if s <= 0:
+        return None
+    return {"Retry-After": str(max(1, int(round(s))))}
 
 __all__ = ["ServingHTTPServer", "serve"]
 
@@ -58,22 +74,49 @@ class ServingHTTPServer:
         class _Handler(http.server.BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
 
-            def _reply(self, code: int, payload: dict):
+            def _reply(self, code: int, payload: dict, headers=None):
                 body = json.dumps(payload).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
+
+            def _healthz(self):
+                worst = "ready"
+                retry_after = 0.0
+                detail = {}
+                for name, e in (("predict", eng), ("generate", gen)):
+                    if e is None:
+                        continue
+                    if hasattr(e, "health"):
+                        h = e.health()
+                    else:
+                        h = {"state": "ready" if e.ready
+                             else "warming"}
+                    detail[name] = h
+                    if _STATE_RANK.get(h["state"], 4) > \
+                            _STATE_RANK.get(worst, 4):
+                        worst = h["state"]
+                    retry_after = max(retry_after,
+                                      h.get("retry_after_s") or 0.0)
+                body = {"state": "ok" if worst == "ready" else worst,
+                        "engines": detail}
+                if worst in ("ready", "degraded"):
+                    self._reply(200, body)
+                else:
+                    hdrs = None
+                    if worst == "open" and retry_after > 0:
+                        hdrs = {"Retry-After":
+                                str(max(1, int(round(retry_after))))}
+                    self._reply(503, body, headers=hdrs)
 
             def do_GET(self):
                 STAT_ADD("serving.http_requests")
                 if self.path.startswith("/healthz"):
-                    if all(e.ready for e in (eng, gen)
-                           if e is not None):
-                        self._reply(200, {"status": "ok"})
-                    else:
-                        self._reply(503, {"status": "not ready"})
+                    self._healthz()
                 elif self.path.startswith("/metrics"):
                     body = prometheus_text().encode()
                     self.send_response(200)
@@ -110,6 +153,11 @@ class ServingHTTPServer:
                 try:
                     outs = eng.predict(
                         feed, timeout_ms=req.get("timeout_ms"))
+                except OverloadedError as e:
+                    self._reply(503, {"error": str(e),
+                                      "retryable": True},
+                                headers=_retry_after_hdr(e))
+                    return
                 except QueueFullError as e:
                     self._reply(503, {"error": str(e),
                                       "retryable": True})
@@ -155,6 +203,11 @@ class ServingHTTPServer:
                     return
                 try:
                     out = gen.submit(greq).result()
+                except OverloadedError as e:
+                    self._reply(503, {"error": str(e),
+                                      "retryable": True},
+                                headers=_retry_after_hdr(e))
+                    return
                 except QueueFullError as e:
                     self._reply(503, {"error": str(e),
                                       "retryable": True})
@@ -199,15 +252,32 @@ class ServingHTTPServer:
 
 def serve(engine: Optional[ServingEngine] = None,
           port: Optional[int] = None,
-          gen_engine=None) -> ServingHTTPServer:
+          gen_engine=None,
+          async_start: bool = False) -> ServingHTTPServer:
     """Start the engine(s) (if not already started) and expose them
     over HTTP. port=None reads EngineConfig.http_port when a
     ServingEngine is attached (itself defaulted from
-    FLAGS_serving_http_port; 0 binds an ephemeral port)."""
-    if engine is not None:
-        engine.start()
-    if gen_engine is not None:
-        gen_engine.start()
+    FLAGS_serving_http_port; 0 binds an ephemeral port).
+
+    async_start=True binds the port first and runs the engine starts
+    (warmup compiles) on a background thread, so /healthz answers 503
+    ``{"state": "warming"}`` during warmup instead of the connection
+    being refused — the readiness-probe contract load balancers
+    expect."""
+    def _start_engines():
+        if engine is not None:
+            engine.start()
+        if gen_engine is not None:
+            gen_engine.start()
+
     if port is None:
         port = engine.config.http_port if engine is not None else 0
+    if async_start:
+        srv = ServingHTTPServer(engine, port=port,
+                                gen_engine=gen_engine)
+        threading.Thread(target=_start_engines,
+                         name="ptn-serving-warmup",
+                         daemon=True).start()
+        return srv
+    _start_engines()
     return ServingHTTPServer(engine, port=port, gen_engine=gen_engine)

@@ -42,7 +42,7 @@ def _fmt_bytes(v):
 def load(path):
     snapshots, results, op_profiles = [], [], []
     loadgens, lints, graph_opts = [], [], []
-    gen_loadgens = []
+    gen_loadgens, chaos_loadgens = [], []
     with open(path) as f:
         for ln, line in enumerate(f, 1):
             line = line.strip()
@@ -65,12 +65,14 @@ def load(path):
                 loadgens.append(rec)
             elif kind == "generation_loadgen":
                 gen_loadgens.append(rec)
+            elif kind == "chaos_loadgen":
+                chaos_loadgens.append(rec)
             elif kind == "program_lint":
                 lints.append(rec)
             elif kind == "graph_opt":
                 graph_opts.append(rec)
     return (snapshots, results, op_profiles, loadgens, lints,
-            graph_opts, gen_loadgens)
+            graph_opts, gen_loadgens, chaos_loadgens)
 
 
 def _hist(snap, name):
@@ -79,12 +81,12 @@ def _hist(snap, name):
 
 def report(path, out=sys.stdout):
     (snapshots, results, op_profiles, loadgens, lints,
-     graph_opts, gen_loadgens) = load(path)
+     graph_opts, gen_loadgens, chaos_loadgens) = load(path)
     w = out.write
     w(f"runtime stats report — {path}\n")
     if not snapshots and not results and not op_profiles \
             and not loadgens and not lints and not graph_opts \
-            and not gen_loadgens:
+            and not gen_loadgens and not chaos_loadgens:
         w("no snapshots or bench results found\n")
         return 1
     w(f"snapshots: {len(snapshots)}   bench results: {len(results)}\n")
@@ -245,6 +247,51 @@ def report(path, out=sys.stdout):
               f"ttft p99 {ttft.get('p99')} ms  "
               f"inter-token p99 {inter.get('p99')} ms  "
               f"errors {r.get('errors', 0)}{extra}\n")
+
+    faults = c.get("resilience.faults_injected")
+    retries = c.get("resilience.retries")
+    opens = c.get("resilience.breaker_opens")
+    if faults or retries or opens or chaos_loadgens:
+        w("\n-- resilience (docs/resilience.md) --\n")
+        if faults:
+            detail = "  ".join(
+                f"{k.split('.')[-1][6:]} {int(v)}"
+                for k, v in sorted(c.items())
+                if k.startswith("resilience.fault_"))
+            w(f"{'faults injected':26s} {int(faults)}   {detail}\n")
+        if retries:
+            w(f"{'retries':26s} {int(retries)}   give-ups "
+              f"{int(c.get('resilience.retry_giveups', 0))}\n")
+        bo = _hist(snap, "resilience.retry_backoff_ms")
+        if bo and bo["count"]:
+            w(f"{'retry backoff':26s} count {bo['count']:<6d} "
+              f"p50 {bo['p50']:.1f} ms  p95 {bo['p95']:.1f} ms\n")
+        if opens or g.get("resilience.breaker_state") is not None:
+            state = {0: "closed", 1: "half_open", 2: "open"}.get(
+                g.get("resilience.breaker_state"), "n/a")
+            w(f"{'circuit breaker':26s} state {state}   opens "
+              f"{int(opens or 0)}   shed "
+              f"{int(c.get('resilience.breaker_shed', 0))}\n")
+        for label, name in (("nan steps skipped",
+                             "resilience.nan_steps_skipped"),
+                            ("rollbacks", "resilience.rollbacks"),
+                            ("checkpoints", "resilience.checkpoints"),
+                            ("resumes", "resilience.resumes"),
+                            ("preemptions", "resilience.preemptions"),
+                            ("watchdog fires",
+                             "resilience.watchdog_fires")):
+            v = c.get(name)
+            if v:
+                w(f"{label:26s} {int(v)}\n")
+        for r in chaos_loadgens:
+            lat = r.get("latency_ms") or {}
+            w(f"{'chaos loadgen':26s} {r.get('requests', 0)} req  "
+              f"errors {r.get('errors', 0)}  wrong "
+              f"{r.get('wrong_answers', 0)}  worker deaths "
+              f"{r.get('worker_deaths', 0)}  p99 {lat.get('p99')} ms "
+              f"({r.get('p99_inflation')}x fault-free, bound "
+              f"{r.get('p99_bound')}x)  spec "
+              f"\"{r.get('fault_spec', '')}\"\n")
 
     phases = snap.get("phases") or {}
     if phases:

@@ -39,6 +39,14 @@ single-request predictor and emits a second record (mode
 executor cache-miss counter stayed flat after warmup (exit 3 when it
 moved).
 
+--chaos is the resilience acceptance run (`kind="chaos_loadgen"`
+records): a fault-free baseline pass pins per-request expected outputs
+and the fault-free p99, then the same traffic replays with
+FLAGS_fault_spec armed (--fault-spec). Every 200 is numerically
+verified against the baseline; exit 4 on any wrong answer or engine
+worker death, exit 5 when the chaos p99 exceeds --chaos-p99-bound
+times the fault-free p99.
+
 Usage:
     python tools/serving_loadgen.py --requests 200 --concurrency 8 \
         --compare-serial --check-compiles --out loadgen.jsonl
@@ -46,6 +54,8 @@ Usage:
         --rate 50 --duration 10
     python tools/serving_loadgen.py --generate --requests 24 \
         --slots 4 --max-new-tokens 8 --compare-serial --check-compiles
+    python tools/serving_loadgen.py --chaos --requests 100 \
+        --fault-spec "transient_fail:p=0.05,step_nan:p=0.01"
 """
 from __future__ import annotations
 
@@ -446,6 +456,142 @@ def run_generation(args):
     return 0
 
 
+def run_chaos_closed(engine, requests, expected, concurrency,
+                     timeout_ms):
+    """Closed-loop pass that also VERIFIES every successful response
+    against the fault-free expected outputs: under chaos a request may
+    fail (shed, timed out — that is degradation, allowed and counted)
+    but a 200 carrying wrong numbers is a correctness bug (counted
+    separately, never allowed)."""
+    latencies, errors, wrong = [], [0], [0]
+    lock = threading.Lock()
+    it = iter(list(enumerate(requests)))
+
+    def worker():
+        while True:
+            with lock:
+                item = next(it, None)
+            if item is None:
+                return
+            idx, feed = item
+            t0 = time.perf_counter()
+            try:
+                outs = engine.predict(feed, timeout_ms=timeout_ms)
+                dt = time.perf_counter() - t0
+                ok = len(outs) == len(expected[idx]) and all(
+                    np.allclose(o, e, rtol=1e-4, atol=1e-5)
+                    for o, e in zip(outs, expected[idx]))
+                with lock:
+                    latencies.append(dt)
+                    if not ok:
+                        wrong[0] += 1
+            except Exception:  # noqa: BLE001 — shed/timeout under chaos
+                with lock:
+                    errors[0] += 1
+
+    threads = [threading.Thread(target=worker)
+               for _ in range(concurrency)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return latencies, errors[0], wrong[0], time.perf_counter() - t0
+
+
+def run_chaos(args):
+    """--chaos: the graceful-degradation acceptance run. Baseline pass
+    (faults off) for expected outputs + fault-free p99, then the same
+    traffic with FLAGS_fault_spec armed. Exit 4 on any wrong answer or
+    worker death, 5 when chaos p99 exceeds --chaos-p99-bound x the
+    fault-free p99."""
+    import paddle_tpu as fluid
+    from paddle_tpu.resilience import reset_injector
+    from paddle_tpu.serving import EngineConfig, ServingEngine
+
+    if args.url:
+        print("--chaos drives an in-process engine; --url is not "
+              "supported", file=sys.stderr)
+        return 2
+
+    seq_buckets = tuple(int(s) for s in args.seq_buckets.split(","))
+    feat = 6
+    reqs = make_requests(args.requests, seq_buckets, feat, args.seed)
+
+    fluid.set_flags({"FLAGS_fault_spec": ""})
+    reset_injector()
+    model_dir = args.model_dir or build_tiny_model(
+        tempfile.mkdtemp(prefix="serving_chaos_"), feat)
+    cfg = EngineConfig(model_dir,
+                       max_batch_size=args.max_batch_size,
+                       max_wait_us=args.max_wait_us,
+                       queue_capacity=max(64, args.concurrency * 8),
+                       default_timeout_ms=args.timeout_ms,
+                       seq_buckets=seq_buckets,
+                       warmup=True)
+    engine = ServingEngine(cfg)
+    engine.start()
+
+    # fault-free ground truth, one request at a time (no batching
+    # effects), through a predictor clone sharing the weights
+    ref = engine.predictor.clone()
+    expected = [ref.run_dict(feed) for feed in reqs]
+
+    base_lat, base_errs, base_wrong, base_dur = run_chaos_closed(
+        engine, reqs, expected, args.concurrency, args.timeout_ms)
+    base_p99 = _percentile(sorted(v * 1e3 for v in base_lat), 0.99)
+
+    fluid.set_flags({"FLAGS_fault_spec": args.fault_spec,
+                     "FLAGS_fault_seed": args.seed})
+    reset_injector()
+    lat, errs, wrong, dur = run_chaos_closed(
+        engine, reqs, expected, args.concurrency, args.timeout_ms)
+    worker_deaths = sum(1 for w in engine._workers if not w.is_alive())
+    fluid.set_flags({"FLAGS_fault_spec": ""})
+    reset_injector()
+    engine.stop()
+
+    chaos_p99 = _percentile(sorted(v * 1e3 for v in lat), 0.99)
+    inflation = (round(chaos_p99 / base_p99, 3)
+                 if base_p99 and chaos_p99 else None)
+    n = len(lat)
+    rec = {
+        "kind": "chaos_loadgen",
+        "mode": "chaos",
+        "requests": n,
+        "errors": errs,
+        "duration_s": round(dur, 4),
+        "throughput_rps": round(n / dur, 2) if dur else 0.0,
+        "latency_ms": _lat_summary(lat),
+        "fault_spec": args.fault_spec,
+        "wrong_answers": wrong + base_wrong,
+        "worker_deaths": worker_deaths,
+        "baseline_p99_ms": base_p99,
+        "chaos_p99_ms": chaos_p99,
+        "p99_inflation": inflation,
+        "p99_bound": args.chaos_p99_bound,
+        "config": {"concurrency": args.concurrency,
+                   "max_batch_size": args.max_batch_size,
+                   "max_wait_us": args.max_wait_us,
+                   "seq_buckets": list(seq_buckets),
+                   "baseline_errors": base_errs,
+                   "seed": args.seed},
+    }
+    emit(rec, args.out)
+
+    if rec["wrong_answers"] or worker_deaths:
+        print(f"FAIL: {rec['wrong_answers']} wrong answers, "
+              f"{worker_deaths} worker deaths under chaos",
+              file=sys.stderr)
+        return 4
+    if inflation is not None and inflation > args.chaos_p99_bound:
+        print(f"FAIL: chaos p99 {chaos_p99}ms is {inflation}x the "
+              f"fault-free p99 {base_p99}ms (bound "
+              f"{args.chaos_p99_bound}x)", file=sys.stderr)
+        return 5
+    return 0
+
+
 def emit(rec, out_path):
     print(json.dumps(rec))
     if out_path:
@@ -497,8 +643,20 @@ def main(argv=None):
     ap.add_argument("--max-seq", type=int, default=32,
                     help="generation KV-cache length")
     ap.add_argument("--vocab", type=int, default=64)
+    ap.add_argument("--chaos", action="store_true",
+                    help="fault-injection acceptance run: baseline "
+                         "pass, then the same traffic under "
+                         "--fault-spec; exit 4 on wrong answers or "
+                         "worker deaths, 5 on p99 over bound")
+    ap.add_argument("--fault-spec",
+                    default="transient_fail:p=0.05,step_nan:p=0.01",
+                    help="FLAGS_fault_spec armed for the chaos pass")
+    ap.add_argument("--chaos-p99-bound", type=float, default=50.0,
+                    help="max allowed chaos-p99 / fault-free-p99 ratio")
     args = ap.parse_args(argv)
 
+    if args.chaos:
+        return run_chaos(args)
     if args.generate:
         return run_generation(args)
 

@@ -79,6 +79,11 @@ CONFIGS = [
     # TTFT/inter-token p99 and the continuous-vs-serial speedup, and
     # --check-compiles makes a post-warmup recompile a hard failure
     ("gen_loadgen_s4", None),  # special-cased below
+    # chaos acceptance (serving_loadgen --chaos): serving traffic under
+    # FLAGS_fault_spec; the ledger entry records the p99 inflation and
+    # the zero-wrong-answers / zero-worker-deaths verdict (rc 4/5 when
+    # violated — a hard failure, not a flake)
+    ("chaos_s4", None),  # special-cased below
     ("gpt_b32", {"BENCH_MODEL": "gpt", "BENCH_BATCH": "32"}),
     # graph-opt A/B pairs (FLAGS_graph_opt_level, analysis/passes):
     # same model+batch at level 0 (pipeline off) vs level 2 (full
@@ -297,6 +302,36 @@ def run_special(key):
                 "post_warmup_compiles":
                     (cont.get("cache") or {}).get("post_warmup_compiles"),
                 "speedup_note": speedup.lstrip("# ").strip()}, None
+    if key == "chaos_s4":
+        out_path = f"/tmp/chaos_loadgen_{ROUND}.jsonl"
+        p = subprocess.run(
+            [sys.executable, "tools/serving_loadgen.py", "--chaos",
+             "--requests", "100", "--concurrency", "4",
+             "--out", out_path],
+            cwd=REPO, capture_output=True, text=True, timeout=1800)
+        if p.returncode != 0:
+            # rc 4 = wrong answers / worker deaths, rc 5 = p99 blown:
+            # both are graceful-degradation regressions, not flakes
+            return None, (f"rc={p.returncode}: "
+                          + (p.stdout + p.stderr)[-300:])
+        recs = []
+        try:
+            with open(out_path) as f:
+                recs = [json.loads(ln) for ln in f if ln.strip()]
+        except (OSError, ValueError) as e:
+            return None, f"unreadable {out_path}: {e}"
+        rec = next((r for r in recs
+                    if r.get("kind") == "chaos_loadgen"), None)
+        if rec is None:
+            return None, "no chaos_loadgen record"
+        return {"metric": "chaos_p99_inflation",
+                "value": rec.get("p99_inflation"), "unit": "x",
+                "wrong_answers": rec.get("wrong_answers"),
+                "worker_deaths": rec.get("worker_deaths"),
+                "errors": rec.get("errors"),
+                "chaos_p99_ms": rec.get("chaos_p99_ms"),
+                "baseline_p99_ms": rec.get("baseline_p99_ms"),
+                "fault_spec": rec.get("fault_spec")}, None
     if key == "profile":
         p = subprocess.run([sys.executable, "tools/profile_step.py"],
                            cwd=REPO, capture_output=True, text=True,

@@ -18,6 +18,10 @@ Checks, per file (type auto-detected from content):
   "generation_loadgen" (tools/serving_loadgen.py --generate) carry
   that plus tokens/tokens_per_s and ttft_ms/inter_token_ms percentile
   objects (the generation report section's contract); lines with
+  kind == "chaos_loadgen" (tools/serving_loadgen.py --chaos) carry the
+  loadgen contract plus fault_spec and the chaos verdict
+  (wrong_answers/worker_deaths, both required to be ZERO, and the
+  baseline/chaos p99 pair with its inflation bound); lines with
   kind == "program_lint" (tools/program_lint.py) carry the
   model/ok/counts/findings contract the lint report section reads;
   lines with kind == "graph_opt" (tools/program_lint.py --optimize)
@@ -148,6 +152,36 @@ def validate_generation_loadgen(obj, where="generation_loadgen"):
     return errs
 
 
+def validate_chaos_loadgen(obj, where="chaos_loadgen"):
+    """Schema of one tools/serving_loadgen.py --chaos record: the base
+    loadgen contract plus the chaos verdict fields. wrong_answers and
+    worker_deaths must be zero — the record documents the
+    graceful-degradation guarantee, not a best-effort tally."""
+    errs = validate_loadgen(obj, where=where)
+    if not isinstance(obj.get("fault_spec"), str):
+        errs.append(f"{where}: fault_spec must be a string "
+                    f"(got {obj.get('fault_spec')!r})")
+    for key in ("wrong_answers", "worker_deaths"):
+        v = obj.get(key)
+        if not isinstance(v, int) or isinstance(v, bool):
+            errs.append(f"{where}: {key} must be an int (got {v!r})")
+        elif v != 0:
+            errs.append(f"{where}: {key}={v} violates the zero-"
+                        f"incorrect-responses chaos contract")
+    for key in ("baseline_p99_ms", "chaos_p99_ms", "p99_inflation",
+                "p99_bound"):
+        v = obj.get(key)
+        if v is not None and (not isinstance(v, (int, float))
+                              or isinstance(v, bool)):
+            errs.append(f"{where}: {key} must be numeric (got {v!r})")
+    if isinstance(obj.get("p99_inflation"), (int, float)) \
+            and isinstance(obj.get("p99_bound"), (int, float)) \
+            and obj["p99_inflation"] > obj["p99_bound"]:
+        errs.append(f"{where}: p99_inflation={obj['p99_inflation']} "
+                    f"exceeds p99_bound={obj['p99_bound']}")
+    return errs
+
+
 _LINT_SEVERITIES = ("error", "warn")
 
 
@@ -252,6 +286,9 @@ def validate_jsonl(path):
                 errs.extend(validate_loadgen(rec, where=f"{path}:{ln}"))
             elif rec.get("kind") == "generation_loadgen":
                 errs.extend(validate_generation_loadgen(
+                    rec, where=f"{path}:{ln}"))
+            elif rec.get("kind") == "chaos_loadgen":
+                errs.extend(validate_chaos_loadgen(
                     rec, where=f"{path}:{ln}"))
             elif rec.get("kind") == "program_lint":
                 errs.extend(validate_program_lint(
