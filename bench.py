@@ -183,6 +183,24 @@ def _timed_steps(exe, prog, feed, loss, steps):
         fetch_names=[loss.name], where="bench")
     ops_post = len(opt_prog.global_block().ops)
 
+    # Static peak estimate of the program the executor will actually
+    # compile, sized with the concrete feed shapes — recorded next to
+    # the measured device stats below so every ledger row calibrates
+    # the estimator (analysis/memory, docs/memory_planning.md).
+    est_peak = est_dynamic = None
+    try:
+        from paddle_tpu.analysis import analyze_program_memory
+        _plan = analyze_program_memory(
+            opt_prog, feed_names=sorted(feed.keys()),
+            fetch_names=[loss.name],
+            feed_shapes={k: (tuple(v.shape), str(v.dtype))
+                         for k, v in feed.items()})
+        est_peak = int(_plan.peak_bytes)
+        est_dynamic = bool(_plan.dynamic)
+    except Exception as e:  # noqa: BLE001 — never fail a bench run
+        print(f"# memory estimate unavailable: {type(e).__name__}: {e}",
+              file=sys.stderr)
+
     # compile + warmup (synced)
     exe.run(prog, feed=feed, fetch_list=[loss])
     x, = exe.run(prog, feed=feed, fetch_list=[loss], return_numpy=False)
@@ -221,6 +239,16 @@ def _timed_steps(exe, prog, feed, loss, steps):
              "window_spread": round(abs(dt1 - dt2) / dt, 4),
              "graph_opt_level": opt_level,
              "ops_pre_opt": ops_pre, "ops_post_opt": ops_post}
+    if est_peak is not None:
+        stats["est_peak_bytes"] = est_peak
+        stats["est_peak_dynamic"] = est_dynamic
+        # measured counterpart: PJRT per-device stats after the timed
+        # windows (empty {} on backends that don't report, e.g. CPU)
+        from paddle_tpu.core.memory import device_memory_stats
+        mem = device_memory_stats()
+        for key in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
+            if mem.get(key) is not None:
+                stats[f"measured_{key}"] = int(mem[key])
     return dt, lv, stats
 
 

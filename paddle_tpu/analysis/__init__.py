@@ -19,6 +19,10 @@ This package checks a Program with ZERO device work:
   write, inplace aliasing hazards, sub-block consistency, registry and
   version checks) + the executor/serving pre-compile gate driven by
   FLAGS_program_verify=off|warn|error.
+- `memory`: the static memory planner — liveness intervals over the
+  global block, a per-op resident-bytes timeline, a peak-HBM estimate,
+  and the FLAGS_memory_gate pre-compile OOM gate (PTV050/051/052) that
+  rejects over-budget programs before a single XLA compile.
 
 Every diagnostic carries a stable rule ID (PTVnnn), a severity, and
 provenance in the same "{op_type}:{block}/{op_idx}" format the op trace
@@ -40,5 +44,24 @@ def optimize_gate(program, feed_names=None, fetch_names=None,
                  fetch_names=fetch_names, where=where)
 
 
+def memory_gate(program, feed_shapes=None, fetch_names=None,
+                where="executor"):
+    """Memoized FLAGS_memory_gate static-memory gate (analysis/memory)
+    — lazy import, same reason as optimize_gate."""
+    from .memory import memory_gate as _gate
+    return _gate(program, feed_shapes=feed_shapes,
+                 fetch_names=fetch_names, where=where)
+
+
+def analyze_program_memory(program, feed_names=(), fetch_names=(),
+                           feed_shapes=None, budget_bytes=0):
+    """Unmemoized memory analysis -> MemoryPlan (CLI, bench, tests)."""
+    from .memory import analyze_program_memory as _analyze
+    return _analyze(program, feed_names=feed_names,
+                    fetch_names=fetch_names, feed_shapes=feed_shapes,
+                    budget_bytes=budget_bytes)
+
+
 __all__ = ["Diagnostic", "VerifyResult", "ProgramVerificationError",
-           "RULES", "verify_program", "verify_gate", "optimize_gate"]
+           "RULES", "verify_program", "verify_gate", "optimize_gate",
+           "memory_gate", "analyze_program_memory"]

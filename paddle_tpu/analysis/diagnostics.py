@@ -37,6 +37,10 @@ RULES = {
     "PTV031": (ERROR, "fetch target is never materialised at top level"),
     # control-flow band (04x)
     "PTV040": (ERROR, "control-flow sub-block reference is inconsistent"),
+    # memory band (05x) — the static memory planner (analysis/memory.py)
+    "PTV050": (ERROR, "estimated peak HBM exceeds the memory budget"),
+    "PTV051": (ERROR, "a single tensor alone exceeds the memory budget"),
+    "PTV052": (WARN, "large dead buffers are eligible for reuse"),
 }
 
 

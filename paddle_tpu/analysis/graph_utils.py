@@ -19,7 +19,8 @@ __all__ = [
     "SIDE_EFFECT_OPS", "MERGE_OPS", "CTRL_FLOW_SUB_BLOCK",
     "op_names", "attr_read_names", "op_is_anchored",
     "available_at_entry", "live_op_mask", "scan_block_hazards",
-    "referenced_var_names",
+    "referenced_var_names", "sub_block_index", "sub_block_read_names",
+    "program_read_names",
 ]
 
 # Ops whose execution is the point (host effects), so dead-op
@@ -69,6 +70,46 @@ def attr_read_names(op, attrs=_READ_ATTRS) -> set:
             names.add(v)
         elif isinstance(v, (list, tuple)):
             names |= {str(x) for x in v}
+    return names
+
+
+def sub_block_index(program, op):
+    """The valid sub-block index an op carries, or None. Accepts both
+    the live int form and the serialized {"__block__": idx} form."""
+    sb = op.attrs.get("sub_block")
+    if isinstance(sb, dict):
+        sb = sb.get("__block__")
+    if isinstance(sb, int) and 0 < sb < len(program.blocks):
+        return sb
+    return None
+
+
+def sub_block_read_names(program, op) -> set:
+    """Every var name read anywhere inside `op`'s sub-block — op inputs
+    AND attr-based reads, transitively through nested control-flow ops
+    (a conditional_block inside a while body counts).
+
+    This is THE definition of "a sub-block read is a use", shared by
+    the dead-op reachability (live_op_mask / PTV012 / DCE), the
+    unused-output lint (PTV013), the donation planner, and the memory
+    planner's liveness intervals, so a var whose only reader lives two
+    blocks down is never declared dead by one consumer and live by
+    another. The one-level scan this replaces missed nested sub-blocks
+    and sub-op attr reads entirely.
+    """
+    names = set()
+    seen = set()
+    stack = [op]
+    while stack:
+        sb = sub_block_index(program, stack.pop())
+        if sb is None or sb in seen:
+            continue
+        seen.add(sb)
+        for sop in program.blocks[sb].ops:
+            names |= set(op_names(sop, "in"))
+            names |= attr_read_names(sop)
+            if sop.type in CTRL_FLOW_SUB_BLOCK:
+                stack.append(sop)
     return names
 
 
@@ -131,13 +172,11 @@ def live_op_mask(program, fetch_list: Iterable[str]) -> List[bool]:
         if live:
             needed |= set(op_names(op, "in"))
             # sub-block reads count: condition/carried vars resolve
-            # against the parent scope too
+            # against the parent scope too, transitively through
+            # nested control flow (sub_block_read_names)
             needed |= attr_read_names(op)
             if op.type in CTRL_FLOW_SUB_BLOCK:
-                sb = op.attrs.get("sub_block")
-                if isinstance(sb, int) and 0 < sb < len(program.blocks):
-                    for sop in program.blocks[sb].ops:
-                        needed |= set(op_names(sop, "in"))
+                needed |= sub_block_read_names(program, op)
     return mask
 
 
@@ -188,6 +227,22 @@ def scan_block_hazards(block) -> Tuple[list, list, list]:
             if is_inplace and name in ins:
                 inplace_writes.append((op_idx, op.type, name))
     return waw, alias_reads, inplace_writes
+
+
+def program_read_names(program) -> set:
+    """Every var name READ anywhere in the program: op inputs of every
+    block plus attr-carried names (conditions, carried vars, the
+    output_vars lists control-flow ops resolve by name). The complement
+    of this set over an op's outputs is the PTV013 "never read"
+    finding, and the memory planner's last-use scan must agree with it.
+    Includes the lod_link companions the feed path reads implicitly."""
+    reads = set(program.lod_link.values())
+    for blk in program.blocks:
+        for op in blk.ops:
+            reads |= set(op_names(op, "in"))
+            reads |= attr_read_names(
+                op, _READ_ATTRS + ("output_vars",))
+    return reads
 
 
 def referenced_var_names(program) -> set:

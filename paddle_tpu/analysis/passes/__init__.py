@@ -11,9 +11,11 @@ clone, gated by FLAGS_graph_opt_level:
       (value numbering on (op_type, attrs, input versions)).
   2 — adds elementwise-chain fusion (consecutive chains merge into one
       fused_elementwise op replaying the originals bit-exactly, with a
-      shared-jax.named_scope fallback) and the inplace/donation
-      planner (PTV015 alias analysis → per-var jax.jit donation of
-      hazard-free optimizer state).
+      shared-jax.named_scope fallback), buffer reuse (liveness
+      intervals from analysis/memory.py → disjoint same-spec
+      transients renamed onto one buffer, FLAGS_buffer_reuse), and the
+      inplace/donation planner (PTV015 alias analysis → per-var
+      jax.jit donation of hazard-free optimizer state).
 
 Every rewrite must preserve bit-exact observable outputs (the parity
 sweep in tests/test_graph_passes.py), and the optimized program must
@@ -29,11 +31,12 @@ from .cse import CommonSubexprElimination
 from .dce import DeadOpElimination
 from .donation import DonationPlanner
 from .fusion import FUSABLE_OPS, ElementwiseFusionScopes
+from .reuse import BufferReuse
 
 __all__ = [
     "Pass", "PassContext", "PassManager", "default_passes",
     "optimize_program", "optimize_gate", "reset_memo",
     "DeadOpElimination", "ConstantFolding", "CommonSubexprElimination",
-    "ElementwiseFusionScopes", "DonationPlanner",
+    "ElementwiseFusionScopes", "BufferReuse", "DonationPlanner",
     "FOLDABLE_OPS", "FUSABLE_OPS",
 ]

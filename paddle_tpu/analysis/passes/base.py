@@ -55,16 +55,19 @@ def default_passes() -> List[Pass]:
     """The standard pipeline, in dependency order: DCE first (nothing
     downstream wastes work on dead ops), folding before CSE (folding
     creates identical assign_value ops CSE then merges), fusion after
-    the simplifiers (it splices the surviving chains), donation last
-    (it only annotates and must see the final op list)."""
+    the simplifiers (it splices the surviving chains), buffer reuse
+    after fusion (it must see — and rename inside — the final fused
+    slot maps), donation last (it only annotates and must see the
+    final op list)."""
     from .constant_fold import ConstantFolding
     from .cse import CommonSubexprElimination
     from .dce import DeadOpElimination
     from .donation import DonationPlanner
     from .fusion import ElementwiseFusionScopes
+    from .reuse import BufferReuse
     return [DeadOpElimination(), ConstantFolding(),
             CommonSubexprElimination(), ElementwiseFusionScopes(),
-            DonationPlanner()]
+            BufferReuse(), DonationPlanner()]
 
 
 class PassManager:
@@ -167,7 +170,9 @@ def optimize_gate(program, feed_names=None, fetch_names=None,
     level = int(FLAGS.graph_opt_level)
     if level <= 0:
         return program, None
-    key = (program.fingerprint(), level,
+    # FLAGS_buffer_reuse changes what level 2 produces, so it joins the
+    # memo key — flipping it mid-process must not serve a stale rewrite
+    key = (program.fingerprint(), level, bool(FLAGS.buffer_reuse),
            tuple(sorted(str(n) for n in (feed_names or ()))),
            tuple(str(n) for n in (fetch_names or ())))
     with _MEMO_LOCK:
@@ -176,7 +181,7 @@ def optimize_gate(program, feed_names=None, fetch_names=None,
             _OPT_MEMO.move_to_end(key)
     if hit is not None:
         return hit
-    out = PassManager().run(program, key[2], key[3], level=level)
+    out = PassManager().run(program, key[3], key[4], level=level)
     with _MEMO_LOCK:
         _OPT_MEMO[key] = out
         while len(_OPT_MEMO) > _MEMO_CAP:

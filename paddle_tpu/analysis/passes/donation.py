@@ -21,7 +21,8 @@ import numpy as np
 
 from ...core.dtypes import as_np_dtype
 from ...monitor import STAT_ADD
-from ..graph_utils import op_names, scan_block_hazards
+from ..graph_utils import (attr_read_names, op_names,
+                           scan_block_hazards)
 from .base import Pass
 
 __all__ = ["DonationPlanner"]
@@ -40,7 +41,10 @@ class DonationPlanner(Pass):
             if blk.idx == block.idx:
                 continue
             for op in blk.ops:
+                # attr-carried names (conditions, carried vars) are
+                # reads too — same rule as sub_block_read_names
                 sub_reads |= set(op_names(op, "in"))
+                sub_reads |= attr_read_names(op)
 
         plan = set()
         donated_bytes = 0

@@ -27,7 +27,7 @@ scalars but can never produce a false positive.
 """
 from __future__ import annotations
 
-from typing import Dict, Optional, Tuple
+from typing import Dict, NamedTuple, Optional, Tuple
 
 import jax
 import numpy as np
@@ -36,7 +36,41 @@ from ..core.dtypes import as_np_dtype
 from ..core.registry import REGISTRY
 from ..core import lowering
 
-Spec = Tuple[Tuple[int, ...], str]
+
+class Spec(NamedTuple):
+    """(shape, dtype_name) with -1 marking dynamic dims.
+
+    A NamedTuple so the historical plain-tuple protocol still holds —
+    `shape, dtype = spec`, equality against `(shape, dtype)`, and plain
+    tuples returned by abstract_eval rules all keep working; consumers
+    that need methods normalize with `Spec(*spec)`.
+    """
+
+    shape: Tuple[int, ...]
+    dtype: str
+
+    def nbytes(self, dyn_defaults: int = 1) -> Tuple[int, bool]:
+        """Size in bytes -> (nbytes, dynamic).
+
+        Dynamic dims (-1, or the _DYN_DIM placeholder family) are
+        substituted with `dyn_defaults` elements each, so with the
+        default of 1 the returned byte count is a documented LOWER
+        BOUND whenever `dynamic` is True. Callers doing budget math
+        (PTV050) must surface the marker instead of presenting the
+        bound as exact; resolving real feed shapes first (the memory
+        gate's seed path) clears the marker.
+        """
+        dynamic = False
+        n = 1
+        for d in self.shape:
+            d = int(d)
+            if d < 0 or d >= _DYN:
+                dynamic = True
+                d = int(dyn_defaults)
+            n *= max(d, 0)
+        itemsize = np.dtype(as_np_dtype(self.dtype)).itemsize
+        return n * itemsize, dynamic
+
 
 # Dynamic-dim placeholder shared with lowering.infer_op_shapes: dims this
 # large (or products thereof) read back as dynamic.
@@ -85,7 +119,7 @@ def declared_spec(var) -> Optional[Spec]:
     shp = getattr(var, "shape", None)
     if not shp:  # None or () — see module docstring
         return None
-    return tuple(int(d) for d in shp), str(var.dtype)
+    return Spec(tuple(int(d) for d in shp), str(var.dtype))
 
 
 def _dtype_name(dt) -> str:
@@ -127,18 +161,29 @@ def _eval_op(op, in_specs: Dict[str, Spec]) -> Dict[str, Spec]:
     specs = {}
     for name, sds in out.items():
         shape = tuple(-1 if d >= _DYN else int(d) for d in sds.shape)
-        specs[name] = (shape, _dtype_name(sds.dtype))
+        specs[name] = Spec(shape, _dtype_name(sds.dtype))
     return specs
 
 
-def infer_program_specs(program, result, check=True) -> Dict[str, Spec]:
+def infer_program_specs(program, result, check=True,
+                        seed: Optional[Dict[str, Spec]] = None
+                        ) -> Dict[str, Spec]:
     """Propagate specs through every block; append PTV020/021/022
-    findings to `result`. Returns the global block's final spec env."""
+    findings to `result`. Returns the global block's final spec env.
+
+    seed: {var name: (shape, dtype)} pre-loaded into the global block's
+    env before propagation — the memory gate seeds the concrete feed
+    shapes here so dynamic (-1/_DYN_DIM) dims resolve downstream
+    instead of poisoning size arithmetic (Spec.nbytes)."""
     envs: Dict[int, Dict[str, Spec]] = {}
     for block in program.blocks:
         parent = envs.get(block.parent_idx, {}) \
             if block.parent_idx >= 0 else {}
         env = dict(parent)
+        if block.idx == 0 and seed:
+            for name, spec in seed.items():
+                env[str(name)] = Spec(tuple(int(d) for d in spec[0]),
+                                      str(spec[1]))
         envs[block.idx] = env
         for op_idx, op in enumerate(block.ops):
             _infer_op(op, op_idx, block, env, result, check)

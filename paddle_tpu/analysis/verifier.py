@@ -21,8 +21,8 @@ from ..monitor import STAT_ADD
 from .diagnostics import VerifyResult
 from .graph_utils import (CTRL_FLOW_SUB_BLOCK as _CTRL_FLOW_SUB_BLOCK,
                           SIDE_EFFECT_OPS as _SIDE_EFFECT_OPS,
-                          attr_read_names, available_at_entry,
-                          live_op_mask, op_names as _op_names,
+                          available_at_entry, live_op_mask,
+                          op_names as _op_names, program_read_names,
                           scan_block_hazards)
 from .shape_infer import OPAQUE_OPS, declared_spec, infer_program_specs
 
@@ -214,14 +214,12 @@ def _lint_dead_ops(program, fetch_list, result):
 
 
 def _lint_unused_outputs(program, fetch_list, result):
-    reads = set(fetch_list)
-    reads |= set(program.lod_link.values())
-    for blk in program.blocks:
-        for op in blk.ops:
-            reads |= set(_op_names(op, "in"))
-            reads |= attr_read_names(
-                op, ("input_vars", "carried_vars", "condition",
-                     "output_vars"))
+    # one shared definition of "read" (graph_utils.program_read_names):
+    # op inputs + attr-carried names of EVERY block, so a var whose
+    # only reader sits in a (possibly nested) while/conditional_block
+    # sub-block counts as used — same rule the memory planner's
+    # liveness and the DCE reachability apply
+    reads = set(fetch_list) | program_read_names(program)
     for blk in program.blocks:
         for op_idx, op in enumerate(blk.ops):
             if op.type in _SIDE_EFFECT_OPS or op.type in OPAQUE_OPS:

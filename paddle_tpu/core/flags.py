@@ -284,6 +284,41 @@ DEFINE_int32(
     "the original, and it is what the executable cache is keyed on. "
     "Catalog: docs/graph_passes.md.", traced=True)
 
+DEFINE_int64(
+    "memory_budget_bytes", 0,
+    "HBM budget for the static memory gate (analysis/memory.py). 0 "
+    "(default) = auto: use the device's reported bytes_limit "
+    "(core.memory.device_memory_stats) when the backend reports one, "
+    "otherwise no budget — CPU backends report nothing, so the gate "
+    "never fires there. -1 = never apply a budget even when the device "
+    "reports a limit. Any positive value is the budget in bytes. "
+    "PTV050 fires when a program's estimated peak exceeds it, PTV051 "
+    "when one tensor alone does. Docs: docs/memory_planning.md.")
+
+DEFINE_string(
+    "memory_gate", "error",
+    "The pre-compile OOM gate (FLAGS_program_verify's sibling for the "
+    "memory band, analysis/memory.py): 'off' = skip the static memory "
+    "analysis; 'warn' = analyze once per (fingerprint, feed shapes, "
+    "fetches, budget) and surface PTV05x findings as one summarized "
+    "warning; 'error' (default) = raise ProgramVerificationError on "
+    "PTV050/PTV051 — in Executor._resolve_step BEFORE the executable "
+    "cache records a miss, and in ServingEngine.warmup before any "
+    "ladder cell compiles — so a program that cannot fit is rejected "
+    "with zero compiles attempted. Estimates with unresolved dynamic "
+    "dims are documented lower bounds and the finding says so "
+    "(Spec.nbytes). Docs: docs/memory_planning.md.")
+
+DEFINE_bool(
+    "buffer_reuse", True,
+    "Enable the buffer-reuse rewrite (analysis/passes/reuse.py) when "
+    "FLAGS_graph_opt_level >= 2: transient same-shape/dtype vars with "
+    "strictly disjoint liveness intervals collapse onto one shared "
+    "buffer (the reference framework's memory_optimize_pass), lowering "
+    "the static peak estimate the memory gate enforces. Off = level 2 "
+    "keeps fusion+donation but skips the reuse rewrite (the sweep "
+    "driver's _reuse_on/_reuse_off A/B pair).", traced=True)
+
 DEFINE_bool(
     "flight_recorder", True,
     "Keep a bounded in-memory ring of per-step flight records (step "

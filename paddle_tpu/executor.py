@@ -234,6 +234,19 @@ class Executor:
                                    where="executor")
         block = program.global_block()
 
+        # Static memory gate (FLAGS_memory_gate, default error): peak-
+        # HBM estimate of the OPTIMIZED program (so level-2 buffer
+        # reuse counts) against FLAGS_memory_budget_bytes, with dynamic
+        # dims resolved from the concrete feed shapes. An over-budget
+        # program raises PTV050/PTV051 HERE — before the cache key, so
+        # cache_stats() shows zero compiles attempted
+        # (paddle_tpu/analysis/memory.py).
+        from .analysis import memory_gate
+        memory_gate(program,
+                    feed_shapes={n: (tuple(a.shape), str(a.dtype))
+                                 for n, a in feed_arrays.items()},
+                    fetch_names=fetch_names, where="executor")
+
         key = self._cache_key(program, feed_arrays, fetch_names, compiled)
         step_fn = self._cache.get(key) if use_program_cache else None
         self._last_cache_hit = step_fn is not None

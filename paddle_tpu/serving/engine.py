@@ -183,12 +183,35 @@ class ServingEngine:
         # — compiles the optimized program without re-running a single
         # pass per cell.
         from ..analysis import optimize_gate
-        optimize_gate(self.predictor.program(),
-                      feed_names=self.predictor.get_input_names(),
-                      fetch_names=self.predictor.get_output_names(),
-                      where="serving.warmup")
+        opt_prog, _ = optimize_gate(
+            self.predictor.program(),
+            feed_names=self.predictor.get_input_names(),
+            fetch_names=self.predictor.get_output_names(),
+            where="serving.warmup")
         spec = self._feed_spec()
         shapes = self.warmup_shapes()
+        # Static memory gate over EVERY ladder cell before the first
+        # compile (FLAGS_memory_gate): the warmup budget check is the
+        # max over cells, so one oversized (batch, seq) corner rejects
+        # the whole ladder with cache_stats() still at zero misses —
+        # instead of OOMing after the smaller cells already compiled.
+        # Analyzes the optimized program (level-2 buffer reuse counts);
+        # the per-cell plans are memoized, so the executor's own gate
+        # hits the same entries during the warm loop below.
+        from ..analysis import memory_gate
+        for bb, sb in shapes:
+            cell = {}
+            for name, (per_example, dtype) in spec.items():
+                dims = [bb] + [sb if d is None else d
+                               for d in per_example]
+                if any(d is None for d in dims):
+                    raise ValueError(
+                        f"feed {name!r} has a seq dim but the ladder "
+                        f"has no seq_buckets")
+                cell[name] = (tuple(dims), dtype)
+            memory_gate(opt_prog, feed_shapes=cell,
+                        fetch_names=self.predictor.get_output_names(),
+                        where="serving.warmup")
         for bb, sb in shapes:
             feed = {}
             for name, (per_example, dtype) in spec.items():

@@ -1,0 +1,558 @@
+"""Static memory planner (paddle_tpu/analysis/memory): golden liveness
+fixtures, PTV050-052 budget findings, the FLAGS_memory_gate pre-compile
+gate in Executor and ServingEngine.warmup, the level-2 buffer-reuse
+rewrite (bit-exact + lower estimated peak), optimizer-sink scheduling,
+estimator-vs-measured calibration on the bench builders, and the
+memory_plan artifact schema + CLI.
+
+Model and consumers: docs/memory_planning.md.
+"""
+from __future__ import annotations
+
+import io
+import json
+import os
+import subprocess
+import sys
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.analysis import (ProgramVerificationError,
+                                 verify_program)
+from paddle_tpu.analysis import memory as memory_mod
+from paddle_tpu.analysis.memory import (analyze_program_memory,
+                                        apply_state_update_sinks,
+                                        memory_gate,
+                                        state_update_sinks)
+from paddle_tpu.analysis.passes import optimize_program
+from paddle_tpu.analysis.passes import reset_memo as reset_opt_memo
+from paddle_tpu.analysis.shape_infer import Spec
+from paddle_tpu.framework import Operator
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_F32_23 = dict(shape=[2, 3], dtype="float32")
+
+
+def _tools(module):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        return __import__(module)
+    finally:
+        sys.path.pop(0)
+
+
+def _raw_program(var_specs, op_specs):
+    prog = fluid.Program()
+    blk = prog.global_block()
+    for name, kw in var_specs:
+        blk.create_var(name=name, **kw)
+    for op_type, ins, outs, attrs in op_specs:
+        blk.ops.append(Operator(blk, op_type, ins, outs, attrs))
+    return prog
+
+
+def _flags(**kv):
+    """Set flags, return the previous values for the finally-restore."""
+    prev = {k: getattr(fluid.FLAGS, k[len("FLAGS_"):]) for k in kv}
+    fluid.set_flags(kv)
+    return prev
+
+
+def _tiny_builds():
+    sys.path.insert(0, REPO)
+    os.environ.setdefault("BENCH_FLASH", "0")
+    import bench
+    return bench._CPU_TINY_BUILDS
+
+
+# ---------------------------------------------------------------------------
+# golden liveness fixtures
+# ---------------------------------------------------------------------------
+
+def test_golden_intervals_on_a_chain():
+    """relu chain x -> a -> b -> out: transients live [def, last read],
+    feeds/fetches pin for the whole program, and the peak lands on the
+    op where both temporaries are resident."""
+    prog = _raw_program(
+        [("x", dict(is_data=True, **_F32_23)), ("a", dict(**_F32_23)),
+         ("b", dict(**_F32_23)), ("out", dict(**_F32_23))],
+        [("relu", {"X": ["x"]}, {"Out": ["a"]}, {}),
+         ("relu", {"X": ["a"]}, {"Out": ["b"]}, {}),
+         ("relu", {"X": ["b"]}, {"Out": ["out"]}, {})])
+    plan = analyze_program_memory(prog, feed_names=["x"],
+                                  fetch_names=["out"])
+    a, b = plan.intervals["a"], plan.intervals["b"]
+    assert (a.def_idx, a.last_use) == (0, 1)
+    assert (b.def_idx, b.last_use) == (1, 2)
+    assert a.nbytes == b.nbytes == 2 * 3 * 4
+    assert plan.intervals["x"].pinned and plan.intervals["out"].pinned
+    assert plan.pinned_bytes == 2 * 24
+    # timeline: op0 = pinned+a, op1 = pinned+a+b (peak), op2 = pinned+b
+    assert plan.timeline == [72, 96, 72]
+    assert plan.peak_bytes == 96 and plan.peak_op == "relu:0/1"
+    assert not plan.dynamic and plan.unsized_vars == 0
+    # b is defined by the op that last reads a -> in-place reuse pair
+    assert plan.reuse_bytes_available == 24
+
+
+def test_spec_nbytes_units_and_dynamic_lower_bound():
+    assert Spec((2, 3), "float32").nbytes() == (24, False)
+    assert Spec((4,), "int64").nbytes() == (32, False)
+    # dynamic dims size at dyn_defaults each and set the marker
+    assert Spec((-1, 4), "float32").nbytes() == (16, True)
+    assert Spec((-1, 4), "float32").nbytes(dyn_defaults=8) == (128, True)
+
+
+def test_dynamic_dims_resolve_from_feed_shapes():
+    prog = _raw_program(
+        [("x", dict(is_data=True, shape=[-1, 4], dtype="float32")),
+         ("y", dict(shape=[-1, 4], dtype="float32"))],
+        [("relu", {"X": ["x"]}, {"Out": ["y"]}, {})])
+    # without concrete shapes the plan is a marked lower bound ...
+    plan = analyze_program_memory(prog, feed_names=["x"],
+                                  fetch_names=["y"])
+    assert plan.dynamic
+    # ... with the gate's feed-shape seed it is exact
+    plan2 = analyze_program_memory(
+        prog, fetch_names=["y"],
+        feed_shapes={"x": ((8, 4), "float32")})
+    assert not plan2.dynamic
+    assert plan2.intervals["x"].nbytes == 8 * 4 * 4
+
+
+def test_sub_block_read_extends_liveness():
+    """A var read only inside a control-flow op's sub-block stays live
+    up to that op's index (same rule as PTV012/PTV013 and DCE)."""
+    prog = fluid.Program()
+    blk = prog.global_block()
+    blk.create_var(name="x", is_data=True, **_F32_23)
+    blk.create_var(name="t", **_F32_23)
+    blk.create_var(name="cond", shape=[1], dtype="bool")
+    blk.create_var(name="cb_out", **_F32_23)
+    blk.ops.append(Operator(blk, "relu", {"X": ["x"]}, {"Out": ["t"]}, {}))
+    sub = prog._create_block()
+    sub.create_var(name="cb_out", **_F32_23)
+    sub.ops.append(Operator(sub, "scale", {"X": ["t"]},
+                            {"Out": ["cb_out"]}, {"scale": 2.0}))
+    prog._rollback()
+    blk.ops.append(Operator(
+        blk, "conditional_block", {"Cond": ["cond"], "Input": ["x"]},
+        {"Out": ["cb_out"]},
+        {"sub_block": sub.idx, "input_vars": ["x"],
+         "output_vars": ["cb_out"]}))
+    plan = analyze_program_memory(prog, feed_names=["x", "cond"],
+                                  fetch_names=["cb_out"])
+    # t is read by the sub-block only: live through the ctrl-flow op
+    assert plan.intervals["t"].last_use == 1
+    # satellite regression: the sub-block read also keeps PTV013 quiet
+    prog2 = _raw_program(
+        [("a", dict(is_data=True, **_F32_23)), ("b", dict(**_F32_23)),
+         ("xs", dict(**_F32_23)), ("cond", dict(shape=[1],
+                                                dtype="bool")),
+         ("cb", dict(**_F32_23))],
+        [("reshape2", {"X": ["a"]}, {"Out": ["b"], "XShape": ["xs"]},
+          {"shape": [2, 3]})])
+    sub2 = prog2._create_block()
+    sub2.create_var(name="cb", **_F32_23)
+    sub2.ops.append(Operator(sub2, "scale", {"X": ["xs"]},
+                             {"Out": ["cb"]}, {"scale": 1.0}))
+    prog2._rollback()
+    prog2.global_block().ops.append(Operator(
+        prog2.global_block(), "conditional_block",
+        {"Cond": ["cond"], "Input": ["b"]}, {"Out": ["cb"]},
+        {"sub_block": sub2.idx, "input_vars": ["b"],
+         "output_vars": ["cb"]}))
+    res = verify_program(prog2, fetch_names=["cb"], check_shapes=False)
+    assert not [d for d in res.findings
+                if d.rule == "PTV013" and d.var == "xs"]
+
+
+# ---------------------------------------------------------------------------
+# budget findings
+# ---------------------------------------------------------------------------
+
+def _mib_chain():
+    """Four 1-MiB relu stages: peak ~3 MiB over 2 MiB pinned; a and c
+    are strictly disjoint same-spec temporaries."""
+    spec = dict(shape=[512, 512], dtype="float32")
+    return _raw_program(
+        [("x", dict(is_data=True, **spec)), ("a", dict(**spec)),
+         ("b", dict(**spec)), ("c", dict(**spec)),
+         ("out", dict(**spec))],
+        [("relu", {"X": ["x"]}, {"Out": ["a"]}, {}),
+         ("relu", {"X": ["a"]}, {"Out": ["b"]}, {}),
+         ("relu", {"X": ["b"]}, {"Out": ["c"]}, {}),
+         ("relu", {"X": ["c"]}, {"Out": ["out"]}, {})])
+
+
+def test_ptv050_peak_over_budget():
+    plan = analyze_program_memory(_mib_chain(), feed_names=["x"],
+                                  fetch_names=["out"],
+                                  budget_bytes=2 << 20)
+    res = plan.findings()
+    hits = [d for d in res.findings if d.rule == "PTV050"]
+    assert hits and hits[0].severity == "error"
+    assert "exceeds" in hits[0].message \
+        and "FLAGS_memory_budget_bytes" in hits[0].message
+    assert res.errors()
+
+
+def test_ptv051_single_tensor_over_budget():
+    plan = analyze_program_memory(_mib_chain(), feed_names=["x"],
+                                  fetch_names=["out"],
+                                  budget_bytes=512 << 10)
+    hits = [d for d in plan.findings().findings if d.rule == "PTV051"]
+    assert hits and all(d.severity == "error" for d in hits)
+    assert any(d.var == "a" for d in hits)
+    assert "no buffer plan can fit it" in hits[0].message
+
+
+def test_ptv052_reuse_advisory_without_budget():
+    """>=1 MiB and >=5% of peak reusable fires the advisory even with
+    no budget configured."""
+    plan = analyze_program_memory(_mib_chain(), feed_names=["x"],
+                                  fetch_names=["out"])
+    assert plan.reuse_bytes_available >= 1 << 20
+    hits = [d for d in plan.findings().findings if d.rule == "PTV052"]
+    assert hits and hits[0].severity == "warn"
+    assert "FLAGS_buffer_reuse" in hits[0].message
+    # under budget, no PTV050/051
+    assert {d.rule for d in plan.findings().findings} == {"PTV052"}
+
+
+# ---------------------------------------------------------------------------
+# the pre-compile gate
+# ---------------------------------------------------------------------------
+
+def test_executor_gate_rejects_over_budget_before_compile():
+    memory_mod.reset_memo()
+    prev = _flags(FLAGS_memory_budget_bytes=4096,
+                  FLAGS_memory_gate="error")
+    try:
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = layers.data(name="x", shape=[256], dtype="float32")
+            y = layers.relu(layers.scale(x, scale=2.0))
+        exe = fluid.Executor()
+        with fluid.scope_guard(fluid.Scope()):
+            with pytest.raises(ProgramVerificationError) as ei:
+                exe.run(main, feed={"x": np.ones((64, 256), np.float32)},
+                        fetch_list=[y.name])
+        msg = str(ei.value)
+        assert "PTV050" in msg and "budget" in msg
+        # rejected BEFORE the executable-cache key: zero compiles
+        stats = exe.cache_stats()
+        assert stats["misses"] == 0 and stats["size"] == 0, stats
+    finally:
+        _flags(**prev)
+        memory_mod.reset_memo()
+
+
+def test_gate_warn_mode_warns_once_then_memoizes():
+    memory_mod.reset_memo()
+    prev = _flags(FLAGS_memory_budget_bytes=4096,
+                  FLAGS_memory_gate="warn")
+    try:
+        prog = _mib_chain()
+        shapes = {"x": ((512, 512), "float32")}
+        with pytest.warns(UserWarning, match="PTV050"):
+            plan = memory_gate(prog, feed_shapes=shapes,
+                               fetch_names=["out"], where="test")
+        assert plan is not None and plan.peak_bytes > 4096
+        with warnings.catch_warnings(record=True) as rec:
+            warnings.simplefilter("always")
+            memory_gate(prog, feed_shapes=shapes, fetch_names=["out"],
+                        where="test")
+        assert not [w for w in rec if "PTV" in str(w.message)], \
+            [str(w.message) for w in rec]
+    finally:
+        _flags(**prev)
+        memory_mod.reset_memo()
+
+
+def test_gate_off_mode_and_bad_value():
+    memory_mod.reset_memo()
+    prev = _flags(FLAGS_memory_gate="off")
+    try:
+        assert memory_gate(_mib_chain(), fetch_names=["out"]) is None
+        fluid.set_flags({"FLAGS_memory_gate": "everything"})
+        with pytest.raises(ValueError, match="memory_gate"):
+            memory_gate(_mib_chain(), fetch_names=["out"])
+    finally:
+        _flags(**prev)
+        memory_mod.reset_memo()
+
+
+def test_serving_warmup_gate_rejects_over_budget(tmp_path):
+    """An over-budget model is rejected during warmup as the max over
+    ladder cells — with zero ladder-cell compiles spent."""
+    from paddle_tpu.serving import EngineConfig, ServingEngine
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[64], dtype="float32")
+        out = layers.fc(x, size=64, act="relu")
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        mdir = str(tmp_path / "model")
+        fluid.io.save_inference_model(mdir, ["x"], [out], exe,
+                                      main_program=main)
+    memory_mod.reset_memo()
+    prev = _flags(FLAGS_memory_budget_bytes=1024,
+                  FLAGS_memory_gate="error")
+    try:
+        engine = ServingEngine(EngineConfig(model_dir=mdir,
+                                            max_batch_size=4,
+                                            warmup=True))
+        with pytest.raises(ProgramVerificationError, match="PTV050"):
+            engine.start()
+        assert engine.cache_stats()["misses"] == 0
+    finally:
+        _flags(**prev)
+        memory_mod.reset_memo()
+
+
+# ---------------------------------------------------------------------------
+# optimizer-sink scheduling
+# ---------------------------------------------------------------------------
+
+def _sgd_fixture(reader_of=None):
+    specs = [("x", dict(is_data=True, **_F32_23)),
+             ("w", dict(persistable=True, **_F32_23)),
+             ("g", dict(**_F32_23)), ("t", dict(**_F32_23)),
+             ("lr", dict(persistable=True, shape=[1],
+                         dtype="float32"))]
+    ops = [("relu", {"X": ["x"]}, {"Out": ["g"]}, {}),
+           ("relu", {"X": [reader_of or "x"]}, {"Out": ["t"]}, {}),
+           ("sgd", {"Param": ["w"], "Grad": ["g"],
+                    "LearningRate": ["lr"]}, {"ParamOut": ["w"]}, {})]
+    return _raw_program(specs, ops)
+
+
+def test_state_update_sinks_past_independent_ops():
+    prog = _sgd_fixture()
+    moves = state_update_sinks(prog)
+    # the sgd can run right after its gradient producer
+    assert moves == {2: 1}
+    assert apply_state_update_sinks(prog) == 1
+    assert [op.type for op in prog.global_block().ops] == \
+        ["relu", "sgd", "relu"]
+    # sunk schedule still verifies clean
+    res = verify_program(prog, feed_names=["x"], fetch_names=["t"],
+                         check_shapes=False)
+    assert not res.errors()
+
+
+def test_state_update_sinks_respects_readers_of_the_param():
+    # op1 reads w -> sinking the sgd above it would reorder a RAW
+    prog = _sgd_fixture(reader_of="w")
+    assert state_update_sinks(prog) == {}
+
+
+def test_state_update_sink_shortens_gradient_lifetime():
+    prog = _sgd_fixture()
+    before = analyze_program_memory(prog, feed_names=["x"],
+                                    fetch_names=["t"])
+    assert before.intervals["g"].last_use == 2
+    apply_state_update_sinks(prog)
+    after = analyze_program_memory(prog, feed_names=["x"],
+                                   fetch_names=["t"])
+    assert after.intervals["g"].last_use == 1
+
+
+# ---------------------------------------------------------------------------
+# buffer reuse: bit-exact + lower estimated peak on a bench builder
+# ---------------------------------------------------------------------------
+
+def _builder_losses(build, level, steps=2, reuse=True):
+    prev = _flags(FLAGS_graph_opt_level=level,
+                  FLAGS_buffer_reuse=reuse)
+    reset_opt_memo()
+    try:
+        exe, prog, scope, feed, loss, _cfg = build()
+        out = []
+        with fluid.scope_guard(scope):
+            for _ in range(steps):
+                lv, = exe.run(prog, feed=feed, fetch_list=[loss])
+                out.append(np.asarray(lv))
+        exe.close()
+        return out
+    finally:
+        _flags(**prev)
+        reset_opt_memo()
+
+
+def test_reuse_pass_bit_exact_and_lowers_estimated_peak():
+    """Acceptance: on the bert builder the level-2 pipeline with buffer
+    reuse is bit-exact vs level 0, and the pass itself reports a lower
+    estimated peak. (The reuse-off arm and the other builders ride in
+    the slow parity sweep below.)"""
+    build = _tiny_builds()["bert"]
+    l0 = _builder_losses(build, 0)
+    l2_on = _builder_losses(build, 2, reuse=True)
+    for a, b in zip(l0, l2_on):
+        assert np.array_equal(a, b), (l0, l2_on)
+
+    exe, prog, scope, feed, loss, _cfg = build()
+    exe.close()
+    prev = _flags(FLAGS_buffer_reuse=True)
+    try:
+        _, report = optimize_program(prog, feed_names=list(feed),
+                                     fetch_names=[loss.name], level=2)
+    finally:
+        _flags(**prev)
+    assert not report.get("rejected"), report
+    entry = next(p for p in report["passes"]
+                 if p["name"] == "buffer_reuse")
+    assert entry["reused_vars"] > 0 and entry["sunk_updates"] > 0
+    assert entry["est_peak_bytes"] < entry["est_peak_before"], entry
+
+
+def test_reuse_pass_disabled_by_flag():
+    prog = _mib_chain()
+    prev = _flags(FLAGS_buffer_reuse=False)
+    try:
+        opt, report = optimize_program(prog, feed_names=["x"],
+                                       fetch_names=["out"], level=2)
+    finally:
+        _flags(**prev)
+    entry = next(p for p in report["passes"]
+                 if p["name"] == "buffer_reuse")
+    assert entry.get("disabled") and entry["reused_vars"] == 0
+
+
+# ---------------------------------------------------------------------------
+# estimator calibration vs the compiled executable
+# ---------------------------------------------------------------------------
+
+def _calibrate(model, lo, hi):
+    import jax.numpy as jnp
+    build = _tiny_builds()[model]
+    exe, prog, scope, feed, loss, _cfg = build()
+    with fluid.scope_guard(scope):
+        step_fn, state, feed_arrays = exe._resolve_step(
+            prog, feed, [loss.name], scope, None)
+        compiled = step_fn.fn.lower(state, feed_arrays,
+                                    jnp.uint32(0)).compile()
+        try:
+            ma = compiled.memory_analysis()
+        except Exception as e:  # pragma: no cover - backend-dependent
+            pytest.skip(f"no memory_analysis on this backend: {e}")
+    exe.close()
+    measured = ma.temp_size_in_bytes + ma.argument_size_in_bytes
+    plan = analyze_program_memory(
+        prog, feed_names=sorted(feed), fetch_names=[loss.name],
+        feed_shapes={k: (tuple(v.shape), str(v.dtype))
+                     for k, v in feed.items()})
+    assert not plan.dynamic
+    ratio = plan.peak_bytes / max(measured, 1)
+    assert lo <= ratio <= hi, (model, plan.peak_bytes, measured, ratio)
+
+
+def test_estimated_peak_calibrates_against_xla_on_bert():
+    """Acceptance: the static estimate tracks what XLA actually
+    allocates (temp + argument buffers) for the compiled train step."""
+    _calibrate("bert", 0.5, 2.0)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("model", ["resnet50", "gpt", "transformer",
+                                   "deeplab"])
+def test_estimated_peak_calibrates_on_all_builders(model):
+    _calibrate(model, 1 / 3, 3.0)
+
+
+@pytest.mark.slow
+def test_self_check_memory_full_sweep_exits_zero():
+    """--self-check-memory sweeps the planner over every bench builder
+    and the whole op matrix (minutes; the fast sampled smoke rides in
+    --self-check, covered by test_analysis.py)."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "program_lint.py"),
+         "--self-check-memory"],
+        capture_output=True, text=True, timeout=580,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "builders" in r.stdout and "self-check ok" in r.stdout
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("model", ["bert", "resnet50", "gpt",
+                                   "transformer", "deeplab"])
+def test_reuse_parity_on_all_builders(model):
+    build = _tiny_builds()[model]
+    base = _builder_losses(build, 0)
+    for reuse in (True, False):
+        got = _builder_losses(build, 2, reuse=reuse)
+        for a, b in zip(base, got):
+            assert np.array_equal(a, b), (model, reuse, base, got)
+
+
+# ---------------------------------------------------------------------------
+# artifact schema + CLI
+# ---------------------------------------------------------------------------
+
+def test_memory_plan_record_schema():
+    validate = _tools("validate_bench_json").validate_memory_plan
+    plan = analyze_program_memory(_mib_chain(), feed_names=["x"],
+                                  fetch_names=["out"],
+                                  budget_bytes=2 << 20)
+    good = plan.to_record(model="mib_chain")
+    assert validate(good, where="t") == []
+    assert good["est_peak_bytes"] >= good["pinned_bytes"]
+    assert any(f["rule"] == "PTV050" for f in good["findings"])
+    # invariants the validator must hold
+    assert validate({"kind": "memory_plan"}, where="t")  # all missing
+    shrunk = dict(good, est_peak_bytes=good["pinned_bytes"] - 1)
+    assert any("pinned" in e for e in validate(shrunk, where="t"))
+    assert validate(dict(good, ops=True), where="t")  # bool is not int
+
+
+def test_program_lint_memory_cli_end_to_end(tmp_path):
+    """--memory emits a kind="memory_plan" record that the artifact
+    validator accepts and metrics_report renders."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[8], dtype="float32")
+        out = layers.softmax(layers.fc(x, size=4))
+    exe = fluid.Executor()
+    model_dir = str(tmp_path / "model")
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        fluid.io.save_inference_model(model_dir, ["x"], [out], exe,
+                                      main_program=main)
+    log = str(tmp_path / "lint.jsonl")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "program_lint.py"),
+         model_dir, "--memory", "--jsonl", "--out", log],
+        capture_output=True, text=True, timeout=300,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert r.returncode == 0, r.stdout + r.stderr
+    recs = [json.loads(ln) for ln in r.stdout.splitlines() if ln.strip()]
+    kinds = [rec["kind"] for rec in recs]
+    assert kinds == ["program_lint", "memory_plan"]
+    mem = recs[1]
+    assert mem["est_peak_bytes"] >= mem["pinned_bytes"] > 0
+    assert mem["ops"] > 0 and mem["top_residents"]
+    # --budget drives PTV050 and the exit code
+    r2 = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "program_lint.py"),
+         model_dir, "--memory", "--budget", "64", "--jsonl"],
+        capture_output=True, text=True, timeout=300,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert r2.returncode == 1, r2.stdout + r2.stderr
+    mem2 = [json.loads(ln) for ln in r2.stdout.splitlines()
+            if ln.strip()][1]
+    assert any(f["rule"] == "PTV050" for f in mem2["findings"])
+    # schema + rendering
+    assert _tools("validate_bench_json").validate_file(log) == []
+    buf = io.StringIO()
+    rc = _tools("metrics_report").report(log, out=buf)
+    text = buf.getvalue()
+    assert rc == 0 and "-- memory" in text and "est_peak=" in text

@@ -42,7 +42,7 @@ def _fmt_bytes(v):
 def load(path):
     snapshots, results, op_profiles = [], [], []
     loadgens, lints, graph_opts = [], [], []
-    gen_loadgens, chaos_loadgens = [], []
+    gen_loadgens, chaos_loadgens, memory_plans = [], [], []
     with open(path) as f:
         for ln, line in enumerate(f, 1):
             line = line.strip()
@@ -71,8 +71,10 @@ def load(path):
                 lints.append(rec)
             elif kind == "graph_opt":
                 graph_opts.append(rec)
+            elif kind == "memory_plan":
+                memory_plans.append(rec)
     return (snapshots, results, op_profiles, loadgens, lints,
-            graph_opts, gen_loadgens, chaos_loadgens)
+            graph_opts, gen_loadgens, chaos_loadgens, memory_plans)
 
 
 def _hist(snap, name):
@@ -81,12 +83,13 @@ def _hist(snap, name):
 
 def report(path, out=sys.stdout):
     (snapshots, results, op_profiles, loadgens, lints,
-     graph_opts, gen_loadgens, chaos_loadgens) = load(path)
+     graph_opts, gen_loadgens, chaos_loadgens, memory_plans) = load(path)
     w = out.write
     w(f"runtime stats report — {path}\n")
     if not snapshots and not results and not op_profiles \
             and not loadgens and not lints and not graph_opts \
-            and not gen_loadgens and not chaos_loadgens:
+            and not gen_loadgens and not chaos_loadgens \
+            and not memory_plans:
         w("no snapshots or bench results found\n")
         return 1
     w(f"snapshots: {len(snapshots)}   bench results: {len(results)}\n")
@@ -368,6 +371,29 @@ def report(path, out=sys.stdout):
                 w(f"  {p.get('name', '?'):<16s} "
                   f"{p.get('ops_before', 0):>5d} -> "
                   f"{p.get('ops_after', 0):<5d} {detail}\n")
+
+    if memory_plans:
+        # one record per analyzed model (tools/program_lint.py --memory
+        # --out, or bench.py's est_peak_bytes calibration rows)
+        w("\n-- memory (analysis/memory, docs/memory_planning.md) --\n")
+        for r in memory_plans:
+            dyn = " (lower bound)" if r.get("dynamic") else ""
+            bud = f"  budget={_fmt_bytes(r['budget_bytes'])}" \
+                if r.get("budget_bytes") else ""
+            w(f"mem  {r.get('model', '?'):40s} est_peak="
+              f"{_fmt_bytes(r.get('est_peak_bytes', 0))}{dyn} at "
+              f"{r.get('peak_op', '?')}  pinned="
+              f"{_fmt_bytes(r.get('pinned_bytes', 0))}  "
+              f"reuse_available="
+              f"{_fmt_bytes(r.get('reuse_bytes_available', 0))}{bud}\n")
+            for iv in r.get("top_residents", [])[:5]:
+                span = "pinned" if iv.get("pinned") \
+                    else f"[{iv.get('def')}, {iv.get('last_use')}]"
+                w(f"  {iv.get('name', '?'):<40s} "
+                  f"{_fmt_bytes(iv.get('nbytes', 0)):>10s}  {span}\n")
+            for f in r.get("findings", []):
+                w(f"  {f.get('rule', '?')} {f.get('severity', '?'):5s}: "
+                  f"{f.get('message', '')}\n")
 
     if results:
         w("\n-- bench results --\n")

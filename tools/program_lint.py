@@ -23,12 +23,29 @@ Options:
                   a pass-by-pass table (op count before/after, vars
                   eliminated, constants folded); emits one extra
                   kind="graph_opt" JSONL record per model
-  --opt-level N   pipeline level for --optimize (default 2 = all five
+  --opt-level N   pipeline level for --optimize (default 2 = all six
                   passes; matches FLAGS_graph_opt_level semantics)
+  --memory        additionally run the static memory planner
+                  (paddle_tpu/analysis/memory) on each model and print
+                  the timeline table — estimated peak + its op, the
+                  top-10 resident tensors there, and available reuse
+                  savings; emits one extra kind="memory_plan" JSONL
+                  record per model
+  --budget BYTES  memory budget for --memory's PTV050/051 findings
+                  (default: FLAGS_memory_budget_bytes semantics — 0
+                  auto-detects from the device, which on CPU means no
+                  budget)
   --self-check    lint two bundled in-process example programs (one
-                  known-good, one with seeded defects) and exit 0 iff
-                  the verifier classifies both correctly — the repo's
-                  CI self-lint
+                  known-good, one with seeded defects), then run the
+                  memory planner over a fixed sample of OP_TEST_MATRIX
+                  pass ops (must not crash, must not raise PTV050 at
+                  the default budget) — the repo's CI self-lint,
+                  seconds-scale
+  --self-check-memory
+                  the same, but the planner sweeps EVERY tiny bench
+                  builder and ALL matrix pass ops — minutes of work
+                  (builder startup compiles); the slow-tier planner
+                  coverage gate
 
 Exit codes: 0 = no error findings (no warnings either under --strict),
 1 = findings, 2 = usage / unreadable model.
@@ -113,6 +130,51 @@ def optimize_path(path, level=2):
     return rec
 
 
+def memory_path(path, budget=None):
+    """Run the static memory planner on one model path ->
+    kind="memory_plan" record (MemoryPlan.to_record plus model)."""
+    from paddle_tpu.analysis import analyze_program_memory
+    from paddle_tpu.analysis.memory import resolve_budget_bytes
+    from paddle_tpu.framework import Program
+
+    prog_dict, feeds, fetches, label = _load_program_dict(path)
+    prog_dict = dict(prog_dict)
+    prog_dict.pop("op_versions", None)
+    program = Program.from_dict(dict(prog_dict, op_versions={}))
+    if budget is None:
+        budget = resolve_budget_bytes()
+    plan = analyze_program_memory(program, feed_names=feeds,
+                                  fetch_names=fetches,
+                                  budget_bytes=budget)
+    return plan.to_record(model=label)
+
+
+def _print_memory_text(rec, out=sys.stdout):
+    from paddle_tpu.analysis.memory import _fmt_bytes
+    dyn = " (lower bound: dynamic dims)" if rec["dynamic"] else ""
+    bud = f"  budget={_fmt_bytes(rec['budget_bytes'])}" \
+        if rec["budget_bytes"] else ""
+    out.write(f"mem {rec['model']}  est_peak="
+              f"{_fmt_bytes(rec['est_peak_bytes'])}{dyn} at "
+              f"{rec['peak_op']}  pinned="
+              f"{_fmt_bytes(rec['pinned_bytes'])}  "
+              f"reuse_available="
+              f"{_fmt_bytes(rec['reuse_bytes_available'])}{bud}\n")
+    if rec["unsized_vars"]:
+        out.write(f"  ({rec['unsized_vars']} var(s) without a spec — "
+                  f"not counted)\n")
+    out.write(f"  {'resident @ peak':<40s} {'bytes':>12s}  interval\n")
+    for iv in rec["top_residents"]:
+        span = "pinned" if iv["pinned"] \
+            else f"[{iv['def']}, {iv['last_use']}]"
+        dynm = "≥" if iv["dynamic"] else " "
+        out.write(f"  {iv['name']:<40s} {dynm}{iv['nbytes']:>11d}  "
+                  f"{span}\n")
+    for f in rec["findings"]:
+        out.write(f"  {f['rule']} {f['severity']:5s}: "
+                  f"{f['message']}\n")
+
+
 def _print_opt_text(rec, out=sys.stdout):
     status = "REJECTED" if rec.get("rejected") else "opt"
     out.write(f"{status} {rec['model']}  level={rec['opt_level']}  "
@@ -141,9 +203,15 @@ def _print_text(rec, out=sys.stdout):
                   f"{var}: {f['message']}\n")
 
 
-def self_check() -> int:
+def self_check(full_memory: bool = False) -> int:
     """Build one known-good and one seeded-defect program in process and
-    verify the classifier gets both right. The repo CI runs this."""
+    verify the classifier gets both right. The repo CI runs this.
+
+    full_memory=True (--self-check-memory) additionally sweeps the
+    static memory planner over every tiny bench builder and every
+    OP_TEST_MATRIX pass op (minutes of work — builder startup compiles
+    plus ~340 abstract evaluations); the default self-check keeps a
+    seconds-scale planner smoke over a fixed op sample instead."""
     from paddle_tpu import Program, program_guard, layers
     from paddle_tpu.analysis import verify_program
     from paddle_tpu.framework import Operator
@@ -182,8 +250,94 @@ def self_check() -> int:
         print(f"self-check FAILED: seeded defects {sorted(want - got)} "
               f"not detected (got {sorted(got)})", file=sys.stderr)
         return 1
+    rc = _self_check_memory(full=full_memory)
+    if rc:
+        return rc
     print(f"self-check ok: clean program clean, seeded defects "
-          f"{sorted(want)} all detected")
+          f"{sorted(want)} all detected, memory planner clean on "
+          + ("all bench builders and matrix ops" if full_memory
+             else "the matrix-op sample"))
+    return 0
+
+
+# Fixed op sample for the default self-check's planner smoke: one-op
+# programs for every sampled op analyze in a couple of seconds, while
+# the full matrix (+ builder startup compiles) is minutes of work and
+# lives behind --self-check-memory.
+_MEMORY_SMOKE_SAMPLE = 24
+
+
+def _self_check_memory(full: bool = False) -> int:
+    """Run the static memory planner over OP_TEST_MATRIX pass ops (a
+    fixed sample by default, all of them plus every tiny bench builder
+    with full=True): the analysis must not crash and must not produce
+    PTV050 at the default (auto) budget."""
+    from paddle_tpu.analysis import analyze_program_memory
+    from paddle_tpu.analysis.memory import resolve_budget_bytes
+
+    budget = resolve_budget_bytes()
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, repo)
+    os.environ.setdefault("BENCH_FLASH", "0")
+
+    n_builders = 0
+    if full:
+        import bench
+        n_builders = len(bench._CPU_TINY_BUILDS)
+        for model, build in bench._CPU_TINY_BUILDS.items():
+            try:
+                exe, prog, scope, feed, loss, cfg = build()
+                plan = analyze_program_memory(
+                    prog, feed_names=sorted(feed),
+                    fetch_names=[loss.name],
+                    feed_shapes={n: (tuple(a.shape), str(a.dtype))
+                                 for n, a in feed.items()},
+                    budget_bytes=budget)
+            except Exception as e:  # noqa: BLE001 — classify
+                print(f"self-check FAILED: memory planner crashed on "
+                      f"builder {model!r}: {type(e).__name__}: {e}",
+                      file=sys.stderr)
+                return 1
+            rules = {d.rule for d in plan.findings().findings}
+            if "PTV050" in rules:
+                print(f"self-check FAILED: builder {model!r} over the "
+                      f"default budget ({budget}B): peak "
+                      f"{plan.peak_bytes}B", file=sys.stderr)
+                return 1
+
+    sys.path.insert(0, os.path.join(repo, "tests"))
+    from op_specs import SKIPS, SPECS
+    import test_op_sweep as sweep
+    matrix = json.load(open(os.path.join(repo, "OP_TEST_MATRIX.json")))
+    ops = [op for op, rec in matrix["ops"].items()
+           if rec.get("status") == "pass"
+           and op in SPECS and op not in SKIPS]
+    if not full:
+        # deterministic spread over the sorted op list
+        ops = sorted(ops)
+        step = max(len(ops) // _MEMORY_SMOKE_SAMPLE, 1)
+        ops = ops[::step][:_MEMORY_SMOKE_SAMPLE]
+    for op in ops:
+        try:
+            main, feeds, out_map, _direct, _ = sweep._build_program(
+                op, SPECS[op])
+            fetch = [nm for names in out_map.values() for nm in names]
+            plan = analyze_program_memory(main, feed_names=list(feeds),
+                                          fetch_names=fetch,
+                                          budget_bytes=budget)
+        except Exception as e:  # noqa: BLE001
+            print(f"self-check FAILED: memory planner crashed on op "
+                  f"{op!r}: {type(e).__name__}: {e}", file=sys.stderr)
+            return 1
+        rules = {d.rule for d in plan.findings().findings}
+        if "PTV050" in rules:
+            print(f"self-check FAILED: one-op program for {op!r} over "
+                  f"the default budget", file=sys.stderr)
+            return 1
+    scope_txt = (f"{n_builders} builders + {len(ops)} matrix ops"
+                 if full else f"{len(ops)} sampled matrix ops")
+    print(f"memory planner: {scope_txt} analyzed, no crashes, "
+          f"no PTV050")
     return 0
 
 
@@ -192,6 +346,8 @@ def main(argv=None):
     if not argv or argv[0] in ("-h", "--help"):
         print(__doc__)
         return 0 if argv else 2
+    if "--self-check-memory" in argv:
+        return self_check(full_memory=True)
     if "--self-check" in argv:
         return self_check()
 
@@ -199,7 +355,9 @@ def main(argv=None):
     strict = "--strict" in argv
     check_shapes = "--no-shapes" not in argv
     optimize = "--optimize" in argv
+    memory = "--memory" in argv
     opt_level = 2
+    budget = None
     out_path = None
     paths = []
     it = iter(argv)
@@ -216,7 +374,16 @@ def main(argv=None):
             except (TypeError, ValueError):
                 print("--opt-level needs an integer", file=sys.stderr)
                 return 2
-        elif a in ("--jsonl", "--strict", "--no-shapes", "--optimize"):
+        elif a == "--budget":
+            b = next(it, None)
+            try:
+                budget = int(b)
+            except (TypeError, ValueError):
+                print("--budget needs an integer byte count",
+                      file=sys.stderr)
+                return 2
+        elif a in ("--jsonl", "--strict", "--no-shapes", "--optimize",
+                   "--memory"):
             continue
         else:
             paths.append(a)
@@ -252,6 +419,21 @@ def main(argv=None):
                 print(json.dumps(opt_rec))
             else:
                 _print_opt_text(opt_rec)
+        if memory:
+            try:
+                mem_rec = memory_path(path, budget=budget)
+            except (ValueError, OSError, KeyError,
+                    json.JSONDecodeError) as e:
+                print(f"INVALID: {path}: {e}", file=sys.stderr)
+                return 2
+            records.append(mem_rec)
+            sevs = {f["severity"] for f in mem_rec["findings"]}
+            if "error" in sevs or (strict and "warn" in sevs):
+                failed = True
+            if as_jsonl:
+                print(json.dumps(mem_rec))
+            else:
+                _print_memory_text(mem_rec)
     if out_path:
         with open(out_path, "a") as f:
             for rec in records:

@@ -100,6 +100,17 @@ CONFIGS = [
     ("transformer_opt2_b32", {"BENCH_MODEL": "transformer",
                               "BENCH_BATCH": "32",
                               "FLAGS_graph_opt_level": "2"}),
+    # buffer-reuse A/B pair (FLAGS_buffer_reuse, analysis/passes/reuse):
+    # both cells run the full level-2 pipeline; only the reuse rewrite
+    # flips. The bench extras record est_peak_bytes next to measured
+    # device_memory_stats, so the pair quantifies the planner's peak-HBM
+    # saving AND checks it against what the device actually allocated.
+    ("gpt_reuse_on_b32", {"BENCH_MODEL": "gpt", "BENCH_BATCH": "32",
+                          "FLAGS_graph_opt_level": "2",
+                          "FLAGS_buffer_reuse": "1"}),
+    ("gpt_reuse_off_b32", {"BENCH_MODEL": "gpt", "BENCH_BATCH": "32",
+                           "FLAGS_graph_opt_level": "2",
+                           "FLAGS_buffer_reuse": "0"}),
     ("bert_f1_b16_s1024", {"BENCH_FLASH": "1", "BENCH_BATCH": "16",
                            "BENCH_SEQ": "1024"}),
     ("bert_f0_b16_s1024", {"BENCH_FLASH": "0", "BENCH_BATCH": "16",

@@ -268,6 +268,69 @@ def validate_graph_opt(obj, where="graph_opt"):
     return errs
 
 
+def validate_memory_plan(obj, where="memory_plan"):
+    """Schema of one tools/program_lint.py --memory record
+    (analysis/memory.MemoryPlan.to_record)."""
+    errs = []
+    if not isinstance(obj.get("model"), str):
+        errs.append(f"{where}: model must be a string "
+                    f"(got {obj.get('model')!r})")
+    if not isinstance(obj.get("fingerprint"), str):
+        errs.append(f"{where}: fingerprint must be a string")
+    for key in ("ops", "vars", "est_peak_bytes", "pinned_bytes",
+                "peak_op_idx", "unsized_vars", "budget_bytes",
+                "reuse_bytes_available"):
+        v = obj.get(key)
+        if not isinstance(v, int) or isinstance(v, bool):
+            errs.append(f"{where}: {key} must be an int (got {v!r})")
+    for key in ("est_peak_bytes", "pinned_bytes", "budget_bytes",
+                "reuse_bytes_available"):
+        v = obj.get(key)
+        if isinstance(v, int) and not isinstance(v, bool) and v < 0:
+            errs.append(f"{where}: {key} must be >= 0 (got {v})")
+    if not isinstance(obj.get("peak_op"), str):
+        errs.append(f"{where}: peak_op must be a string")
+    if not isinstance(obj.get("dynamic"), bool):
+        errs.append(f"{where}: dynamic must be a bool")
+    # the peak counts the pinned set, so it can never undercut it
+    if isinstance(obj.get("est_peak_bytes"), int) \
+            and isinstance(obj.get("pinned_bytes"), int) \
+            and obj["est_peak_bytes"] < obj["pinned_bytes"]:
+        errs.append(f"{where}: est_peak_bytes={obj['est_peak_bytes']} "
+                    f"below pinned_bytes={obj['pinned_bytes']}")
+    residents = obj.get("top_residents")
+    if not isinstance(residents, list):
+        errs.append(f"{where}: top_residents must be a list")
+        residents = []
+    for i, iv in enumerate(residents):
+        if not isinstance(iv, dict):
+            errs.append(f"{where}: top_residents[{i}] is not an object")
+            continue
+        if not isinstance(iv.get("name"), str):
+            errs.append(f"{where}: top_residents[{i}].name must be a "
+                        f"string")
+        for key in ("nbytes", "def", "last_use"):
+            v = iv.get(key)
+            if not isinstance(v, int) or isinstance(v, bool):
+                errs.append(f"{where}: top_residents[{i}].{key} must "
+                            f"be an int (got {v!r})")
+        for key in ("pinned", "dynamic"):
+            if not isinstance(iv.get(key), bool):
+                errs.append(f"{where}: top_residents[{i}].{key} must "
+                            f"be a bool")
+    findings = obj.get("findings")
+    if not isinstance(findings, list):
+        errs.append(f"{where}: findings must be a list")
+        findings = []
+    for i, f in enumerate(findings):
+        if not isinstance(f, dict) or not isinstance(
+                f.get("rule"), str) or not f.get("rule", "").startswith(
+                "PTV"):
+            errs.append(f"{where}: findings[{i}] must be an object "
+                        f"with a PTVnnn rule")
+    return errs
+
+
 def validate_jsonl(path):
     errs = []
     with open(path) as f:
@@ -295,6 +358,9 @@ def validate_jsonl(path):
                     rec, where=f"{path}:{ln}"))
             elif rec.get("kind") == "graph_opt":
                 errs.extend(validate_graph_opt(
+                    rec, where=f"{path}:{ln}"))
+            elif rec.get("kind") == "memory_plan":
+                errs.extend(validate_memory_plan(
                     rec, where=f"{path}:{ln}"))
     return errs
 
