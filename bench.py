@@ -160,14 +160,40 @@ def _timed_steps(exe, prog, feed, loss, steps):
     import jax
     import jax.numpy as jnp
 
+    # BENCH_MESH ('8' dp-only, '4,2' dp x tp): run the step through the
+    # GSPMD sharded path — a SpecLayout table over the mesh (ZeRO
+    # moments on the data axis, params on the model axis, feeds batch-
+    # sharded), one compile per signature exactly like the single-chip
+    # path. Ledger rows then report tok/s/chip next to the single-chip
+    # numbers (docs/sharding.md).
+    mesh_env = os.environ.get("BENCH_MESH", "")
+    mesh = layout = None
+    run_prog = prog
+    if mesh_env:
+        from paddle_tpu.compiler import CompiledProgram
+        from paddle_tpu.parallel.layout import SpecLayout, mesh_from_spec
+        mesh = mesh_from_spec(mesh_env)
+        layout = SpecLayout(mesh).add_program(prog)
+        run_prog = CompiledProgram(prog).with_distributed(
+            mesh, state_spec_fn=layout,
+            batch_axes=(layout.data_axis,) if layout.data_axis else ())
+
     # Stage the batch on device ONCE: the executor passes jax.Array
     # feeds straight to the jitted step, so the timed loop measures the
     # training step, not a per-step host->device reupload of the batch
     # (38 MB/step for ResNet images — behind the tunnel that transfer
     # alone is seconds, 30x the step itself; a production input
     # pipeline double-buffers batches onto device the same way,
-    # reference reader/buffered_reader.cc).
-    feed = {k: jax.device_put(np.asarray(v)) for k, v in feed.items()}
+    # reference reader/buffered_reader.cc). Under a mesh each batch is
+    # device_put straight into its batch-sharded layout, so no chip
+    # ever holds the full host batch.
+    def _stage(v):
+        arr = np.asarray(v)
+        ns = run_prog.feed_sharding(arr.shape) if mesh is not None \
+            else None
+        return jax.device_put(arr, ns) if ns is not None \
+            else jax.device_put(arr)
+    feed = {k: _stage(v) for k, v in feed.items()}
 
     # Record what the graph-optimization pipeline does to this program
     # (FLAGS_graph_opt_level, analysis/passes): the gate memoizes per
@@ -202,8 +228,9 @@ def _timed_steps(exe, prog, feed, loss, steps):
               file=sys.stderr)
 
     # compile + warmup (synced)
-    exe.run(prog, feed=feed, fetch_list=[loss])
-    x, = exe.run(prog, feed=feed, fetch_list=[loss], return_numpy=False)
+    exe.run(run_prog, feed=feed, fetch_list=[loss])
+    x, = exe.run(run_prog, feed=feed, fetch_list=[loss],
+                 return_numpy=False)
     np.asarray(x)  # drain the queue
     np.asarray(jnp.zeros(()) + 1)  # compile the probe expression
     rtts = []
@@ -220,7 +247,7 @@ def _timed_steps(exe, prog, feed, loss, steps):
     def window(n):
         t0 = time.perf_counter()
         for _ in range(n):
-            x, = exe.run(prog, feed=feed, fetch_list=[loss],
+            x, = exe.run(run_prog, feed=feed, fetch_list=[loss],
                          return_numpy=False)
         lv = np.asarray(x)
         elapsed = time.perf_counter() - t0
@@ -239,6 +266,13 @@ def _timed_steps(exe, prog, feed, loss, steps):
              "window_spread": round(abs(dt1 - dt2) / dt, 4),
              "graph_opt_level": opt_level,
              "ops_pre_opt": ops_pre, "ops_post_opt": ops_post}
+    if mesh is not None:
+        stats["mesh_shape"] = [int(mesh.shape[a])
+                               for a in mesh.axis_names]
+        stats["mesh_axes"] = list(mesh.axis_names)
+        stats["mesh_devices"] = int(mesh.size)
+        stats["collective_bytes_per_step"] = \
+            int(layout.collective_bytes_estimate(prog))
     if est_peak is not None:
         stats["est_peak_bytes"] = est_peak
         stats["est_peak_dynamic"] = est_dynamic
@@ -394,6 +428,9 @@ def bench_bert():
              "mlm": os.environ.get("BENCH_MLM", "0"), **stats}
     if probes_ms is not None:
         extra["flash_probe_ms"] = probes_ms
+    if stats.get("mesh_devices"):
+        extra["tok_s_per_chip"] = round(
+            tokens_per_sec / stats["mesh_devices"], 1)
     return {
         "metric": "bert_base_pretrain_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 1),
@@ -470,14 +507,18 @@ def bench_gpt():
     flops = flops_tok * batch * t_eff
     mfu = flops / dt / peak_flops_per_chip()
     _record_bench_stats(flops)
+    extra = {"step_ms": round(dt * 1000, 2), "mfu": round(mfu, 4),
+             "batch": int(batch), "seq_len": int(seq_len),
+             "loss": float(np.asarray(lv)), **stats}
+    if stats.get("mesh_devices"):
+        extra["tok_s_per_chip"] = round(
+            tokens_per_sec / stats["mesh_devices"], 1)
     return {
         "metric": "gpt_small_pretrain_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/s",
         "vs_baseline": round(mfu / 0.50, 4),
-        "extra": {"step_ms": round(dt * 1000, 2), "mfu": round(mfu, 4),
-                  "batch": int(batch), "seq_len": int(seq_len),
-                  "loss": float(np.asarray(lv)), **stats},
+        "extra": extra,
     }
 
 
@@ -528,15 +569,19 @@ def bench_transformer():
     flops = nmt.flops_per_step(cfg, batch, src_len, trg_len)
     mfu = flops / dt / peak_flops_per_chip()
     _record_bench_stats(flops)
+    extra = {"step_ms": round(dt * 1000, 2), "mfu": round(mfu, 4),
+             "batch": int(batch), "src_len": int(src_len),
+             "trg_len": int(trg_len),
+             "loss": float(np.asarray(lv)), **stats}
+    if stats.get("mesh_devices"):
+        extra["tok_s_per_chip"] = round(
+            tokens_per_sec / stats["mesh_devices"], 1)
     return {
         "metric": "transformer_big_ende_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/s",
         "vs_baseline": round(mfu / 0.50, 4),
-        "extra": {"step_ms": round(dt * 1000, 2), "mfu": round(mfu, 4),
-                  "batch": int(batch), "src_len": int(src_len),
-                  "trg_len": int(trg_len),
-                  "loss": float(np.asarray(lv)), **stats},
+        "extra": extra,
     }
 
 
@@ -925,6 +970,23 @@ def main(argv=None):
         prev_elapsed = time.time() - t0
         print(json.dumps(line), flush=True)
         _emit(log, {"kind": "bench_result", "ts": time.time(), **line})
+        ex = line.get("extra") or {}
+        if ex.get("mesh_shape") and ex.get("mesh_devices"):
+            # companion ledger record for BENCH_MESH runs: the scaling
+            # facts validate_bench_json.py checks and the
+            # metrics_report.py '-- sharding --' section renders
+            _emit(log, {"kind": "sharded_bench", "ts": time.time(),
+                        "metric": line["metric"],
+                        "unit": line.get("unit"),
+                        "mesh_shape": ex["mesh_shape"],
+                        "mesh_axes": ex.get("mesh_axes"),
+                        "mesh_devices": ex["mesh_devices"],
+                        "per_chip_throughput": ex.get(
+                            "tok_s_per_chip",
+                            round(line["value"] / ex["mesh_devices"],
+                                  1)),
+                        "collective_bytes_per_step": ex.get(
+                            "collective_bytes_per_step", 0)})
         results.append(line)
         done.add(m)
         _finalize_summary("running")  # artifact parses mid-run too

@@ -107,6 +107,28 @@ class CompiledProgram:
             self._mesh = Mesh(devs, axis_names=("dp",))
         return self._mesh
 
+    def feed_sharding(self, shape) -> Optional[NamedSharding]:
+        """Target placement for one feed of `shape`: dim 0 split over
+        the batch axes when it divides their product, else replicated.
+        None when sharding is inactive (single device / not parallel).
+        Executor._prepare_feed uses this to device_put batches straight
+        into their sharded layout (no host gather), and build_jit uses
+        the SAME rule for in_shardings — the two must agree or jit
+        re-stages every feed."""
+        if not self._is_data_parallel or len(jax.devices()) == 1:
+            return None
+        mesh = self.mesh()
+        batch_axes = tuple(a for a in self._batch_axes
+                           if a in mesh.axis_names)
+        nbatch = int(np.prod([mesh.shape[a] for a in batch_axes])) \
+            if batch_axes else 1
+        shape = tuple(shape or ())
+        if (batch_axes and len(shape) >= 1 and nbatch > 1
+                and shape[0] % nbatch == 0):
+            return NamedSharding(mesh, P(batch_axes if len(batch_axes) > 1
+                                         else batch_axes[0]))
+        return NamedSharding(mesh, P())
+
     def build_jit(self, step_fn, state_in_names, feed_arrays,
                   state_out_names=()):
         """jit `step_fn(state, feeds, step_idx)` with SPMD shardings:
@@ -132,18 +154,8 @@ class CompiledProgram:
         if unknown:
             raise ValueError(
                 f"batch_axes {unknown} not in mesh axes {mesh.axis_names}")
-        batch_axes = tuple(self._batch_axes)
-        nbatch = int(np.prod([mesh.shape[a] for a in batch_axes])) \
-            if batch_axes else 1
-        batch = NamedSharding(mesh, P(batch_axes if len(batch_axes) > 1
-                                      else batch_axes[0])) \
-            if batch_axes else repl
-        feed_shard = {}
-        for n, a in feed_arrays.items():
-            if a.ndim >= 1 and nbatch > 1 and a.shape[0] % nbatch == 0:
-                feed_shard[n] = batch
-            else:
-                feed_shard[n] = repl
+        feed_shard = {n: self.feed_sharding(a.shape)
+                      for n, a in feed_arrays.items()}
         # Pin state out_shardings only when every state output is also a
         # state input — then each returned value provably exists and the
         # pytree matches. A program with produced-but-not-consumed

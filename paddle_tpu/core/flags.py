@@ -457,6 +457,23 @@ DEFINE_bool(
     "is treated as a transient fault (retried via RetryPolicy, then "
     "failed) instead of being served as a wrong answer.")
 
+DEFINE_bool(
+    "sharded_exec", False,
+    "GSPMD sharded execution (paddle_tpu/parallel/layout.py): when a "
+    "CompiledProgram runs data-parallel, attach a SpecLayout table over "
+    "the FLAGS_sharded_mesh Mesh — feeds batch-shard on the data axis, "
+    "optimizer moments and the weight update ZeRO-shard across replicas "
+    "(arxiv 2004.13336), params optionally split on the model axis — "
+    "and jit with the derived in/out_shardings. Off = legacy replicated "
+    "data-parallel. Traced: flipping it recompiles.", traced=True)
+
+DEFINE_string(
+    "sharded_mesh", "",
+    "Mesh shape for FLAGS_sharded_exec as 'dp' or 'dp,tp' (e.g. '8' or "
+    "'4,2'); axis 0 is the data axis, axis 1 the model axis. Empty = "
+    "the parallel.get_mesh() registry mesh (all devices, 1-D data "
+    "axis). Traced: a shape change recompiles.", traced=True)
+
 # ---------------------------------------------------------------------------
 # Reference-flag compat surface (App. C parity target:
 # platform/flags.cc:33-449 + the read_env_flags whitelist in

@@ -6,6 +6,7 @@ jax.sharding meshes + GSPMD + shard_map collectives over ICI/DCN.
 """
 from .api import ParallelExecutor  # noqa: F401
 from .mesh import get_mesh, set_mesh, mesh_context  # noqa: F401
+from .layout import SpecLayout, mesh_from_spec  # noqa: F401
 from . import ring_attention  # noqa: F401  (registers the op)
 from . import recompute  # noqa: F401  (registers recompute_segment)
 from .pipeline import gpipe, stack_stage_params, SectionPipeline  # noqa: F401

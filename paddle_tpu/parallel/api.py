@@ -18,12 +18,23 @@ class ParallelExecutor:
     def __init__(self, use_cuda=False, loss_name=None, main_program=None,
                  share_vars_from=None, exec_strategy=None,
                  build_strategy=None, num_trainers=1, trainer_id=0,
-                 scope=None):
+                 scope=None, mesh=None, layout=None):
         program = main_program or default_main_program()
         self._compiled = CompiledProgram(
             program, build_strategy or BuildStrategy()).with_data_parallel(
                 loss_name=loss_name,
                 exec_strategy=exec_strategy or ExecutionStrategy())
+        # Explicit sharded path (the FLAGS_sharded_exec executor gate
+        # attaches the same thing automatically for plain instances):
+        # a mesh plus an optional SpecLayout for ZeRO/tensor sharding.
+        if mesh is not None:
+            if layout is None:
+                from .layout import SpecLayout
+                layout = SpecLayout(mesh).add_program(program)
+            axes = (layout.data_axis,) if getattr(
+                layout, "data_axis", None) else ("dp",)
+            self._compiled.with_distributed(mesh, state_spec_fn=layout,
+                                            batch_axes=axes)
         self._executor = Executor()
         self._scope = scope
 

@@ -1,0 +1,262 @@
+"""SpecLayout: program-var -> PartitionSpec table over a Mesh(data, model).
+
+Reference analogue: the distributed transpiler's per-var placement tables
+(multi_devices_graph_pass.cc shard assignment + the fleet sharding
+strategies). On TPU the whole placement problem reduces to one table of
+named-axis PartitionSpecs handed to GSPMD as in/out_shardings.
+
+The ZeRO rule follows "Automatic Cross-Replica Sharding of Weight Update
+in Data-Parallel Training" (arxiv 2004.13336): parameters stay replicated
+across the data axis (activations/gradients shard on batch), while the
+optimizer accumulators — and therefore the weight-update computation that
+consumes them — shard their leading dim across the data axis. GSPMD then
+emits the reduce-scatter + all-gather decomposition of the gradient
+all-reduce automatically. Any dim that does not divide its axis falls back
+to replication (SNIPPETS.md [3] naive-sharding rule), so the table always
+resolves: every var gets *some* spec.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..monitor import STAT_SET
+from ..monitor import enabled as _monitor_on
+from .mesh import make_mesh
+
+__all__ = ["SpecLayout", "MeshDims", "mesh_from_spec", "DATA_AXIS",
+           "MODEL_AXIS"]
+
+DATA_AXIS = "dp"
+MODEL_AXIS = "tp"
+
+# Optimizer accumulator name markers. optimizer._add_accumulator names
+# accumulators unique_name.generate(f"{param.name}_{acc}") -> e.g.
+# "fc_0.w_0_moment1_0"; these substrings identify the param-shaped
+# moments/velocities that the ZeRO rule shards over the data axis.
+_ZERO_ACC_MARKERS = (
+    "_moment1_", "_moment2_", "_moment_", "_velocity_", "_inf_norm_",
+    "_avg_squared_grad_", "_avg_squared_update_", "_mean_square_",
+    "_momentum_", "_mean_grad_", "_squared_", "_linear_",
+)
+# Scalar schedule state: always replicated (shape [1] — never divisible,
+# but matching by name avoids even attempting the fallback path).
+_SCALAR_MARKERS = ("learning_rate", "_beta1_pow_", "_beta2_pow_")
+
+
+def mesh_from_spec(spec: str, devices=None) -> Mesh:
+    """Build a Mesh from a 'dp' / 'dp,tp' shape string ("8", "4,2").
+
+    Axis names follow position: first axis is the data axis, second the
+    model axis — the Mesh(data, model) convention of docs/sharding.md.
+    """
+    dims = tuple(int(d) for d in str(spec).replace("x", ",").split(",")
+                 if str(d).strip())
+    if not dims or any(d < 1 for d in dims):
+        raise ValueError(
+            f"mesh spec {spec!r}: expected 'dp' or 'dp,tp' positive ints")
+    if len(dims) > 2:
+        raise ValueError(
+            f"mesh spec {spec!r}: at most 2 axes (data, model) supported")
+    names = (DATA_AXIS,) if len(dims) == 1 else (DATA_AXIS, MODEL_AXIS)
+    return make_mesh(shape=dims, axis_names=names, devices=devices)
+
+
+class MeshDims:
+    """Device-free stand-in for jax.sharding.Mesh: axis names + sizes
+    only. Static tooling (tools/program_lint.py --memory --mesh) needs
+    shard counts on hosts that don't HAVE the dp x tp devices; only
+    SpecLayout's spec/shard-count queries work over it (named_sharding
+    requires a real Mesh)."""
+
+    def __init__(self, shape, axis_names=None):
+        shape = tuple(int(d) for d in shape)
+        if axis_names is None:
+            axis_names = (DATA_AXIS, MODEL_AXIS)[:len(shape)]
+        if len(axis_names) != len(shape):
+            raise ValueError(f"axis_names {axis_names} vs shape {shape}")
+        self.axis_names = tuple(axis_names)
+        self.shape = dict(zip(self.axis_names, shape))
+        self.size = int(np.prod(shape)) if shape else 1
+
+
+class SpecLayout:
+    """Var-name -> PartitionSpec table for one program under one mesh.
+
+    Resolution is total: `spec_for` returns a PartitionSpec for ANY
+    (name, shape) — the fallback is replication (PartitionSpec()), never
+    an error. Built once per (program, mesh); the instance is then both
+    the `state_spec_fn` for CompiledProgram.with_distributed (callable
+    on a var name) and the shard-count oracle for the memory planner.
+    """
+
+    def __init__(self, mesh: Mesh, data_axis: str = DATA_AXIS,
+                 model_axis: str = MODEL_AXIS, shard_params: bool = True):
+        self.mesh = mesh
+        self.data_axis = data_axis if data_axis in mesh.axis_names else None
+        self.model_axis = model_axis if model_axis in mesh.axis_names \
+            else None
+        self.dp = int(mesh.shape[self.data_axis]) if self.data_axis else 1
+        self.tp = int(mesh.shape[self.model_axis]) if self.model_axis \
+            else 1
+        self.shard_params = shard_params
+        self._table: Dict[str, PartitionSpec] = {}
+
+    # -- classification --------------------------------------------------
+    @staticmethod
+    def _is_scalar_state(name: str) -> bool:
+        return any(m in name or name.endswith(m.rstrip("_"))
+                   for m in _SCALAR_MARKERS)
+
+    @staticmethod
+    def _is_zero_accumulator(name: str) -> bool:
+        return any(m in name or name.endswith(m.rstrip("_"))
+                   for m in _ZERO_ACC_MARKERS)
+
+    # -- spec rules ------------------------------------------------------
+    def _model_parts(self, shape) -> list:
+        """Per-dim axis assignment for the model (tp) axis: last dim of
+        a >=2-D tensor, when divisible. [] when tp doesn't apply."""
+        parts = [None] * len(shape)
+        if (self.shard_params and self.tp > 1 and len(shape) >= 2
+                and shape[-1] is not None and shape[-1] > 0
+                and shape[-1] % self.tp == 0):
+            parts[-1] = self.model_axis
+        return parts
+
+    def param_spec(self, name: str, shape: Tuple[int, ...]) -> \
+            PartitionSpec:
+        """Parameters: replicated over data (ZeRO keeps weights whole
+        for the forward pass), last dim over the model axis when it
+        divides — the Megatron-style column split GSPMD propagates
+        through matmuls."""
+        shape = tuple(s for s in (shape or ()))
+        parts = self._model_parts(shape)
+        return PartitionSpec(*parts) if any(parts) else PartitionSpec()
+
+    def zero_spec(self, name: str, shape: Tuple[int, ...]) -> \
+            PartitionSpec:
+        """Optimizer accumulators (arxiv 2004.13336): leading dim over
+        the data axis when divisible (plus the same model split as the
+        owning param), else fall back toward replication per-dim."""
+        shape = tuple(s for s in (shape or ()))
+        if not shape:
+            return PartitionSpec()
+        parts = self._model_parts(shape)
+        if (self.data_axis and self.dp > 1 and shape[0] is not None
+                and shape[0] > 0 and shape[0] % self.dp == 0
+                and parts[0] is None):
+            parts[0] = self.data_axis
+        return PartitionSpec(*parts) if any(parts) else PartitionSpec()
+
+    def feed_spec(self, name: str, shape: Tuple[int, ...]) -> \
+            PartitionSpec:
+        """Feeds shard dim 0 (batch) across the data axis when it
+        divides; otherwise replicate (small/odd batches still run)."""
+        shape = tuple(s for s in (shape or ()))
+        if (self.data_axis and self.dp > 1 and shape
+                and shape[0] is not None and shape[0] > 0
+                and shape[0] % self.dp == 0):
+            return PartitionSpec(self.data_axis)
+        return PartitionSpec()
+
+    def spec_for(self, name: str, shape=None,
+                 is_param: bool = False) -> PartitionSpec:
+        """Total resolution: scalar state -> replicate; optimizer
+        accumulator -> ZeRO rule; params -> param rule; everything else
+        (activations live inside the jitted step — GSPMD propagates
+        them from feeds/params) -> replicate."""
+        shape = tuple(shape or ())
+        if self._is_scalar_state(name) or not shape or \
+                int(np.prod([s or 1 for s in shape])) <= 1:
+            return PartitionSpec()
+        if self._is_zero_accumulator(name):
+            return self.zero_spec(name, shape)
+        if is_param or len(shape) >= 2:
+            return self.param_spec(name, shape)
+        return PartitionSpec()
+
+    # -- table build -----------------------------------------------------
+    def add_program(self, program) -> "SpecLayout":
+        """Resolve every persistable var in `program` into the table
+        (activations are left to GSPMD propagation inside the jit)."""
+        sharded = replicated = 0
+        for v in program.list_vars():
+            if not getattr(v, "persistable", False):
+                continue
+            spec = self.spec_for(
+                v.name, getattr(v, "shape", None) or (),
+                is_param=getattr(v, "is_parameter", False))
+            self._table[v.name] = spec
+            if any(a is not None for a in spec):
+                sharded += 1
+            else:
+                replicated += 1
+        if _monitor_on():
+            STAT_SET("parallel.sharded_vars", sharded)
+            STAT_SET("parallel.replicated_vars", replicated)
+            STAT_SET("parallel.mesh_devices", int(self.mesh.size))
+        return self
+
+    # -- consumers -------------------------------------------------------
+    def __call__(self, name: str) -> Optional[PartitionSpec]:
+        """state_spec_fn signature for CompiledProgram.with_distributed:
+        None means 'replicated' there, so unknown names resolve safely."""
+        spec = self._table.get(name)
+        if spec is not None and any(a is not None for a in spec):
+            return spec
+        return None
+
+    def named_sharding(self, name: str, shape=None) -> NamedSharding:
+        spec = self._table.get(name)
+        if spec is None:
+            spec = self.spec_for(name, shape)
+        return NamedSharding(self.mesh, spec)
+
+    def shard_count(self, name: str, shape=None) -> int:
+        """How many ways the var's bytes split across the mesh — the
+        divisor tools/program_lint.py --memory --mesh applies to the
+        per-chip peak-HBM estimate."""
+        spec = self._table.get(name)
+        if spec is None:
+            spec = self.spec_for(name, shape)
+        n = 1
+        for axes in spec:
+            if axes is None:
+                continue
+            for a in (axes if isinstance(axes, tuple) else (axes,)):
+                n *= int(self.mesh.shape[a])
+        return n
+
+    def collective_bytes_estimate(self, program) -> int:
+        """Static per-step gradient-synchronisation volume: every
+        dp-replicated parameter's gradient is all-reduced (2(n-1)/n ~ 2x
+        payload in a ring), counted once per step. Sharded-update params
+        reduce-scatter + all-gather the same payload, so the estimate
+        holds for both layouts (arxiv 2004.13336 §3)."""
+        if not self.data_axis or self.dp <= 1:
+            return 0
+        total = 0
+        for v in program.list_vars():
+            if not getattr(v, "is_parameter", False):
+                continue
+            shape = tuple(s for s in (getattr(v, "shape", ()) or ())
+                          if s and s > 0)
+            if not shape:
+                continue
+            try:
+                from ..core.dtypes import as_np_dtype
+                itemsize = np.dtype(as_np_dtype(v.dtype)).itemsize
+            except Exception:
+                itemsize = 4
+            nbytes = int(np.prod(shape)) * itemsize
+            total += nbytes // self.shard_count(v.name, shape)
+        return 2 * total
+
+    def to_dict(self) -> Dict[str, str]:
+        return {n: str(s) for n, s in sorted(self._table.items())}
+
+    def __len__(self) -> int:
+        return len(self._table)

@@ -43,6 +43,7 @@ def load(path):
     snapshots, results, op_profiles = [], [], []
     loadgens, lints, graph_opts = [], [], []
     gen_loadgens, chaos_loadgens, memory_plans = [], [], []
+    sharded_benches = []
     with open(path) as f:
         for ln, line in enumerate(f, 1):
             line = line.strip()
@@ -57,6 +58,10 @@ def load(path):
             kind = rec.get("kind")
             if kind == "stats_snapshot" or "histograms" in rec:
                 snapshots.append(rec)
+            # before the bench_result fallback: sharded_bench rows also
+            # carry a "metric" key
+            elif kind == "sharded_bench":
+                sharded_benches.append(rec)
             elif kind == "bench_result" or "metric" in rec:
                 results.append(rec)
             elif kind == "op_profile":
@@ -74,7 +79,8 @@ def load(path):
             elif kind == "memory_plan":
                 memory_plans.append(rec)
     return (snapshots, results, op_profiles, loadgens, lints,
-            graph_opts, gen_loadgens, chaos_loadgens, memory_plans)
+            graph_opts, gen_loadgens, chaos_loadgens, memory_plans,
+            sharded_benches)
 
 
 def _hist(snap, name):
@@ -83,13 +89,14 @@ def _hist(snap, name):
 
 def report(path, out=sys.stdout):
     (snapshots, results, op_profiles, loadgens, lints,
-     graph_opts, gen_loadgens, chaos_loadgens, memory_plans) = load(path)
+     graph_opts, gen_loadgens, chaos_loadgens, memory_plans,
+     sharded_benches) = load(path)
     w = out.write
     w(f"runtime stats report — {path}\n")
     if not snapshots and not results and not op_profiles \
             and not loadgens and not lints and not graph_opts \
             and not gen_loadgens and not chaos_loadgens \
-            and not memory_plans:
+            and not memory_plans and not sharded_benches:
         w("no snapshots or bench results found\n")
         return 1
     w(f"snapshots: {len(snapshots)}   bench results: {len(results)}\n")
@@ -394,6 +401,20 @@ def report(path, out=sys.stdout):
             for f in r.get("findings", []):
                 w(f"  {f.get('rule', '?')} {f.get('severity', '?'):5s}: "
                   f"{f.get('message', '')}\n")
+
+    if sharded_benches:
+        # BENCH_MESH dp x tp rows (bench.py, docs/sharding.md): read
+        # tok/s/chip against the single-chip baseline of the same
+        # metric in -- bench results -- below
+        w("\n-- sharding (parallel/layout, docs/sharding.md) --\n")
+        for r in sharded_benches:
+            shape = "x".join(str(d) for d in r.get("mesh_shape", []))
+            axes = ",".join(r.get("mesh_axes") or [])
+            w(f"mesh {shape:>7s} ({axes:9s}) "
+              f"{r.get('metric', '?'):48s} "
+              f"{r.get('per_chip_throughput', 0):>10} "
+              f"{r.get('unit', '') or '':8s}/chip  collective/step="
+              f"{_fmt_bytes(r.get('collective_bytes_per_step', 0))}\n")
 
     if results:
         w("\n-- bench results --\n")

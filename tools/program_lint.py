@@ -35,6 +35,12 @@ Options:
                   (default: FLAGS_memory_budget_bytes semantics — 0
                   auto-detects from the device, which on CPU means no
                   budget)
+  --mesh DP[,TP]  report --memory's peak PER CHIP under a dp(,tp) mesh
+                  ('8', '4,2'): each var's bytes divide by its shard
+                  count under the SpecLayout rules (parallel/layout.py
+                  — ZeRO moments over dp, params over tp, batch-major
+                  feeds/transients over dp) instead of over-reporting
+                  the replicated footprint; needs no actual devices
   --self-check    lint two bundled in-process example programs (one
                   known-good, one with seeded defects), then run the
                   memory planner over a fixed sample of OP_TEST_MATRIX
@@ -130,9 +136,17 @@ def optimize_path(path, level=2):
     return rec
 
 
-def memory_path(path, budget=None):
+def memory_path(path, budget=None, mesh=None):
     """Run the static memory planner on one model path ->
-    kind="memory_plan" record (MemoryPlan.to_record plus model)."""
+    kind="memory_plan" record (MemoryPlan.to_record plus model).
+
+    mesh: 'dp' or 'dp,tp' shard counts ('8', '4,2'). The per-chip peak
+    then divides each var by its shard count under the SpecLayout
+    rules (parallel/layout.py): persistables per the table (ZeRO
+    moments over dp, params over tp), feeds and batch-major transients
+    over dp when dim 0 divides — the GSPMD batch propagation — so the
+    estimate stops over-reporting a sharded run's per-chip footprint.
+    """
     from paddle_tpu.analysis import analyze_program_memory
     from paddle_tpu.analysis.memory import resolve_budget_bytes
     from paddle_tpu.framework import Program
@@ -146,7 +160,58 @@ def memory_path(path, budget=None):
     plan = analyze_program_memory(program, feed_names=feeds,
                                   fetch_names=fetches,
                                   budget_bytes=budget)
-    return plan.to_record(model=label)
+    rec_extra = {}
+    if mesh:
+        dims = _apply_mesh_to_plan(plan, program, mesh)
+        rec_extra = {"mesh_shape": dims}
+    rec = plan.to_record(model=label)
+    rec.update(rec_extra)
+    return rec
+
+
+def _apply_mesh_to_plan(plan, program, mesh):
+    """Divide every interval's bytes by its shard count under the
+    layout table, then rebuild the timeline/peak in place."""
+    from paddle_tpu.analysis.memory import _timeline
+    from paddle_tpu.parallel.layout import MeshDims, SpecLayout
+
+    dims = [int(d) for d in str(mesh).replace("x", ",").split(",")
+            if str(d).strip()]
+    if not dims or any(d < 1 for d in dims) or len(dims) > 2:
+        raise ValueError(f"--mesh {mesh!r}: expected 'dp' or 'dp,tp' "
+                         f"positive ints")
+    layout = SpecLayout(MeshDims(dims)).add_program(program)
+    block = program.global_block()
+    dp = layout.dp
+    pinned_delta = 0
+    for iv in plan.intervals.values():
+        var = block.vars.get(iv.name)
+        if var is not None and getattr(var, "persistable", False):
+            n = layout.shard_count(iv.name, iv.shape)
+        elif (dp > 1 and iv.shape and iv.shape[0]
+                and iv.shape[0] % dp == 0):
+            n = dp  # batch-major feed/transient: GSPMD batch sharding
+        else:
+            n = 1
+        if n > 1:
+            saved = iv.nbytes - iv.nbytes // n
+            iv.nbytes -= saved
+            if iv.pinned:
+                pinned_delta += saved
+    plan.pinned_bytes -= pinned_delta
+    tl = _timeline(plan.intervals.values(), plan.op_count,
+                   plan.pinned_bytes)
+    plan.timeline = tl
+    if tl:
+        plan.peak_bytes = max(tl)
+        plan.peak_op_idx = tl.index(plan.peak_bytes)
+        op = block.ops[plan.peak_op_idx]
+        plan.peak_op = f"{op.type}:0/{plan.peak_op_idx}"
+    else:
+        plan.peak_bytes = plan.pinned_bytes
+        plan.peak_op_idx = -1
+        plan.peak_op = "program"
+    return dims
 
 
 def _print_memory_text(rec, out=sys.stdout):
@@ -358,6 +423,7 @@ def main(argv=None):
     memory = "--memory" in argv
     opt_level = 2
     budget = None
+    mesh = None
     out_path = None
     paths = []
     it = iter(argv)
@@ -381,6 +447,12 @@ def main(argv=None):
             except (TypeError, ValueError):
                 print("--budget needs an integer byte count",
                       file=sys.stderr)
+                return 2
+        elif a == "--mesh":
+            mesh = next(it, None)
+            if mesh is None:
+                print("--mesh needs a 'dp' or 'dp,tp' shape (e.g. "
+                      "8 or 4,2)", file=sys.stderr)
                 return 2
         elif a in ("--jsonl", "--strict", "--no-shapes", "--optimize",
                    "--memory"):
@@ -421,7 +493,7 @@ def main(argv=None):
                 _print_opt_text(opt_rec)
         if memory:
             try:
-                mem_rec = memory_path(path, budget=budget)
+                mem_rec = memory_path(path, budget=budget, mesh=mesh)
             except (ValueError, OSError, KeyError,
                     json.JSONDecodeError) as e:
                 print(f"INVALID: {path}: {e}", file=sys.stderr)

@@ -85,6 +85,19 @@ CONFIGS = [
     # violated — a hard failure, not a flake)
     ("chaos_s4", None),  # special-cased below
     ("gpt_b32", {"BENCH_MODEL": "gpt", "BENCH_BATCH": "32"}),
+    # GSPMD dp x tp scaling (BENCH_MESH + FLAGS_sharded_exec layout,
+    # docs/sharding.md): each sharded cell pairs with its single-chip
+    # baseline (gpt_b32 / transformer_b32 above) so the ledger carries
+    # the tok/s/chip scaling curve; extras record mesh_shape +
+    # tok_s_per_chip and a kind="sharded_bench" companion row lands in
+    # the JSONL log. dp8 keeps the global batch (32 -> 4/chip); dp4_tp2
+    # additionally splits the model axis.
+    ("gpt_dp8", {"BENCH_MODEL": "gpt", "BENCH_BATCH": "32",
+                 "BENCH_MESH": "8"}),
+    ("gpt_dp4_tp2", {"BENCH_MODEL": "gpt", "BENCH_BATCH": "32",
+                     "BENCH_MESH": "4,2"}),
+    ("transformer_dp8", {"BENCH_MODEL": "transformer",
+                         "BENCH_BATCH": "32", "BENCH_MESH": "8"}),
     # graph-opt A/B pairs (FLAGS_graph_opt_level, analysis/passes):
     # same model+batch at level 0 (pipeline off) vs level 2 (full
     # pipeline incl. fusion scopes + donation planner). The bench

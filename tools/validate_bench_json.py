@@ -331,6 +331,51 @@ def validate_memory_plan(obj, where="memory_plan"):
     return errs
 
 
+def validate_sharded_bench(obj, where):
+    """kind="sharded_bench" (bench.py BENCH_MESH runs): the scaling
+    facts a dp x tp ledger row must carry — mesh shape, per-chip
+    throughput, and the static collective-traffic estimate."""
+    errs = []
+    if not isinstance(obj.get("metric"), str):
+        errs.append(f"{where}: metric must be a string")
+    shape = obj.get("mesh_shape")
+    if not isinstance(shape, list) or not shape or not all(
+            isinstance(d, int) and not isinstance(d, bool) and d >= 1
+            for d in shape):
+        errs.append(f"{where}: mesh_shape must be a non-empty list of "
+                    f"positive ints (got {shape!r})")
+    axes = obj.get("mesh_axes")
+    if axes is not None:
+        if not isinstance(axes, list) or not all(
+                isinstance(a, str) for a in axes):
+            errs.append(f"{where}: mesh_axes must be a list of strings")
+        elif isinstance(shape, list) and len(axes) != len(shape):
+            errs.append(f"{where}: mesh_axes {axes} and mesh_shape "
+                        f"{shape} disagree on rank")
+    nd = obj.get("mesh_devices")
+    if not isinstance(nd, int) or isinstance(nd, bool) or nd < 1:
+        errs.append(f"{where}: mesh_devices must be a positive int "
+                    f"(got {nd!r})")
+    elif isinstance(shape, list) and shape and all(
+            isinstance(d, int) and not isinstance(d, bool)
+            for d in shape):
+        prod = 1
+        for d in shape:
+            prod *= d
+        if prod != nd:
+            errs.append(f"{where}: mesh_devices={nd} != "
+                        f"prod(mesh_shape)={prod}")
+    v = obj.get("per_chip_throughput")
+    if not isinstance(v, (int, float)) or isinstance(v, bool) or v < 0:
+        errs.append(f"{where}: per_chip_throughput must be a "
+                    f"non-negative number (got {v!r})")
+    cb = obj.get("collective_bytes_per_step")
+    if not isinstance(cb, int) or isinstance(cb, bool) or cb < 0:
+        errs.append(f"{where}: collective_bytes_per_step must be a "
+                    f"non-negative int (got {cb!r})")
+    return errs
+
+
 def validate_jsonl(path):
     errs = []
     with open(path) as f:
@@ -361,6 +406,9 @@ def validate_jsonl(path):
                     rec, where=f"{path}:{ln}"))
             elif rec.get("kind") == "memory_plan":
                 errs.extend(validate_memory_plan(
+                    rec, where=f"{path}:{ln}"))
+            elif rec.get("kind") == "sharded_bench":
+                errs.extend(validate_sharded_bench(
                     rec, where=f"{path}:{ln}"))
     return errs
 
