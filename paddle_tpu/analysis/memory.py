@@ -131,6 +131,32 @@ class MemoryPlan:
             else list(self.intervals.values())
         return sorted(live, key=lambda iv: (-iv.nbytes, iv.name))[:k]
 
+    def kv_summary(self) -> Optional[dict]:
+        """Decode KV-cache footprint, when this program holds one.
+
+        Recognizes the two generation KV layouts by persistable naming
+        convention: `*.kv_pool_k` / `*.kv_pool_v` are the block pools
+        of the paged decode step (models/gpt.build_paged_decode_step —
+        sized num_blocks x block_size, decoupled from max_slots x
+        max_seq), `*.cache_k` / `*.cache_v` are the contiguous slabs of
+        the classic step (sized max_slots x max_seq). Both are pinned
+        at full size by the planner, so `kv_bytes` is exactly what the
+        PTV050 budget gate prices them at. None when the program holds
+        neither (i.e. it is not a decode program)."""
+        paged = [iv for iv in self.intervals.values()
+                 if iv.name.endswith((".kv_pool_k", ".kv_pool_v"))]
+        slab = [iv for iv in self.intervals.values()
+                if iv.name.endswith((".cache_k", ".cache_v"))]
+        if not paged and not slab:
+            return None
+        group = paged or slab
+        return {"layout": "paged" if paged else "slab",
+                "kv_bytes": int(sum(iv.nbytes for iv in group)),
+                "kv_vars": len(group),
+                "kv_frac_of_peak": round(
+                    sum(iv.nbytes for iv in group)
+                    / max(self.peak_bytes, 1), 4)}
+
     # -- diagnostics -----------------------------------------------------
     def findings(self) -> VerifyResult:
         """PTV05x findings against `budget_bytes` (0 = no budget: only
@@ -189,6 +215,9 @@ class MemoryPlan:
                                  for iv in self.top_residents(10)],
                "findings": [d.to_dict()
                             for d in self.findings().findings]}
+        kv = self.kv_summary()
+        if kv is not None:
+            rec["kv"] = kv
         if model is not None:
             rec["model"] = model
         return rec

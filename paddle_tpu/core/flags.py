@@ -388,6 +388,41 @@ DEFINE_int32(
     "the dynamic batcher before submissions are rejected with "
     "QueueFullError (backpressure instead of unbounded queueing).")
 
+DEFINE_bool(
+    "gen_paged_kv", True,
+    "Generation engine KV layout: True (default) = block-table paged "
+    "KV cache (serving/kv_blocks.py + models/gpt."
+    "build_paged_decode_step) with prefix caching and chunked prefill; "
+    "False = the PR-7 contiguous [max_slots, max_seq] slab decode, "
+    "retained for the paged-vs-slab A/B (sweep_driver "
+    "gen_paged_vs_slab pair). Host-side program choice only — not part "
+    "of any executable cache key.")
+
+DEFINE_int32(
+    "gen_kv_block_size", 16,
+    "Paged KV cache: tokens per physical block. Larger blocks mean "
+    "fewer gather indices per step but coarser prefix-cache "
+    "granularity (only FULL prompt blocks are content-hash shareable) "
+    "and more tail waste per sequence. Also the chunk width of the "
+    "chunked-prefill executable.")
+
+DEFINE_int32(
+    "gen_kv_pool_blocks", 0,
+    "Paged KV cache: physical blocks in the pool (one is reserved as "
+    "the scratch block). 0 (default) = derive: from "
+    "FLAGS_gen_kv_pool_bytes when set, else full capacity "
+    "(max_slots x ceil(max_seq/block_size) + scratch). This — not "
+    "max_slots x max_seq — is what bounds peak KV HBM; the static "
+    "memory planner prices the pool persistables directly.")
+
+DEFINE_int64(
+    "gen_kv_pool_bytes", 0,
+    "Paged KV cache: HBM budget for the K/V pools across all layers; "
+    "the engine sizes the pool as budget // block_bytes blocks. 0 = "
+    "unset (FLAGS_gen_kv_pool_blocks or full capacity applies). The "
+    "knob the gen_paged_vs_slab A/B holds fixed while comparing "
+    "sustainable slot counts.")
+
 DEFINE_double(
     "serving_default_timeout_ms", 1000.0,
     "Default EngineConfig.default_timeout_ms: per-request deadline "

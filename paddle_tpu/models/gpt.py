@@ -16,8 +16,8 @@ from .. import layers
 from . import transformer
 
 __all__ = ["gpt_small", "gpt_medium", "build_train", "greedy_generate",
-           "DecodeStep", "build_decode_step", "kv_generate",
-           "beam_generate"]
+           "DecodeStep", "build_decode_step", "PagedDecodeStep",
+           "build_paged_decode_step", "kv_generate", "beam_generate"]
 
 
 def gpt_small(**kw):
@@ -295,6 +295,176 @@ def build_decode_step(cfg, batch, max_seq, state_prefix=""):
     layers.assign(pos_next, output=pos)
     return DecodeStep(token, logits, cache_names, reset, active, batch,
                       max_seq, state_prefix)
+
+
+class PagedDecodeStep:
+    """Handle on one paged decode/prefill program.
+
+    Unlike the slab `DecodeStep` there is NO in-graph position state
+    and NO reset feed: the host scheduler owns every position (it knows
+    them exactly — `serving/kv_blocks.py` tracks each slot's block
+    table and write cursor), and "reset" is just releasing the slot's
+    blocks back to the pool. The graph's per-step control feeds are:
+
+    * `table_var`  — `block_table` [batch, max_blocks] int64: logical
+      block j of row b lives in physical pool block table[b, j].
+    * `start_var`  — `start_pos` [batch] int64: position of the row's
+      first token this step.
+    * `nvalid_var` — `n_valid` [batch] int64: how many of the
+      `seq_tokens` fed tokens are real; 0 mutes the row (its writes
+      land in the reserved scratch block 0, its logits are junk).
+
+    `cache_names` are the per-layer `[num_blocks, block_size, h, hd]`
+    K/V pool persistables — the SAME names for the 1-token decode
+    program and the block-sized chunked-prefill program, so both
+    executables update one physical pool in the shared scope.
+    """
+
+    def __init__(self, token_var, logits_var, cache_names, table_var,
+                 start_var, nvalid_var, batch, max_seq, block_size,
+                 num_blocks, seq_tokens, state_prefix):
+        self.token_var = token_var
+        self.logits_var = logits_var
+        self.cache_names = cache_names
+        self.table_var = table_var
+        self.start_var = start_var
+        self.nvalid_var = nvalid_var
+        self.batch = batch
+        self.max_seq = max_seq
+        self.block_size = block_size
+        self.num_blocks = num_blocks
+        self.seq_tokens = seq_tokens
+        self.max_blocks_per_slot = int(table_var.shape[1])
+        self.state_prefix = state_prefix
+
+    def __iter__(self):
+        return iter((self.token_var, self.logits_var, self.cache_names))
+
+
+def build_paged_decode_step(cfg, batch, max_seq, block_size, num_blocks,
+                            seq_tokens=1, state_prefix="",
+                            with_logits=True):
+    """Paged variant of `build_decode_step`: K/V lives in per-layer
+    physical POOLS of `num_blocks` fixed-size blocks instead of one
+    contiguous `[batch, max_seq]` slab per slot, and every read/write
+    goes through the `paged_attention` op (ops/attention.py) via a
+    per-slot block table. Peak KV HBM is therefore
+    `num_blocks × block_bytes` — chosen from the budget, decoupled from
+    `max_slots × max_seq` — and the static memory planner prices it
+    that way automatically, because the pools are ordinary persistables
+    (analysis/memory.py pins persistables at full size).
+
+    `seq_tokens` tokens are consumed per row per step: 1 builds the
+    decode executable, `block_size` builds the chunked-prefill
+    executable that retires a whole block of prompt per step. Both use
+    the same pool var names, so one scope carries one physical pool.
+    `with_logits=False` (the prefill program) skips the lm head and
+    returns a cheap [batch] health probe as `logits_var` instead —
+    prefill logits are never sampled, and fetching
+    `[batch, block_size, vocab]` per chunk would waste host bandwidth.
+
+    Weight names match the training graph exactly as in
+    `build_decode_step`; only the pool STATE names carry
+    `state_prefix`."""
+    from ..framework import ParamAttr
+    from ..initializer import Normal
+    from ..layer_helper import LayerHelper
+    import math as _math
+
+    d, h = cfg.d_model, cfg.n_heads
+    hd = d // h
+    T = int(seq_tokens)
+    max_blocks = -(-int(max_seq) // int(block_size))
+    token = layers.data("step_token", shape=[batch, T], dtype="int64",
+                        append_batch_size=False)
+    table = layers.data("block_table", shape=[batch, max_blocks],
+                        dtype="int64", append_batch_size=False)
+    start = layers.data("start_pos", shape=[batch], dtype="int64",
+                        append_batch_size=False)
+    nvalid = layers.data("n_valid", shape=[batch], dtype="int64",
+                         append_batch_size=False)
+    cache_names = []
+
+    x = layers.embedding(token, size=[cfg.vocab_size, d],
+                         param_attr=ParamAttr(name="word_emb",
+                                              initializer=Normal(0.0,
+                                                                 0.02)))
+    x = layers.reshape(x, [batch, T, d])
+    # per-token position encodings: row b token t sits at start[b] + t
+    qpos = layers.elementwise_add(
+        layers.reshape(start, [batch, 1]),
+        layers.reshape(layers.range(0, T, 1, "int64"), [1, T]))
+    zeros_seq = layers.fill_constant([1, max_seq, d], "float32", 0.0)
+    pe_table = layers.add_position_encoding(zeros_seq, alpha=1.0,
+                                            beta=1.0)
+    pe_rows = layers.gather(layers.reshape(pe_table, [max_seq, d]),
+                            layers.reshape(qpos, [batch * T]))
+    x = layers.elementwise_add(x, layers.reshape(pe_rows, [batch, T, d]))
+
+    def dense(z, size, name, act=None):
+        return transformer._dense(z, size, name, cfg, act=act)
+
+    for i in range(cfg.n_layers):
+        pre = f"layer_{i}"
+        q = dense(x, d, f"{pre}.att.q")
+        k = dense(x, d, f"{pre}.att.k")
+        v = dense(x, d, f"{pre}.att.v")
+
+        def heads(z):
+            return layers.transpose(layers.reshape(z, [batch, T, h, hd]),
+                                    [0, 2, 1, 3])   # [B, H, T, hd]
+        q, k, v = heads(q), heads(k), heads(v)
+
+        ckp = layers.create_global_var(
+            [num_blocks, block_size, h, hd], 0.0, "float32",
+            persistable=True, name=f"{state_prefix}{pre}.kv_pool_k")
+        cvp = layers.create_global_var(
+            [num_blocks, block_size, h, hd], 0.0, "float32",
+            persistable=True, name=f"{state_prefix}{pre}.kv_pool_v")
+        cache_names += [ckp.name, cvp.name]
+
+        helper = LayerHelper("paged_attention")
+        ctxv = helper.create_variable_for_type_inference("float32")
+        ck_out = helper.create_variable_for_type_inference("float32")
+        cv_out = helper.create_variable_for_type_inference("float32")
+        helper.append_op(
+            type="paged_attention",
+            inputs={"Q": [q.name], "K": [k.name], "V": [v.name],
+                    "CacheK": [ckp.name], "CacheV": [cvp.name],
+                    "BlockTable": [table.name], "StartPos": [start.name],
+                    "NValid": [nvalid.name]},
+            outputs={"Out": [ctxv.name], "CacheKOut": [ck_out.name],
+                     "CacheVOut": [cv_out.name]},
+            attrs={"sm_scale": 1.0 / _math.sqrt(hd)})
+        layers.assign(ck_out, output=ckp)
+        layers.assign(cv_out, output=cvp)
+
+        ctxv = layers.reshape(
+            layers.transpose(ctxv, [0, 2, 1, 3]), [batch, T, d])
+        att = dense(ctxv, d, f"{pre}.att.proj")
+        x = layers.layer_norm(layers.elementwise_add(x, att),
+                              begin_norm_axis=2,
+                              param_attr=ParamAttr(name=f"{pre}.ln1.w"),
+                              bias_attr=ParamAttr(name=f"{pre}.ln1.b"))
+        ff = transformer._ffn(x, cfg, f"{pre}.ffn")
+        x = layers.layer_norm(layers.elementwise_add(x, ff),
+                              begin_norm_axis=2,
+                              param_attr=ParamAttr(name=f"{pre}.ln2.w"),
+                              bias_attr=ParamAttr(name=f"{pre}.ln2.b"))
+
+    if with_logits:
+        out = layers.fc(x, size=cfg.vocab_size, num_flatten_dims=2,
+                        param_attr=ParamAttr(
+                            name="lm_head.w",
+                            initializer=Normal(0.0, 0.02)),
+                        bias_attr=False)
+    else:
+        # cheap [batch] health probe (keeps the whole stack live for
+        # the fetch and feeds the serving NaN guard per-row)
+        out = layers.reduce_mean(x, dim=[1, 2])
+    return PagedDecodeStep(token, out, cache_names, table, start,
+                           nvalid, batch, max_seq, block_size,
+                           num_blocks, T, state_prefix)
 
 
 def _ensure_decode_state(scope, blk, cache_names):

@@ -1,10 +1,16 @@
-"""Fused attention op over the Pallas kernel.
+"""Fused attention ops: the Pallas flash kernel and paged decode.
 
 Reference analogue: operators/fused/multihead_matmul (the fused attention
 target of the multihead fusion pass). Here fusion is explicit: one op, one
-Pallas kernel, with custom-vjp backward.
+Pallas kernel, with custom-vjp backward. `paged_attention` is the
+serving-side sibling: gather-based incremental attention over a
+block-table paged KV pool (vLLM's PagedAttention model), exact on CPU
+so tier-1 parity tests hold bit-for-bit against the contiguous path.
 """
 from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
 
 from ..core.registry import register_op
 from .pallas.flash_attention import flash_attention, reference_attention
@@ -35,3 +41,74 @@ def _flash_attention_op(ctx, ins, attrs):
         out = flash_attention(q, k, v, causal=causal, sm_scale=sm_scale,
                               block_q=bq, block_k=bk)
     return {"Out": [out]}
+
+
+@register_op("paged_attention", stateful=True,
+             nondiff_inputs=("BlockTable", "StartPos", "NValid"))
+def _paged_attention_op(ctx, ins, attrs):
+    """Incremental attention over a block-table paged KV pool.
+
+    One call both WRITES this step's new K/V into the physical pool and
+    READS the row's whole logical history back out of it:
+
+      Q/K/V        [B, H, T, hd]   T new tokens per row (decode: T=1,
+                                   chunked prefill: T=block_size)
+      CacheK/V     [nb, bs, H, hd] the physical pool (block-major, so a
+                                   later int8 leg only rescales blocks)
+      BlockTable   [B, max_blocks] logical block j of row b lives in
+                                   physical block BlockTable[b, j]
+      StartPos     [B]             position of the row's first new token
+      NValid       [B]             how many of the T tokens are real;
+                                   0 mutes the row entirely
+
+    Invalid (beyond-NValid) positions write to physical block 0 — the
+    engine-reserved scratch block that no table ever maps — so the op
+    is total over the fixed shape and the scheduler never needs a
+    second executable for partial chunks. Reads gather each row's
+    blocks in logical order, so key position j*bs+o carries the row's
+    j-th block at offset o; the causal mask (key_pos <= query_pos) uses
+    the slab path's exact 0/-1e30 additive form, keeping padded lanes
+    bit-identical zeros after softmax.
+    """
+    q, k, v = ins["Q"][0], ins["K"][0], ins["V"][0]
+    cache_k, cache_v = ins["CacheK"][0], ins["CacheV"][0]
+    table = ins["BlockTable"][0].astype(jnp.int32)
+    start = ins["StartPos"][0].astype(jnp.int32)
+    nvalid = ins["NValid"][0].astype(jnp.int32)
+    nb, bs, nh, hd = cache_k.shape
+    B, H, T, _ = q.shape
+    max_blocks = table.shape[1]
+    max_t = max_blocks * bs
+    sm_scale = attrs.get("sm_scale") or float(hd) ** -0.5
+
+    steps = jnp.arange(T, dtype=jnp.int32)
+    qpos = start[:, None] + steps[None, :]               # [B, T]
+    valid = steps[None, :] < nvalid[:, None]             # [B, T]
+    phys = jnp.take_along_axis(table, qpos // bs, axis=1)
+    flat_idx = jnp.where(valid, phys * bs + qpos % bs, 0)
+
+    def write(pool, new):                                # new [B,H,T,hd]
+        flat = pool.reshape(nb * bs, nh, hd)
+        rows = new.transpose(0, 2, 1, 3).reshape(B * T, nh, hd)
+        return flat.at[flat_idx.reshape(-1)].set(rows).reshape(
+            nb, bs, nh, hd)
+
+    ck_new = write(cache_k, k)
+    cv_new = write(cache_v, v)
+
+    # gather each row's logical history: [B, max_blocks, bs, H, hd]
+    # -> [B, H, max_t, hd]; entries past qpos are stale/scratch and die
+    # under the mask below
+    def history(pool):
+        g = jnp.take(pool, table, axis=0)
+        return g.reshape(B, max_t, nh, hd).transpose(0, 2, 1, 3)
+
+    keys, vals = history(ck_new), history(cv_new)
+    scores = jnp.einsum("bhtd,bhsd->bhts", q, keys) * sm_scale
+    kpos = jnp.arange(max_t, dtype=jnp.int32)
+    keep = (kpos[None, None, :] <= qpos[:, :, None]).astype(scores.dtype)
+    scores = scores + (keep * 1e30 - 1e30)[:, None, :, :]
+    probs = jax.nn.softmax(scores, axis=-1)  # same lowering as the
+    # slab path's softmax op (ops/nn_ops.py) — parity to the bit
+    out = jnp.einsum("bhts,bhsd->bhtd", probs, vals)
+    return {"Out": [out], "CacheKOut": [ck_new], "CacheVOut": [cv_new]}

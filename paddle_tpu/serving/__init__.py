@@ -32,9 +32,12 @@ from .engine import EngineConfig, ServingEngine  # noqa: F401
 from .generation import (GenerationEngine, GenerationRequest,  # noqa: F401
                          SlotManager)
 from .http import ServingHTTPServer, serve  # noqa: F401
+from .kv_blocks import (BlockPool, PrefixCache,  # noqa: F401
+                        blocks_for_tokens)
 
 __all__ = ["BucketLadder", "DynamicBatcher", "EngineConfig",
            "ServingEngine", "ServingHTTPServer", "serve", "ServingError",
            "QueueFullError", "DeadlineExceededError", "EngineClosedError",
            "OverloadedError", "GenerationEngine", "GenerationRequest",
-           "SlotManager"]
+           "SlotManager", "BlockPool", "PrefixCache",
+           "blocks_for_tokens"]

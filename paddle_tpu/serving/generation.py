@@ -26,6 +26,25 @@ Queueing reuses the `batcher.py` vocabulary: bounded queue with
 `QueueFullError` backpressure, per-request deadlines failing with
 `DeadlineExceededError`, `EngineClosedError` + drain semantics on
 shutdown, `_Response` future handles.
+
+Paged KV (FLAGS_gen_paged_kv, the default): instead of one contiguous
+`[max_slots, max_seq]` slab per layer, K/V lives in per-layer physical
+POOLS of fixed-size blocks (`serving/kv_blocks.py`), addressed through
+per-slot block tables fed to the `paged_attention` op every step. Peak
+KV HBM becomes `num_blocks x block_bytes` — budget-derived and
+decoupled from the longest POSSIBLE sequence — and three scheduler
+moves fall out of the indirection: admission gates on free BLOCKS
+(actual tokens) rather than slots alone; a slot "reset" is just
+releasing its blocks back to the pool (no in-graph wipe — the table
+simply never maps the old blocks again); and shared prompt prefixes
+hit a content-hash `PrefixCache` so identical system prompts reuse the
+same physical blocks and skip re-prefill. Long prompts retire through
+a second fixed-shape executable that prefills a whole block per step
+(chunked prefill), so a 10k-token prompt costs ~10k/block_size
+iterations interleaved with — never stalling — the decode batch. The
+compile contract widens from one executable to exactly two (decode +
+chunk prefill), both compiled in `start()`: `post_warmup_compiles()`
+stays 0 for the engine's lifetime either way.
 """
 from __future__ import annotations
 
@@ -44,6 +63,8 @@ from ..resilience.retry import RetryPolicy, is_transient
 from .batcher import (DeadlineExceededError, EngineClosedError,
                       FRACTION_BUCKETS, MS_BUCKETS, OverloadedError,
                       QueueFullError, _Response)
+from .kv_blocks import (SCRATCH_BLOCK, BlockPool, PrefixCache,
+                        blocks_for_tokens)
 
 __all__ = ["GenerationRequest", "SlotManager", "GenerationEngine"]
 
@@ -119,13 +140,14 @@ class _SlotState:
 
     __slots__ = ("req", "response", "fed", "cur", "generated", "rng",
                  "needs_reset", "deadline", "t_submit", "t_prev_token",
-                 "ttft_ms")
+                 "ttft_ms", "blocks", "n_cached", "registered")
 
     def __init__(self, req: GenerationRequest, response: _Response,
                  deadline: Optional[float], t_submit: float):
         self.req = req
         self.response = response
-        self.fed = 0                  # tokens already stepped
+        self.fed = 0                  # tokens already stepped (== the
+        #                               slot's next KV write position)
         self.cur = req.prompt[0]      # next token to feed
         self.generated: List[int] = []
         self.rng = np.random.RandomState(req.seed)
@@ -134,6 +156,12 @@ class _SlotState:
         self.t_submit = t_submit
         self.t_prev_token: Optional[float] = None
         self.ttft_ms: Optional[float] = None
+        # paged-KV bookkeeping: the slot's block table (shared prefix
+        # blocks first, then owned), prefix-cache hit length in tokens,
+        # and whether the full prompt blocks have been registered
+        self.blocks: List[int] = []
+        self.n_cached = 0
+        self.registered = False
 
 
 class _Queued:
@@ -164,7 +192,10 @@ class GenerationEngine:
                  max_seq: Optional[int] = None,
                  queue_capacity: Optional[int] = None,
                  default_timeout_ms: Optional[float] = None,
-                 state_prefix: str = "gen."):
+                 state_prefix: str = "gen.",
+                 paged: Optional[bool] = None,
+                 block_size: Optional[int] = None,
+                 kv_pool_blocks: Optional[int] = None):
         import paddle_tpu as fluid
         from ..core.flags import FLAGS
         from ..models import gpt
@@ -182,15 +213,47 @@ class GenerationEngine:
         self.default_timeout_ms = (
             default_timeout_ms if default_timeout_ms is not None
             else FLAGS.serving_default_timeout_ms)
-        # the decode-step program; its startup is never run (it would
-        # re-init the shared trained weights) — state is seeded by
-        # _ensure_decode_state in start()
+        self.paged = bool(FLAGS.gen_paged_kv if paged is None else paged)
+        # the decode-step program(s); their startup is never run (it
+        # would re-init the shared trained weights) — state is seeded
+        # by _ensure_decode_state in start()
         self._prog = fluid.Program()
         self._startup = fluid.Program()
-        with fluid.program_guard(self._prog, self._startup):
-            self.step = gpt.build_decode_step(
-                cfg, batch=self.max_slots, max_seq=self.max_seq,
-                state_prefix=state_prefix)
+        self._prefill_prog = None
+        self._pool: Optional[BlockPool] = None
+        self._prefix: Optional[PrefixCache] = None
+        if self.paged:
+            self.block_size = int(
+                min(block_size if block_size is not None
+                    else FLAGS.gen_kv_block_size, self.max_seq))
+            self.num_blocks = self._resolve_pool_blocks(kv_pool_blocks)
+            with fluid.program_guard(self._prog, self._startup):
+                self.step = gpt.build_paged_decode_step(
+                    cfg, batch=self.max_slots, max_seq=self.max_seq,
+                    block_size=self.block_size,
+                    num_blocks=self.num_blocks, seq_tokens=1,
+                    state_prefix=state_prefix)
+            # the second (and last) executable of the lifetime: retires
+            # one whole block of prompt per row per step
+            self._prefill_prog = fluid.Program()
+            self._prefill_startup = fluid.Program()
+            with fluid.program_guard(self._prefill_prog,
+                                     self._prefill_startup):
+                self.prefill_step = gpt.build_paged_decode_step(
+                    cfg, batch=self.max_slots, max_seq=self.max_seq,
+                    block_size=self.block_size,
+                    num_blocks=self.num_blocks,
+                    seq_tokens=self.block_size,
+                    state_prefix=state_prefix, with_logits=False)
+            self._pool = BlockPool(self.num_blocks, self.block_size)
+            self._prefix = PrefixCache(self._pool)
+        else:
+            self.block_size = 0
+            self.num_blocks = 0
+            with fluid.program_guard(self._prog, self._startup):
+                self.step = gpt.build_decode_step(
+                    cfg, batch=self.max_slots, max_seq=self.max_seq,
+                    state_prefix=state_prefix)
         self._slots = SlotManager(self.max_slots)
         self._state: List[Optional[_SlotState]] = \
             [None] * self.max_slots
@@ -211,6 +274,46 @@ class GenerationEngine:
             is_retryable=lambda e: isinstance(e, TransientFault))
         self._engine_state = "warming"  # warming -> ready -> stopped
 
+    # -- paged-pool sizing ----------------------------------------------
+    def kv_block_bytes(self) -> int:
+        """HBM bytes one block occupies across every layer's K+V pool
+        (float32 today; the int8 KV leg only changes this number)."""
+        if not self.paged:
+            return 0
+        return 2 * self.cfg.n_layers * self.block_size * \
+            self.cfg.d_model * 4
+
+    def kv_pool_bytes(self) -> int:
+        """Total K/V pool HBM across layers — what the static memory
+        planner prices for the paged program (pool persistables)."""
+        if not self.paged:
+            return 2 * self.cfg.n_layers * self.max_slots * \
+                self.max_seq * self.cfg.d_model * 4
+        return self.num_blocks * self.kv_block_bytes()
+
+    def _resolve_pool_blocks(self, kv_pool_blocks) -> int:
+        """Pool size precedence: ctor arg > FLAGS_gen_kv_pool_blocks >
+        FLAGS_gen_kv_pool_bytes (budget // block_bytes) > full capacity
+        (every slot can hold max_seq — no eviction pressure, but also
+        no savings; production sets the budget)."""
+        from ..core.flags import FLAGS
+        per_slot = blocks_for_tokens(self.max_seq, self.block_size)
+        if kv_pool_blocks is not None:
+            # an explicit ctor arg is honored exactly (tests build
+            # deliberately tight pools; submit reports requests that
+            # can never fit) — only the BlockPool minimum applies
+            return max(int(kv_pool_blocks), 2)
+        if FLAGS.gen_kv_pool_blocks > 0:
+            n = int(FLAGS.gen_kv_pool_blocks)
+        elif FLAGS.gen_kv_pool_bytes > 0:
+            block_bytes = 2 * self.cfg.n_layers * self.block_size * \
+                self.cfg.d_model * 4
+            n = int(FLAGS.gen_kv_pool_bytes) // block_bytes
+        else:
+            n = self.max_slots * per_slot + 1
+        # floor: scratch + one slot's worth, or nothing ever admits
+        return max(n, per_slot + 1)
+
     # -- lifecycle -------------------------------------------------------
     def init_scope(self):
         """Run the decode program's startup to give the scope FRESH
@@ -222,17 +325,36 @@ class GenerationEngine:
         return self
 
     def start(self):
-        """Seed the decode state, run one warmup step (the single
-        compile of the engine's lifetime — all slots muted), then start
-        the worker thread."""
+        """Seed the decode state, run one warmup step per executable
+        (slab: one; paged: decode + chunk prefill — ALL the compiles of
+        the engine's lifetime, slots muted), then start the worker
+        thread."""
         if self._worker is not None:
             return self
         from ..models import gpt
         blk = self._prog.global_block()
         gpt._ensure_decode_state(self.scope, blk, self.step.cache_names)
-        self._run_step(np.zeros((self.max_slots, 1), np.int64),
-                       reset=np.ones(self.max_slots, np.float32),
-                       active=np.zeros(self.max_slots, np.float32))
+        if self.paged:
+            B = self.max_slots
+            mb = self.step.max_blocks_per_slot
+            self._run_paged(self._prog, self.step,
+                            np.zeros((B, 1), np.int64),
+                            np.zeros((B, mb), np.int64),
+                            np.zeros(B, np.int64),
+                            np.zeros(B, np.int64))
+            self._run_paged(self._prefill_prog, self.prefill_step,
+                            np.zeros((B, self.block_size), np.int64),
+                            np.zeros((B, mb), np.int64),
+                            np.zeros(B, np.int64),
+                            np.zeros(B, np.int64))
+            STAT_SET("serving.gen_kv_blocks_total",
+                     self._pool.capacity())
+            STAT_SET("serving.gen_kv_blocks_free",
+                     self._pool.free_count())
+        else:
+            self._run_step(np.zeros((self.max_slots, 1), np.int64),
+                           reset=np.ones(self.max_slots, np.float32),
+                           active=np.zeros(self.max_slots, np.float32))
         self._warm_misses = self.cache_stats()["misses"]
         self._closed = False
         self._worker = threading.Thread(target=self._worker_loop,
@@ -284,6 +406,19 @@ class GenerationEngine:
         (tools/serving_loadgen.py --generate --check-compiles)."""
         return self.exe.cache_stats()
 
+    def kv_block_stats(self) -> dict:
+        """Snapshot of the paged pool for reporting (loadgen records,
+        sweep ledgers): capacity/free in blocks, the bytes the pool
+        pins, and how many prefix-cache entries are resident."""
+        if not self.paged:
+            return {"paged": False, "pool_bytes": self.kv_pool_bytes()}
+        return {"paged": True,
+                "block_size": self.block_size,
+                "blocks_total": self._pool.capacity(),
+                "blocks_free": self._pool.free_count(),
+                "prefix_entries": len(self._prefix),
+                "pool_bytes": self.kv_pool_bytes()}
+
     def post_warmup_compiles(self) -> int:
         if self._warm_misses is None:
             return 0
@@ -294,7 +429,25 @@ class GenerationEngine:
         """Enqueue; returns a future handle whose `.result()` blocks for
         ``{"tokens", "finish_reason", "ttft_ms", "e2e_ms"}``."""
         need = len(req.prompt) + req.max_new_tokens - 1
-        if need > self.max_seq:
+        if self.paged:
+            # block-aware admission: a request that can never fit is
+            # rejected here; one that merely has to WAIT for blocks
+            # queues and is admitted by the worker when the pool drains
+            need_blocks = blocks_for_tokens(need, self.block_size)
+            if need_blocks > self.step.max_blocks_per_slot:
+                raise ValueError(
+                    f"request needs {need_blocks} KV blocks but a "
+                    f"slot's block table holds at most "
+                    f"{self.step.max_blocks_per_slot} "
+                    f"(max_seq={self.max_seq}, "
+                    f"block_size={self.block_size})")
+            if need_blocks > self._pool.capacity():
+                raise ValueError(
+                    f"request needs {need_blocks} KV blocks but the "
+                    f"engine's pool has only {self._pool.capacity()} "
+                    f"allocatable blocks "
+                    f"({self._pool.free_count()} free now)")
+        elif need > self.max_seq:
             raise ValueError(
                 f"request needs {need} cache positions but the engine "
                 f"was built with max_seq={self.max_seq}")
@@ -338,6 +491,110 @@ class GenerationEngine:
             scope=self.scope)
         return np.asarray(out)
 
+    def _run_paged(self, prog, step, tokens, table, start, nvalid):
+        out, = self.exe.run(
+            prog,
+            feed={step.token_var.name: tokens,
+                  step.table_var.name: table,
+                  step.start_var.name: start,
+                  step.nvalid_var.name: nvalid},
+            fetch_list=[step.logits_var],
+            scope=self.scope)
+        return np.asarray(out)
+
+    # -- paged-KV bookkeeping (worker thread only) -----------------------
+    def _alloc_block(self) -> Optional[int]:
+        """Pool alloc with prefix-cache pressure relief: when the free
+        list is empty, evict cold cached prefixes (LRU, only blocks no
+        live slot references) until one frees."""
+        bid = self._pool.alloc()
+        while bid is None:
+            if self._prefix.evict_lru() is None:
+                return None
+            bid = self._pool.alloc()
+        return bid
+
+    def _set_block_gauges(self):
+        STAT_SET("serving.gen_kv_blocks_free", self._pool.free_count())
+
+    def _admit_locked(self) -> bool:
+        """Move the queue head into a free slot. Paged mode additionally
+        gates on block availability: shared prefix blocks come from the
+        PrefixCache (refcounted, zero prefill cost), the rest are
+        allocated upfront for the request's worst case — so a decode
+        can never die mid-flight from pool exhaustion. Returns False
+        (leaving the queue untouched) when the head cannot be placed
+        yet."""
+        q = self._queue[0]
+        slot = self._slots.acquire()
+        if slot is None:
+            return False
+        st = _SlotState(q.req, q.response, q.deadline, q.t_submit)
+        if self.paged:
+            prompt = q.req.prompt
+            need = len(prompt) + q.req.max_new_tokens - 1
+            # the last prompt position must stay writable (its KV is
+            # written by this slot's first decode step), so the prefix
+            # match is capped one token short of the prompt
+            n_cached, shared = self._prefix.lookup(
+                prompt, max_tokens=len(prompt) - 1)
+            owned: List[int] = []
+            missing = blocks_for_tokens(need, self.block_size) - \
+                len(shared)
+            while len(owned) < missing:
+                bid = self._alloc_block()
+                if bid is None:
+                    break
+                owned.append(bid)
+            else:
+                st.blocks = shared + owned
+                st.n_cached = n_cached
+                st.fed = n_cached
+                st.cur = prompt[n_cached]
+                STAT_ADD("serving.gen_prefix_hits" if n_cached
+                         else "serving.gen_prefix_misses")
+                self._set_block_gauges()
+                self._state[slot] = st
+                self._queue.pop(0)
+                return True
+            # not enough blocks: roll back and wait for releases
+            for bid in owned + shared:
+                self._pool.decref(bid)
+            self._slots.release(slot)
+            self._set_block_gauges()
+            return False
+        self._state[slot] = st
+        self._queue.pop(0)
+        return True
+
+    def _release_slot(self, i: int):
+        """Retire slot i: in paged mode 'reset' IS this — the blocks go
+        back to the pool (or stay resident for the prefix cache /
+        other slots holding refs); the graph never wipes anything."""
+        st = self._state[i]
+        if st is not None and self.paged:
+            for bid in st.blocks:
+                self._pool.decref(bid)
+            st.blocks = []
+            self._set_block_gauges()
+        self._state[i] = None
+        self._slots.release(i)
+
+    def _register_prefix(self, st: _SlotState):
+        """After the first decode step, every full prompt block is
+        immutable (all later writes land at positions past the prompt)
+        — publish them to the prefix cache so the NEXT identical
+        prefix skips its prefill."""
+        bs = self.block_size
+        n_full = len(st.req.prompt) // bs
+        if n_full == 0:
+            return
+        hashes = self._prefix.chunk_hashes(st.req.prompt[:n_full * bs],
+                                           bs)
+        for j, h in enumerate(hashes):
+            self._prefix.insert(h, st.blocks[j])
+        self._set_block_gauges()
+
     # -- worker ----------------------------------------------------------
     def _expire_queued_locked(self, now) -> List[_Queued]:
         dead = [q for q in self._queue
@@ -353,6 +610,7 @@ class GenerationEngine:
             "finish_reason": reason,
             "ttft_ms": st.ttft_ms,
             "e2e_ms": (now - st.t_submit) * 1e3,
+            "cached_tokens": st.n_cached,
         })
         if _monitor_on():
             STAT_OBSERVE("serving.gen_e2e_ms",
@@ -375,12 +633,11 @@ class GenerationEngine:
                     self._queue = []
                 # admit queued requests into free slots (iteration-level
                 # scheduling: this runs BETWEEN decode steps, so a slot
-                # freed by the previous step is reusable right now)
-                while self._queue and self._slots.free_count():
-                    q = self._queue.pop(0)
-                    slot = self._slots.acquire()
-                    self._state[slot] = _SlotState(
-                        q.req, q.response, q.deadline, q.t_submit)
+                # — and in paged mode its KV blocks — freed by the
+                # previous step is reusable right now)
+                while self._queue and self._slots.free_count() \
+                        and self._admit_locked():
+                    pass
                 active_idx = [i for i in range(B)
                               if self._state[i] is not None]
                 STAT_SET("serving.gen_queue_depth", len(self._queue))
@@ -405,12 +662,14 @@ class GenerationEngine:
                     if st is not None:
                         st.response._complete(error=EngineClosedError(
                             "generation engine shut down mid-decode"))
-                        self._state[i] = None
-                        self._slots.release(i)
+                        self._release_slot(i)
                 break
             if exit_loop:
                 break
             if not active_idx:
+                continue
+            if self.paged:
+                self._paged_iteration()
                 continue
 
             # ---- one decode step over the full fixed-shape batch ----
@@ -525,3 +784,183 @@ class GenerationEngine:
                     self._slots.release(i)
                 else:
                     st.cur = tok
+
+    # -- paged iteration -------------------------------------------------
+    def _paged_iteration(self):
+        """One scheduler iteration of the paged engine: (1) chunked
+        prefill — every slot still consuming its prompt retires up to
+        one BLOCK of tokens through the prefill executable; (2) one
+        decode step for every slot past its prompt. Both run the same
+        two warmed executables every time (fixed shapes; muted rows
+        write to the scratch block), so admission, chunk scheduling,
+        release and prefix reuse never cost a compile. Long prompts
+        therefore interleave with decode at block granularity instead
+        of stalling the batch for O(prompt) steps."""
+        from ..core.flags import FLAGS
+        from ..models import sampling
+        B = self.max_slots
+        bs = self.block_size
+        mb = self.step.max_blocks_per_slot
+        now = time.perf_counter()
+        for i in range(B):
+            st = self._state[i]
+            if st is not None and st.deadline is not None \
+                    and now >= st.deadline:
+                STAT_ADD("serving.gen_timeouts")
+                st.response._complete(error=DeadlineExceededError(
+                    "generation deadline passed mid-decode"))
+                self._release_slot(i)
+
+        def fill_row(arr_table, arr_start, i, st):
+            arr_table[i, :len(st.blocks)] = st.blocks
+            arr_start[i] = st.fed
+
+        def run_guarded(prog, step, tokens, table, start, nvalid,
+                        idx, what):
+            """Shared failure envelope: injector pre-step faults retry
+            (RetryPolicy), anything after the real dispatch fails the
+            involved slots — KV already advanced, a replay would
+            double-write. Returns the fetch or None."""
+            def _attempt():
+                inj = _fault_injector()
+                if inj is not None:
+                    inj.pre_step("generation")
+                return self._run_paged(prog, step, tokens, table,
+                                       start, nvalid)
+            try:
+                out = self._step_retry.call(_attempt)
+            except Exception as e:  # noqa: BLE001 — worker must survive
+                if is_transient(e):
+                    self._breaker.record_failure()
+                STAT_ADD("resilience.gen_step_failures")
+                for i in idx:
+                    st = self._state[i]
+                    st.response._complete(error=RuntimeError(
+                        f"{what} step failed: {e!r}"))
+                    self._release_slot(i)
+                return None
+            self._breaker.record_success()
+            return out
+
+        # ---- phase 1: chunked prefill ---------------------------------
+        prefill_idx = [
+            i for i in range(B) if self._state[i] is not None
+            and self._state[i].fed < len(self._state[i].req.prompt) - 1]
+        if prefill_idx:
+            tokens = np.zeros((B, bs), np.int64)
+            table = np.zeros((B, mb), np.int64)
+            start = np.zeros(B, np.int64)
+            nvalid = np.zeros(B, np.int64)
+            chunk_n = {}
+            for i in prefill_idx:
+                st = self._state[i]
+                prompt = st.req.prompt
+                n = min(bs, len(prompt) - 1 - st.fed)
+                tokens[i, :n] = prompt[st.fed:st.fed + n]
+                fill_row(table, start, i, st)
+                nvalid[i] = n
+                chunk_n[i] = n
+            probe = run_guarded(self._prefill_prog, self.prefill_step,
+                                tokens, table, start, nvalid,
+                                prefill_idx, "prefill")
+            if probe is None:
+                return
+            if FLAGS.serving_nan_guard:
+                bad = [i for i in prefill_idx
+                       if not np.isfinite(probe[i])]
+                if bad:
+                    self._breaker.record_failure()
+                    STAT_ADD("resilience.gen_step_failures")
+                    for i in bad:
+                        st = self._state[i]
+                        st.response._complete(error=RuntimeError(
+                            "non-finite activations in chunked prefill "
+                            "(cannot replay a stateful step)"))
+                        self._release_slot(i)
+                    prefill_idx = [i for i in prefill_idx
+                                   if i not in bad]
+            for i in prefill_idx:
+                st = self._state[i]
+                st.fed += chunk_n[i]
+                st.cur = st.req.prompt[st.fed]
+                STAT_ADD("serving.gen_chunked_prefills")
+
+        # ---- phase 2: one decode step ---------------------------------
+        decode_idx = [
+            i for i in range(B) if self._state[i] is not None
+            and self._state[i].fed >=
+            len(self._state[i].req.prompt) - 1]
+        if not decode_idx:
+            return
+        tokens = np.zeros((B, 1), np.int64)
+        table = np.zeros((B, mb), np.int64)
+        start = np.zeros(B, np.int64)
+        nvalid = np.zeros(B, np.int64)
+        for i in decode_idx:
+            st = self._state[i]
+            tokens[i, 0] = st.cur
+            fill_row(table, start, i, st)
+            nvalid[i] = 1
+        logits = run_guarded(self._prog, self.step, tokens, table,
+                             start, nvalid, decode_idx, "decode")
+        if logits is None:
+            return
+        inj = _fault_injector()
+        if inj is not None:
+            arrs = [logits]
+            if inj.corrupt_fetches("generation", arrs):
+                logits = arrs[0]
+        if FLAGS.serving_nan_guard:
+            bad = [i for i in decode_idx
+                   if not np.all(np.isfinite(logits[i, 0]))]
+            if bad:
+                self._breaker.record_failure()
+                STAT_ADD("resilience.gen_step_failures")
+                for i in bad:
+                    st = self._state[i]
+                    st.response._complete(error=RuntimeError(
+                        "non-finite logits (cannot replay a stateful "
+                        "decode step)"))
+                    self._release_slot(i)
+                decode_idx = [i for i in decode_idx if i not in bad]
+                if not decode_idx:
+                    return
+        STAT_ADD("serving.gen_steps")
+        if _monitor_on():
+            STAT_OBSERVE("serving.gen_slot_occupancy",
+                         len(decode_idx) / float(B),
+                         buckets=FRACTION_BUCKETS)
+
+        t_step = time.perf_counter()
+        for i in decode_idx:
+            st = self._state[i]
+            st.fed += 1
+            tok = sampling.sample_token(
+                logits[i, 0], temperature=st.req.temperature,
+                top_k=st.req.top_k, rng=st.rng)
+            st.generated.append(tok)
+            STAT_ADD("serving.gen_tokens")
+            if len(st.generated) == 1:
+                st.ttft_ms = (t_step - st.t_submit) * 1e3
+                if _monitor_on():
+                    STAT_OBSERVE("serving.gen_ttft_ms", st.ttft_ms,
+                                 buckets=MS_BUCKETS)
+                if not st.registered:
+                    # the whole prompt (every full block of it) is now
+                    # resident and immutable — shareable from here on
+                    self._register_prefix(st)
+                    st.registered = True
+            elif _monitor_on() and st.t_prev_token is not None:
+                STAT_OBSERVE("serving.gen_inter_token_ms",
+                             (t_step - st.t_prev_token) * 1e3,
+                             buckets=MS_BUCKETS)
+            st.t_prev_token = t_step
+            if st.req.stream_cb is not None:
+                st.req.stream_cb(tok)
+            done_eos = (st.req.eos_id is not None
+                        and tok == st.req.eos_id)
+            if done_eos or len(st.generated) >= st.req.max_new_tokens:
+                self._finish(st, "eos" if done_eos else "length")
+                self._release_slot(i)
+            else:
+                st.cur = tok

@@ -234,6 +234,18 @@ def report(path, out=sys.stdout):
         if occ and occ["count"]:
             w(f"{'slot occupancy':26s} mean "
               f"{occ['sum'] / occ['count']:.1%} of slots per step\n")
+        phit = c.get("serving.gen_prefix_hits", 0)
+        pmiss = c.get("serving.gen_prefix_misses", 0)
+        if phit or pmiss:
+            rate = phit / (phit + pmiss)
+            w(f"{'prefix cache':26s} hits {int(phit)}   misses "
+              f"{int(pmiss)}   hit rate {rate:.1%}   chunked prefills "
+              f"{int(c.get('serving.gen_chunked_prefills', 0))}\n")
+        kv_total = g.get("serving.gen_kv_blocks_total")
+        if kv_total:
+            w(f"{'kv block pool':26s} "
+              f"{int(g.get('serving.gen_kv_blocks_free', 0))} free of "
+              f"{int(kv_total)} blocks\n")
         for label, name in (("ttft", "serving.gen_ttft_ms"),
                             ("inter-token", "serving.gen_inter_token_ms"),
                             ("e2e latency", "serving.gen_e2e_ms")):
@@ -257,6 +269,16 @@ def report(path, out=sys.stdout):
               f"ttft p99 {ttft.get('p99')} ms  "
               f"inter-token p99 {inter.get('p99')} ms  "
               f"errors {r.get('errors', 0)}{extra}\n")
+            pre = r.get("prefix") or {}
+            if pre.get("hit_requests") or pre.get("miss_requests"):
+                th = (pre.get("ttft_hit_ms") or {}).get("p50")
+                tm = (pre.get("ttft_miss_ms") or {}).get("p50")
+                hr = pre.get("hit_rate")
+                w(f"{'  prefix split':26s} hit rate "
+                  f"{'-' if hr is None else format(hr, '.1%')}  "
+                  f"ttft p50 hit {th} ms vs miss {tm} ms  "
+                  f"({pre.get('hit_requests', 0)} hit / "
+                  f"{pre.get('miss_requests', 0)} miss)\n")
 
     faults = c.get("resilience.faults_injected")
     retries = c.get("resilience.retries")

@@ -228,6 +228,12 @@ def _print_memory_text(rec, out=sys.stdout):
     if rec["unsized_vars"]:
         out.write(f"  ({rec['unsized_vars']} var(s) without a spec — "
                   f"not counted)\n")
+    kv = rec.get("kv")
+    if kv:
+        out.write(f"  kv cache: layout={kv['layout']}  "
+                  f"{_fmt_bytes(kv['kv_bytes'])} across "
+                  f"{kv['kv_vars']} persistables "
+                  f"({kv['kv_frac_of_peak']:.0%} of peak)\n")
     out.write(f"  {'resident @ peak':<40s} {'bytes':>12s}  interval\n")
     for iv in rec["top_residents"]:
         span = "pinned" if iv["pinned"] \

@@ -10,7 +10,12 @@ Two workloads:
   (`kind="generation_loadgen"` records carrying tokens/s, TTFT and
   inter-token latency percentiles). --compare-serial replays the same
   request set through serial per-request `gpt.kv_generate` — the
-  throughput floor continuous batching must beat.
+  throughput floor continuous batching must beat AND the exact-answer
+  reference every engine output is verified against (exit 4 on
+  mismatch). --shared-prefix-frac makes that fraction of requests open
+  with one fixed whole-block prefix: the record gains a "prefix"
+  object splitting TTFT hit-vs-miss and snapshotting the paged KV
+  pool; --block-size / --slab pick the KV layout for A/B runs.
 
 Two targets:
 
@@ -269,17 +274,35 @@ def summarize_generation(mode, latencies_s, ttfts_s, inter_s, tokens,
     }
 
 
-def make_gen_requests(n, vocab, max_prompt, max_new_tokens, seed=0):
+def make_gen_requests(n, vocab, max_prompt, max_new_tokens, seed=0,
+                      shared_prefix_frac=0.0, shared_prefix_len=0):
     """Mixed prompt lengths in [1, max_prompt] — with staggered
     admission this is exactly the traffic that would recompile a
-    shape-naive decode path."""
+    shape-naive decode path.
+
+    `shared_prefix_frac` of the requests open with one fixed
+    `shared_prefix_len`-token prefix (the shared-system-prompt shape of
+    real LLM traffic): the prefix-cache workload. Each request carries
+    `"shared": bool` so the report can split TTFT by cohort even when
+    the engine under test has no cache to report hits from."""
     rng = np.random.RandomState(seed)
-    return [{"prompt": rng.randint(0, vocab,
-                                   size=rng.randint(
-                                       1, max_prompt + 1)).tolist(),
-             "max_new_tokens": int(max_new_tokens),
-             "seed": int(seed + i)}
-            for i, _ in enumerate(range(n))]
+    prefix = rng.randint(0, vocab, size=max(int(shared_prefix_len),
+                                            0)).tolist()
+    out = []
+    for i in range(n):
+        shared = bool(prefix) and shared_prefix_frac > 0 \
+            and rng.random_sample() < shared_prefix_frac
+        if shared:
+            tail = rng.randint(0, vocab, size=rng.randint(
+                1, max(2, max_prompt - len(prefix) + 1))).tolist()
+            prompt = prefix + tail
+        else:
+            prompt = rng.randint(0, vocab, size=rng.randint(
+                1, max_prompt + 1)).tolist()
+        out.append({"prompt": prompt,
+                    "max_new_tokens": int(max_new_tokens),
+                    "seed": int(seed + i), "idx": i, "shared": shared})
+    return out
 
 
 class _GenStats:
@@ -291,6 +314,14 @@ class _GenStats:
         self.ttfts = []
         self.inter = []
         self.tokens = 0
+        # prefix-cache probe: TTFT split by whether the engine reported
+        # cached prompt tokens, plus per-request outputs keyed by the
+        # request's idx for the wrong-answers check vs the serial ref
+        self.ttft_hit = []
+        self.ttft_miss = []
+        self.hits = 0
+        self.misses = 0
+        self.outputs = {}
 
     def record(self, t_submit, token_times, n_tokens):
         with self.lock:
@@ -299,6 +330,19 @@ class _GenStats:
                 self.inter.extend(b - a for a, b in
                                   zip(token_times, token_times[1:]))
             self.tokens += n_tokens
+
+    def record_prefix(self, t_submit, token_times, cached_tokens,
+                      idx=None, tokens=None):
+        with self.lock:
+            if cached_tokens:
+                self.hits += 1
+            else:
+                self.misses += 1
+            if token_times:
+                (self.ttft_hit if cached_tokens
+                 else self.ttft_miss).append(token_times[0] - t_submit)
+            if idx is not None:
+                self.outputs[idx] = list(tokens or ())
 
 
 class _GenEngineTarget:
@@ -319,6 +363,9 @@ class _GenEngineTarget:
             stream_cb=lambda tok: times.append(time.perf_counter())))
         out = resp.result(timeout=(timeout_ms or 30000.0) / 1e3 + 30.0)
         self.stats.record(t0, times, len(out["tokens"]))
+        self.stats.record_prefix(t0, times, out.get("cached_tokens", 0),
+                                 idx=req.get("idx"),
+                                 tokens=out["tokens"])
 
 
 class _GenHTTPTarget:
@@ -348,10 +395,12 @@ class _GenHTTPTarget:
 
 def run_serial_generation(exe, scope, prog, step, reqs):
     """Serial per-request kv_generate over a batch=1 decode graph
-    sharing the engine's scope — the no-continuous-batching floor."""
+    sharing the engine's scope — the no-continuous-batching floor AND
+    the exact-answer reference (outputs keyed by request idx)."""
     from paddle_tpu.models import gpt
     stats = _GenStats()
     latencies = []
+    outputs = {}
     t0 = time.perf_counter()
     for req in reqs:
         times = []
@@ -363,19 +412,37 @@ def run_serial_generation(exe, scope, prog, step, reqs):
             stream_cb=lambda tok: times.append(time.perf_counter()))
         latencies.append(time.perf_counter() - t1)
         stats.record(t1, times, len(out))
-    return stats, latencies, time.perf_counter() - t0
+        if "idx" in req:
+            outputs[req["idx"]] = list(out)
+    return stats, latencies, time.perf_counter() - t0, outputs
 
 
 def run_generation(args):
     """The --generate workload: continuous-batching engine (or HTTP
     front end) under closed/open-loop generation traffic, optional
     serial kv_generate baseline, optional compile-count gate."""
+    prefix_frac = getattr(args, "shared_prefix_frac", 0.0) or 0.0
+    prefix_len = getattr(args, "shared_prefix_len", 0) or 0
+    block_size = getattr(args, "block_size", 0) or 0
+    if prefix_frac > 0 and prefix_len <= 0:
+        # auto: largest whole-block prefix that still leaves >= 1
+        # uncached prompt token (only FULL blocks are shareable, so the
+        # block size itself must fit under max_prompt too)
+        if block_size <= 0:
+            block_size = min(16, max(args.max_prompt - 1, 1))
+        prefix_len = (max(args.max_prompt - 1, 1)
+                      // block_size) * block_size
+        prefix_len = max(prefix_len, 0)
     reqs = make_gen_requests(args.requests, args.vocab, args.max_prompt,
-                             args.max_new_tokens, args.seed)
+                             args.max_new_tokens, args.seed,
+                             shared_prefix_frac=prefix_frac,
+                             shared_prefix_len=prefix_len)
     common = {"concurrency": args.concurrency, "rate": args.rate,
               "slots": args.slots, "max_prompt": args.max_prompt,
               "max_new_tokens": args.max_new_tokens,
-              "max_seq": args.max_seq, "vocab": args.vocab}
+              "max_seq": args.max_seq, "vocab": args.vocab,
+              "shared_prefix_frac": prefix_frac,
+              "shared_prefix_len": prefix_len}
 
     if args.url:
         stats = _GenStats()
@@ -405,7 +472,10 @@ def run_generation(args):
     scope = fluid.Scope()
     engine = GenerationEngine(cfg, scope, max_slots=args.slots,
                               max_seq=args.max_seq,
-                              default_timeout_ms=args.timeout_ms)
+                              default_timeout_ms=args.timeout_ms,
+                              paged=(False if getattr(args, "slab", False)
+                                     else None),
+                              block_size=block_size or None)
     engine.init_scope()   # scratch weights: loadgen measures the
     engine.start()        # serving path, not model quality
     misses_after_warmup = engine.cache_stats()["misses"]
@@ -428,6 +498,17 @@ def run_generation(args):
     rec["cache"] = {"misses_after_warmup": misses_after_warmup,
                     "misses_total": engine.cache_stats()["misses"],
                     "post_warmup_compiles": post}
+    total = stats.hits + stats.misses
+    rec["prefix"] = {
+        "shared_prefix_frac": prefix_frac,
+        "shared_prefix_len": prefix_len,
+        "hit_requests": stats.hits,
+        "miss_requests": stats.misses,
+        "hit_rate": round(stats.hits / total, 4) if total else None,
+        "ttft_hit_ms": _lat_summary(stats.ttft_hit),
+        "ttft_miss_ms": _lat_summary(stats.ttft_miss),
+        "kv": engine.kv_block_stats(),
+    }
     emit(rec, args.out)
 
     if args.compare_serial:
@@ -437,12 +518,25 @@ def run_generation(args):
         with fluid.program_guard(dec_main, dec_start):
             step1 = gpt.build_decode_step(cfg, batch=1,
                                           max_seq=args.max_seq)
-        sstats, slat, sdur = run_serial_generation(
+        sstats, slat, sdur, souts = run_serial_generation(
             engine.exe, scope, dec_main, step1, reqs)
         srec = summarize_generation(
             "serial_baseline", slat, sstats.ttfts, sstats.inter,
             sstats.tokens, 0, sdur, common)
+        wrong = sum(
+            1 for i, toks in souts.items()
+            if i in stats.outputs
+            and [int(t) for t in stats.outputs[i]]
+            != [int(t) for t in toks])
+        srec["wrong_answers"] = wrong
+        srec["compared_requests"] = sum(
+            1 for i in souts if i in stats.outputs)
         emit(srec, args.out)
+        if wrong:
+            print(f"FAIL: {wrong} engine outputs diverge from the "
+                  f"serial reference", file=sys.stderr)
+            engine.stop()
+            return 4
         if srec["tokens_per_s"]:
             speedup = rec["tokens_per_s"] / srec["tokens_per_s"]
             print(f"# continuous/serial tokens-per-second speedup: "
@@ -643,6 +737,19 @@ def main(argv=None):
     ap.add_argument("--max-seq", type=int, default=32,
                     help="generation KV-cache length")
     ap.add_argument("--vocab", type=int, default=64)
+    ap.add_argument("--shared-prefix-frac", type=float, default=0.0,
+                    help="fraction of generation requests opening with "
+                         "one fixed shared prefix (the prefix-cache "
+                         "workload); report splits TTFT hit vs miss")
+    ap.add_argument("--shared-prefix-len", type=int, default=0,
+                    help="shared prefix length in tokens (0 = auto: "
+                         "largest whole-block prefix < max-prompt)")
+    ap.add_argument("--block-size", type=int, default=0,
+                    help="KV block size for the paged engine "
+                         "(0 = FLAGS_gen_kv_block_size)")
+    ap.add_argument("--slab", action="store_true",
+                    help="force the contiguous slab KV layout "
+                         "(paged=False) regardless of FLAGS_gen_paged_kv")
     ap.add_argument("--chaos", action="store_true",
                     help="fault-injection acceptance run: baseline "
                          "pass, then the same traffic under "

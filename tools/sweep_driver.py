@@ -79,6 +79,15 @@ CONFIGS = [
     # TTFT/inter-token p99 and the continuous-vs-serial speedup, and
     # --check-compiles makes a post-warmup recompile a hard failure
     ("gen_loadgen_s4", None),  # special-cased below
+    # paged-vs-slab KV layout A/B at a FIXED HBM budget (docs/
+    # serving.md "Paged KV cache"): both cells get the same KV byte
+    # budget; the slab cell can only afford budget/(2*L*max_seq*d*4)
+    # slots while the paged cell sizes a block pool from the same bytes
+    # and runs every slot the pool sustains at worst-case request
+    # length. The pair records sustainable-slot-count and inter-token
+    # p99 per layout.
+    ("gen_paged_kvfix", None),  # special-cased below
+    ("gen_slab_kvfix", None),  # special-cased below
     # chaos acceptance (serving_loadgen --chaos): serving traffic under
     # FLAGS_fault_spec; the ledger entry records the p99 inflation and
     # the zero-wrong-answers / zero-worker-deaths verdict (rc 4/5 when
@@ -326,6 +335,57 @@ def run_special(key):
                 "post_warmup_compiles":
                     (cont.get("cache") or {}).get("post_warmup_compiles"),
                 "speedup_note": speedup.lstrip("# ").strip()}, None
+    if key in ("gen_paged_kvfix", "gen_slab_kvfix"):
+        # fixed KV budget A/B: geometry mirrors run_generation's
+        # gpt_small (d_model=32, n_layers=2) at max_seq=32, fp32.
+        # budget = 4 slab slots; the paged cell turns the same bytes
+        # into a block pool and runs every slot it sustains at
+        # worst-case length (max_prompt + max_new_tokens tokens).
+        d_model, n_layers, max_seq, block_size = 32, 2, 32, 16
+        slab_slot_bytes = 2 * n_layers * max_seq * d_model * 4
+        budget = 4 * slab_slot_bytes
+        paged = key == "gen_paged_kvfix"
+        if paged:
+            block_bytes = 2 * n_layers * block_size * d_model * 4
+            per_req_blocks = -(-(8 + 8) // block_size)  # max_prompt=8,
+            # max_new_tokens=8 (loadgen defaults), ceil-div
+            slots = max(1, (budget // block_bytes - 1) // per_req_blocks)
+        else:
+            slots = budget // slab_slot_bytes
+        out_path = f"/tmp/gen_{key}_{ROUND}.jsonl"
+        env = dict(os.environ,
+                   FLAGS_gen_paged_kv=str(int(paged)),
+                   FLAGS_gen_kv_pool_bytes=str(budget),
+                   FLAGS_gen_kv_block_size=str(block_size))
+        p = subprocess.run(
+            [sys.executable, "tools/serving_loadgen.py", "--generate",
+             "--slots", str(slots), "--requests", "24",
+             "--check-compiles", "--out", out_path],
+            cwd=REPO, capture_output=True, text=True, timeout=1800,
+            env=env)
+        if p.returncode != 0:
+            return None, (f"rc={p.returncode}: "
+                          + (p.stdout + p.stderr)[-300:])
+        recs = []
+        try:
+            with open(out_path) as f:
+                recs = [json.loads(ln) for ln in f if ln.strip()]
+        except (OSError, ValueError) as e:
+            return None, f"unreadable {out_path}: {e}"
+        cont = next((r for r in recs
+                     if r.get("kind") == "generation_loadgen"), None)
+        if cont is None or not cont.get("tokens_per_s"):
+            return None, "no generation_loadgen record with tokens_per_s"
+        return {"metric": "gen_sustainable_slots", "value": slots,
+                "unit": "slots", "layout": "paged" if paged else "slab",
+                "kv_budget_bytes": budget,
+                "tokens_per_s": cont["tokens_per_s"],
+                "inter_token_p99_ms":
+                    (cont.get("inter_token_ms") or {}).get("p99"),
+                "ttft_p99_ms": (cont.get("ttft_ms") or {}).get("p99"),
+                "post_warmup_compiles":
+                    (cont.get("cache") or {}).get("post_warmup_compiles"),
+                }, None
     if key == "chaos_s4":
         out_path = f"/tmp/chaos_loadgen_{ROUND}.jsonl"
         p = subprocess.run(

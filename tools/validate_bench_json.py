@@ -149,6 +149,34 @@ def validate_generation_loadgen(obj, where="generation_loadgen"):
                             f"(got {v!r})")
     if not isinstance(obj.get("config"), dict):
         errs.append(f"{where}: config must be an object")
+    # optional prefix-cache probe block (--shared-prefix-frac runs)
+    pre = obj.get("prefix")
+    if pre is not None:
+        if not isinstance(pre, dict):
+            errs.append(f"{where}: prefix must be an object")
+        else:
+            for key in ("hit_requests", "miss_requests"):
+                v = pre.get(key)
+                if not isinstance(v, int) or isinstance(v, bool):
+                    errs.append(f"{where}: prefix.{key} must be an int "
+                                f"(got {v!r})")
+            hr = pre.get("hit_rate")
+            if hr is not None and (not isinstance(hr, (int, float))
+                                   or isinstance(hr, bool)):
+                errs.append(f"{where}: prefix.hit_rate must be numeric "
+                            f"or null (got {hr!r})")
+            for field in ("ttft_hit_ms", "ttft_miss_ms"):
+                hist = pre.get(field)
+                if not isinstance(hist, dict):
+                    errs.append(f"{where}: prefix.{field} must be an "
+                                f"object")
+                    continue
+                for q in _LOADGEN_PCTS:
+                    v = hist.get(q)
+                    if v is not None and (not isinstance(v, (int, float))
+                                          or isinstance(v, bool)):
+                        errs.append(f"{where}: prefix.{field}.{q} must "
+                                    f"be numeric (got {v!r})")
     return errs
 
 
