@@ -509,6 +509,34 @@ DEFINE_string(
     "the parallel.get_mesh() registry mesh (all devices, 1-D data "
     "axis). Traced: a shape change recompiles.", traced=True)
 
+DEFINE_bool(
+    "enable_trace", False,
+    "Per-request distributed tracing (paddle_tpu/trace.py): spans with "
+    "W3C traceparent propagation across the HTTP -> batcher -> engine "
+    "-> executor path. Off, every trace entry point returns after one "
+    "cached-flag read. Host-side only — never part of a compile cache "
+    "key.")
+
+DEFINE_double(
+    "trace_sample", 0.05,
+    "Head-sampling keep probability for request traces (decided once "
+    "per root span). Tail rules OVERRIDE it: errored requests and "
+    "requests slower than the rolling latency threshold are always "
+    "kept. 1.0 keeps every trace.")
+
+DEFINE_int32(
+    "trace_ring_capacity", 8192,
+    "Bounded in-process span ring: kept spans past this count evict "
+    "oldest-first. Sized for post-mortem dumps, not long-term storage "
+    "— export with trace.export_jsonl / export_chrome_tracing.")
+
+DEFINE_double(
+    "trace_tail_slow_ms", 0.0,
+    "Absolute tail-sampling slow threshold (ms): any request whose "
+    "e2e exceeds it is kept regardless of head sampling. 0 (default) "
+    "= rolling p95 over the last trace window (keeps ~the slowest 5% "
+    "once enough requests have completed).")
+
 # ---------------------------------------------------------------------------
 # Reference-flag compat surface (App. C parity target:
 # platform/flags.cc:33-449 + the read_env_flags whitelist in

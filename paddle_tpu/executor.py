@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import trace as _trace
 from .core.dtypes import as_np_dtype
 from .core.lowering import LowerCtx, lower_block
 from .core.place import Place, default_place
@@ -92,6 +93,12 @@ class Executor:
         # Strong refs to CompiledPrograms in the cache: keys use
         # id(compiled), which is only stable while the object is alive.
         self._compiled_refs: Dict[int, object] = {}
+        # Sub-step timing of the most recent run() (feed staging /
+        # dispatch / fetch-block, seconds). The generation engine reads
+        # this after each step to attribute fetch time to the request
+        # spans of the slots in flight.
+        self.last_step_timings: Optional[Dict[str, float]] = None
+        self._last_feed_s = 0.0
 
     # ------------------------------------------------------------------
     def run(self, program: Optional[Program] = None, feed=None,
@@ -120,6 +127,7 @@ class Executor:
             return []
 
         t_run0 = time.perf_counter()
+        self._last_feed_s = 0.0
         step_fn, state, feed_arrays = self._resolve_step(
             program, feed, fetch_list, scope, compiled, use_program_cache)
 
@@ -129,6 +137,8 @@ class Executor:
 
         first_run = step_fn.runs == 0
         step_fn.runs += 1
+
+        t_disp0 = time.perf_counter()
 
         # Fault injection (FLAGS_fault_spec; paddle_tpu/resilience).
         # Empty spec = one cached None-check. An injected TransientFault
@@ -170,19 +180,44 @@ class Executor:
         else:
             out = list(fetches)
         now = time.perf_counter()
+        self.last_step_timings = {
+            "feed_s": self._last_feed_s,
+            "dispatch_s": t_fetch0 - t_disp0,
+            "fetch_s": now - t_fetch0,
+            "total_s": now - t_run0,
+        }
         if _monitor_on():
+            tid = _trace.current_trace_id()
             # fetch/block time: device sync happens in np.asarray; with
             # return_numpy=False dispatch is async and this measures ~0
-            STAT_OBSERVE("executor.fetch_block_seconds", now - t_fetch0)
-            STAT_OBSERVE("executor.step_seconds", now - t_run0)
+            STAT_OBSERVE("executor.fetch_block_seconds", now - t_fetch0,
+                         exemplar=tid)
+            STAT_OBSERVE("executor.step_seconds", now - t_run0,
+                         exemplar=tid)
             if first_run:
                 # lazy-jit compile is paid here: first-call wall time is
                 # the compile + first-execute cost (amortization input
                 # for tools/metrics_report.py)
                 STAT_OBSERVE("executor.compile_first_step_seconds",
-                             now - t_run0)
+                             now - t_run0, exemplar=tid)
             from .core.memory import record_device_memory
             record_device_memory(self.place.jax_device())
+        cur = _trace.current_span()
+        if cur is not None:
+            # Retroactive per-step sub-spans (feed staging / dispatch /
+            # fetch-block) under whatever span is current — the batch
+            # span in the serving worker, a step span in tests. Wall-
+            # clock endpoints are reconstructed from the perf deltas.
+            wall_end = time.time()
+            w_fetch0 = wall_end - (now - t_fetch0)
+            w_disp0 = wall_end - (now - t_disp0)
+            w_run0 = wall_end - (now - t_run0)
+            if self._last_feed_s > 0:
+                _trace.record_span("executor.feed", w_run0,
+                                   w_run0 + self._last_feed_s, cur)
+            _trace.record_span("executor.dispatch", w_disp0, w_fetch0,
+                               cur, attrs={"first_run": first_run})
+            _trace.record_span("executor.fetch", w_fetch0, wall_end, cur)
         # flight recorder (FLAGS_flight_recorder): one bounded-ring
         # record per completed step — the post-mortem trail dumped on
         # crash/SIGTERM (monitor.dump_flight_recorder)
@@ -432,6 +467,7 @@ class Executor:
                     f"(shape {list(got)}) but the program declares "
                     f"rank {len(declared)} (shape {list(declared)}); "
                     f"reshape the feed or fix the data layer")
+        self._last_feed_s = time.perf_counter() - t0
         if _monitor_on():
             total = host = 0
             for a in out.values():
@@ -445,7 +481,8 @@ class Executor:
             # sharding/device and were handed through untouched
             STAT_ADD("exec.feed_presharded", presharded)
             STAT_OBSERVE("executor.feed_stage_seconds",
-                         time.perf_counter() - t0)
+                         self._last_feed_s,
+                         exemplar=_trace.current_trace_id())
         return out
 
     def _cache_key(self, program, feed_arrays, fetch_names, compiled):

@@ -30,7 +30,8 @@ from typing import Dict, Optional
 
 __all__ = ["STAT_ADD", "STAT_SET", "STAT_OBSERVE", "STAT_RESET",
            "enabled", "reset_stats", "reset_phases", "get_stats_snapshot",
-           "get_phase_stats", "phase", "push_phase", "pop_phase",
+           "get_phase_stats", "phase_events", "phase", "push_phase",
+           "pop_phase",
            "snapshot_to_jsonl", "prometheus_text", "export_prometheus",
            "export_chrome_tracing", "start_exporter", "stop_exporter",
            "flight_enabled", "flight_record", "flight_step",
@@ -73,7 +74,8 @@ def enabled() -> bool:
 
 
 class _Histogram:
-    __slots__ = ("buckets", "counts", "count", "sum", "min", "max")
+    __slots__ = ("buckets", "counts", "count", "sum", "min", "max",
+                 "exemplars")
 
     def __init__(self, buckets):
         self.buckets = tuple(buckets)
@@ -82,8 +84,11 @@ class _Histogram:
         self.sum = 0.0
         self.min = float("inf")
         self.max = float("-inf")
+        # bucket index -> last exemplar (a trace_id): a slow-bucket hit
+        # in the snapshot points straight at a kept trace to pull up.
+        self.exemplars: Dict[int, str] = {}
 
-    def observe(self, v):
+    def observe(self, v, exemplar=None):
         v = float(v)
         i = 0
         for b in self.buckets:
@@ -97,6 +102,8 @@ class _Histogram:
             self.min = v
         if v > self.max:
             self.max = v
+        if exemplar is not None:
+            self.exemplars[i] = exemplar
 
     def percentile(self, q):
         """Estimate from bucket counts: linear interpolation inside the
@@ -120,12 +127,18 @@ class _Histogram:
         for i, c in enumerate(self.counts):
             le = repr(self.buckets[i]) if i < len(self.buckets) else "+inf"
             b[le] = c
-        return {"count": self.count, "sum": self.sum,
-                "min": self.min if self.count else None,
-                "max": self.max if self.count else None,
-                "p50": self.percentile(0.50),
-                "p95": self.percentile(0.95),
-                "buckets": b}
+        d = {"count": self.count, "sum": self.sum,
+             "min": self.min if self.count else None,
+             "max": self.max if self.count else None,
+             "p50": self.percentile(0.50),
+             "p95": self.percentile(0.95),
+             "buckets": b}
+        if self.exemplars:
+            d["exemplars"] = {
+                (repr(self.buckets[i]) if i < len(self.buckets)
+                 else "+inf"): ex
+                for i, ex in sorted(self.exemplars.items())}
+        return d
 
 
 # ---------------------------------------------------------------------------
@@ -152,10 +165,12 @@ def STAT_SET(name: str, value):
         _GAUGES[name] = float(value)
 
 
-def STAT_OBSERVE(name: str, value, buckets=None):
+def STAT_OBSERVE(name: str, value, buckets=None, exemplar=None):
     """Record one observation into a fixed-bucket histogram. `buckets`
     (upper bounds, ascending) only applies at first creation; default is
-    DEFAULT_TIME_BUCKETS (seconds-oriented)."""
+    DEFAULT_TIME_BUCKETS (seconds-oriented). `exemplar` (typically a
+    trace_id) is remembered as the last exemplar of the bucket the
+    value lands in and surfaces in get_stats_snapshot()."""
     if not enabled():
         return
     with _LOCK:
@@ -164,7 +179,7 @@ def STAT_OBSERVE(name: str, value, buckets=None):
         h = _HISTS.get(name)
         if h is None:
             h = _HISTS[name] = _Histogram(buckets or DEFAULT_TIME_BUCKETS)
-        h.observe(value)
+        h.observe(value, exemplar=exemplar)
 
 
 def STAT_RESET(name: Optional[str] = None):
@@ -231,6 +246,14 @@ def phase(name: str):
 def get_phase_stats() -> Dict[str, Dict[str, float]]:
     with _LOCK:
         return {k: dict(v) for k, v in _PHASES.items()}
+
+
+def phase_events() -> list:
+    """Point-in-time copy of the recent phase-event ring as
+    (name, ts_us, dur_us, tid) tuples — trace.export_chrome_tracing
+    merges these with request spans onto one timeline."""
+    with _LOCK:
+        return list(_EVENTS)
 
 
 def reset_phases():
@@ -435,29 +458,75 @@ def snapshot_to_jsonl(path: Optional[str] = None) -> str:
     return path
 
 
+_HELP_CACHE: Optional[Dict[str, str]] = None
+
+
+def _stat_help() -> Dict[str, str]:
+    """Stat name -> one-line description, parsed (once) from the
+    docs/observability.md inventory table — the docs are the single
+    source of truth for descriptions, and the bidirectional lint already
+    guarantees every recorded stat has a row there. Missing docs (e.g.
+    an installed wheel without the docs tree) degrade to no HELP lines,
+    never an error on the scrape path."""
+    global _HELP_CACHE
+    if _HELP_CACHE is not None:
+        return _HELP_CACHE
+    help_: Dict[str, str] = {}
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "..", "docs", "observability.md")
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line.startswith("| `"):
+                    continue
+                cells = [c.strip() for c in line.strip("|").split("|")]
+                if len(cells) < 3:
+                    continue
+                name = cells[0].strip("`")
+                desc = cells[2].replace("`", "").replace("\\", "")
+                if name and desc:
+                    help_[name] = " ".join(desc.split())
+    except OSError:
+        pass
+    _HELP_CACHE = help_
+    return help_
+
+
 def prometheus_text() -> str:
     """Prometheus text exposition format. Dotted stat names become
-    underscore-joined metric names under the paddle_tpu_ prefix."""
+    underscore-joined metric names under the paddle_tpu_ prefix; HELP
+    text comes from the docs/observability.md inventory."""
     def mname(name):
         return "paddle_tpu_" + name.replace(".", "_")
 
+    help_ = _stat_help()
     out = []
+
+    def header(name, m, mtype):
+        desc = help_.get(name)
+        if desc:
+            out.append(f"# HELP {m} {desc}")
+        out.append(f"# TYPE {m} {mtype}")
+
     snap = get_stats_snapshot()
     for name, v in sorted(snap["counters"].items()):
         m = mname(name)
-        out.append(f"# TYPE {m} counter")
+        header(name, m, "counter")
         out.append(f"{m} {v}")
     for name, v in sorted(snap["gauges"].items()):
         m = mname(name)
-        out.append(f"# TYPE {m} gauge")
+        header(name, m, "gauge")
         out.append(f"{m} {v}")
     for name, h in sorted(snap["histograms"].items()):
         m = mname(name)
-        out.append(f"# TYPE {m} histogram")
+        header(name, m, "histogram")
         cum = 0
         for le, c in h["buckets"].items():
             cum += c
-            le_s = le if le == "+inf" else repr(float(le))
+            # Exposition format requires +Inf (capital I) — the internal
+            # snapshot key stays "+inf" for JSON stability.
+            le_s = "+Inf" if le == "+inf" else repr(float(le))
             out.append(f'{m}_bucket{{le="{le_s}"}} {cum}')
         out.append(f"{m}_sum {h['sum']}")
         out.append(f"{m}_count {h['count']}")
@@ -562,6 +631,8 @@ class _Exporter(threading.Thread):
         self.path = path
         self.interval = interval
         self._stop = threading.Event()
+        self._flush_lock = threading.Lock()
+        self._flushed = False
 
     def run(self):
         while not self._stop.wait(self.interval):
@@ -573,6 +644,13 @@ class _Exporter(threading.Thread):
     def stop(self, flush=True):
         self._stop.set()
         if flush:
+            # Exactly-once final flush: an explicit stop_exporter() plus
+            # the atexit hook (or any racing double stop) must not write
+            # the terminal snapshot twice.
+            with self._flush_lock:
+                if self._flushed:
+                    return
+                self._flushed = True
             try:
                 snapshot_to_jsonl(self.path)
             except OSError:

@@ -23,6 +23,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from .. import trace
 from ..monitor import STAT_ADD, STAT_OBSERVE, STAT_SET
 from ..monitor import enabled as _monitor_on
 
@@ -138,18 +139,26 @@ class BucketLadder:
 class _Response:
     """Future-ish handle returned by DynamicBatcher.submit."""
 
-    __slots__ = ("_event", "_value", "_error")
+    __slots__ = ("_event", "_value", "_error", "span")
 
     def __init__(self):
         self._event = threading.Event()
         self._value = None
         self._error = None
+        # Request span, completed in _complete — the one funnel every
+        # success and failure path flows through, so the trace is
+        # finished exactly once no matter which path filled us in.
+        self.span = None
 
     def done(self) -> bool:
         return self._event.is_set()
 
     def _complete(self, value=None, error=None):
         self._value, self._error = value, error
+        if self.span is not None:
+            err = None if error is None else \
+                f"{type(error).__name__}: {error}"
+            trace.complete_request(self.span, error=err)
         self._event.set()
 
     def result(self, timeout: Optional[float] = None):
@@ -163,7 +172,8 @@ class _Response:
 
 
 class _Request:
-    __slots__ = ("feed", "rows", "response", "t_enqueue", "deadline")
+    __slots__ = ("feed", "rows", "response", "t_enqueue", "deadline",
+                 "span", "qspan")
 
     def __init__(self, feed, rows, deadline):
         self.feed = feed          # {name: seq-padded ndarray}
@@ -171,6 +181,11 @@ class _Request:
         self.response = _Response()
         self.t_enqueue = time.perf_counter()
         self.deadline = deadline  # perf_counter deadline or None
+        # Request span + its queue-wait child. Spans cross the
+        # submit -> worker thread hand-off ON this object (contextvars
+        # do not follow requests across threads).
+        self.span = None
+        self.qspan = None
 
 
 class _Batch:
@@ -210,12 +225,19 @@ class _Batch:
         their responses."""
         offset = 0
         now = time.perf_counter()
+        t_end = time.time()
+        # Wall-clock start of the execute interval (dispatch -> now),
+        # recorded retroactively under each member request's span.
+        t_exec0 = t_end - (now - self.t_dispatch)
         for r in self.requests:
+            trace.record_span("execute", t_exec0, t_end, r.span,
+                              attrs={"batch_rows": self.rows})
             r.response._complete(
                 [np.asarray(o[offset:offset + r.rows]) for o in outputs])
             if _monitor_on():
                 STAT_OBSERVE("serving.e2e_ms",
-                             (now - r.t_enqueue) * 1e3, buckets=MS_BUCKETS)
+                             (now - r.t_enqueue) * 1e3, buckets=MS_BUCKETS,
+                             exemplar=r.span.trace_id if r.span else None)
             offset += r.rows
 
     def fail(self, error: Exception):
@@ -289,19 +311,35 @@ class DynamicBatcher:
         deadline = time.perf_counter() + timeout_ms / 1e3 \
             if timeout_ms else None
         req = _Request(arrays, rows, deadline)
-        with self._cond:
-            if self._closed:
-                raise EngineClosedError("batcher is shut down")
-            if self._rows + rows > self.queue_capacity:
-                STAT_ADD("serving.rejected")
-                raise QueueFullError(
-                    f"queue at capacity ({self._rows}/"
-                    f"{self.queue_capacity} rows pending)")
-            self._pending.setdefault(sig, []).append(req)
-            self._rows += rows
-            STAT_ADD("serving.requests")
-            STAT_SET("serving.queue_depth", self._rows)
-            self._cond.notify_all()
+        if trace.enabled():
+            # Child of the caller's span (http.request) when one is
+            # current, else a new root trace.
+            req.span = trace.start_span("serving.request",
+                                        attrs={"rows": rows})
+            req.response.span = req.span
+            req.qspan = trace.start_span("queue", parent=req.span)
+        try:
+            with self._cond:
+                if self._closed:
+                    raise EngineClosedError("batcher is shut down")
+                if self._rows + rows > self.queue_capacity:
+                    STAT_ADD("serving.rejected")
+                    raise QueueFullError(
+                        f"queue at capacity ({self._rows}/"
+                        f"{self.queue_capacity} rows pending)")
+                self._pending.setdefault(sig, []).append(req)
+                self._rows += rows
+                STAT_ADD("serving.requests")
+                STAT_SET("serving.queue_depth", self._rows)
+                self._cond.notify_all()
+        except ServingError as e:
+            # Rejected before it was visible to any worker: the raise IS
+            # the completion, so finish the trace here (errored -> the
+            # tail rules keep it).
+            trace.end_span(req.qspan, error=type(e).__name__)
+            trace.complete_request(req.span,
+                                   error=f"{type(e).__name__}: {e}")
+            raise
         return req.response
 
     # -- consumer side --------------------------------------------------
@@ -383,14 +421,17 @@ class DynamicBatcher:
                 STAT_SET("serving.queue_depth", self._rows)
         for r in expired:
             STAT_ADD("serving.timeouts")
+            trace.end_span(r.qspan, error="DeadlineExceededError")
             r.response._complete(error=DeadlineExceededError(
                 f"request waited past its "
                 f"{'deadline' if r.deadline else 'timeout'}"))
-        if batch is not None and _monitor_on():
+        if batch is not None:
             for r in batch.requests:
-                STAT_OBSERVE("serving.queue_wait_ms",
-                             (batch.t_dispatch - r.t_enqueue) * 1e3,
-                             buckets=MS_BUCKETS)
+                trace.end_span(r.qspan)
+                if _monitor_on():
+                    STAT_OBSERVE("serving.queue_wait_ms",
+                                 (batch.t_dispatch - r.t_enqueue) * 1e3,
+                                 buckets=MS_BUCKETS)
         return batch
 
     # -- lifecycle ------------------------------------------------------

@@ -19,6 +19,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from .. import trace
 from ..monitor import STAT_ADD, STAT_OBSERVE
 from ..resilience.breaker import CLOSED, HALF_OPEN, OPEN, CircuitBreaker
 from ..resilience.faults import TransientFault
@@ -348,8 +349,28 @@ class ServingEngine:
                     return
                 continue
             try:
-                feed, bucket, waste = batch.build_feed(self._ladder)
-                outputs = self._retry.call(self._execute, feed)
+                # One span per dispatched batch. It cannot PARENT the
+                # member request spans (they live in N different
+                # traces), so it links them instead; being contextvar-
+                # current, the executor's feed/dispatch/fetch sub-spans
+                # attach under it.
+                bspan = trace.start_span(
+                    "serving.batch", attrs={"rows": batch.rows})
+                if bspan is not None:
+                    for r in batch.requests:
+                        bspan.add_link(r.span)
+                try:
+                    with trace.use_span(bspan):
+                        feed, bucket, waste = batch.build_feed(
+                            self._ladder)
+                        outputs = self._retry.call(self._execute, feed)
+                except Exception as e:  # noqa: BLE001 — close the batch
+                    # trace, then let the existing handler fail the batch
+                    trace.finish_trace(bspan,
+                                       error=f"{type(e).__name__}: {e}",
+                                       record_latency=False)
+                    raise
+                trace.finish_trace(bspan, record_latency=False)
                 STAT_ADD("serving.batches")
                 STAT_OBSERVE("serving.batch_size", batch.rows,
                              buckets=BATCH_BUCKETS_HIST)

@@ -54,6 +54,7 @@ from typing import Callable, List, Optional, Sequence
 
 import numpy as np
 
+from .. import trace
 from ..monitor import STAT_ADD, STAT_OBSERVE, STAT_SET
 from ..monitor import enabled as _monitor_on
 from ..resilience.breaker import CLOSED, HALF_OPEN, OPEN, CircuitBreaker
@@ -62,7 +63,7 @@ from ..resilience.faults import injector as _fault_injector
 from ..resilience.retry import RetryPolicy, is_transient
 from .batcher import (DeadlineExceededError, EngineClosedError,
                       FRACTION_BUCKETS, MS_BUCKETS, OverloadedError,
-                      QueueFullError, _Response)
+                      QueueFullError, ServingError, _Response)
 from .kv_blocks import (SCRATCH_BLOCK, BlockPool, PrefixCache,
                         blocks_for_tokens)
 
@@ -140,7 +141,8 @@ class _SlotState:
 
     __slots__ = ("req", "response", "fed", "cur", "generated", "rng",
                  "needs_reset", "deadline", "t_submit", "t_prev_token",
-                 "ttft_ms", "blocks", "n_cached", "registered")
+                 "ttft_ms", "blocks", "n_cached", "registered",
+                 "span", "phase_span", "fetch_s")
 
     def __init__(self, req: GenerationRequest, response: _Response,
                  deadline: Optional[float], t_submit: float):
@@ -162,16 +164,26 @@ class _SlotState:
         self.blocks: List[int] = []
         self.n_cached = 0
         self.registered = False
+        # Tracing: the request span (carried over from _Queued — spans
+        # cross the submit -> worker thread hand-off ON these objects),
+        # the current lifecycle phase span (prefill, then decode), and
+        # accumulated fetch-block seconds from the steps this slot rode.
+        self.span = None
+        self.phase_span = None
+        self.fetch_s = 0.0
 
 
 class _Queued:
-    __slots__ = ("req", "response", "deadline", "t_submit")
+    __slots__ = ("req", "response", "deadline", "t_submit",
+                 "span", "qspan")
 
     def __init__(self, req, response, deadline, t_submit):
         self.req = req
         self.response = response
         self.deadline = deadline
         self.t_submit = t_submit
+        self.span = None   # request span (hand-off to the worker)
+        self.qspan = None  # its queue-wait child
 
 
 class GenerationEngine:
@@ -460,18 +472,37 @@ class GenerationEngine:
                 "generation backend is unhealthy (circuit breaker "
                 "open)", retry_after_s=self._breaker.retry_after_s())
         resp = _Response()
-        with self._cond:
-            if self._closed:
-                raise EngineClosedError("generation engine is shut down")
-            if len(self._queue) >= self.queue_capacity:
-                STAT_ADD("serving.gen_rejected")
-                raise QueueFullError(
-                    f"generation queue at capacity "
-                    f"({len(self._queue)}/{self.queue_capacity})")
-            self._queue.append(_Queued(req, resp, deadline, now))
-            STAT_ADD("serving.gen_requests")
-            STAT_SET("serving.gen_queue_depth", len(self._queue))
-            self._cond.notify_all()
+        q = _Queued(req, resp, deadline, now)
+        if trace.enabled():
+            # Child of the caller's span (http.request, loadgen's
+            # per-request root) when one is current, else a new root.
+            q.span = trace.start_span(
+                "gen.request",
+                attrs={"prompt_tokens": len(req.prompt),
+                       "max_new_tokens": req.max_new_tokens})
+            resp.span = q.span
+            q.qspan = trace.start_span("queue", parent=q.span)
+        try:
+            with self._cond:
+                if self._closed:
+                    raise EngineClosedError(
+                        "generation engine is shut down")
+                if len(self._queue) >= self.queue_capacity:
+                    STAT_ADD("serving.gen_rejected")
+                    raise QueueFullError(
+                        f"generation queue at capacity "
+                        f"({len(self._queue)}/{self.queue_capacity})")
+                self._queue.append(q)
+                STAT_ADD("serving.gen_requests")
+                STAT_SET("serving.gen_queue_depth", len(self._queue))
+                self._cond.notify_all()
+        except ServingError as e:
+            # Rejected before any worker saw it: the raise is the
+            # completion (errored -> the tail rules keep the trace).
+            trace.end_span(q.qspan, error=type(e).__name__)
+            trace.complete_request(q.span,
+                                   error=f"{type(e).__name__}: {e}")
+            raise
         return resp
 
     def generate(self, prompt: Sequence[int], max_new_tokens: int,
@@ -517,6 +548,14 @@ class GenerationEngine:
     def _set_block_gauges(self):
         STAT_SET("serving.gen_kv_blocks_free", self._pool.free_count())
 
+    def _admit_trace(self, st: _SlotState, q: "_Queued"):
+        """Queue -> prefill phase transition on the request's span tree
+        (admission happens on the worker thread — the span rode the
+        _Queued object across)."""
+        st.span = q.span
+        trace.end_span(q.qspan)
+        st.phase_span = trace.start_span("prefill", parent=st.span)
+
     def _admit_locked(self) -> bool:
         """Move the queue head into a free slot. Paged mode additionally
         gates on block availability: shared prefix blocks come from the
@@ -554,6 +593,9 @@ class GenerationEngine:
                 STAT_ADD("serving.gen_prefix_hits" if n_cached
                          else "serving.gen_prefix_misses")
                 self._set_block_gauges()
+                self._admit_trace(st, q)
+                if st.phase_span is not None and n_cached:
+                    st.phase_span.set_attr("cached_tokens", n_cached)
                 self._state[slot] = st
                 self._queue.pop(0)
                 return True
@@ -563,6 +605,7 @@ class GenerationEngine:
             self._slots.release(slot)
             self._set_block_gauges()
             return False
+        self._admit_trace(st, q)
         self._state[slot] = st
         self._queue.pop(0)
         return True
@@ -605,16 +648,37 @@ class GenerationEngine:
 
     def _finish(self, st: _SlotState, reason: str):
         now = time.perf_counter()
+        e2e_ms = (now - st.t_submit) * 1e3
+        if st.span is not None:
+            # Aggregated device-sync attribution: one synthetic "fetch"
+            # child of the decode phase carrying the summed fetch-block
+            # time of every step this slot rode (NESTED, so the
+            # queue+prefill+decode critical path doesn't double-count).
+            if st.phase_span is not None and st.fetch_s > 0:
+                trace.record_span(
+                    "fetch", st.phase_span.t_start,
+                    st.phase_span.t_start + st.fetch_s, st.phase_span,
+                    attrs={"aggregated": True,
+                           "fetch_ms": round(st.fetch_s * 1e3, 3)})
+            trace.end_span(st.phase_span)
+            st.span.attrs.update({
+                "e2e_ms": round(e2e_ms, 3),
+                "ttft_ms": None if st.ttft_ms is None
+                else round(st.ttft_ms, 3),
+                "tokens": len(st.generated),
+                "finish_reason": reason,
+                "cached_tokens": st.n_cached})
         st.response._complete({
             "tokens": list(st.generated),
             "finish_reason": reason,
             "ttft_ms": st.ttft_ms,
-            "e2e_ms": (now - st.t_submit) * 1e3,
+            "e2e_ms": e2e_ms,
             "cached_tokens": st.n_cached,
         })
         if _monitor_on():
-            STAT_OBSERVE("serving.gen_e2e_ms",
-                         (now - st.t_submit) * 1e3, buckets=MS_BUCKETS)
+            STAT_OBSERVE("serving.gen_e2e_ms", e2e_ms,
+                         buckets=MS_BUCKETS,
+                         exemplar=st.span.trace_id if st.span else None)
 
     def _worker_loop(self):
         # deferred: paddle_tpu/__init__ imports serving before the
@@ -649,9 +713,11 @@ class GenerationEngine:
                         self._cond.wait(0.05)
             for q in expired:
                 STAT_ADD("serving.gen_timeouts")
+                trace.end_span(q.qspan, error="DeadlineExceededError")
                 q.response._complete(error=DeadlineExceededError(
                     "generation request waited past its deadline"))
             for q in failed:
+                trace.end_span(q.qspan, error="EngineClosedError")
                 q.response._complete(error=EngineClosedError(
                     "generation engine shut down before the request "
                     "ran"))
@@ -718,6 +784,11 @@ class GenerationEngine:
                     self._slots.release(i)
                 continue
             self._breaker.record_success()
+            if trace.enabled():
+                lt = self.exe.last_step_timings
+                if lt is not None:
+                    for i in stepped:
+                        self._state[i].fetch_s += lt["fetch_s"]
             inj = _fault_injector()
             if inj is not None:
                 # step_nan at site=generation corrupts only the host
@@ -768,6 +839,11 @@ class GenerationEngine:
                     if _monitor_on():
                         STAT_OBSERVE("serving.gen_ttft_ms", st.ttft_ms,
                                      buckets=MS_BUCKETS)
+                    if st.span is not None:
+                        # prefill -> decode phase flip at first token
+                        trace.end_span(st.phase_span)
+                        st.phase_span = trace.start_span(
+                            "decode", parent=st.span)
                 elif _monitor_on() and st.t_prev_token is not None:
                     STAT_OBSERVE("serving.gen_inter_token_ms",
                                  (t_step - st.t_prev_token) * 1e3,
@@ -775,6 +851,9 @@ class GenerationEngine:
                 st.t_prev_token = t_step
                 if st.req.stream_cb is not None:
                     st.req.stream_cb(tok)
+                    if st.phase_span is not None:
+                        st.phase_span.add_event(
+                            "stream_flush", token_index=len(st.generated))
                 done_eos = (st.req.eos_id is not None
                             and tok == st.req.eos_id)
                 if done_eos or len(st.generated) >= \
@@ -840,6 +919,13 @@ class GenerationEngine:
                     self._release_slot(i)
                 return None
             self._breaker.record_success()
+            if trace.enabled():
+                lt = self.exe.last_step_timings
+                if lt is not None:
+                    for i in idx:
+                        st = self._state[i]
+                        if st is not None:
+                            st.fetch_s += lt["fetch_s"]
             return out
 
         # ---- phase 1: chunked prefill ---------------------------------
@@ -884,6 +970,9 @@ class GenerationEngine:
                 st.fed += chunk_n[i]
                 st.cur = st.req.prompt[st.fed]
                 STAT_ADD("serving.gen_chunked_prefills")
+                if st.phase_span is not None:
+                    st.phase_span.add_event("prefill_chunk",
+                                            tokens=chunk_n[i])
 
         # ---- phase 2: one decode step ---------------------------------
         decode_idx = [
@@ -945,6 +1034,11 @@ class GenerationEngine:
                 if _monitor_on():
                     STAT_OBSERVE("serving.gen_ttft_ms", st.ttft_ms,
                                  buckets=MS_BUCKETS)
+                if st.span is not None:
+                    # prefill -> decode phase flip at first token
+                    trace.end_span(st.phase_span)
+                    st.phase_span = trace.start_span(
+                        "decode", parent=st.span)
                 if not st.registered:
                     # the whole prompt (every full block of it) is now
                     # resident and immutable — shareable from here on
@@ -957,6 +1051,9 @@ class GenerationEngine:
             st.t_prev_token = t_step
             if st.req.stream_cb is not None:
                 st.req.stream_cb(tok)
+                if st.phase_span is not None:
+                    st.phase_span.add_event(
+                        "stream_flush", token_index=len(st.generated))
             done_eos = (st.req.eos_id is not None
                         and tok == st.req.eos_id)
             if done_eos or len(st.generated) >= st.req.max_new_tokens:

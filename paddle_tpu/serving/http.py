@@ -32,6 +32,7 @@ from typing import Optional
 
 import numpy as np
 
+from .. import trace
 from ..monitor import STAT_ADD, prometheus_text
 from .batcher import (DeadlineExceededError, EngineClosedError,
                       OverloadedError, QueueFullError)
@@ -73,12 +74,28 @@ class ServingHTTPServer:
 
         class _Handler(http.server.BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+            # per-request trace state (each request is handled
+            # start-to-finish on one connection thread)
+            _span = None
+            _last_code = None
 
             def _reply(self, code: int, payload: dict, headers=None):
+                self._last_code = code
                 body = json.dumps(payload).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                if self._span is not None:
+                    # Router-ready response identity: clients (and the
+                    # future multi-replica router) correlate by request
+                    # id; the traceparent echo lets a caller that did
+                    # NOT send one adopt the trace this server opened.
+                    self._span.set_attr("http.status", code)
+                    self.send_header("X-Request-Id",
+                                     self._span.trace_id)
+                    self.send_header(
+                        "traceparent",
+                        trace.format_traceparent(self._span))
                 for k, v in (headers or {}).items():
                     self.send_header(k, v)
                 self.end_headers()
@@ -130,6 +147,36 @@ class ServingHTTPServer:
 
             def do_POST(self):
                 STAT_ADD("serving.http_requests")
+                self._span = None
+                self._last_code = None
+                if trace.enabled():
+                    # W3C trace-context ingress: continue the caller's
+                    # trace when a valid traceparent arrived, else open
+                    # a new root. The span is contextvar-current for
+                    # the handler body, so the batcher/generation
+                    # submit() spans parent under it.
+                    remote = trace.parse_traceparent(
+                        self.headers.get("traceparent"))
+                    self._span = trace.start_span(
+                        "http.request", remote=remote,
+                        attrs={"method": "POST",
+                               "path": self.path.split("?")[0]})
+                try:
+                    with trace.use_span(self._span):
+                        self._route_post()
+                except BaseException as e:
+                    trace.finish_trace(
+                        self._span, error=f"{type(e).__name__}: {e}")
+                    self._span = None
+                    raise
+                else:
+                    code = self._last_code
+                    err = f"http {code}" \
+                        if code is not None and code >= 400 else None
+                    trace.finish_trace(self._span, error=err)
+                    self._span = None
+
+            def _route_post(self):
                 if self.path.startswith("/v1/generate"):
                     self._generate()
                     return

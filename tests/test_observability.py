@@ -300,6 +300,77 @@ def test_monitor_thread_safety_smoke():
             n_threads * n_iter
 
 
+def test_monitor_concurrent_mixed_exact_counts():
+    """N threads hammering a MIX of STAT_ADD and STAT_OBSERVE (distinct
+    per-thread increments and values) must lose nothing: exact counter
+    totals, exact histogram count AND sum."""
+    with _monitor_on() as monitor:
+        n_threads, n_iter = 8, 400
+
+        def work(tid):
+            for i in range(n_iter):
+                monitor.STAT_ADD("t.mix_counter", tid + 1)
+                monitor.STAT_ADD("t.mix_counter_b")
+                monitor.STAT_OBSERVE("t.mix_hist", 0.001 * (tid + 1),
+                                     exemplar=f"trace-{tid}")
+
+        ts = [threading.Thread(target=work, args=(k,))
+              for k in range(n_threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        snap = monitor.get_stats_snapshot()
+        want_counter = n_iter * sum(k + 1 for k in range(n_threads))
+        assert snap["counters"]["t.mix_counter"] == want_counter
+        assert snap["counters"]["t.mix_counter_b"] == n_threads * n_iter
+        h = snap["histograms"]["t.mix_hist"]
+        assert h["count"] == n_threads * n_iter
+        want_sum = n_iter * sum(0.001 * (k + 1) for k in range(n_threads))
+        assert abs(h["sum"] - want_sum) < 1e-6
+        # exemplars survive the race and surface in the snapshot
+        assert any(ex.startswith("trace-")
+                   for ex in h.get("exemplars", {}).values())
+
+
+def test_exporter_stop_flushes_exactly_once(tmp_path):
+    """stop(flush=True) writes the terminal snapshot exactly once even
+    when invoked repeatedly (explicit stop + atexit both call it)."""
+    with _monitor_on() as monitor:
+        monitor.STAT_ADD("t.flush_counter")
+        log = str(tmp_path / "flush.jsonl")
+        exp = monitor.start_exporter(log, interval=60)
+        assert exp is not None
+        # repeat start returns the same live exporter, no second thread
+        assert monitor.start_exporter(log, interval=60) is exp
+        monitor.stop_exporter(flush=True)
+        n1 = len(open(log).read().splitlines())
+        assert n1 == 1
+        # direct re-stop on the same exporter object: _flushed guard
+        exp.stop(flush=True)
+        exp.stop(flush=True)
+        # module-level stop is now a no-op too (exporter cleared)
+        monitor.stop_exporter(flush=True)
+        assert len(open(log).read().splitlines()) == n1
+
+
+def test_prometheus_help_lines_from_docs():
+    """# HELP text is sourced from the docs/observability.md inventory:
+    documented stats get a HELP line, ad-hoc test stats do not."""
+    with _monitor_on() as monitor:
+        help_ = monitor._stat_help()
+        assert help_, "docs/observability.md inventory parsed empty"
+        assert "serving.requests" in help_
+        assert "trace.spans_kept" in help_
+        monitor.STAT_ADD("serving.requests")
+        monitor.STAT_ADD("t.undocumented_counter")
+        txt = monitor.prometheus_text()
+        assert ("# HELP paddle_tpu_serving_requests "
+                + help_["serving.requests"]) in txt
+        assert "# HELP paddle_tpu_t_undocumented_counter" not in txt
+        assert "# TYPE paddle_tpu_t_undocumented_counter counter" in txt
+
+
 def test_monitor_exporters(tmp_path):
     with _monitor_on() as monitor:
         monitor.STAT_ADD("t.exp_counter", 2)
@@ -316,7 +387,10 @@ def test_monitor_exporters(tmp_path):
         txt = monitor.prometheus_text()
         assert "# TYPE paddle_tpu_t_exp_counter counter" in txt
         assert "paddle_tpu_t_exp_counter 3" in txt
-        assert 'paddle_tpu_t_exp_hist_bucket{le="+inf"} 1' in txt
+        # exposition format requires +Inf (capital I), not the JSON
+        # snapshot's "+inf" key
+        assert 'paddle_tpu_t_exp_hist_bucket{le="+Inf"} 1' in txt
+        assert '{le="+inf"}' not in txt
         assert "paddle_tpu_t_exp_hist_count 1" in txt
         prom = str(tmp_path / "m.prom")
         monitor.export_prometheus(prom)

@@ -43,7 +43,7 @@ def load(path):
     snapshots, results, op_profiles = [], [], []
     loadgens, lints, graph_opts = [], [], []
     gen_loadgens, chaos_loadgens, memory_plans = [], [], []
-    sharded_benches = []
+    sharded_benches, trace_reports = [], []
     with open(path) as f:
         for ln, line in enumerate(f, 1):
             line = line.strip()
@@ -78,9 +78,11 @@ def load(path):
                 graph_opts.append(rec)
             elif kind == "memory_plan":
                 memory_plans.append(rec)
+            elif kind == "trace_report":
+                trace_reports.append(rec)
     return (snapshots, results, op_profiles, loadgens, lints,
             graph_opts, gen_loadgens, chaos_loadgens, memory_plans,
-            sharded_benches)
+            sharded_benches, trace_reports)
 
 
 def _hist(snap, name):
@@ -90,13 +92,14 @@ def _hist(snap, name):
 def report(path, out=sys.stdout):
     (snapshots, results, op_profiles, loadgens, lints,
      graph_opts, gen_loadgens, chaos_loadgens, memory_plans,
-     sharded_benches) = load(path)
+     sharded_benches, trace_reports) = load(path)
     w = out.write
     w(f"runtime stats report — {path}\n")
     if not snapshots and not results and not op_profiles \
             and not loadgens and not lints and not graph_opts \
             and not gen_loadgens and not chaos_loadgens \
-            and not memory_plans and not sharded_benches:
+            and not memory_plans and not sharded_benches \
+            and not trace_reports:
         w("no snapshots or bench results found\n")
         return 1
     w(f"snapshots: {len(snapshots)}   bench results: {len(results)}\n")
@@ -324,6 +327,35 @@ def report(path, out=sys.stdout):
               f"({r.get('p99_inflation')}x fault-free, bound "
               f"{r.get('p99_bound')}x)  spec "
               f"\"{r.get('fault_spec', '')}\"\n")
+
+    started = c.get("trace.spans_started")
+    if started or trace_reports:
+        w("\n-- tracing (paddle_tpu.trace, docs/observability.md) --\n")
+        if started:
+            kept = int(c.get("trace.spans_kept", 0))
+            dropped = int(c.get("trace.spans_dropped", 0))
+            decided = kept + dropped
+            rate = f"  keep rate {kept / decided:.1%}" if decided else ""
+            w(f"{'spans':26s} started {int(started)}   kept {kept}   "
+              f"dropped {dropped}{rate}   ring "
+              f"{int(g.get('trace.ring_spans', 0))}\n")
+        for r in trace_reports:
+            keep = r.get("keep") or {}
+            cons = r.get("consistency") or {}
+            keeps = " ".join(f"{k}={v}" for k, v in sorted(keep.items()))
+            w(f"{'trace report':26s} {r.get('n_requests', 0)} request(s) "
+              f"in {r.get('n_traces', 0)} trace(s), "
+              f"{r.get('n_spans', 0)} span(s)  [{keeps}]  consistency "
+              f"{cons.get('violations', 0)} violation(s) of "
+              f"{cons.get('checked', 0)}\n")
+            bd = r.get("breakdown_ms") or {}
+            for comp in ("queue", "prefill", "decode", "fetch",
+                         "execute", "critical_path", "e2e"):
+                ent = bd.get(comp) or {}
+                m, p = ent.get("mean_ms"), ent.get("p95_ms")
+                if m is None and p is None:
+                    continue
+                w(f"  {comp:<24s} mean {m} ms  p95 {p} ms\n")
 
     phases = snap.get("phases") or {}
     if phases:

@@ -44,6 +44,15 @@ single-request predictor and emits a second record (mode
 executor cache-miss counter stayed flat after warmup (exit 3 when it
 moved).
 
+--trace (generation, in-process only) arms FLAGS_enable_trace at 100%
+sampling, wraps every request in a root span, dumps the kept spans to
+--trace-out (JSONL) and ASSERTS the trace trees are complete: every
+request must carry queue/prefill/decode/fetch child spans, the
+critical-path components must sum to within 10% of the measured e2e,
+and the parent/child consistency audit must be clean — exit 6 on any
+violation. The record gains a "trace" object and the span dump feeds
+tools/trace_report.py.
+
 --chaos is the resilience acceptance run (`kind="chaos_loadgen"`
 records): a fault-free baseline pass pins per-request expected outputs
 and the fault-free p99, then the same traffic replays with
@@ -347,21 +356,53 @@ class _GenStats:
 
 class _GenEngineTarget:
     """Drives an in-process GenerationEngine; per-token timestamps come
-    from the engine's stream_cb."""
+    from the engine's stream_cb. With `traced` each call opens a root
+    "request" span (the loadgen stands in for the HTTP front end), so
+    the engine's gen.request/queue/prefill/decode spans nest under it
+    and the loadgen-measured e2e is the trace's tail-sampling input."""
 
-    def __init__(self, engine, stats):
+    def __init__(self, engine, stats, traced=False):
         self.engine = engine
         self.stats = stats
+        self.traced = traced
 
     def call(self, req, timeout_ms):
         from paddle_tpu.serving import GenerationRequest
         times = []
+        root = None
+        if self.traced:
+            from paddle_tpu import trace
+            root = trace.start_span("request",
+                                    attrs={"idx": req.get("idx")})
         t0 = time.perf_counter()
-        resp = self.engine.submit(GenerationRequest(
-            req["prompt"], req["max_new_tokens"], seed=req["seed"],
-            timeout_ms=timeout_ms,
-            stream_cb=lambda tok: times.append(time.perf_counter())))
-        out = resp.result(timeout=(timeout_ms or 30000.0) / 1e3 + 30.0)
+        try:
+            if root is not None:
+                from paddle_tpu import trace
+                with trace.use_span(root):
+                    resp = self.engine.submit(GenerationRequest(
+                        req["prompt"], req["max_new_tokens"],
+                        seed=req["seed"], timeout_ms=timeout_ms,
+                        stream_cb=lambda tok: times.append(
+                            time.perf_counter())))
+            else:
+                resp = self.engine.submit(GenerationRequest(
+                    req["prompt"], req["max_new_tokens"],
+                    seed=req["seed"], timeout_ms=timeout_ms,
+                    stream_cb=lambda tok: times.append(
+                        time.perf_counter())))
+            out = resp.result(
+                timeout=(timeout_ms or 30000.0) / 1e3 + 30.0)
+        except Exception as e:
+            if root is not None:
+                from paddle_tpu import trace
+                trace.finish_trace(
+                    root, error=f"{type(e).__name__}: {e}",
+                    e2e_ms=(time.perf_counter() - t0) * 1e3)
+            raise
+        if root is not None:
+            from paddle_tpu import trace
+            trace.finish_trace(
+                root, e2e_ms=(time.perf_counter() - t0) * 1e3)
         self.stats.record(t0, times, len(out["tokens"]))
         self.stats.record_prefix(t0, times, out.get("cached_tokens", 0),
                                  idx=req.get("idx"),
@@ -417,6 +458,88 @@ def run_serial_generation(exe, scope, prog, step, reqs):
     return stats, latencies, time.perf_counter() - t0, outputs
 
 
+_TRACE_PHASES = ("queue", "prefill", "decode", "fetch")
+
+
+def _check_traces(args, tr_mod):
+    """--trace post-run audit: drain the kept-span ring, dump it to
+    --trace-out, and verify (a) every ok request trace is COMPLETE
+    (queue/prefill/decode/fetch spans all present), (b) the
+    critical-path component sum lands within 10% of the measured e2e,
+    (c) the parent/child consistency audit is clean. Returns
+    (failed, summary-dict for the loadgen record)."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import trace_report as trp
+
+    spans = tr_mod.drain_spans()
+    out = args.trace_out
+    if not out:
+        base = args.out or os.path.join(tempfile.gettempdir(),
+                                        "serving_loadgen.jsonl")
+        out = os.path.splitext(os.path.abspath(base))[0] \
+            + ".spans.jsonl"
+    try:  # fresh dump per run: trace_report reads whole files
+        os.remove(out)
+    except OSError:
+        pass
+    tr_mod.export_jsonl(out, spans)
+
+    by_id, children = trp.build_index(spans)
+    roots = [r for r in trp.trace_roots(spans, by_id)
+             if r["name"] in trp.REQUEST_ROOTS]
+    rows = [trp.analyze_request(r, children) for r in roots]
+    checked, violations = trp.check_consistency(spans, children)
+
+    incomplete, crit_bad = [], []
+    n_err = 0
+    for root, row in zip(roots, rows):
+        if row["status"] != "ok":
+            n_err += 1  # rejected/timed-out requests legitimately
+            continue    # carry partial trees
+        names = {s["name"] for s in trp._walk(root, children)}
+        missing = [p for p in _TRACE_PHASES if p not in names]
+        if missing:
+            incomplete.append((row["trace_id"], missing))
+            continue
+        e2e, crit = row["e2e_ms"], row["critical_path_ms"]
+        # The phase spans tile the ENGINE-side request span. A loadgen
+        # or HTTP root above it additionally measures the client
+        # waiter-thread wakeup delay between engine completion and the
+        # caller observing it — time no span can cover — so check the
+        # identity against the innermost request-boundary span.
+        for s in trp._walk(root, children):
+            if s["name"] in trp.REQUEST_ROOTS:
+                a = s.get("attrs", {}).get("e2e_ms")
+                e2e = float(a) if isinstance(a, (int, float)) \
+                    else float(s.get("dur_ms") or e2e)
+        # 10% of e2e plus 2ms absolute slack for thread-wakeup jitter
+        # on sub-10ms CPU requests
+        if abs(e2e - crit) > 0.10 * e2e + 2.0:
+            crit_bad.append((row["trace_id"], e2e, crit))
+
+    failed = False
+    if not rows:
+        print("FAIL: --trace run kept no request traces", file=sys.stderr)
+        failed = True
+    for tid, missing in incomplete[:10]:
+        print(f"FAIL: trace {tid[:8]} incomplete: missing "
+              f"{','.join(missing)} span(s)", file=sys.stderr)
+    for tid, e2e, crit in crit_bad[:10]:
+        print(f"FAIL: trace {tid[:8]} critical path {crit}ms vs e2e "
+              f"{e2e}ms (>10% apart)", file=sys.stderr)
+    for v in violations[:10]:
+        print(f"FAIL: trace consistency: {v}", file=sys.stderr)
+    failed = failed or bool(incomplete or crit_bad or violations)
+
+    return failed, {
+        "out": out, "spans": len(spans), "requests": len(rows),
+        "error_requests": n_err, "incomplete": len(incomplete),
+        "crit_path_violations": len(crit_bad),
+        "consistency_checked": checked,
+        "consistency_violations": len(violations),
+    }
+
+
 def run_generation(args):
     """The --generate workload: continuous-batching engine (or HTTP
     front end) under closed/open-loop generation traffic, optional
@@ -443,6 +566,10 @@ def run_generation(args):
               "max_seq": args.max_seq, "vocab": args.vocab,
               "shared_prefix_frac": prefix_frac,
               "shared_prefix_len": prefix_len}
+    if args.trace and args.url:
+        print("--trace inspects the in-process span ring; --url is not "
+              "supported", file=sys.stderr)
+        return 2
 
     if args.url:
         stats = _GenStats()
@@ -466,6 +593,13 @@ def run_generation(args):
     from paddle_tpu.models import gpt
     from paddle_tpu.serving import GenerationEngine
 
+    if args.trace:
+        from paddle_tpu import trace as _tr
+        # 100% head sampling by default: the completeness assertion
+        # must see EVERY request's tree, not just the tail-kept ones.
+        fluid.set_flags({"FLAGS_enable_trace": True,
+                         "FLAGS_trace_sample": args.trace_sample})
+
     cfg = gpt.gpt_small(vocab_size=args.vocab, d_model=32, n_heads=4,
                         n_layers=2, d_ff=64, max_seq_len=args.max_seq,
                         dropout=0.0, use_flash=False)
@@ -479,9 +613,12 @@ def run_generation(args):
     engine.init_scope()   # scratch weights: loadgen measures the
     engine.start()        # serving path, not model quality
     misses_after_warmup = engine.cache_stats()["misses"]
+    if args.trace:
+        _tr.reset()  # drop any warmup-era spans: the dump must hold
+        # exactly the measured run's traces
 
     stats = _GenStats()
-    target = _GenEngineTarget(engine, stats)
+    target = _GenEngineTarget(engine, stats, traced=args.trace)
     if args.rate > 0:
         if args.duration > 0:
             reqs = reqs[:max(1, int(args.rate * args.duration))]
@@ -509,6 +646,9 @@ def run_generation(args):
         "ttft_miss_ms": _lat_summary(stats.ttft_miss),
         "kv": engine.kv_block_stats(),
     }
+    trace_fail = False
+    if args.trace:
+        trace_fail, rec["trace"] = _check_traces(args, _tr)
     emit(rec, args.out)
 
     if args.compare_serial:
@@ -547,6 +687,8 @@ def run_generation(args):
         print(f"FAIL: {post} compiles after generation warmup",
               file=sys.stderr)
         return 3
+    if trace_fail:
+        return 6
     return 0
 
 
@@ -750,6 +892,16 @@ def main(argv=None):
     ap.add_argument("--slab", action="store_true",
                     help="force the contiguous slab KV layout "
                          "(paged=False) regardless of FLAGS_gen_paged_kv")
+    ap.add_argument("--trace", action="store_true",
+                    help="generation only: arm FLAGS_enable_trace, dump "
+                         "kept spans to --trace-out and assert complete "
+                         "span trees + critical-path consistency "
+                         "(exit 6 on violation)")
+    ap.add_argument("--trace-out",
+                    help="span dump path (default: <out>.spans.jsonl)")
+    ap.add_argument("--trace-sample", type=float, default=1.0,
+                    help="FLAGS_trace_sample for the --trace run "
+                         "(default 1.0 so every tree is auditable)")
     ap.add_argument("--chaos", action="store_true",
                     help="fault-injection acceptance run: baseline "
                          "pass, then the same traffic under "

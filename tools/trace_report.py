@@ -1,0 +1,235 @@
+"""Critical-path report over a request-trace span dump.
+
+Usage:
+    python tools/trace_report.py SPANS.jsonl [SPANS2.jsonl ...]
+        [--top N] [--out REPORT.jsonl] [--strict]
+
+Input: JSONL of `kind == "span"` records (paddle_tpu.trace.export_jsonl,
+or the --trace dump of tools/serving_loadgen.py); other kinds on the
+same file are ignored, so a mixed monitor-export log works as-is.
+
+Per tail-kept request this reconstructs the span tree
+(http.request -> gen.request/serving.request -> queue / prefill /
+decode(+fetch) / execute) and answers "where did this request spend its
+time": a queue vs prefill vs decode vs fetch breakdown, a slowest-N
+table, and a self-consistency audit that every child span fits inside
+its parent (child time <= parent e2e, plus bounded slack for clock
+skew) — the check that catches a broken thread hand-off or a span
+ended on the wrong side of a phase flip.
+
+--out appends one `kind == "trace_report"` JSONL record
+(tools/validate_bench_json.py enforces its schema; the report section
+in tools/metrics_report.py renders it). --strict exits 1 when the
+consistency audit found violations.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from collections import defaultdict
+from typing import Dict, List, Optional, Tuple
+
+# Span names that open a request (roots of a request span tree).
+REQUEST_ROOTS = ("http.request", "gen.request", "serving.request",
+                 "request")
+# Lifecycle components summed per request for the breakdown. `fetch`
+# and the executor.* sub-steps are NESTED inside decode/execute, so the
+# critical path is queue+prefill+decode+execute only (no double count).
+COMPONENTS = ("queue", "prefill", "decode", "execute", "fetch")
+CRITICAL = ("queue", "prefill", "decode", "execute")
+# Consistency slack: children may overhang their parent by this much
+# before it counts as a violation (wall-clock reconstruction of
+# retroactive spans vs perf-counter durations).
+SLACK_MS = 1.0
+SLACK_FRAC = 0.05
+
+
+def load_spans(paths: List[str]) -> List[dict]:
+    spans = []
+    for path in paths:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(rec, dict) and rec.get("kind") == "span":
+                    spans.append(rec)
+    return spans
+
+
+def build_index(spans: List[dict]):
+    """(by_id, children): span_id -> span, and parent span_id ->
+    [child spans] (parent links only bind within the same trace_id)."""
+    by_id = {s["span_id"]: s for s in spans}
+    children: Dict[str, List[dict]] = defaultdict(list)
+    for s in spans:
+        pid = s.get("parent_id")
+        if pid and pid in by_id \
+                and by_id[pid]["trace_id"] == s["trace_id"]:
+            children[pid].append(s)
+    return by_id, children
+
+
+def trace_roots(spans: List[dict], by_id) -> List[dict]:
+    """Local roots: no parent, or a parent outside this dump (a remote
+    traceparent ancestor)."""
+    return [s for s in spans
+            if not s.get("parent_id") or s["parent_id"] not in by_id]
+
+
+def _walk(span: dict, children) -> List[dict]:
+    out = [span]
+    stack = [span]
+    while stack:
+        for c in children.get(stack.pop()["span_id"], ()):
+            out.append(c)
+            stack.append(c)
+    return out[1:]  # descendants only
+
+
+def analyze_request(root: dict, children) -> dict:
+    """One request's critical-path row."""
+    comp = {c: 0.0 for c in COMPONENTS}
+    n_spans = 1
+    for s in _walk(root, children):
+        n_spans += 1
+        if s["name"] in comp:
+            comp[s["name"]] += s.get("dur_ms") or 0.0
+    e2e = root.get("attrs", {}).get("e2e_ms")
+    if not isinstance(e2e, (int, float)):
+        e2e = root.get("dur_ms") or 0.0
+    critical = sum(comp[c] for c in CRITICAL)
+    return {"trace_id": root["trace_id"], "name": root["name"],
+            "status": root.get("status", "ok"),
+            "keep": root.get("attrs", {}).get("keep"),
+            "e2e_ms": round(float(e2e), 3),
+            "critical_path_ms": round(critical, 3),
+            "n_spans": n_spans,
+            **{f"{c}_ms": round(comp[c], 3) for c in COMPONENTS}}
+
+
+def check_consistency(spans: List[dict], children) -> Tuple[int, List[str]]:
+    """Audit: every child span's time must fit inside its parent
+    (per-child containment AND the summed non-overlapping children
+    budget). Returns (n_checked, violations)."""
+    checked = 0
+    violations = []
+    for s in spans:
+        kids = children.get(s["span_id"])
+        if not kids:
+            continue
+        parent_ms = s.get("dur_ms") or 0.0
+        allow = parent_ms * (1 + SLACK_FRAC) + SLACK_MS
+        for c in kids:
+            checked += 1
+            if (c.get("dur_ms") or 0.0) > allow:
+                violations.append(
+                    f"{c['name']} ({c.get('dur_ms')}ms) exceeds parent "
+                    f"{s['name']} ({parent_ms}ms) "
+                    f"[trace {s['trace_id'][:8]}]")
+    return checked, violations
+
+
+def percentile(vals: List[float], q: float) -> Optional[float]:
+    if not vals:
+        return None
+    ordered = sorted(vals)
+    return ordered[min(len(ordered) - 1, int(q * len(ordered)))]
+
+
+def build_report(spans: List[dict], top: int = 10,
+                 source: str = "") -> dict:
+    by_id, children = build_index(spans)
+    roots = trace_roots(spans, by_id)
+    requests = [analyze_request(r, children) for r in roots
+                if r["name"] in REQUEST_ROOTS]
+    checked, violations = check_consistency(spans, children)
+    keep: Dict[str, int] = defaultdict(int)
+    for r in roots:
+        k = r.get("attrs", {}).get("keep")
+        if k:
+            keep[k] += 1
+    breakdown = {}
+    for c in COMPONENTS + ("e2e", "critical_path"):
+        vals = [rq[f"{c}_ms"] for rq in requests]
+        breakdown[c] = {
+            "mean_ms": round(sum(vals) / len(vals), 3) if vals else None,
+            "p95_ms": round(percentile(vals, 0.95), 3)
+            if vals else None}
+    slowest = sorted(requests, key=lambda r: -r["e2e_ms"])[:top]
+    return {"kind": "trace_report", "ts": time.time(), "source": source,
+            "n_spans": len(spans), "n_traces": len(roots),
+            "n_requests": len(requests), "keep": dict(keep),
+            "breakdown_ms": breakdown, "slowest": slowest,
+            "consistency": {"checked": checked,
+                            "violations": len(violations),
+                            "details": violations[:20]}}
+
+
+def render(rep: dict) -> str:
+    out = [f"trace report — {rep['n_requests']} request(s), "
+           f"{rep['n_traces']} trace(s), {rep['n_spans']} span(s)"
+           f"  keep={rep['keep'] or {}}"]
+    bd = rep["breakdown_ms"]
+    if rep["n_requests"]:
+        out.append("  component     mean_ms     p95_ms")
+        for c in COMPONENTS + ("critical_path", "e2e"):
+            m, p = bd[c]["mean_ms"], bd[c]["p95_ms"]
+            out.append(f"  {c:<12} {m if m is not None else '-':>9} "
+                       f"{p if p is not None else '-':>10}")
+        out.append(f"  slowest {len(rep['slowest'])}:")
+        out.append("  trace_id  e2e_ms  queue  prefill  decode  fetch"
+                   "  exec  crit%  status")
+        for r in rep["slowest"]:
+            frac = 100.0 * r["critical_path_ms"] / r["e2e_ms"] \
+                if r["e2e_ms"] else 0.0
+            out.append(
+                f"  {r['trace_id'][:8]}  {r['e2e_ms']:>7.1f} "
+                f"{r['queue_ms']:>6.1f} {r['prefill_ms']:>8.1f} "
+                f"{r['decode_ms']:>7.1f} {r['fetch_ms']:>6.1f} "
+                f"{r['execute_ms']:>5.1f} {frac:>5.1f}  {r['status']}")
+    cons = rep["consistency"]
+    out.append(f"  consistency: {cons['checked']} parent/child pairs "
+               f"checked, {cons['violations']} violation(s)")
+    for d in cons["details"]:
+        out.append(f"    VIOLATION: {d}")
+    return "\n".join(out)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="critical-path report over a trace span dump")
+    ap.add_argument("files", nargs="+", help="span JSONL file(s)")
+    ap.add_argument("--top", type=int, default=10,
+                    help="slowest-N table size (default 10)")
+    ap.add_argument("--out", default=None,
+                    help="append one kind=trace_report JSONL record")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 on consistency violations")
+    args = ap.parse_args(argv)
+
+    spans = load_spans(args.files)
+    if not spans:
+        print("no spans found (is tracing enabled? FLAGS_enable_trace; "
+              "only tail-kept traces are exported)", file=sys.stderr)
+        return 1
+    rep = build_report(spans, top=args.top,
+                       source=",".join(args.files))
+    print(render(rep))
+    if args.out:
+        with open(args.out, "a") as f:
+            f.write(json.dumps(rep) + "\n")
+        print(f"report appended to {args.out}")
+    if args.strict and rep["consistency"]["violations"]:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -26,7 +26,11 @@ Checks, per file (type auto-detected from content):
   model/ok/counts/findings contract the lint report section reads;
   lines with kind == "graph_opt" (tools/program_lint.py --optimize)
   carry the model/opt_level/ops_before/ops_after/vars_eliminated/
-  passes contract the graph-optimization report section reads.
+  passes contract the graph-optimization report section reads; lines
+  with kind == "trace_report" (tools/trace_report.py --out) carry the
+  span/trace/request counts, the per-component breakdown_ms, the
+  slowest-N rows and the consistency-audit verdict the tracing report
+  section reads.
 * driver BENCH_rNN.json wrappers ({"n", "cmd", "rc", "tail",
   "parsed"}): parsed must be non-null — the exact invariant the r05
   rc=124 artifact violated.
@@ -404,6 +408,64 @@ def validate_sharded_bench(obj, where):
     return errs
 
 
+def validate_trace_report(obj, where="trace_report"):
+    """kind="trace_report" (tools/trace_report.py --out): the
+    critical-path summary over a span dump — counts, per-component
+    breakdown, slowest-N rows, and the consistency audit verdict."""
+    errs = []
+    for key in ("n_spans", "n_traces", "n_requests"):
+        v = obj.get(key)
+        if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+            errs.append(f"{where}: {key} must be a non-negative int "
+                        f"(got {v!r})")
+    if not isinstance(obj.get("keep"), dict):
+        errs.append(f"{where}: keep must be an object "
+                    f"(reason -> count)")
+    bd = obj.get("breakdown_ms")
+    if not isinstance(bd, dict):
+        errs.append(f"{where}: breakdown_ms must be an object")
+        bd = {}
+    for comp in ("queue", "prefill", "decode", "fetch", "e2e",
+                 "critical_path"):
+        ent = bd.get(comp)
+        if not isinstance(ent, dict):
+            errs.append(f"{where}: breakdown_ms.{comp} must be an "
+                        f"object")
+            continue
+        for key in ("mean_ms", "p95_ms"):
+            v = ent.get(key)
+            if v is not None and (not isinstance(v, (int, float))
+                                  or isinstance(v, bool)):
+                errs.append(f"{where}: breakdown_ms.{comp}.{key} must "
+                            f"be numeric or null (got {v!r})")
+    slowest = obj.get("slowest")
+    if not isinstance(slowest, list):
+        errs.append(f"{where}: slowest must be a list")
+        slowest = []
+    for i, r in enumerate(slowest):
+        if not isinstance(r, dict):
+            errs.append(f"{where}: slowest[{i}] is not an object")
+            continue
+        if not isinstance(r.get("trace_id"), str):
+            errs.append(f"{where}: slowest[{i}].trace_id must be a "
+                        f"string")
+        for key in ("e2e_ms", "critical_path_ms"):
+            v = r.get(key)
+            if not isinstance(v, (int, float)) or isinstance(v, bool):
+                errs.append(f"{where}: slowest[{i}].{key} must be "
+                            f"numeric (got {v!r})")
+    cons = obj.get("consistency")
+    if not isinstance(cons, dict):
+        errs.append(f"{where}: consistency must be an object")
+    else:
+        for key in ("checked", "violations"):
+            v = cons.get(key)
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                errs.append(f"{where}: consistency.{key} must be a "
+                            f"non-negative int (got {v!r})")
+    return errs
+
+
 def validate_jsonl(path):
     errs = []
     with open(path) as f:
@@ -437,6 +499,9 @@ def validate_jsonl(path):
                     rec, where=f"{path}:{ln}"))
             elif rec.get("kind") == "sharded_bench":
                 errs.extend(validate_sharded_bench(
+                    rec, where=f"{path}:{ln}"))
+            elif rec.get("kind") == "trace_report":
+                errs.extend(validate_trace_report(
                     rec, where=f"{path}:{ln}"))
     return errs
 
