@@ -485,6 +485,33 @@ DEFINE_double(
     "probe traffic. A successful probe closes the breaker; a failed "
     "one re-opens it for another cooldown.")
 
+DEFINE_int32(
+    "router_redispatch_budget", 2,
+    "Multi-replica router (paddle_tpu/serving/router.py): how many "
+    "times one request may be re-dispatched to a different replica "
+    "after a retryable failure (replica death, 503 shed, connection "
+    "reset) before the error is surfaced to the client. 0 disables "
+    "failover.")
+
+DEFINE_double(
+    "router_probe_interval_s", 0.5,
+    "Router health-probe cadence: every interval the router polls each "
+    "replica's health (/healthz for --url replicas, engine.health() "
+    "in-process) and updates its routing table. 0 disables active "
+    "probing (passive failure accounting still runs).")
+
+DEFINE_int32(
+    "router_failure_threshold", 3,
+    "Consecutive dispatch failures before the router's per-replica "
+    "circuit breaker marks that replica unhealthy and routes around "
+    "it. 0 disables the per-replica breaker.")
+
+DEFINE_double(
+    "router_drain_timeout_s", 30.0,
+    "Hot-swap / deregister drain deadline: how long the router waits "
+    "for a retired replica's in-flight requests to finish before "
+    "stopping it anyway.")
+
 DEFINE_bool(
     "serving_nan_guard", True,
     "Serving engine output hygiene: verify every batch's float outputs "

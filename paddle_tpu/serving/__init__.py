@@ -34,10 +34,11 @@ from .generation import (GenerationEngine, GenerationRequest,  # noqa: F401
 from .http import ServingHTTPServer, serve  # noqa: F401
 from .kv_blocks import (BlockPool, PrefixCache,  # noqa: F401
                         blocks_for_tokens)
+from .router import Replica, Router, RouterHTTP  # noqa: F401
 
 __all__ = ["BucketLadder", "DynamicBatcher", "EngineConfig",
            "ServingEngine", "ServingHTTPServer", "serve", "ServingError",
            "QueueFullError", "DeadlineExceededError", "EngineClosedError",
            "OverloadedError", "GenerationEngine", "GenerationRequest",
            "SlotManager", "BlockPool", "PrefixCache",
-           "blocks_for_tokens"]
+           "blocks_for_tokens", "Replica", "Router", "RouterHTTP"]

@@ -411,6 +411,14 @@ class GenerationEngine:
         return {"state": state, "breaker": b,
                 "retry_after_s": self._breaker.retry_after_s()}
 
+    def load(self) -> int:
+        """Queued + active requests — what the router's least-loaded
+        dispatch compares (the serving.gen_queue_depth /
+        gen_active_slots gauges, read directly)."""
+        with self._cond:
+            queued = len(self._queue)
+        return queued + self._slots.active_count()
+
     def cache_stats(self):
         """The executor's per-instance executable-cache counters; after
         `start()` the `misses` count must never move again — the

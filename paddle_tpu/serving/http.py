@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from typing import Optional
 
 import numpy as np
@@ -71,6 +72,14 @@ class ServingHTTPServer:
                              "a gen_engine")
         eng = engine
         gen = gen_engine
+        # In-flight POST accounting so close(drain=True) can wait for
+        # work already inside an engine instead of resetting the
+        # connection under it (replica restarts behind the router must
+        # not surface as wrong answers).
+        self._inflight = 0
+        self._inflight_cv = threading.Condition()
+        self._draining = False
+        outer = self
 
         class _Handler(http.server.BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
@@ -147,6 +156,29 @@ class ServingHTTPServer:
 
             def do_POST(self):
                 STAT_ADD("serving.http_requests")
+                with outer._inflight_cv:
+                    if outer._draining:
+                        draining = True
+                    else:
+                        draining = False
+                        outer._inflight += 1
+                if draining:
+                    # Keep-alive connections outlive shutdown(); refuse
+                    # new work with the retryable backpressure status
+                    # and drop the connection so clients re-dial.
+                    self._reply(503, {"error": "server is draining",
+                                      "retryable": True})
+                    self.close_connection = True
+                    return
+                try:
+                    self._do_post()
+                finally:
+                    with outer._inflight_cv:
+                        outer._inflight -= 1
+                        if outer._inflight == 0:
+                            outer._inflight_cv.notify_all()
+
+            def _do_post(self):
                 self._span = None
                 self._last_code = None
                 if trace.enabled():
@@ -292,9 +324,30 @@ class ServingHTTPServer:
         host, port = self._srv.server_address[:2]
         return f"http://{host}:{port}"
 
-    def close(self):
+    def inflight(self) -> int:
+        with self._inflight_cv:
+            return self._inflight
+
+    def close(self, drain: bool = True, timeout: float = 10.0):
+        """Stop accepting, optionally wait (bounded) for in-flight POSTs
+        to finish, then release the socket. Requests arriving on live
+        keep-alive connections after close() begins answer a retryable
+        503 instead of a connection reset."""
+        with self._inflight_cv:
+            self._draining = True
         self._srv.shutdown()
+        if drain:
+            deadline = time.monotonic() + max(0.0, timeout)
+            with self._inflight_cv:
+                while self._inflight > 0:
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        break
+                    self._inflight_cv.wait(left)
         self._srv.server_close()
+
+    # the router's replica lifecycle speaks stop(); same semantics
+    stop = close
 
 
 def serve(engine: Optional[ServingEngine] = None,

@@ -203,7 +203,16 @@ def start_span(name: str, parent: Optional[Span] = None,
                   t_start=t_start)
         head = random.random() < FLAGS.trace_sample
         with _LOCK:
-            _ACTIVE[trace_id] = _Trace(trace_id, sp, head)
+            tr = _ACTIVE.get(trace_id)
+            if remote is not None and tr is not None:
+                # The "remote" parent lives in THIS process (in-process
+                # router tier -> replica tier): the trace is already
+                # active here, so joining must not steal its root —
+                # record the hop as an ordinary child span and leave the
+                # keep/drop decision with the owning root.
+                tr.spans.append(sp)
+            else:
+                _ACTIVE[trace_id] = _Trace(trace_id, sp, head)
     if attrs:
         sp.attrs.update(attrs)
     STAT_ADD("trace.spans_started")
@@ -261,7 +270,13 @@ def finish_trace(root: Optional[Span], error: Optional[str] = None,
     root.attrs.setdefault("e2e_ms", round(e2e_ms, 3))
     from .core.flags import FLAGS
     with _LOCK:
-        tr = _ACTIVE.pop(root.trace_id, None)
+        tr = _ACTIVE.get(root.trace_id)
+        if tr is not None and tr.root is not root:
+            # A same-process traceparent join (see start_span): this
+            # span is a child of a trace whose root is still open —
+            # closing it must not pop the owner's bookkeeping.
+            return False
+        _ACTIVE.pop(root.trace_id, None)
         thresh = _slow_threshold_locked(FLAGS)
         if record_latency:
             _LAT_WINDOW.append(e2e_ms)

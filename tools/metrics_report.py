@@ -43,7 +43,7 @@ def load(path):
     snapshots, results, op_profiles = [], [], []
     loadgens, lints, graph_opts = [], [], []
     gen_loadgens, chaos_loadgens, memory_plans = [], [], []
-    sharded_benches, trace_reports = [], []
+    sharded_benches, trace_reports, router_loadgens = [], [], []
     with open(path) as f:
         for ln, line in enumerate(f, 1):
             line = line.strip()
@@ -72,6 +72,8 @@ def load(path):
                 gen_loadgens.append(rec)
             elif kind == "chaos_loadgen":
                 chaos_loadgens.append(rec)
+            elif kind == "router_loadgen":
+                router_loadgens.append(rec)
             elif kind == "program_lint":
                 lints.append(rec)
             elif kind == "graph_opt":
@@ -82,7 +84,7 @@ def load(path):
                 trace_reports.append(rec)
     return (snapshots, results, op_profiles, loadgens, lints,
             graph_opts, gen_loadgens, chaos_loadgens, memory_plans,
-            sharded_benches, trace_reports)
+            sharded_benches, trace_reports, router_loadgens)
 
 
 def _hist(snap, name):
@@ -92,14 +94,14 @@ def _hist(snap, name):
 def report(path, out=sys.stdout):
     (snapshots, results, op_profiles, loadgens, lints,
      graph_opts, gen_loadgens, chaos_loadgens, memory_plans,
-     sharded_benches, trace_reports) = load(path)
+     sharded_benches, trace_reports, router_loadgens) = load(path)
     w = out.write
     w(f"runtime stats report — {path}\n")
     if not snapshots and not results and not op_profiles \
             and not loadgens and not lints and not graph_opts \
             and not gen_loadgens and not chaos_loadgens \
             and not memory_plans and not sharded_benches \
-            and not trace_reports:
+            and not trace_reports and not router_loadgens:
         w("no snapshots or bench results found\n")
         return 1
     w(f"snapshots: {len(snapshots)}   bench results: {len(results)}\n")
@@ -282,6 +284,64 @@ def report(path, out=sys.stdout):
                   f"ttft p50 hit {th} ms vs miss {tm} ms  "
                   f"({pre.get('hit_requests', 0)} hit / "
                   f"{pre.get('miss_requests', 0)} miss)\n")
+
+    rreq = c.get("serving.router_requests")
+    if rreq or router_loadgens:
+        w("\n-- router (serving/router.py, docs/serving.md) --\n")
+        if rreq:
+            w(f"{'requests':26s} {int(rreq)}   redispatches "
+              f"{int(c.get('serving.router_redispatches', 0))}   shed "
+              f"{int(c.get('serving.router_shed', 0))}   affinity hits "
+              f"{int(c.get('serving.router_affinity_hits', 0))}\n")
+            w(f"{'membership':26s} "
+              f"{int(g.get('serving.router_healthy_replicas', 0))} "
+              f"healthy of {int(g.get('serving.router_replicas', 0))} "
+              f"replica(s)   probe failures "
+              f"{int(c.get('serving.router_probe_failures', 0))}   "
+              f"hot swaps {int(c.get('serving.router_hot_swaps', 0))}   "
+              f"preemptions "
+              f"{int(c.get('serving.router_preemptions', 0))}\n")
+            h = _hist(snap, "serving.router_e2e_ms")
+            if h and h["count"]:
+                w(f"{'e2e latency':26s} count {h['count']:<6d} "
+                  f"p50 {h['p50']:.2f} ms  p95 {h['p95']:.2f} ms\n")
+        for r in router_loadgens:
+            lat = r.get("latency_ms") or {}
+            sc = r.get("scaling") or {}
+            w(f"{'router loadgen':26s} {r.get('replicas', 0)} replica(s)"
+              f"  {r.get('requests', 0)} req  "
+              f"{r.get('throughput_rps', 0)} rps  p99 "
+              f"{lat.get('p99')} ms  errors {r.get('errors', 0)}  "
+              f"wrong {r.get('wrong_answers', 0)}  redispatches "
+              f"{r.get('redispatches', 0)}  shed {r.get('shed', 0)}\n")
+            if sc:
+                w(f"{'  scaling 1->N':26s} {sc.get('rps_1')} -> "
+                  f"{sc.get('rps_n')} rps  ratio {sc.get('ratio')}"
+                  f" (floor {sc.get('min_ratio')})\n")
+            pre = r.get("preempt")
+            if pre:
+                w(f"{'  preempt drill':26s} replica "
+                  f"{pre.get('replica', '?')}  client errors "
+                  f"{pre.get('client_errors', 0)}  wrong "
+                  f"{pre.get('wrong_answers', 0)}  resumed "
+                  f"{pre.get('resumed')}\n")
+            hs = r.get("hot_swap")
+            if hs:
+                w(f"{'  hot swap':26s} {hs.get('old', '?')} -> "
+                  f"{hs.get('new', '?')}  dropped "
+                  f"{hs.get('dropped_requests', 0)} of "
+                  f"{hs.get('requests', 0)}  standby compiles "
+                  f"{hs.get('standby_post_warmup_compiles', 0)}  "
+                  f"drained {hs.get('drained')}\n")
+            ch = r.get("chaos")
+            if ch:
+                w(f"{'  chaos (replica kill)':26s} killed "
+                  f"{ch.get('killed_replica', '?')}  client errors "
+                  f"{ch.get('client_errors', 0)}  wrong "
+                  f"{ch.get('wrong_answers', 0)}  worker deaths "
+                  f"{ch.get('worker_deaths', 0)}  p99 "
+                  f"{ch.get('p99_inflation')}x fault-free (bound "
+                  f"{ch.get('p99_bound')}x)\n")
 
     faults = c.get("resilience.faults_injected")
     retries = c.get("resilience.retries")

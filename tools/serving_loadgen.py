@@ -692,16 +692,34 @@ def run_generation(args):
     return 0
 
 
+def _chaos_retryable(e):
+    from paddle_tpu.serving import OverloadedError, QueueFullError
+    return isinstance(e, (OverloadedError, QueueFullError,
+                          ConnectionError))
+
+
 def run_chaos_closed(engine, requests, expected, concurrency,
-                     timeout_ms):
+                     timeout_ms, retries=0, call=None):
     """Closed-loop pass that also VERIFIES every successful response
     against the fault-free expected outputs: under chaos a request may
     fail (shed, timed out — that is degradation, allowed and counted)
     but a 200 carrying wrong numbers is a correctness bug (counted
-    separately, never allowed)."""
-    latencies, errors, wrong = [], [0], [0]
+    separately, never allowed).
+
+    Accounting is by VERDICT, exactly one per request index: a request
+    that sheds on one attempt and answers on a later one (client retry
+    here, or router failover behind `call`) counts once, with its final
+    outcome — never as both an error and an answer.
+
+    `call(feed, timeout_ms) -> [arrays]` overrides the engine dispatch
+    (the router mode routes through Router.predict); `retries` bounds
+    client-side re-submissions after a retryable rejection."""
+    verdicts = {}          # idx -> ("ok"|"wrong", latency_s) | ("error", None)
     lock = threading.Lock()
     it = iter(list(enumerate(requests)))
+    if call is None:
+        def call(feed, t):  # noqa: E306
+            return engine.predict(feed, timeout_ms=t)
 
     def worker():
         while True:
@@ -711,19 +729,28 @@ def run_chaos_closed(engine, requests, expected, concurrency,
                 return
             idx, feed = item
             t0 = time.perf_counter()
-            try:
-                outs = engine.predict(feed, timeout_ms=timeout_ms)
-                dt = time.perf_counter() - t0
-                ok = len(outs) == len(expected[idx]) and all(
-                    np.allclose(o, e, rtol=1e-4, atol=1e-5)
-                    for o, e in zip(outs, expected[idx]))
+            outs = None
+            attempt = 0
+            while True:
+                try:
+                    outs = call(feed, timeout_ms)
+                    break
+                except Exception as e:  # noqa: BLE001 — shed/timeout
+                    if attempt < retries and _chaos_retryable(e):
+                        attempt += 1
+                        time.sleep(0.01 * attempt)
+                        continue
+                    break
+            if outs is None:
                 with lock:
-                    latencies.append(dt)
-                    if not ok:
-                        wrong[0] += 1
-            except Exception:  # noqa: BLE001 — shed/timeout under chaos
-                with lock:
-                    errors[0] += 1
+                    verdicts[idx] = ("error", None)
+                continue
+            dt = time.perf_counter() - t0
+            ok = len(outs) == len(expected[idx]) and all(
+                np.allclose(o, e, rtol=1e-4, atol=1e-5)
+                for o, e in zip(outs, expected[idx]))
+            with lock:
+                verdicts[idx] = ("ok" if ok else "wrong", dt)
 
     threads = [threading.Thread(target=worker)
                for _ in range(concurrency)]
@@ -732,7 +759,11 @@ def run_chaos_closed(engine, requests, expected, concurrency,
         t.start()
     for t in threads:
         t.join()
-    return latencies, errors[0], wrong[0], time.perf_counter() - t0
+    dur = time.perf_counter() - t0
+    latencies = [v[1] for v in verdicts.values() if v[1] is not None]
+    errors = sum(1 for v in verdicts.values() if v[0] == "error")
+    wrong = sum(1 for v in verdicts.values() if v[0] == "wrong")
+    return latencies, errors, wrong, dur
 
 
 def run_chaos(args):
@@ -828,6 +859,289 @@ def run_chaos(args):
     return 0
 
 
+def run_router(args):
+    """--router N: the multi-replica acceptance run
+    (`kind="router_loadgen"` records). N warmed in-process replicas go
+    behind the serving Router; the run measures closed-loop throughput
+    with 1 registered replica then with all N (the ~linear-scaling
+    smoke — a deterministic per-batch service time injected via
+    `slow_step` makes the ratio machine-independent), and optionally:
+
+    * --preempt-drill: deregister+resume one replica mid-load; any
+      client-visible error while another replica is healthy fails the
+      run (exit 4).
+    * --hot-swap: warm a v2 standby under load, flip, drain v1 —
+      zero dropped requests and zero standby post-warmup compiles or
+      exit 4.
+    * --chaos: hard-kill one replica mid-pass (stop(drain=False), no
+      drain) and rely on failover; wrong answers or non-victim worker
+      deaths exit 4, p99 over --chaos-p99-bound x the fault-free p99
+      exits 5.
+
+    Every response in every pass is verified against fault-free
+    expected outputs with exactly-once per-request verdicts. Exit 7
+    when the 1->N throughput ratio lands below --scaling-min (> 0)."""
+    import paddle_tpu as fluid
+    from paddle_tpu.resilience import reset_injector
+    from paddle_tpu.serving import (EngineConfig, Replica, Router,
+                                    ServingEngine)
+
+    if args.url or args.generate:
+        print("--router drives in-process predict replicas; --url and "
+              "--generate are not supported", file=sys.stderr)
+        return 2
+    n_rep = args.router
+    seq_buckets = tuple(int(s) for s in args.seq_buckets.split(","))
+    feat = 6
+    reqs = make_requests(args.requests, seq_buckets, feat, args.seed)
+    # closed-loop scaling needs every replica's queue deep enough to
+    # fill batches in EACH of the ~3 shape-signature groups the mixed
+    # seq lengths land in, even after the load splits N ways
+    conc = max(args.concurrency,
+               4 * n_rep * args.max_batch_size + n_rep)
+
+    fluid.set_flags({"FLAGS_fault_spec": ""})
+    reset_injector()
+    model_dir = args.model_dir or build_tiny_model(
+        tempfile.mkdtemp(prefix="serving_router_"), feat)
+    all_engines = []
+
+    def make_engine(start=True):
+        cfg = EngineConfig(model_dir,
+                           max_batch_size=args.max_batch_size,
+                           max_wait_us=args.max_wait_us,
+                           queue_capacity=max(64, conc * 8),
+                           default_timeout_ms=args.timeout_ms,
+                           seq_buckets=seq_buckets,
+                           warmup=True)
+        e = ServingEngine(cfg)
+        if start:
+            e.start()
+        all_engines.append(e)
+        return e
+
+    engines = [make_engine() for _ in range(n_rep)]
+    names = engines[0].output_names()
+    # fault-free ground truth: every replica loads the same saved
+    # weights, so one clone references them all
+    ref = engines[0].predictor.clone()
+    expected = [ref.run_dict(feed) for feed in reqs]
+
+    if args.service_ms > 0:
+        # deterministic per-batch service time: slow_step with no p=
+        # fires on EVERY batch at the "serving" fault site, sleeping
+        # inside each engine's infer lock — so service parallelizes
+        # across replicas and the 1->N ratio is machine-independent
+        fluid.set_flags(
+            {"FLAGS_fault_spec":
+             f"slow_step:ms={args.service_ms}:site=serving"})
+        reset_injector()
+
+    replicas = [Replica(f"r{i}", engine=e, version="v1")
+                for i, e in enumerate(engines)]
+
+    def router_call(router):
+        def call(feed, t):
+            outs = router.predict(feed, timeout_ms=t)
+            return [outs[n] for n in names]
+        return call
+
+    # -- pass 1: one registered replica (the scaling denominator) ------
+    r1 = Router([replicas[0]], start_probe=False)
+    lat1, err1, wrong1, dur1 = run_chaos_closed(
+        None, reqs, expected, conc, args.timeout_ms,
+        retries=2, call=router_call(r1))
+    r1.close()
+    rps_1 = round(len(lat1) / dur1, 2) if dur1 else 0.0
+
+    # -- pass 2: all N replicas (the main record + chaos baseline) -----
+    router = Router(replicas, probe_interval_s=0.2)
+    call_n = router_call(router)
+    lat_n, err_n, wrong_n, dur_n = run_chaos_closed(
+        None, reqs, expected, conc, args.timeout_ms,
+        retries=2, call=call_n)
+    rps_n = round(len(lat_n) / dur_n, 2) if dur_n else 0.0
+    ratio = round(rps_n / rps_1, 3) if rps_1 else None
+
+    wrong_total = wrong1 + wrong_n
+    hard_fail = []
+
+    # -- preemption drill ----------------------------------------------
+    preempt_rec = None
+    if args.preempt_drill and n_rep >= 2:
+        res = {}
+
+        def _pload():
+            res["r"] = run_chaos_closed(
+                None, reqs, expected, conc, args.timeout_ms,
+                retries=2, call=call_n)
+
+        th = threading.Thread(target=_pload)
+        th.start()
+        time.sleep(max(0.05, dur_n * 0.25))
+        router.preempt("r1")
+        time.sleep(max(0.05, dur_n * 0.25))
+        router.resume("r1")
+        th.join()
+        _, errs_p, wrong_p, _ = res["r"]
+        wrong_total += wrong_p
+        preempt_rec = {"replica": "r1", "client_errors": errs_p,
+                       "wrong_answers": wrong_p, "resumed": True}
+        if errs_p or wrong_p:
+            hard_fail.append(
+                f"preempt drill: {errs_p} client errors / {wrong_p} "
+                f"wrong answers while other replicas were healthy")
+
+    # -- hot-swap drill ------------------------------------------------
+    hot_rec = None
+    if args.hot_swap:
+        stop_evt = threading.Event()
+        lock = threading.Lock()
+        counter, totals, bad = [0], [0], [0]
+
+        def _hs_worker():
+            while not stop_evt.is_set():
+                with lock:
+                    idx = counter[0] % len(reqs)
+                    counter[0] += 1
+                try:
+                    outs = call_n(reqs[idx], args.timeout_ms)
+                    ok = len(outs) == len(expected[idx]) and all(
+                        np.allclose(o, e, rtol=1e-4, atol=1e-5)
+                        for o, e in zip(outs, expected[idx]))
+                except Exception:  # noqa: BLE001
+                    ok = False
+                with lock:
+                    totals[0] += 1
+                    if not ok:
+                        bad[0] += 1
+
+        workers = [threading.Thread(target=_hs_worker)
+                   for _ in range(conc)]
+        for w in workers:
+            w.start()
+        # standby warms its full ladder here, WHILE v1 keeps serving
+        standby = Replica("r0v2", engine=make_engine(start=False),
+                          version="v2")
+        swap = router.hot_swap("r0", standby)
+        time.sleep(max(0.1, dur_n * 0.25))  # post-flip load on v2
+        stop_evt.set()
+        for w in workers:
+            w.join()
+        standby_compiles = standby.post_warmup_compiles()
+        hot_rec = {"swapped": bool(swap["swapped"]),
+                   "old": swap["old"], "new": swap["new"],
+                   "requests": totals[0],
+                   "dropped_requests": bad[0],
+                   "drained": bool(swap["drained"]),
+                   "standby_post_warmup_compiles": standby_compiles}
+        if bad[0]:
+            hard_fail.append(f"hot-swap drill dropped {bad[0]} of "
+                             f"{totals[0]} requests")
+        if standby_compiles:
+            hard_fail.append(f"standby compiled {standby_compiles} "
+                             f"time(s) after warmup")
+        if not swap["drained"]:
+            hard_fail.append("old replica not drained before stop")
+
+    # -- chaos: hard-kill one replica mid-run --------------------------
+    chaos_rec = None
+    p99_over = False
+    if args.chaos:
+        base_p99 = _percentile(sorted(v * 1e3 for v in lat_n), 0.99)
+        victim = router.replicas()[-1]
+        red0 = router.redispatches
+
+        def _killer():
+            time.sleep(max(0.05, dur_n * 0.3))
+            victim.engine.stop(drain=False)
+
+        kth = threading.Thread(target=_killer)
+        kth.start()
+        lat_c, err_c, wrong_c, dur_c = run_chaos_closed(
+            None, reqs, expected, conc, args.timeout_ms,
+            retries=3, call=call_n)
+        kth.join()
+        wrong_total += wrong_c
+        chaos_p99 = _percentile(sorted(v * 1e3 for v in lat_c), 0.99)
+        inflation = (round(chaos_p99 / base_p99, 3)
+                     if base_p99 and chaos_p99 else None)
+        deaths = sum(1 for r in router.replicas() if r is not victim
+                     for w in r.engine._workers if not w.is_alive())
+        chaos_rec = {"killed_replica": victim.name,
+                     "requests": len(lat_c),
+                     "client_errors": err_c,
+                     "wrong_answers": wrong_c,
+                     "worker_deaths": deaths,
+                     "redispatches": router.redispatches - red0,
+                     "baseline_p99_ms": base_p99,
+                     "chaos_p99_ms": chaos_p99,
+                     "p99_inflation": inflation,
+                     "p99_bound": args.chaos_p99_bound}
+        if wrong_c or deaths:
+            hard_fail.append(f"chaos: {wrong_c} wrong answers, "
+                             f"{deaths} non-victim worker deaths")
+        p99_over = inflation is not None \
+            and inflation > args.chaos_p99_bound
+
+    fluid.set_flags({"FLAGS_fault_spec": ""})
+    reset_injector()
+    router.close()
+    for e in all_engines:
+        try:
+            e.stop(drain=False, timeout=5.0)
+        except Exception:  # noqa: BLE001 — chaos victims already down
+            pass
+
+    rec = {
+        "kind": "router_loadgen",
+        "mode": "closed",
+        "replicas": n_rep,
+        "requests": len(lat_n),
+        "errors": err_n,
+        "wrong_answers": wrong_total,
+        "duration_s": round(dur_n, 4),
+        "throughput_rps": rps_n,
+        "latency_ms": _lat_summary(lat_n),
+        "redispatches": router.redispatches,
+        "shed": router.shed,
+        "scaling": {"rps_1": rps_1, "rps_n": rps_n, "ratio": ratio,
+                    "min_ratio": args.scaling_min,
+                    "pass1_errors": err1},
+        "config": {"concurrency": conc,
+                   "max_batch_size": args.max_batch_size,
+                   "max_wait_us": args.max_wait_us,
+                   "seq_buckets": list(seq_buckets),
+                   "service_ms": args.service_ms,
+                   "seed": args.seed},
+    }
+    if preempt_rec:
+        rec["preempt"] = preempt_rec
+    if hot_rec:
+        rec["hot_swap"] = hot_rec
+    if chaos_rec:
+        rec["chaos"] = chaos_rec
+    emit(rec, args.out)
+
+    if wrong_total or hard_fail:
+        for msg in hard_fail or [f"{wrong_total} wrong answers"]:
+            print(f"FAIL: {msg}", file=sys.stderr)
+        return 4
+    if p99_over:
+        print(f"FAIL: chaos p99 {chaos_rec['chaos_p99_ms']}ms is "
+              f"{chaos_rec['p99_inflation']}x the fault-free p99 "
+              f"{chaos_rec['baseline_p99_ms']}ms (bound "
+              f"{args.chaos_p99_bound}x)", file=sys.stderr)
+        return 5
+    if args.scaling_min > 0 and (ratio is None
+                                 or ratio < args.scaling_min):
+        print(f"FAIL: 1->{n_rep} replica throughput ratio {ratio} "
+              f"below --scaling-min {args.scaling_min}",
+              file=sys.stderr)
+        return 7
+    return 0
+
+
 def emit(rec, out_path):
     print(json.dumps(rec))
     if out_path:
@@ -912,8 +1226,33 @@ def main(argv=None):
                     help="FLAGS_fault_spec armed for the chaos pass")
     ap.add_argument("--chaos-p99-bound", type=float, default=50.0,
                     help="max allowed chaos-p99 / fault-free-p99 ratio")
+    ap.add_argument("--router", type=int, default=0,
+                    help="multi-replica mode: N in-process replicas "
+                         "behind the serving Router; records 1->N "
+                         "throughput scaling (kind=router_loadgen). "
+                         "Combine with --chaos for the replica-kill "
+                         "failover run, --hot-swap / --preempt-drill "
+                         "for the elasticity drills")
+    ap.add_argument("--service-ms", type=float, default=20.0,
+                    help="router mode: deterministic per-batch service "
+                         "time injected at the serving fault site so "
+                         "the scaling ratio is machine-independent "
+                         "(0 = none)")
+    ap.add_argument("--scaling-min", type=float, default=0.0,
+                    help="router mode: minimum required rps_N/rps_1 "
+                         "ratio; exit 7 below it (0 = record only)")
+    ap.add_argument("--hot-swap", action="store_true",
+                    help="router mode: v1->v2 hot-swap drill under "
+                         "load (exit 4 on any dropped request or "
+                         "standby post-warmup compile)")
+    ap.add_argument("--preempt-drill", action="store_true",
+                    help="router mode: preempt+resume one replica "
+                         "under load; exit 4 on any client-visible "
+                         "error")
     args = ap.parse_args(argv)
 
+    if args.router:
+        return run_router(args)
     if args.chaos:
         return run_chaos(args)
     if args.generate:

@@ -100,6 +100,7 @@ CONFIGS = [
     # the zero-wrong-answers / zero-worker-deaths verdict (rc 4/5 when
     # violated — a hard failure, not a flake)
     ("chaos_s4", None),  # special-cased below
+    ("router_chaos_s4", None),  # special-cased below
     ("gpt_b32", {"BENCH_MODEL": "gpt", "BENCH_BATCH": "32"}),
     # GSPMD dp x tp scaling (BENCH_MESH + FLAGS_sharded_exec layout,
     # docs/sharding.md): each sharded cell pairs with its single-chip
@@ -463,6 +464,42 @@ def run_special(key):
                 "chaos_p99_ms": rec.get("chaos_p99_ms"),
                 "baseline_p99_ms": rec.get("baseline_p99_ms"),
                 "fault_spec": rec.get("fault_spec")}, None
+    if key == "router_chaos_s4":
+        out_path = f"/tmp/router_chaos_{ROUND}.jsonl"
+        p = subprocess.run(
+            [sys.executable, "tools/serving_loadgen.py", "--router", "3",
+             "--requests", "400", "--max-batch-size", "4",
+             "--service-ms", "15", "--scaling-min", "2.0",
+             "--chaos", "--chaos-p99-bound", "10",
+             "--out", out_path],
+            cwd=REPO, capture_output=True, text=True, timeout=1800)
+        if p.returncode != 0:
+            # rc 4 = wrong answers / drops, rc 5 = p99 blown, rc 7 =
+            # sublinear 1->N scaling: all real regressions, not flakes
+            return None, (f"rc={p.returncode}: "
+                          + (p.stdout + p.stderr)[-300:])
+        recs = []
+        try:
+            with open(out_path) as f:
+                recs = [json.loads(ln) for ln in f if ln.strip()]
+        except (OSError, ValueError) as e:
+            return None, f"unreadable {out_path}: {e}"
+        rec = next((r for r in recs
+                    if r.get("kind") == "router_loadgen"), None)
+        if rec is None:
+            return None, "no router_loadgen record"
+        chaos = rec.get("chaos") or {}
+        return {"metric": "router_scaling_ratio",
+                "value": (rec.get("scaling") or {}).get("ratio"),
+                "unit": "x",
+                "replicas": rec.get("replicas"),
+                "throughput_rps": rec.get("throughput_rps"),
+                "redispatches": rec.get("redispatches"),
+                "shed": rec.get("shed"),
+                "wrong_answers": rec.get("wrong_answers"),
+                "chaos_wrong_answers": chaos.get("wrong_answers"),
+                "chaos_worker_deaths": chaos.get("worker_deaths"),
+                "chaos_p99_inflation": chaos.get("p99_inflation")}, None
     if key == "profile":
         p = subprocess.run([sys.executable, "tools/profile_step.py"],
                            cwd=REPO, capture_output=True, text=True,

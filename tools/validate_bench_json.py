@@ -22,6 +22,10 @@ Checks, per file (type auto-detected from content):
   loadgen contract plus fault_spec and the chaos verdict
   (wrong_answers/worker_deaths, both required to be ZERO, and the
   baseline/chaos p99 pair with its inflation bound); lines with
+  kind == "router_loadgen" (tools/serving_loadgen.py --router N) carry
+  the loadgen contract plus replicas/redispatches/shed, the 1->N
+  scaling block, and zero-gated preempt / hot_swap / chaos drill
+  verdicts; lines with
   kind == "program_lint" (tools/program_lint.py) carry the
   model/ok/counts/findings contract the lint report section reads;
   lines with kind == "graph_opt" (tools/program_lint.py --optimize)
@@ -211,6 +215,111 @@ def validate_chaos_loadgen(obj, where="chaos_loadgen"):
             and obj["p99_inflation"] > obj["p99_bound"]:
         errs.append(f"{where}: p99_inflation={obj['p99_inflation']} "
                     f"exceeds p99_bound={obj['p99_bound']}")
+    return errs
+
+
+def validate_router_loadgen(obj, where="router_loadgen"):
+    """Schema of one tools/serving_loadgen.py --router record: the base
+    loadgen contract plus replica count, failover accounting, the 1->N
+    scaling block, and the optional preempt / hot-swap / chaos drill
+    verdicts. Wherever a drill block is present its zero-regression
+    fields (wrong answers, dropped requests, standby compiles) must
+    actually be zero — the record documents the fleet guarantee."""
+    errs = validate_loadgen(obj, where=where)
+    reps = obj.get("replicas")
+    if not isinstance(reps, int) or isinstance(reps, bool) or reps < 1:
+        errs.append(f"{where}: replicas must be a positive int "
+                    f"(got {reps!r})")
+    for key in ("redispatches", "shed", "wrong_answers"):
+        v = obj.get(key)
+        if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+            errs.append(f"{where}: {key} must be a non-negative int "
+                        f"(got {v!r})")
+    if obj.get("wrong_answers"):
+        errs.append(f"{where}: wrong_answers="
+                    f"{obj['wrong_answers']} violates the exactly-"
+                    f"once, zero-incorrect-responses router contract")
+    scaling = obj.get("scaling")
+    if not isinstance(scaling, dict):
+        errs.append(f"{where}: scaling must be an object")
+    else:
+        for key in ("rps_1", "rps_n"):
+            v = scaling.get(key)
+            if not isinstance(v, (int, float)) or isinstance(v, bool):
+                errs.append(f"{where}: scaling.{key} must be numeric "
+                            f"(got {v!r})")
+        ratio = scaling.get("ratio")
+        if ratio is not None and (not isinstance(ratio, (int, float))
+                                  or isinstance(ratio, bool)):
+            errs.append(f"{where}: scaling.ratio must be numeric or "
+                        f"null (got {ratio!r})")
+        mr = scaling.get("min_ratio")
+        if isinstance(ratio, (int, float)) \
+                and isinstance(mr, (int, float)) and mr > 0 \
+                and ratio < mr:
+            errs.append(f"{where}: scaling.ratio={ratio} below "
+                        f"min_ratio={mr}")
+    pre = obj.get("preempt")
+    if pre is not None:
+        if not isinstance(pre, dict):
+            errs.append(f"{where}: preempt must be an object")
+        else:
+            for key in ("client_errors", "wrong_answers"):
+                v = pre.get(key)
+                if not isinstance(v, int) or isinstance(v, bool):
+                    errs.append(f"{where}: preempt.{key} must be an "
+                                f"int (got {v!r})")
+                elif v != 0:
+                    errs.append(f"{where}: preempt.{key}={v} — a "
+                                f"deregistered replica must not cost "
+                                f"clients anything while others are "
+                                f"healthy")
+    hot = obj.get("hot_swap")
+    if hot is not None:
+        if not isinstance(hot, dict):
+            errs.append(f"{where}: hot_swap must be an object")
+        else:
+            if hot.get("swapped") is not True:
+                errs.append(f"{where}: hot_swap.swapped must be true")
+            if hot.get("drained") is not True:
+                errs.append(f"{where}: hot_swap.drained must be true "
+                            f"(old replica stopped undrained)")
+            for key in ("dropped_requests",
+                        "standby_post_warmup_compiles"):
+                v = hot.get(key)
+                if not isinstance(v, int) or isinstance(v, bool):
+                    errs.append(f"{where}: hot_swap.{key} must be an "
+                                f"int (got {v!r})")
+                elif v != 0:
+                    errs.append(f"{where}: hot_swap.{key}={v} violates "
+                                f"the zero-downtime swap contract")
+    chaos = obj.get("chaos")
+    if chaos is not None:
+        if not isinstance(chaos, dict):
+            errs.append(f"{where}: chaos must be an object")
+        else:
+            for key in ("wrong_answers", "worker_deaths"):
+                v = chaos.get(key)
+                if not isinstance(v, int) or isinstance(v, bool):
+                    errs.append(f"{where}: chaos.{key} must be an int "
+                                f"(got {v!r})")
+                elif v != 0:
+                    errs.append(f"{where}: chaos.{key}={v} violates "
+                                f"the replica-kill failover contract")
+            for key in ("redispatches", "baseline_p99_ms",
+                        "chaos_p99_ms", "p99_inflation", "p99_bound"):
+                v = chaos.get(key)
+                if v is not None and (not isinstance(v, (int, float))
+                                      or isinstance(v, bool)):
+                    errs.append(f"{where}: chaos.{key} must be "
+                                f"numeric (got {v!r})")
+            if isinstance(chaos.get("p99_inflation"), (int, float)) \
+                    and isinstance(chaos.get("p99_bound"),
+                                   (int, float)) \
+                    and chaos["p99_inflation"] > chaos["p99_bound"]:
+                errs.append(f"{where}: chaos.p99_inflation="
+                            f"{chaos['p99_inflation']} exceeds "
+                            f"p99_bound={chaos['p99_bound']}")
     return errs
 
 
@@ -487,6 +596,9 @@ def validate_jsonl(path):
                     rec, where=f"{path}:{ln}"))
             elif rec.get("kind") == "chaos_loadgen":
                 errs.extend(validate_chaos_loadgen(
+                    rec, where=f"{path}:{ln}"))
+            elif rec.get("kind") == "router_loadgen":
+                errs.extend(validate_router_loadgen(
                     rec, where=f"{path}:{ln}"))
             elif rec.get("kind") == "program_lint":
                 errs.extend(validate_program_lint(
