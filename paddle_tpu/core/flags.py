@@ -506,6 +506,13 @@ DEFINE_int32(
     "circuit breaker marks that replica unhealthy and routes around "
     "it. 0 disables the per-replica breaker.")
 
+DEFINE_int32(
+    "router_affinity_max", 4096,
+    "Session-affinity table capacity: the router keeps at most this "
+    "many session->replica pins, evicting the least recently used pin "
+    "past the cap, so a long-running router's memory stays bounded "
+    "under a stream of short-lived generation sessions.")
+
 DEFINE_double(
     "router_drain_timeout_s", 30.0,
     "Hot-swap / deregister drain deadline: how long the router waits "

@@ -108,6 +108,35 @@ class CircuitBreaker:
             STAT_ADD("resilience.breaker_shed")
             return False
 
+    def would_allow(self) -> bool:
+        """Side-effect-free preview of `allow()`: True if a request
+        issued now would be admitted. Unlike `allow()` this never
+        consumes a HALF_OPEN probe slot and never bumps the shed stat,
+        so it is safe to call from health checks, gauges, and routing
+        filters. The dispatch path must still call `allow()` (paired
+        with record_success/record_failure) on the one request it
+        actually sends."""
+        if self.failure_threshold <= 0:
+            return True
+        with self._lock:
+            self._maybe_half_open()
+            if self._state == CLOSED:
+                return True
+            return (self._state == HALF_OPEN
+                    and self._probes_in_flight < self.half_open_probes)
+
+    def release_probe(self):
+        """Return a HALF_OPEN probe slot without recording a verdict —
+        for an admitted request that ended in a way that says nothing
+        about backend health (e.g. the client sent a malformed
+        request). No-op in every other state."""
+        if self.failure_threshold <= 0:
+            return
+        with self._lock:
+            if self._state == HALF_OPEN:
+                self._probes_in_flight = max(
+                    0, self._probes_in_flight - 1)
+
     def record_success(self):
         if self.failure_threshold <= 0:
             return

@@ -50,6 +50,7 @@ import threading
 import time
 import urllib.error
 import urllib.request
+from collections import OrderedDict
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -330,7 +331,7 @@ class Router:
 
     def __init__(self, replicas=(), probe_interval_s=None,
                  redispatch_budget=None, drain_timeout_s=None,
-                 start_probe: bool = True):
+                 affinity_max=None, start_probe: bool = True):
         self.probe_interval_s = float(
             probe_interval_s if probe_interval_s is not None
             else FLAGS.router_probe_interval_s)
@@ -340,9 +341,14 @@ class Router:
         self.drain_timeout_s = float(
             drain_timeout_s if drain_timeout_s is not None
             else FLAGS.router_drain_timeout_s)
+        self.affinity_max = int(
+            affinity_max if affinity_max is not None
+            else FLAGS.router_affinity_max)
         self._lock = threading.RLock()
         self._replicas: Dict[str, Replica] = {}
-        self._affinity: Dict[str, str] = {}
+        # session -> replica-name pins, LRU-bounded at affinity_max so
+        # a stream of short-lived sessions can't grow the map forever
+        self._affinity: "OrderedDict[str, str]" = OrderedDict()
         # plain counters mirroring the serving.router_* stats, readable
         # without a monitor scrape (loadgen records them)
         self.requests = 0
@@ -399,8 +405,13 @@ class Router:
     # -- health ----------------------------------------------------------
 
     def _routable(self, rep: Replica, now: float) -> bool:
+        # would_allow, not allow: this runs from read-only paths
+        # (gauges, healthz, candidate filtering) and must never consume
+        # a HALF_OPEN probe slot — _dispatch claims the slot via
+        # allow() on the one replica it actually sends to
         return (rep.registered and rep.healthy
-                and now >= rep.backoff_until and rep.breaker.allow())
+                and now >= rep.backoff_until
+                and rep.breaker.would_allow())
 
     def healthy_replicas(self) -> List[Replica]:
         now = time.monotonic()
@@ -458,6 +469,8 @@ class Router:
                 return None
             if session is not None:
                 pinned = self._affinity.get(session)
+                if pinned is not None:
+                    self._affinity.move_to_end(session)
                 for r in reps:
                     if r.name == pinned:
                         STAT_ADD("serving.router_affinity_hits")
@@ -465,12 +478,15 @@ class Router:
             best = min(reps, key=lambda r: (r.load(), r.name))
             if session is not None:
                 self._affinity[session] = best.name
+                self._affinity.move_to_end(session)
+                while len(self._affinity) > self.affinity_max:
+                    self._affinity.popitem(last=False)
             return best
 
-    def _shed_error(self) -> OverloadedError:
-        STAT_ADD("serving.router_shed")
-        with self._lock:
-            self.shed += 1
+    def _fleet_retry_after(self) -> float:
+        """Max backoff across the fleet — the Retry-After an unhealthy
+        router answers with. Pure read: bumps no counters, so healthz
+        polls don't inflate the shed stat."""
         now = time.monotonic()
         with self._lock:
             reps = list(self._replicas.values())
@@ -478,9 +494,16 @@ class Router:
         for r in reps:
             ra = max(ra, r.breaker.retry_after_s(),
                      r.backoff_until - now)
+        return ra
+
+    def _shed_error(self) -> OverloadedError:
+        STAT_ADD("serving.router_shed")
+        with self._lock:
+            self.shed += 1
         return OverloadedError(
             "no healthy replica (all replicas unhealthy, "
-            "backing off, or deregistered)", retry_after_s=ra)
+            "backing off, or deregistered)",
+            retry_after_s=self._fleet_retry_after())
 
     def _dispatch(self, kind: str, call, session: Optional[str] = None):
         STAT_ADD("serving.router_requests")
@@ -496,6 +519,11 @@ class Router:
                 # healthy set): shed with Retry-After rather than
                 # queueing work nobody can do
                 raise self._shed_error()
+            if not rep.breaker.allow():
+                # raced: another thread claimed the last HALF_OPEN
+                # probe slot between _pick's read-only check and here
+                tried.add(rep.name)
+                continue
             sp = trace.start_span(
                 "router.dispatch",
                 attrs={"replica": rep.name, "attempt": attempt,
@@ -527,7 +555,9 @@ class Router:
                 continue
             except Exception:
                 # non-retryable (bad request, deadline): the replica is
-                # not at fault — don't punish its breaker
+                # not at fault — don't punish its breaker, but hand
+                # back the probe slot allow() may have claimed
+                rep.breaker.release_probe()
                 trace.end_span(sp, error="dispatch_error")
                 raise
             trace.end_span(sp)
@@ -560,26 +590,44 @@ class Router:
         """Zero-downtime model swap: warm `standby` through its full
         ladder while `old_name` keeps serving, gate on zero
         post-warmup compiles, atomically flip the table, drain the old
-        replica, stop it. Call from any thread — traffic keeps flowing
-        the whole time."""
+        replica, stop it. `standby.name == old_name` is allowed (the
+        restart-with-new-weights pattern); any other name collision is
+        rejected before the standby is ever started, and an abort on
+        any later gate stops the standby so no warmed engine leaks.
+        Call from any thread — traffic keeps flowing the whole time."""
         timeout = (drain_timeout_s if drain_timeout_s is not None
                    else self.drain_timeout_s)
-        standby.start()
-        compiles = standby.post_warmup_compiles()
-        if compiles:
-            standby.stop()
-            raise RuntimeError(
-                f"hot-swap aborted: standby {standby.name!r} would "
-                f"compile in the serving path "
-                f"({compiles} post-warmup compiles)")
-        with self._lock:
-            if standby.name in self._replicas:
+
+        def _check_collision():
+            # lock held; same-name swap is fine — old_name is popped
+            # in the same critical section the standby goes in
+            if standby.name != old_name and \
+                    standby.name in self._replicas:
                 raise ValueError(
                     f"duplicate replica {standby.name!r}")
-            old = self._replicas.pop(old_name, None)
-            standby.registered = True
-            self._replicas[standby.name] = standby
-            self._drop_affinity_locked(old_name)
+
+        with self._lock:
+            _check_collision()
+        try:
+            standby.start()
+            compiles = standby.post_warmup_compiles()
+            if compiles:
+                raise RuntimeError(
+                    f"hot-swap aborted: standby {standby.name!r} "
+                    f"would compile in the serving path "
+                    f"({compiles} post-warmup compiles)")
+            with self._lock:
+                _check_collision()   # re-check: add_replica may race
+                old = self._replicas.pop(old_name, None)
+                standby.registered = True
+                self._replicas[standby.name] = standby
+                self._drop_affinity_locked(old_name)
+        except BaseException:
+            try:
+                standby.stop(drain=False)
+            except Exception:
+                pass
+            raise
         self._publish_gauges()
         drained = True
         if old is not None:
@@ -662,9 +710,8 @@ class Router:
                            "load": r.load()} for r in reps}
         if any(self._routable(r, now) for r in reps):
             return 200, {"state": "ok", "replicas": detail}, 0.0
-        err = self._shed_error()
         return 503, {"state": "open", "replicas": detail}, \
-            err.retry_after_s
+            self._fleet_retry_after()
 
     def close(self, stop_replicas: bool = False):
         self._closed = True

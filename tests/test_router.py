@@ -255,6 +255,60 @@ def test_breaker_opens_after_repeated_failures():
         assert bad.calls == calls_before
 
 
+def test_half_open_probe_recovers_replica_after_cooldown():
+    """A tripped breaker must recover through the half-open probe even
+    while read-only paths (healthz, gauge sweeps, healthy_replicas)
+    keep checking routability: those checks must not consume the
+    HALF_OPEN probe slot, or the replica stays excluded forever."""
+    bad = _StubEngine(tag=3, load=0)
+    bad.fail = QueueFullError("full")
+    rep = Replica("r", engine=bad, failure_threshold=1)
+    rep.breaker.cooldown_ms = 60.0
+    with _router(rep) as rt:
+        with pytest.raises(OverloadedError):
+            rt.predict(_FEED)         # one strike trips the breaker
+        assert rt.healthy_replicas() == []
+        time.sleep(0.08)              # cooldown elapsed -> HALF_OPEN
+        for _ in range(5):            # read-only paths, repeatedly
+            rt.healthz()
+            rt.probe_once()
+            assert [r.name for r in rt.healthy_replicas()] == ["r"]
+        bad.fail = None
+        out = rt.predict(_FEED)       # the real probe closes it
+        assert out["y"][0, 0] == 3.0
+        from paddle_tpu.resilience.breaker import CLOSED
+        assert rep.breaker.state == CLOSED
+        assert [r.name for r in rt.healthy_replicas()] == ["r"]
+
+
+def test_nonretryable_in_half_open_releases_probe_slot():
+    bad = _StubEngine(tag=0, load=0)
+    bad.fail = QueueFullError("full")
+    rep = Replica("r", engine=bad, failure_threshold=1)
+    rep.breaker.cooldown_ms = 40.0
+    with _router(rep) as rt:
+        with pytest.raises(OverloadedError):
+            rt.predict(_FEED)         # OPEN
+        time.sleep(0.06)              # HALF_OPEN
+        bad.fail = ValueError("bad feed")
+        with pytest.raises(ValueError):
+            rt.predict(_FEED)         # probe claimed, then released
+        # the replica is not at fault and must stay routable
+        assert [r.name for r in rt.healthy_replicas()] == ["r"]
+        bad.fail = None
+        assert rt.predict(_FEED)["y"][0, 0] == 0.0
+
+
+def test_healthz_polls_do_not_inflate_shed_counter():
+    with _router(Replica("r", engine=_StubEngine(tag=0))) as rt:
+        rt.preempt("r")
+        for _ in range(3):
+            code, _body, ra = rt.healthz()
+            assert code == 503 and ra >= 1.0
+        # no client request was shed: only actual sheds may count
+        assert rt.shed == 0
+
+
 def test_session_affinity_pins_and_repins():
     g0, g1 = _StubGenEngine("g0", load=0), _StubGenEngine("g1", load=5)
     with _router(Replica("r0", gen_engine=g0),
@@ -269,6 +323,23 @@ def test_session_affinity_pins_and_repins():
         # pin breaks with the replica and re-pins on a healthy one
         rt.preempt("r0")
         assert rt.generate(_GEN, session="s1")["text"] == "from-g1"
+
+
+def test_affinity_map_is_lru_bounded():
+    g = _StubGenEngine("g", load=0)
+    with _router(Replica("r", gen_engine=g), affinity_max=4) as rt:
+        for i in range(10):
+            rt.generate(_GEN, session=f"s{i}")
+        with rt._lock:
+            assert list(rt._affinity) == ["s6", "s7", "s8", "s9"]
+        # touching a survivor refreshes it; a new session evicts the
+        # least recently used pin, not the refreshed one
+        rt.generate(_GEN, session="s6")
+        rt.generate(_GEN, session="new")
+        with rt._lock:
+            assert "s6" in rt._affinity
+            assert "s7" not in rt._affinity
+            assert len(rt._affinity) == 4
 
 
 def test_probe_once_gates_unhealthy_replica():
@@ -402,6 +473,59 @@ def test_hot_swap_flips_table_and_drains_old(model_dir):
                                    atol=1e-5)
     finally:
         rt.close(stop_replicas=True)
+
+
+def test_hot_swap_rejects_duplicate_before_start_allows_same_name():
+    a = _StubEngine(tag=0, load=0)
+    b = _StubEngine(tag=1, load=9)
+    with _router(Replica("r0", engine=a), Replica("r1", engine=b)) as rt:
+        # a collision with a live replica is rejected BEFORE the
+        # standby is warmed, so no engine is started just to be thrown
+        # away
+        class _TrackStart(_StubEngine):
+            started = False
+
+            def start(self):
+                self.started = True
+
+        dup_eng = _TrackStart(tag=2)
+        with pytest.raises(ValueError):
+            rt.hot_swap("r0", Replica("r1", engine=dup_eng))
+        assert dup_eng.started is False
+        assert sorted(r.name for r in rt.replicas()) == ["r0", "r1"]
+        # swapping under the SAME name (restart with new weights) works
+        res = rt.hot_swap("r0", Replica(
+            "r0", engine=_StubEngine(tag=5), version="v2"))
+        assert res["swapped"] and res["old"] == "r0" \
+            and res["new"] == "r0"
+        reps = {r.name: r for r in rt.replicas()}
+        assert set(reps) == {"r0", "r1"}
+        assert reps["r0"].version == "v2"
+        assert rt.predict(_FEED)["y"][0, 0] == 5.0
+
+
+def test_hot_swap_compile_gate_stops_standby_and_keeps_table():
+    class _CompilingGen(_StubGenEngine):
+        def __init__(self):
+            super().__init__("c")
+            self.stopped = False
+
+        def post_warmup_compiles(self):
+            return 1
+
+        def stop(self, drain=True, timeout=30.0):
+            self.stopped = True
+
+    g = _StubGenEngine("g0", load=0)
+    comp = _CompilingGen()
+    with _router(Replica("g0", gen_engine=g)) as rt:
+        with pytest.raises(RuntimeError, match="post-warmup compiles"):
+            rt.hot_swap("g0", Replica("g1", gen_engine=comp))
+        # the aborted standby was stopped, and the old replica still
+        # serves
+        assert comp.stopped is True
+        assert [r.name for r in rt.replicas()] == ["g0"]
+        assert rt.generate(_GEN)["text"] == "from-g0"
 
 
 # ---------------------------------------------------------------------------
