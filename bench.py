@@ -96,6 +96,43 @@ def _flight_path() -> str:
     return "bench_flight.jsonl"
 
 
+def _perf_ledger():
+    """Import tools/perf_ledger.py (lightweight: no paddle_tpu/jax
+    import) for provenance stamping and the BENCH_LEDGER hook."""
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"))
+    import perf_ledger
+    return perf_ledger
+
+
+def _ledger_and_gate(summary, log, platform_hint=""):
+    """BENCH_LEDGER=path.jsonl auto-ingests this run's results into
+    the longitudinal perf ledger (provenance stamped); BENCH_GATE=1
+    additionally gates them against the EXISTING history first
+    (tools/perf_gate.py) and emits the perf_gate record to stdout +
+    the JSONL log. Informational: bench's exit code stays the
+    one-artifact-per-model contract — CI that wants a failing gate
+    runs tools/perf_gate.py on the summary itself."""
+    ledger = os.environ.get("BENCH_LEDGER", "")
+    if not ledger:
+        return
+    try:
+        pl = _perf_ledger()
+        rows, _skipped = pl.rows_from_record(summary)
+        if not rows:
+            return
+        if os.environ.get("BENCH_GATE") == "1":
+            import perf_gate
+            results = perf_gate.gate_rows(rows, pl.load_rows(ledger))
+            report = perf_gate.gate_report(results, ledger, 4.0, 3, 20)
+            print(json.dumps(report), flush=True)
+            _emit(log, report)
+        pl.append_rows(ledger, rows,
+                       pl.provenance(platform=platform_hint or None))
+    except Exception as e:  # noqa: BLE001 — ledger must never kill bench
+        print(f"# perf ledger unavailable: {e}", file=sys.stderr)
+
+
 def _record_bench_stats(flops_per_step):
     """Feed the monitor the model's per-step flops + the chip peak so
     tools/metrics_report.py can derive MFU from the step-time histogram
@@ -843,6 +880,20 @@ def main(argv=None):
             print(f"# BENCH_PLATFORM={forced_platform} failed: {e}",
                   file=sys.stderr)
 
+    if args.time_budget <= 0 and not forced_platform \
+            and "BENCH_TIME_BUDGET" not in os.environ:
+        # The round driver runs plain `python bench.py` (TPU path)
+        # under an external `timeout -k 10 870`: self-budget safely
+        # below that so the run ends cleanly between configs with a
+        # parseable artifact instead of dying rc=124 with parsed:null
+        # (the BENCH_r03/r05 failure mode). Forced-platform runs (CPU
+        # tests, plumbing work) keep the no-budget default.
+        args.time_budget = float(os.environ.get(
+            "BENCH_DEFAULT_TIME_BUDGET", "840"))
+        deadline = t_start + args.time_budget
+        print(f"# time budget defaulted to {args.time_budget:.0f}s "
+              f"(set BENCH_TIME_BUDGET to override)", file=sys.stderr)
+
     log = _log_path()
     flight = _flight_path()
     summary_path = _summary_path()
@@ -852,6 +903,13 @@ def main(argv=None):
     summary = {"kind": "bench_summary", "status": "running",
                "models": list(models), "completed": [], "results": [],
                "ts_start": t_start}
+    # run provenance (git rev / platform / mesh) rides in the summary
+    # so a ledger row ingested from this artifact is bisectable
+    try:
+        pl = _perf_ledger()
+        summary.update(pl.provenance(platform=forced_platform or None))
+    except Exception as e:  # noqa: BLE001 — provenance is best-effort
+        print(f"# provenance unavailable: {e}", file=sys.stderr)
     _write_summary(summary_path, summary)
 
     def _finalize_summary(status, reason=None):
@@ -997,6 +1055,7 @@ def main(argv=None):
             except Exception as e:  # noqa: BLE001
                 print(f"# snapshot failed: {e}", file=sys.stderr)
     _finalize_summary("complete")
+    _ledger_and_gate(summary, log, platform_hint=forced_platform)
     try:
         from paddle_tpu import monitor
         if monitor.flight_records():

@@ -571,6 +571,40 @@ DEFINE_double(
     "= rolling p95 over the last trace window (keeps ~the slowest 5% "
     "once enough requests have completed).")
 
+DEFINE_string(
+    "alert_rules", "",
+    "Declarative SLO alert rules for paddle_tpu/monitor_alerts.py, "
+    "semicolon-separated. Grammar per rule: "
+    "'name:threshold:STAT OP VALUE[:for=DUR]' over a counter/gauge, "
+    "'name:ratio:NUM/DEN OP VALUE[:for=DUR]' over two counters, or "
+    "'name:burn:HIST:pQQ OP VALUE:windows=W1,W2' multi-window burn "
+    "rate over a histogram percentile (fires only when EVERY window "
+    "breaches). OP is one of > >= < <=; durations accept s/m/h "
+    "suffixes. Empty (default) disables the evaluator entirely.")
+
+DEFINE_double(
+    "alert_eval_interval_s", 5.0,
+    "Period of the background alert evaluator thread (seconds). Each "
+    "tick snapshots the monitor registry once and evaluates every "
+    "FLAGS_alert_rules rule against it; <= 0 disables the background "
+    "thread (rules still evaluate via alerts.evaluate_once(), which "
+    "tests drive with a fake clock).")
+
+DEFINE_string(
+    "alert_bundle_dir", "",
+    "Directory for incident bundles: on each pending->firing "
+    "transition the alert engine writes exactly one atomic JSON "
+    "bundle correlating the rule, the full stats snapshot, breaching-"
+    "bucket trace exemplars, the kept-trace ring, and the flight-"
+    "recorder ring. Empty (default) = bundles disabled; alerts still "
+    "fire and expose via /alertz and ALERTS exposition.")
+
+DEFINE_int32(
+    "alert_bundle_max_spans", 512,
+    "Cap on kept-trace-ring spans embedded in one incident bundle "
+    "(newest kept spans win, after breaching-bucket exemplar traces "
+    "are included first). Bounds bundle size on busy servers.")
+
 # ---------------------------------------------------------------------------
 # Reference-flag compat surface (App. C parity target:
 # platform/flags.cc:33-449 + the read_env_flags whitelist in

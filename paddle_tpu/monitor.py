@@ -530,6 +530,16 @@ def prometheus_text() -> str:
             out.append(f'{m}_bucket{{le="{le_s}"}} {cum}')
         out.append(f"{m}_sum {h['sum']}")
         out.append(f"{m}_count {h['count']}")
+    # Prometheus ALERTS series from the SLO engine (monitor_alerts.py),
+    # so one scrape carries both the stats and the alert states. Lazy
+    # import: monitor_alerts imports this module at its top level.
+    try:
+        from .monitor_alerts import prometheus_alerts_text
+        alerts = prometheus_alerts_text()
+    except Exception:  # noqa: BLE001 — the scrape path never fails
+        alerts = ""
+    if alerts:
+        out.append(alerts.rstrip("\n"))
     return "\n".join(out) + "\n"
 
 

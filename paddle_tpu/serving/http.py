@@ -22,7 +22,14 @@ its own handler thread, which blocks in `engine.predict` /
   ``Retry-After`` header) while a breaker is shedding load.
 - ``GET /metrics``      -> the same Prometheus text the monitor's scrape
   endpoint serves (monitor.prometheus_text), so one port serves both
-  traffic and observability.
+  traffic and observability — including ``ALERTS{...}`` series and
+  ``alerts.*`` stats when the SLO engine is running.
+- ``GET /alertz``       -> the alert engine's full rule/state dump
+  (monitor_alerts.alertz_dict): every rule with its state
+  (inactive/pending/firing), last value, windows, and the incident
+  bundle path of the current firing. Always 200 — an alert never flips
+  health; ``/healthz`` detail carries an ``alerts_firing`` count for
+  operators instead.
 """
 from __future__ import annotations
 
@@ -33,7 +40,7 @@ from typing import Optional
 
 import numpy as np
 
-from .. import trace
+from .. import monitor_alerts, trace
 from ..monitor import STAT_ADD, prometheus_text
 from .batcher import (DeadlineExceededError, EngineClosedError,
                       OverloadedError, QueueFullError)
@@ -129,7 +136,11 @@ class ServingHTTPServer:
                     retry_after = max(retry_after,
                                       h.get("retry_after_s") or 0.0)
                 body = {"state": "ok" if worst == "ready" else worst,
-                        "engines": detail}
+                        "engines": detail,
+                        # informational: firing alerts never change the
+                        # health verdict (alerts page humans; healthz
+                        # steers load balancers)
+                        "alerts_firing": monitor_alerts.firing_count()}
                 if worst in ("ready", "degraded"):
                     self._reply(200, body)
                 else:
@@ -151,6 +162,8 @@ class ServingHTTPServer:
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)
+                elif self.path.startswith("/alertz"):
+                    self._reply(200, monitor_alerts.alertz_dict())
                 else:
                     self._reply(404, {"error": f"no route {self.path}"})
 
@@ -308,6 +321,10 @@ class ServingHTTPServer:
                 # stderr
 
         self.engine = engine
+        # SLO alerting rides on the serving lifecycle: a front end with
+        # FLAGS_alert_rules set gets the background evaluator for free
+        # (no-op when no rules are configured).
+        monitor_alerts.maybe_start()
         self._srv = http.server.ThreadingHTTPServer((host, port),
                                                     _Handler)
         self._thread = threading.Thread(target=self._srv.serve_forever,

@@ -708,9 +708,15 @@ class Router:
                            "healthy": r.healthy,
                            "version": r.version,
                            "load": r.load()} for r in reps}
+        # informational only — a firing SLO alert never makes the
+        # router stop routing (monitor_alerts.py)
+        from .. import monitor_alerts
+        firing = monitor_alerts.firing_count()
         if any(self._routable(r, now) for r in reps):
-            return 200, {"state": "ok", "replicas": detail}, 0.0
-        return 503, {"state": "open", "replicas": detail}, \
+            return 200, {"state": "ok", "replicas": detail,
+                         "alerts_firing": firing}, 0.0
+        return 503, {"state": "open", "replicas": detail,
+                     "alerts_firing": firing}, \
             self._fleet_retry_after()
 
     def close(self, stop_replicas: bool = False):
@@ -744,6 +750,10 @@ class RouterHTTP:
 
         rt = router
         self.router = router
+        # same lifecycle hook as ServingHTTPServer: a router front end
+        # with FLAGS_alert_rules set runs the SLO evaluator
+        from .. import monitor_alerts
+        monitor_alerts.maybe_start()
         self._inflight = 0
         self._inflight_cv = threading.Condition()
         self._draining = False
@@ -791,6 +801,9 @@ class RouterHTTP:
                                      str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)
+                elif self.path.startswith("/alertz"):
+                    from .. import monitor_alerts
+                    self._reply(200, monitor_alerts.alertz_dict())
                 else:
                     self._reply(404,
                                 {"error": f"no route {self.path}"})

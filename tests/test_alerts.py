@@ -1,0 +1,504 @@
+"""SLO alerting engine (paddle_tpu/monitor_alerts.py): rule grammar,
+threshold/ratio/burn evaluation with a fake clock, multi-window
+burn-rate semantics (a transient spike must NOT fire; a sustained
+breach must), exactly-once atomic incident bundles with trace-exemplar
+correlation, and the /alertz + /healthz + /metrics exposure on the
+serving HTTP front end. Everything runs on a fake clock — no sleeps in
+the evaluation paths."""
+import contextlib
+import json
+import os
+import sys
+import time
+import urllib.request
+
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import monitor, monitor_alerts, trace
+from paddle_tpu.monitor_alerts import (AlertEngine, parse_duration,
+                                       parse_rules)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# ms-oriented buckets for the synthetic latency histograms: good
+# requests land in <=5, the injected-slow ones in (250, 500]
+MS_BUCKETS = (1.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0, 1000.0)
+
+
+def _tools(module):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        return __import__(module)
+    finally:
+        sys.path.pop(0)
+
+
+@contextlib.contextmanager
+def _monitor_on(**flag_over):
+    prev = {k: getattr(fluid.FLAGS, k)
+            for k in list(flag_over) + ["enable_monitor"]}
+    fluid.set_flags({"FLAGS_enable_monitor": True,
+                     **{f"FLAGS_{k}": v for k, v in flag_over.items()}})
+    monitor.reset_stats()
+    monitor.reset_flight_recorder()
+    try:
+        yield monitor
+    finally:
+        monitor_alerts.stop_alerts()
+        monitor.reset_stats()
+        monitor.reset_flight_recorder()
+        fluid.set_flags({f"FLAGS_{k}": v for k, v in prev.items()})
+
+
+class _Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+# ---------------------------------------------------------------------------
+# Rule grammar
+# ---------------------------------------------------------------------------
+
+def test_parse_duration():
+    assert parse_duration("30s") == 30.0
+    assert parse_duration("5m") == 300.0
+    assert parse_duration("1h") == 3600.0
+    assert parse_duration("2.5") == 2.5
+    with pytest.raises(ValueError):
+        parse_duration("")
+
+
+def test_parse_rules_all_kinds():
+    rules = parse_rules(
+        "deep:threshold:serving.queue_depth > 100:for=30s;"
+        "shed:ratio:serving.rejected/serving.requests >= 0.05;"
+        "burny:burn:serving.e2e_ms:p99 > 250:windows=1m,10m")
+    assert [r.kind for r in rules] == ["threshold", "ratio", "burn"]
+    t, r, b = rules
+    assert t.stat == "serving.queue_depth" and t.op == ">" \
+        and t.value == 100.0 and t.for_s == 30.0
+    # >= must not parse as > (longest-op-first)
+    assert r.num == "serving.rejected" and r.den == "serving.requests" \
+        and r.op == ">=" and r.value == 0.05
+    assert b.stat == "serving.e2e_ms" and b.pct == 0.99 \
+        and b.windows_s == (60.0, 600.0)
+    d = b.to_dict()
+    assert d["histogram"] == "serving.e2e_ms" \
+        and d["windows_s"] == [60.0, 600.0]
+    # empty spec -> no rules (the disabled default)
+    assert parse_rules("") == [] and parse_rules(None) == []
+
+
+@pytest.mark.parametrize("bad", [
+    "noexpr:threshold",                        # too few fields
+    "x:threshold:serving.queue_depth 100",     # no operator
+    "x:ratio:serving.rejected > 0.05",         # ratio without NUM/DEN
+    "x:burn:h:p99 > 1",                        # burn without windows=
+    "x:burn:h:q99 > 1:windows=1m",             # bad percentile syntax
+    "x:burn:h:p150 > 1:windows=1m",            # percentile out of range
+    "x:frobnicate:a > 1",                      # unknown kind
+    "x:threshold:a > 1:unknown=2",             # unknown option
+    "a:threshold:x > 1;a:threshold:y > 2",     # duplicate name
+])
+def test_parse_rules_rejects_malformed(bad):
+    with pytest.raises(ValueError, match="bad alert rule"):
+        parse_rules(bad)
+
+
+# ---------------------------------------------------------------------------
+# Threshold + ratio state machine (fake clock)
+# ---------------------------------------------------------------------------
+
+def test_threshold_for_pending_then_firing_then_resolved():
+    with _monitor_on():
+        clock = _Clock()
+        eng = AlertEngine(parse_rules(
+            "deep:threshold:t.depth > 10:for=30s"), clock=clock)
+        # missing stat: no breach, stays inactive
+        out = eng.evaluate_once()
+        assert out["rules"][0]["state"] == "inactive"
+
+        monitor.STAT_SET("t.depth", 50)
+        out = eng.evaluate_once()
+        assert out["rules"][0]["state"] == "pending"  # for= hold-down
+        assert out["pending"] == 1 and out["firing"] == 0
+
+        clock.t += 29
+        assert eng.evaluate_once()["rules"][0]["state"] == "pending"
+        clock.t += 1
+        out = eng.evaluate_once()
+        assert out["rules"][0]["state"] == "firing"
+        assert out["firing"] == 1 and eng.firing() == ["deep"]
+        snap = monitor.get_stats_snapshot()
+        assert snap["counters"]["alerts.fired"] == 1
+        assert snap["gauges"]["alerts.firing"] == 1
+
+        # a breach that clears mid-hold-down resets the episode
+        monitor.STAT_SET("t.depth", 3)
+        out = eng.evaluate_once()
+        assert out["rules"][0]["state"] == "inactive"
+        snap = monitor.get_stats_snapshot()
+        assert snap["counters"]["alerts.resolved"] == 1
+        assert snap["gauges"]["alerts.firing"] == 0
+        # re-breach starts a fresh for= window
+        monitor.STAT_SET("t.depth", 50)
+        assert eng.evaluate_once()["rules"][0]["state"] == "pending"
+
+
+def test_ratio_rule_and_zero_denominator():
+    with _monitor_on():
+        clock = _Clock()
+        eng = AlertEngine(parse_rules(
+            "shed:ratio:t.rej/t.req > 0.05"), clock=clock)
+        # no traffic at all: denominator 0 never breaches
+        assert eng.evaluate_once()["rules"][0]["state"] == "inactive"
+        monitor.STAT_ADD("t.req", 100)
+        monitor.STAT_ADD("t.rej", 3)
+        out = eng.evaluate_once()
+        assert out["rules"][0]["state"] == "inactive"
+        assert out["rules"][0]["value"] == pytest.approx(0.03)
+        monitor.STAT_ADD("t.rej", 7)   # 10/100
+        out = eng.evaluate_once()      # for_s=0 -> fires immediately
+        assert out["rules"][0]["state"] == "firing"
+        assert out["rules"][0]["value"] == pytest.approx(0.10)
+
+
+# ---------------------------------------------------------------------------
+# Multi-window burn rate (fake clock)
+# ---------------------------------------------------------------------------
+
+def _observe(n, ms, exemplar=None):
+    for _ in range(n):
+        monitor.STAT_OBSERVE("t.req_ms", ms, buckets=MS_BUCKETS,
+                             exemplar=exemplar)
+
+
+def test_burn_rate_spike_vs_sustained():
+    """The canonical multi-window property: a one-tick latency spike
+    trips the short window but is diluted out of the long one (no
+    fire); only a sustained breach fires; recovery resolves."""
+    with _monitor_on():
+        clock = _Clock()
+        eng = AlertEngine(parse_rules(
+            "slo:burn:t.req_ms:p99 > 100:windows=10s,60s"), clock=clock)
+
+        # cold start: even an immediately-terrible percentile must not
+        # fire while no window has full history coverage
+        _observe(50, 400.0)
+        out = eng.evaluate_once()
+        r = out["rules"][0]
+        assert r["state"] == "inactive"
+        assert not any(w["covered"]
+                       for w in r["window_detail"].values())
+
+        monitor.STAT_RESET("t.req_ms")
+        eng = AlertEngine(parse_rules(
+            "slo:burn:t.req_ms:p99 > 100:windows=10s,60s"), clock=clock)
+        # warm both windows with healthy traffic: 50 good obs / 5s tick
+        for _ in range(14):            # 70s of history
+            _observe(50, 4.0)
+            eng.evaluate_once()
+            clock.t += 5
+        r = eng.evaluate_once()["rules"][0]
+        assert r["state"] == "inactive"
+        assert all(w["covered"] for w in r["window_detail"].values())
+
+        # transient spike: 5 bad among ~600 good in the 60s window
+        # (0.8% < 1%) -> short window breaches, long one does not
+        _observe(50, 4.0)
+        _observe(5, 400.0)
+        clock.t += 5
+        r = eng.evaluate_once()["rules"][0]
+        assert r["state"] == "inactive", r
+        det = r["window_detail"]
+        assert det["10s"]["breach"] and not det["60s"]["breach"]
+
+        # sustained breach: every request slow for two ticks
+        for _ in range(2):
+            _observe(50, 400.0)
+            clock.t += 5
+            r = eng.evaluate_once()["rules"][0]
+        assert r["state"] == "firing", r
+        assert all(w["breach"] for w in r["window_detail"].values())
+
+        # recovery: healthy traffic until the bad obs age out of both
+        # windows
+        for _ in range(14):
+            _observe(50, 4.0)
+            clock.t += 5
+            r = eng.evaluate_once()["rules"][0]
+        assert r["state"] == "inactive"
+        c = monitor.get_stats_snapshot()["counters"]
+        assert c["alerts.fired"] == 1 and c["alerts.resolved"] == 1
+
+
+def test_burn_rate_survives_stat_reset():
+    with _monitor_on():
+        clock = _Clock()
+        eng = AlertEngine(parse_rules(
+            "slo:burn:t.req_ms:p99 > 100:windows=10s"), clock=clock)
+        for _ in range(4):
+            _observe(20, 4.0)
+            eng.evaluate_once()
+            clock.t += 5
+        monitor.STAT_RESET("t.req_ms")   # counts go backwards
+        _observe(5, 400.0)
+        clock.t += 5
+        # stale history was cleared: the window is uncovered again, so
+        # the reset cannot fabricate a negative-delta breach
+        r = eng.evaluate_once()["rules"][0]
+        assert r["state"] == "inactive"
+
+
+# ---------------------------------------------------------------------------
+# Incident bundles
+# ---------------------------------------------------------------------------
+
+def test_bundle_written_exactly_once_per_episode(tmp_path):
+    with _monitor_on(alert_bundle_dir=str(tmp_path)):
+        clock = _Clock()
+        eng = AlertEngine(parse_rules(
+            "deep:threshold:t.depth > 10"), clock=clock)
+        monitor.STAT_SET("t.depth", 50)
+        eng.evaluate_once()
+        files = sorted(tmp_path.glob("incident_deep_*.json"))
+        assert len(files) == 1
+        # staying in firing across further ticks must not rewrite
+        for _ in range(3):
+            clock.t += 5
+            eng.evaluate_once()
+        assert len(sorted(tmp_path.glob("incident_deep_*.json"))) == 1
+        assert monitor.get_stats_snapshot()["counters"][
+            "alerts.bundles_written"] == 1
+        # resolve + re-fire = a new episode = a second bundle
+        monitor.STAT_SET("t.depth", 0)
+        clock.t += 5
+        eng.evaluate_once()
+        monitor.STAT_SET("t.depth", 99)
+        clock.t += 5
+        eng.evaluate_once()
+        files = sorted(tmp_path.glob("incident_deep_*.json"))
+        assert len(files) == 2
+        # atomic write: no tmp droppings, every file parses + validates
+        assert not list(tmp_path.glob("*.tmp.*"))
+        validate = _tools("validate_bench_json").validate_incident_bundle
+        for f in files:
+            with open(f) as fh:
+                bundle = json.load(fh)
+            assert validate(bundle, f.name) == []
+            assert bundle["rule"]["name"] == "deep"
+            assert bundle["snapshot"]["gauges"]["t.depth"] >= 50
+
+
+def test_bundle_failure_never_unwinds_evaluation(tmp_path):
+    blocked = tmp_path / "not_a_dir"
+    blocked.write_text("a file where the bundle dir should be")
+    with _monitor_on(alert_bundle_dir=str(blocked / "sub")):
+        eng = AlertEngine(parse_rules(
+            "deep:threshold:t.depth > 10"), clock=_Clock())
+        monitor.STAT_SET("t.depth", 50)
+        out = eng.evaluate_once()      # must not raise
+        assert out["rules"][0]["state"] == "firing"
+        c = monitor.get_stats_snapshot()["counters"]
+        assert c["alerts.bundle_errors"] == 1
+        assert "alerts.bundles_written" not in c
+
+
+# ---------------------------------------------------------------------------
+# End-to-end demo: injected latency fault -> burn alert -> one bundle
+# whose exemplars/spans identify the breaching requests
+# ---------------------------------------------------------------------------
+
+def test_e2e_fault_trips_burn_alert_with_correlated_bundle(tmp_path):
+    """The acceptance demo: a synthetic request loop under a
+    deterministic slow_step fault trips the burn-rate alert, and the
+    single incident bundle leads with the trace ids of the requests
+    that actually breached the SLO — with zero compiles involved."""
+    from paddle_tpu.resilience import faults
+    prev_trace = {k: getattr(fluid.FLAGS, k)
+                  for k in ("enable_trace", "trace_sample",
+                            "fault_spec")}
+    fluid.set_flags({"FLAGS_enable_trace": True,
+                     "FLAGS_trace_sample": 1.0,
+                     "FLAGS_fault_spec": ""})
+    trace.reset()
+    faults.reset_injector()
+    try:
+        with _monitor_on(alert_bundle_dir=str(tmp_path),
+                         alert_bundle_max_spans=512):
+            clock = _Clock()
+            eng = AlertEngine(parse_rules(
+                "slo:burn:t.req_ms:p99 > 100:windows=10s,60s"),
+                clock=clock)
+            compiles_before = monitor.get_stats_snapshot()[
+                "counters"].get("executor.compile_cache_miss", 0)
+
+            slow_ids = []
+
+            def request(slow):
+                span = trace.start_span("request")
+                t0 = time.perf_counter()
+                inj = faults.injector()
+                if inj is not None:
+                    inj.pre_step("serving")
+                wall_ms = (time.perf_counter() - t0) * 1000.0
+                # healthy requests measure ~0ms of injected stall; use
+                # a 4ms floor so they land in a deterministic bucket
+                lat = max(wall_ms, 400.0 if slow else 4.0)
+                tid = span.trace_id
+                monitor.STAT_OBSERVE("t.req_ms", lat,
+                                     buckets=MS_BUCKETS, exemplar=tid)
+                trace.finish_trace(span)
+                if slow:
+                    slow_ids.append(tid)
+
+            # healthy warmup covering both windows
+            for _ in range(14):
+                for _ in range(50):
+                    request(slow=False)
+                eng.evaluate_once()
+                clock.t += 5
+            assert eng.evaluate_once()["firing"] == 0
+
+            # arm the fault: every serving-site step now stalls 20ms
+            fluid.set_flags(
+                {"FLAGS_fault_spec": "slow_step:ms=20:site=serving"})
+            faults.reset_injector()
+            for _ in range(2):
+                for _ in range(50):
+                    request(slow=True)
+                clock.t += 5
+                out = eng.evaluate_once()
+            assert out["firing"] == 1, out
+            inj_snap = monitor.get_stats_snapshot()["counters"]
+            assert inj_snap["resilience.fault_slow"] >= 100
+
+            bundles = sorted(tmp_path.glob("incident_slo_*.json"))
+            assert len(bundles) == 1   # exactly one per firing episode
+            with open(bundles[0]) as f:
+                bundle = json.load(f)
+            validate = _tools(
+                "validate_bench_json").validate_incident_bundle
+            assert validate(bundle, bundles[0].name) == []
+
+            # breaching-bucket exemplars lead, and they are traces of
+            # genuinely slow requests
+            ids = bundle["exemplar_trace_ids"]
+            assert ids and ids[0] in slow_ids
+            slow_set = set(slow_ids)
+            breaching = [i for i in ids if i in slow_set]
+            assert breaching, ids
+            # the bundle's spans cover the breaching exemplar traces
+            span_tids = {s["trace_id"] for s in bundle["spans"]}
+            assert ids[0] in span_tids
+            assert bundle["rule"]["histogram"] == "t.req_ms"
+            assert all(w["breach"]
+                       for w in bundle["windows"].values())
+
+            # alert evaluation is pure host-side bookkeeping: nothing
+            # compiled anywhere in the loop
+            compiles_after = monitor.get_stats_snapshot()[
+                "counters"].get("executor.compile_cache_miss", 0)
+            assert compiles_after == compiles_before
+    finally:
+        faults.reset_injector()
+        trace.reset()
+        fluid.set_flags(
+            {f"FLAGS_{k}": v for k, v in prev_trace.items()})
+        faults.reset_injector()
+
+
+# ---------------------------------------------------------------------------
+# HTTP exposure: /alertz, /healthz alerts_firing, /metrics ALERTS
+# ---------------------------------------------------------------------------
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.status, r.read()
+
+
+def test_http_alertz_healthz_and_metrics_exposure():
+    from paddle_tpu.serving.http import ServingHTTPServer
+
+    class _StubEngine:
+        ready = True
+
+    prev = {k: getattr(fluid.FLAGS, k)
+            for k in ("alert_rules", "alert_eval_interval_s")}
+    # interval 0: the engine exists, but only explicit evaluate_once()
+    # ticks it — the test controls exactly when state changes
+    fluid.set_flags({
+        "FLAGS_alert_rules": "deep:threshold:t.depth > 10",
+        "FLAGS_alert_eval_interval_s": 0.0})
+    monitor_alerts.stop_alerts()
+    srv = None
+    try:
+        with _monitor_on():
+            srv = ServingHTTPServer(engine=_StubEngine(), port=0)
+            eng = monitor_alerts.active_engine()
+            assert eng is not None   # maybe_start created it from FLAGS
+
+            code, raw = _get(srv.url + "/alertz")
+            assert code == 200
+            body = json.loads(raw)
+            assert body["firing"] == 0 \
+                and body["rules"][0]["state"] == "inactive"
+            # inactive rules emit no ALERTS series
+            assert "ALERTS{" not in monitor.prometheus_text()
+
+            monitor.STAT_SET("t.depth", 42)
+            eng.evaluate_once()
+
+            code, raw = _get(srv.url + "/alertz")
+            body = json.loads(raw)
+            assert code == 200 and body["firing"] == 1
+            assert body["rules"][0]["state"] == "firing"
+            assert body["rules"][0]["value"] == 42
+
+            code, raw = _get(srv.url + "/healthz")
+            body = json.loads(raw)
+            # a firing alert informs but never flips health
+            assert code == 200 and body["state"] == "ok"
+            assert body["alerts_firing"] == 1
+
+            code, raw = _get(srv.url + "/metrics")
+            text = raw.decode()
+            assert code == 200
+            assert 'ALERTS{alertname="deep",alertstate="firing"} 1' \
+                in text
+    finally:
+        if srv is not None:
+            srv.close()
+        monitor_alerts.stop_alerts()
+        fluid.set_flags({f"FLAGS_{k}": v for k, v in prev.items()})
+
+
+def test_background_evaluator_thread_lifecycle():
+    prev = {k: getattr(fluid.FLAGS, k)
+            for k in ("alert_rules", "alert_eval_interval_s")}
+    fluid.set_flags({
+        "FLAGS_alert_rules": "deep:threshold:t.depth > 10",
+        "FLAGS_alert_eval_interval_s": 0.02})
+    monitor_alerts.stop_alerts()
+    try:
+        with _monitor_on():
+            monitor.STAT_SET("t.depth", 42)
+            eng = monitor_alerts.maybe_start()
+            assert eng is not None
+            deadline = time.time() + 5.0
+            while time.time() < deadline \
+                    and monitor_alerts.firing_count() == 0:
+                time.sleep(0.01)
+            assert monitor_alerts.firing_count() == 1
+            # maybe_start is idempotent: no second thread, same engine
+            assert monitor_alerts.maybe_start() is eng
+    finally:
+        monitor_alerts.stop_alerts()
+        fluid.set_flags({f"FLAGS_{k}": v for k, v in prev.items()})
+    # after stop the module answers empty, engine-lessly
+    assert monitor_alerts.firing_count() == 0
+    assert monitor_alerts.alertz_dict()["rules"] == []

@@ -13,6 +13,12 @@ the model's per-step flops (bench.model_flops_per_step).
 
 Counters and histograms are cumulative, so the LAST snapshot of a run
 summarizes it; earlier snapshots only add the time axis.
+
+Also renders interleaved `kind="perf_gate"` records (tools/
+perf_gate.py verdicts), `kind="incident_bundle"` lines
+(paddle_tpu/monitor_alerts.py), and an `-- alerts --` section from the
+`alerts.*` stats when the SLO engine ran; `kind="ledger_row"` history
+lines are skipped (they are inputs to the gate, not results).
 """
 from __future__ import annotations
 
@@ -44,6 +50,7 @@ def load(path):
     loadgens, lints, graph_opts = [], [], []
     gen_loadgens, chaos_loadgens, memory_plans = [], [], []
     sharded_benches, trace_reports, router_loadgens = [], [], []
+    perf_gates, incident_bundles = [], []
     with open(path) as f:
         for ln, line in enumerate(f, 1):
             line = line.strip()
@@ -62,6 +69,12 @@ def load(path):
             # carry a "metric" key
             elif kind == "sharded_bench":
                 sharded_benches.append(rec)
+            elif kind == "perf_gate":
+                perf_gates.append(rec)
+            elif kind == "incident_bundle":
+                incident_bundles.append(rec)
+            elif kind == "ledger_row":
+                pass  # history rows carry "metric" but are not results
             elif kind == "bench_result" or "metric" in rec:
                 results.append(rec)
             elif kind == "op_profile":
@@ -84,7 +97,8 @@ def load(path):
                 trace_reports.append(rec)
     return (snapshots, results, op_profiles, loadgens, lints,
             graph_opts, gen_loadgens, chaos_loadgens, memory_plans,
-            sharded_benches, trace_reports, router_loadgens)
+            sharded_benches, trace_reports, router_loadgens,
+            perf_gates, incident_bundles)
 
 
 def _hist(snap, name):
@@ -94,14 +108,16 @@ def _hist(snap, name):
 def report(path, out=sys.stdout):
     (snapshots, results, op_profiles, loadgens, lints,
      graph_opts, gen_loadgens, chaos_loadgens, memory_plans,
-     sharded_benches, trace_reports, router_loadgens) = load(path)
+     sharded_benches, trace_reports, router_loadgens,
+     perf_gates, incident_bundles) = load(path)
     w = out.write
     w(f"runtime stats report — {path}\n")
     if not snapshots and not results and not op_profiles \
             and not loadgens and not lints and not graph_opts \
             and not gen_loadgens and not chaos_loadgens \
             and not memory_plans and not sharded_benches \
-            and not trace_reports and not router_loadgens:
+            and not trace_reports and not router_loadgens \
+            and not perf_gates and not incident_bundles:
         w("no snapshots or bench results found\n")
         return 1
     w(f"snapshots: {len(snapshots)}   bench results: {len(results)}\n")
@@ -416,6 +432,53 @@ def report(path, out=sys.stdout):
                 if m is None and p is None:
                     continue
                 w(f"  {comp:<24s} mean {m} ms  p95 {p} ms\n")
+
+    evals = c.get("alerts.evals")
+    if evals or incident_bundles:
+        w("\n-- alerts (paddle_tpu.monitor_alerts, "
+          "docs/observability.md) --\n")
+        if evals:
+            w(f"{'evaluations':26s} {int(evals)}   fired "
+              f"{int(c.get('alerts.fired', 0))}   resolved "
+              f"{int(c.get('alerts.resolved', 0))}   firing now "
+              f"{int(g.get('alerts.firing', 0))}   pending "
+              f"{int(g.get('alerts.pending', 0))}\n")
+            if c.get("alerts.bundles_written") \
+                    or c.get("alerts.bundle_errors"):
+                w(f"{'incident bundles':26s} written "
+                  f"{int(c.get('alerts.bundles_written', 0))}   "
+                  f"errors {int(c.get('alerts.bundle_errors', 0))}\n")
+        for b in incident_bundles:
+            rule = b.get("rule") or {}
+            w(f"{'incident':26s} rule {rule.get('name', '?')} "
+              f"({rule.get('kind', '?')}: {rule.get('expr', '')})  "
+              f"value {b.get('value')}  {len(b.get('spans') or [])} "
+              f"span(s)  {len(b.get('exemplar_trace_ids') or [])} "
+              f"exemplar trace(s)\n")
+
+    if perf_gates:
+        w("\n-- perf gate (tools/perf_gate.py, "
+          "docs/observability.md) --\n")
+        for pg in perf_gates:
+            w(f"ledger {pg.get('ledger', '?')}  "
+              f"{pg.get('regressions', 0)} regression(s), "
+              f"{pg.get('improvements', 0)} improvement(s) of "
+              f"{len(pg.get('results') or [])} row(s) "
+              f"(band: median +- {pg.get('k_mad', '?')}*1.4826*MAD, "
+              f"min {pg.get('min_samples', '?')} samples, last "
+              f"{pg.get('baseline_n', '?')} runs)\n")
+            for r in pg.get("results") or []:
+                med = r.get("baseline_median")
+                df = r.get("delta_frac")
+                detail = ""
+                if med is not None:
+                    pct = "" if df is None else f" ({df:+.1%})"
+                    detail = (f"  vs {med:.6g} +- "
+                              f"{r.get('band', 0):.6g}{pct} "
+                              f"n={r.get('n_baseline')}")
+                w(f"  {r.get('status', '?'):>15s} "
+                  f"{r.get('config', '?')} {r.get('metric', '?')} = "
+                  f"{r.get('value')}{detail}\n")
 
     phases = snap.get("phases") or {}
     if phases:

@@ -1,0 +1,275 @@
+"""Noise-aware perf regression gate over the perf ledger.
+
+Usage:
+    python tools/perf_gate.py --ledger LEDGER.jsonl [options] \
+        FILE [FILE ...]               # gate the rows in these artifacts
+    python tools/perf_gate.py --ledger LEDGER.jsonl \
+        --config C --metric M --value V [--unit U]   # gate one value
+    python tools/perf_gate.py --self-check            # replay fixtures
+
+For every candidate (config, metric) row the gate builds a baseline
+from the last --last same-key ledger rows: the band is
+``median +- max(k * 1.4826 * MAD, min_rel * |median|)`` — the median /
+MAD pair shrugs off the occasional outlier round that would wreck a
+mean/stddev gate, and the relative floor keeps a near-zero-MAD
+baseline (three identical runs) from flagging measurement jitter.
+Verdicts per row:
+
+* ``regression``      — outside the band in the BAD direction (lower
+  for throughput-like metrics, higher for latency/bytes-like ones;
+  direction is inferred from the metric name + unit)
+* ``improvement``     — outside the band in the good direction
+* ``ok``              — inside the band
+* ``too_few_samples`` — baseline smaller than --min-samples (never
+  gates: a thin history must not fail CI)
+* ``new_config``      — no history at all for the key
+
+The run appends one ``kind="perf_gate"`` JSONL record to --out
+(schema enforced by tools/validate_bench_json.py; rendered by
+tools/metrics_report.py) and prints it. Exit 1 when any row regressed
+— the CI/sweep contract — else 0. --ingest additionally appends the
+candidate rows to the ledger AFTER gating (so a gated run becomes
+tomorrow's baseline). --self-check replays the bundled golden
+fixtures (regression / improvement / too-few-samples / new-config /
+latency-direction / outlier-robustness) through the same code path
+and exits nonzero on any unexpected verdict.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import perf_ledger  # noqa: E402
+
+_LOWER_BETTER_UNITS = ("ms", "s", "seconds", "bytes", "ops", "vars")
+_LOWER_BETTER_HINTS = ("latency", "_ms", "ttft", "wait", "seconds",
+                       "bytes", "peak", "ops_after")
+
+
+def lower_is_better(metric: str, unit: str = "") -> bool:
+    """Direction inference: throughput-like metrics regress DOWN,
+    latency/footprint-like metrics regress UP."""
+    m = (metric or "").lower()
+    u = (unit or "").lower()
+    if any(t in m for t in ("per_sec", "per_s", "tokens_per",
+                            "throughput", "rps", "qps", "mfu",
+                            "eliminated")):
+        return False
+    if u in _LOWER_BETTER_UNITS or u.endswith("ms"):
+        return True
+    return any(h in m for h in _LOWER_BETTER_HINTS)
+
+
+def _median(xs: List[float]) -> float:
+    s = sorted(xs)
+    n = len(s)
+    mid = n // 2
+    return s[mid] if n % 2 else 0.5 * (s[mid - 1] + s[mid])
+
+
+def gate_value(value: float, baseline: List[float], metric: str,
+               unit: str = "", k: float = 4.0, min_rel: float = 0.02,
+               min_samples: int = 3) -> Dict:
+    """Verdict for one candidate value against its baseline history
+    (oldest first). Pure function — the fixtures and tests drive it
+    directly."""
+    out = {"metric": metric, "unit": unit, "value": value,
+           "direction": "lower" if lower_is_better(metric, unit)
+           else "higher"}
+    if not baseline:
+        out["status"] = "new_config"
+        return out
+    if len(baseline) < min_samples:
+        out["status"] = "too_few_samples"
+        out["n_baseline"] = len(baseline)
+        return out
+    med = _median(baseline)
+    mad = _median([abs(x - med) for x in baseline])
+    band = max(k * 1.4826 * mad, min_rel * abs(med))
+    delta = value - med
+    out.update({"baseline_median": med, "baseline_mad": mad,
+                "band": band, "n_baseline": len(baseline),
+                "delta": delta,
+                "delta_frac": delta / med if med else None})
+    bad_up = lower_is_better(metric, unit)
+    if delta > band:
+        out["status"] = "regression" if bad_up else "improvement"
+    elif delta < -band:
+        out["status"] = "improvement" if bad_up else "regression"
+    else:
+        out["status"] = "ok"
+    return out
+
+
+def gate_rows(candidates: List[dict], ledger_rows: List[dict],
+              k: float = 4.0, min_rel: float = 0.02,
+              min_samples: int = 3, last: int = 20) -> List[dict]:
+    """Gate candidate ledger rows against history grouped by
+    (config, metric). Baseline = the last `last` same-key rows."""
+    history: Dict[Tuple[str, str], List[float]] = {}
+    for r in ledger_rows:
+        key = (r.get("config"), r.get("metric"))
+        history.setdefault(key, []).append(r.get("value"))
+    results = []
+    for c in candidates:
+        key = (c.get("config"), c.get("metric"))
+        base = [v for v in history.get(key, [])
+                if isinstance(v, (int, float))][-last:]
+        res = gate_value(c.get("value"), base, c.get("metric"),
+                         c.get("unit", ""), k=k, min_rel=min_rel,
+                         min_samples=min_samples)
+        res["config"] = c.get("config")
+        results.append(res)
+    return results
+
+
+def gate_report(results: List[dict], ledger: str, k: float,
+                min_samples: int, last: int) -> dict:
+    return {"kind": "perf_gate", "ts": time.time(), "ledger": ledger,
+            "k_mad": k, "min_samples": min_samples, "baseline_n": last,
+            "results": results,
+            "regressions": sum(r["status"] == "regression"
+                               for r in results),
+            "improvements": sum(r["status"] == "improvement"
+                                for r in results)}
+
+
+# ---------------------------------------------------------------------------
+# Golden fixtures (--self-check)
+# ---------------------------------------------------------------------------
+
+# (name, metric, unit, baseline, candidate, expected status)
+FIXTURES = [
+    ("throughput_regression", "bert_tokens_per_sec", "tokens/s",
+     [35000.0, 35400.0, 35200.0], 27000.0, "regression"),
+    ("throughput_improvement", "bert_tokens_per_sec", "tokens/s",
+     [35000.0, 35400.0, 35200.0], 42000.0, "improvement"),
+    ("within_noise", "bert_tokens_per_sec", "tokens/s",
+     [35000.0, 35400.0, 35200.0, 34900.0, 35600.0], 35100.0, "ok"),
+    ("too_few_samples", "bert_tokens_per_sec", "tokens/s",
+     [35000.0], 20000.0, "too_few_samples"),
+    ("new_config", "gpt_tokens_per_sec", "tokens/s",
+     [], 1000.0, "new_config"),
+    ("latency_regression", "latency_ms_p99", "ms",
+     [10.0, 10.5, 9.8], 20.0, "regression"),
+    ("latency_improvement", "latency_ms_p99", "ms",
+     [10.0, 10.5, 9.8], 5.0, "improvement"),
+    # one wild outlier round must not widen the band enough to pass a
+    # real 20% regression (median/MAD robustness)
+    ("outlier_robust_regression", "bert_tokens_per_sec", "tokens/s",
+     [35000.0, 35400.0, 35200.0, 12000.0, 35100.0], 28000.0,
+     "regression"),
+    # ...nor flag honest jitter on a flat baseline (relative floor)
+    ("flat_baseline_jitter_ok", "bert_tokens_per_sec", "tokens/s",
+     [35000.0, 35000.0, 35000.0], 34650.0, "ok"),
+]
+
+
+def self_check() -> int:
+    failures = []
+    for name, metric, unit, baseline, value, want in FIXTURES:
+        got = gate_value(value, baseline, metric, unit)["status"]
+        if got != want:
+            failures.append(f"{name}: expected {want}, got {got}")
+    if failures:
+        for f in failures:
+            print(f"SELF-CHECK FAIL: {f}", file=sys.stderr)
+        return 1
+    print(f"self-check ok: {len(FIXTURES)} fixtures")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0])
+    ap.add_argument("files", nargs="*",
+                    help="candidate artifacts (any shape "
+                         "validate_bench_json.py knows)")
+    ap.add_argument("--ledger", default=None)
+    ap.add_argument("--out", default=None,
+                    help="append the perf_gate record here (JSONL)")
+    ap.add_argument("--config", default=None)
+    ap.add_argument("--metric", default=None)
+    ap.add_argument("--value", type=float, default=None)
+    ap.add_argument("--unit", default="")
+    ap.add_argument("--k", type=float, default=4.0,
+                    help="MAD multiplier of the noise band")
+    ap.add_argument("--min-rel", type=float, default=0.02,
+                    help="relative floor of the band (fraction of the "
+                         "baseline median)")
+    ap.add_argument("--min-samples", type=int, default=3)
+    ap.add_argument("--last", type=int, default=20,
+                    help="baseline window: last N same-key rows")
+    ap.add_argument("--ingest", action="store_true",
+                    help="append the candidate rows to the ledger "
+                         "after gating")
+    ap.add_argument("--self-check", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.self_check:
+        return self_check()
+    if not args.ledger:
+        ap.error("--ledger is required (unless --self-check)")
+
+    candidates: List[dict] = []
+    if args.value is not None:
+        if not (args.config and args.metric):
+            ap.error("--value needs --config and --metric")
+        candidates.append({"config": args.config,
+                           "metric": args.metric,
+                           "value": args.value, "unit": args.unit})
+    skipped = 0
+    for path in args.files:
+        rows, sk = perf_ledger.rows_from_file(path)
+        candidates.extend(rows)
+        skipped += sk
+    if not candidates:
+        print("perf_gate: no candidate rows found", file=sys.stderr)
+        return 2
+
+    ledger_rows = perf_ledger.load_rows(args.ledger)
+    results = gate_rows(candidates, ledger_rows, k=args.k,
+                        min_rel=args.min_rel,
+                        min_samples=args.min_samples, last=args.last)
+    report = gate_report(results, args.ledger, args.k,
+                         args.min_samples, args.last)
+    if skipped:
+        report["skipped_inputs"] = skipped
+    perf_ledger._stat_add("ledger.gate_runs")
+    if report["regressions"]:
+        perf_ledger._stat_add("ledger.gate_regressions",
+                              report["regressions"])
+
+    if args.out:
+        d = os.path.dirname(os.path.abspath(args.out))
+        os.makedirs(d, exist_ok=True)
+        with open(args.out, "a") as f:
+            f.write(json.dumps(report) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+    for r in results:
+        med = r.get("baseline_median")
+        band = r.get("band")
+        detail = "" if med is None else \
+            f" vs {med:.6g} +- {band:.6g} (n={r.get('n_baseline')})"
+        print(f"perf_gate: {r['status']:>15}  {r['config']} "
+              f"{r['metric']} = {r['value']:.6g}{detail}")
+    print(json.dumps(report))
+
+    if args.ingest:
+        perf_ledger.append_rows(args.ledger, candidates)
+    return 1 if report["regressions"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -33,6 +33,10 @@ import sys
 import tempfile
 import time
 
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import perf_ledger  # noqa: E402 — provenance stamps + gate-demo ledger
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 ROUND = os.environ.get("ROUND", "r05")
 RESULTS = os.environ.get("SWEEP_OUT", "/tmp/sweep_results.jsonl")
@@ -101,6 +105,16 @@ CONFIGS = [
     # violated — a hard failure, not a flake)
     ("chaos_s4", None),  # special-cased below
     ("router_chaos_s4", None),  # special-cased below
+    # perf-gate demo pair (tools/perf_gate.py, docs/observability.md
+    # "Perf ledger & regression gate"): the base cell runs the same
+    # generation loadgen three times to seed a demo ledger; the slow
+    # cell runs the identical traffic once more under a deterministic
+    # slow_step fault and gates it against that baseline. Its ledger
+    # entry records the gate verdict + exit code — the sweep-level
+    # proof that a seeded slowdown exits nonzero while an unchanged
+    # run exits 0.
+    ("gate_demo_base", None),  # special-cased below
+    ("gate_demo_slow", None),  # special-cased below
     ("gpt_b32", {"BENCH_MODEL": "gpt", "BENCH_BATCH": "32"}),
     # GSPMD dp x tp scaling (BENCH_MESH + FLAGS_sharded_exec layout,
     # docs/sharding.md): each sharded cell pairs with its single-chip
@@ -500,6 +514,86 @@ def run_special(key):
                 "chaos_wrong_answers": chaos.get("wrong_answers"),
                 "chaos_worker_deaths": chaos.get("worker_deaths"),
                 "chaos_p99_inflation": chaos.get("p99_inflation")}, None
+    if key in ("gate_demo_base", "gate_demo_slow"):
+        # identical --generate loadgen traffic in both cells; the CLI
+        # flags (and so the record's config digest = the ledger key)
+        # never change, only the seed (not part of the digest — honest
+        # run-to-run jitter) and, in the slow cell, FLAGS_fault_spec.
+        slow = key == "gate_demo_slow"
+        demo_ledger = f"/tmp/gate_demo_ledger_{ROUND}.jsonl"
+        gate_out = f"/tmp/gate_demo_report_{ROUND}.jsonl"
+        prov = perf_ledger.provenance(platform="tpu")
+        if slow:
+            rows = perf_ledger.load_rows(demo_ledger)
+            if len([r for r in rows
+                    if r.get("metric") == "tokens_per_s"]) < 3:
+                # retried on a later pass once gate_demo_base has run
+                return None, "gate baseline not seeded yet (needs " \
+                             "gate_demo_base first)"
+        last_val = None
+        for i in range(1 if slow else 3):
+            out_path = f"/tmp/{key}_{ROUND}_{i}.jsonl"
+            if os.path.exists(out_path):
+                os.unlink(out_path)
+            env = dict(os.environ)
+            if slow:
+                # ~20ms deterministic stall before every decode step:
+                # a guaranteed >>20% tokens/s regression at this model
+                # size, with zero randomness to flake on
+                env["FLAGS_fault_spec"] = \
+                    "slow_step:ms=20:site=generation"
+            p = subprocess.run(
+                [sys.executable, "tools/serving_loadgen.py",
+                 "--generate", "--slots", "4", "--requests", "24",
+                 "--seed", str(i), "--out", out_path],
+                cwd=REPO, capture_output=True, text=True,
+                timeout=1800, env=env)
+            if p.returncode != 0:
+                return None, (f"rc={p.returncode}: "
+                              + (p.stdout + p.stderr)[-300:])
+            rows, _ = perf_ledger.rows_from_file(out_path)
+            rows = [r for r in rows
+                    if r.get("metric") == "tokens_per_s"]
+            if not rows:
+                return None, f"no tokens_per_s row in {out_path}"
+            last_val = rows[-1]["value"]
+            if not slow:
+                perf_ledger.append_rows(demo_ledger, rows, prov)
+        if not slow:
+            return {"metric": "gate_demo_baseline_tokens_per_s",
+                    "value": last_val, "unit": "tok/s", "runs": 3,
+                    "demo_ledger": demo_ledger}, None
+        # gate the faulted run against the 3-run baseline; the CLI
+        # prints + appends the kind="perf_gate" record and exits 1 on
+        # regression — which is the PASS condition for this cell
+        g = subprocess.run(
+            [sys.executable, "tools/perf_gate.py",
+             "--ledger", demo_ledger, "--out", gate_out,
+             f"/tmp/{key}_{ROUND}_0.jsonl"],
+            cwd=REPO, capture_output=True, text=True, timeout=600)
+        verdict = None
+        for ln in g.stdout.splitlines():
+            if ln.startswith("{"):
+                try:
+                    verdict = json.loads(ln)
+                except ValueError:
+                    pass
+        if g.returncode != 1 or not verdict \
+                or not verdict.get("regressions"):
+            return None, (f"gate did NOT flag the seeded slowdown "
+                          f"(rc={g.returncode}): "
+                          + (g.stdout + g.stderr)[-300:])
+        row = next((r for r in verdict["results"]
+                    if r.get("status") == "regression"), {})
+        return {"metric": "gate_demo_regression_delta_frac",
+                "value": row.get("delta_frac"), "unit": "frac",
+                "gate_rc": g.returncode,
+                "gate_status": row.get("status"),
+                "slow_tokens_per_s": last_val,
+                "baseline_median": row.get("baseline_median"),
+                "band": row.get("band"),
+                "fault_spec": "slow_step:ms=20:site=generation",
+                "gate_report": gate_out}, None
     if key == "profile":
         p = subprocess.run([sys.executable, "tools/profile_step.py"],
                            cwd=REPO, capture_output=True, text=True,
@@ -550,6 +644,12 @@ def main():
             except Exception as e:  # noqa: BLE001 — keep the loop alive
                 rec, err = None, repr(e)
             if rec is not None:
+                if isinstance(rec, dict):
+                    # stamp run provenance so a ledger regression can
+                    # be bisected to a commit, not just "round rNN"
+                    for pk, pv in perf_ledger.provenance(
+                            platform="tpu").items():
+                        rec.setdefault(pk, pv)
                 ledger[key] = rec
                 save_ledger(ledger)
                 consecutive_fail = 0

@@ -34,7 +34,16 @@ Checks, per file (type auto-detected from content):
   with kind == "trace_report" (tools/trace_report.py --out) carry the
   span/trace/request counts, the per-component breakdown_ms, the
   slowest-N rows and the consistency-audit verdict the tracing report
-  section reads.
+  section reads; lines with kind == "perf_gate" (tools/perf_gate.py)
+  carry the ledger path, the per-(config, metric) verdict rows
+  (status regression/improvement/ok/too_few_samples/new_config with
+  the median +- k*MAD band that produced them) and regression /
+  improvement counts that must agree with the rows.
+* incident_*.json (paddle_tpu/monitor_alerts.py bundles, also accepted
+  as a JSONL line): kind == "incident_bundle" with the fired rule, the
+  full stats snapshot, breaching-bucket exemplar trace ids, the kept
+  span list and the flight-recorder ring — the correlation contract a
+  post-mortem reads.
 * driver BENCH_rNN.json wrappers ({"n", "cmd", "rc", "tail",
   "parsed"}): parsed must be non-null — the exact invariant the r05
   rc=124 artifact violated.
@@ -575,6 +584,136 @@ def validate_trace_report(obj, where="trace_report"):
     return errs
 
 
+def validate_incident_bundle(obj, where="incident_bundle"):
+    """kind="incident_bundle" (paddle_tpu/monitor_alerts.py): one
+    atomic correlation artifact per pending->firing transition — the
+    rule that fired, the stats snapshot it fired on, the breaching-
+    bucket trace exemplars, the kept-span ring and the flight ring."""
+    errs = []
+    for key in ("ts",):
+        v = obj.get(key)
+        if not isinstance(v, (int, float)) or isinstance(v, bool):
+            errs.append(f"{where}: {key} must be numeric (got {v!r})")
+    if not isinstance(obj.get("pid"), int) \
+            or isinstance(obj.get("pid"), bool):
+        errs.append(f"{where}: pid must be an int")
+    rule = obj.get("rule")
+    if not isinstance(rule, dict):
+        errs.append(f"{where}: rule must be an object")
+    else:
+        for key in ("name", "kind", "expr"):
+            if not isinstance(rule.get(key), str):
+                errs.append(f"{where}: rule.{key} must be a string "
+                            f"(got {rule.get(key)!r})")
+        if rule.get("kind") not in ("threshold", "ratio", "burn"):
+            errs.append(f"{where}: rule.kind {rule.get('kind')!r} not "
+                        f"a known rule kind")
+        t = rule.get("threshold")
+        if not isinstance(t, (int, float)) or isinstance(t, bool):
+            errs.append(f"{where}: rule.threshold must be numeric")
+    snap = obj.get("snapshot")
+    if not isinstance(snap, dict):
+        errs.append(f"{where}: snapshot must be an object")
+    else:
+        for key in ("counters", "gauges", "histograms"):
+            if not isinstance(snap.get(key), dict):
+                errs.append(f"{where}: snapshot.{key} must be an "
+                            f"object")
+    ids = obj.get("exemplar_trace_ids")
+    if not isinstance(ids, list) or not all(
+            isinstance(i, str) for i in ids):
+        errs.append(f"{where}: exemplar_trace_ids must be a list of "
+                    f"strings")
+        ids = []
+    spans = obj.get("spans")
+    if not isinstance(spans, list):
+        errs.append(f"{where}: spans must be a list")
+        spans = []
+    span_traces = set()
+    for i, s in enumerate(spans):
+        if not isinstance(s, dict) or not isinstance(
+                s.get("trace_id"), str):
+            errs.append(f"{where}: spans[{i}] must be an object with "
+                        f"a trace_id")
+            continue
+        span_traces.add(s["trace_id"])
+    # the correlation contract: every exemplar id that has any span in
+    # the bundle comes first-class; an exemplar with NO span at all is
+    # legal (the trace may have been sampled out or evicted), but when
+    # spans exist the bundle must lead with the exemplar-linked ones
+    if ids and spans and span_traces:
+        lead = spans[0].get("trace_id")
+        if ids[0] in span_traces and lead not in ids:
+            errs.append(f"{where}: spans do not lead with the "
+                        f"breaching exemplar traces")
+    if not isinstance(obj.get("flight_records"), list):
+        errs.append(f"{where}: flight_records must be a list")
+    nd = obj.get("n_spans_dropped")
+    if not isinstance(nd, int) or isinstance(nd, bool) or nd < 0:
+        errs.append(f"{where}: n_spans_dropped must be a non-negative "
+                    f"int (got {nd!r})")
+    return errs
+
+
+_GATE_STATUSES = ("ok", "regression", "improvement", "too_few_samples",
+                  "new_config")
+
+
+def validate_perf_gate(obj, where="perf_gate"):
+    """kind="perf_gate" (tools/perf_gate.py): the noise-aware verdict
+    of one gated run against the ledger baseline."""
+    errs = []
+    v = obj.get("ts")
+    if not isinstance(v, (int, float)) or isinstance(v, bool):
+        errs.append(f"{where}: ts must be numeric (got {v!r})")
+    if not isinstance(obj.get("ledger"), str):
+        errs.append(f"{where}: ledger must be a string (path)")
+    for key in ("k_mad", "min_samples", "baseline_n"):
+        v = obj.get(key)
+        if not isinstance(v, (int, float)) or isinstance(v, bool):
+            errs.append(f"{where}: {key} must be numeric (got {v!r})")
+    rows = obj.get("results")
+    if not isinstance(rows, list):
+        errs.append(f"{where}: results must be a list")
+        rows = []
+    n_reg = n_imp = 0
+    for i, r in enumerate(rows):
+        if not isinstance(r, dict):
+            errs.append(f"{where}: results[{i}] is not an object")
+            continue
+        for key in ("config", "metric"):
+            if not isinstance(r.get(key), str):
+                errs.append(f"{where}: results[{i}].{key} must be a "
+                            f"string")
+        st = r.get("status")
+        if st not in _GATE_STATUSES:
+            errs.append(f"{where}: results[{i}].status {st!r} not in "
+                        f"{_GATE_STATUSES}")
+        v = r.get("value")
+        if not isinstance(v, (int, float)) or isinstance(v, bool):
+            errs.append(f"{where}: results[{i}].value must be numeric")
+        # the band fields must exist whenever the row was actually
+        # compared against a baseline
+        if st in ("ok", "regression", "improvement"):
+            for key in ("baseline_median", "baseline_mad", "band",
+                        "n_baseline"):
+                bv = r.get(key)
+                if not isinstance(bv, (int, float)) \
+                        or isinstance(bv, bool):
+                    errs.append(f"{where}: results[{i}].{key} must be "
+                                f"numeric on a compared row")
+        n_reg += st == "regression"
+        n_imp += st == "improvement"
+    for key, n in (("regressions", n_reg), ("improvements", n_imp)):
+        v = obj.get(key)
+        if not isinstance(v, int) or isinstance(v, bool):
+            errs.append(f"{where}: {key} must be an int (got {v!r})")
+        elif v != n:
+            errs.append(f"{where}: {key}={v} disagrees with the "
+                        f"result rows ({n})")
+    return errs
+
+
 def validate_jsonl(path):
     errs = []
     with open(path) as f:
@@ -615,6 +754,12 @@ def validate_jsonl(path):
             elif rec.get("kind") == "trace_report":
                 errs.extend(validate_trace_report(
                     rec, where=f"{path}:{ln}"))
+            elif rec.get("kind") == "incident_bundle":
+                errs.extend(validate_incident_bundle(
+                    rec, where=f"{path}:{ln}"))
+            elif rec.get("kind") == "perf_gate":
+                errs.extend(validate_perf_gate(
+                    rec, where=f"{path}:{ln}"))
     return errs
 
 
@@ -637,6 +782,10 @@ def validate_file(path):
         return [f"{path}: top-level JSON is not an object"]
     if obj.get("kind") == "bench_summary":
         return validate_summary(obj, where=path)
+    if obj.get("kind") == "incident_bundle":
+        return validate_incident_bundle(obj, where=path)
+    if obj.get("kind") == "perf_gate":
+        return validate_perf_gate(obj, where=path)
     if "parsed" in obj and "cmd" in obj:
         return validate_wrapper(obj, where=path)
     # a single-record JSONL (e.g. one snapshot) is also fine
