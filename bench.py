@@ -899,6 +899,18 @@ def main(argv=None):
     summary_path = _summary_path()
     done = set()
     results = []
+    # goodput ledger (FLAGS_enable_goodput): classify the whole bench
+    # run's wall-clock — backend-probe wait and warmup compiles land in
+    # their own categories (a probe-blocked rc=124 round shows up as
+    # probe_wait instead of opaque lost time) and the category table is
+    # stamped into bench_summary.json by _finalize_summary below
+    _goodput = None
+    try:
+        from paddle_tpu import goodput as _gp
+        if _gp.start_run("bench") is not None:
+            _goodput = _gp
+    except Exception as e:  # noqa: BLE001 — goodput must never kill bench
+        print(f"# goodput unavailable: {e}", file=sys.stderr)
     # write-ahead: the artifact parses before the first model starts
     summary = {"kind": "bench_summary", "status": "running",
                "models": list(models), "completed": [], "results": [],
@@ -918,6 +930,17 @@ def main(argv=None):
         summary["results"] = results
         if reason is not None:
             summary["reason"] = reason
+        if _goodput is not None:
+            snap = _goodput.snapshot()
+            if snap is not None:
+                summary["goodput"] = {
+                    "wall_s": snap["wall_s"],
+                    "goodput_frac": snap["goodput_frac"],
+                    "sum_frac_err": snap["sum_frac_err"],
+                    "categories": snap["categories"],
+                    "steps": snap["steps"],
+                    "post_warmup_compiles": snap["post_warmup_compiles"],
+                    "starved_steps": snap["starved_steps"]}
         summary["ts_end"] = time.time()
         _write_summary(summary_path, summary)
 
@@ -967,7 +990,13 @@ def main(argv=None):
     if forced_platform:
         ok, detail = True, f"forced platform {forced_platform}"
     else:
+        t_probe0 = time.perf_counter()
         ok, detail = _probe_backend(budget_left())
+        if _goodput is not None:
+            # tunnel/TPU attach time: its own goodput category, so a
+            # probe-blocked round is classifiable (BENCH_r04/r05)
+            _goodput.attribute("probe_wait",
+                               time.perf_counter() - t_probe0)
     if not ok:
         print(f"# {detail}", file=sys.stderr)
         # children inherit FLAGS_enable_monitor via env and flush their
@@ -1054,6 +1083,14 @@ def main(argv=None):
                 monitor.snapshot_to_jsonl(log)
             except Exception as e:  # noqa: BLE001
                 print(f"# snapshot failed: {e}", file=sys.stderr)
+    if _goodput is not None:
+        _goodput.end_run()
+        try:
+            # goodput_snapshot JSONL record: tools/goodput_report.py
+            # renders the category table + waterfall from the bench log
+            _goodput.export_snapshot(log)
+        except OSError as e:
+            print(f"# goodput export failed: {e}", file=sys.stderr)
     _finalize_summary("complete")
     _ledger_and_gate(summary, log, platform_hint=forced_platform)
     try:

@@ -571,6 +571,32 @@ DEFINE_double(
     "= rolling p95 over the last trace window (keeps ~the slowest 5% "
     "once enough requests have completed).")
 
+DEFINE_bool(
+    "enable_goodput", False,
+    "Run-level goodput accounting (paddle_tpu/goodput.py): classify "
+    "ALL wall-clock of a training/bench run into exclusive categories "
+    "(device_compute, compile, input_wait, feed_stage, fetch_sync, "
+    "checkpoint_save/restore, retry_backoff, nan_rollback, "
+    "preempt_drain, probe_wait, other) with the invariant that the "
+    "categories sum to wall-clock. Off (default) = every goodput hook "
+    "is one cached-flag read. Stats ride the monitor registry, so "
+    "FLAGS_enable_monitor gates the exported goodput.* stats.")
+
+DEFINE_double(
+    "goodput_starved_ms", 50.0,
+    "Input-starvation threshold: a training step whose reader batch "
+    "wait exceeds this many milliseconds counts as input-starved "
+    "(goodput.input_starved_steps) and feeds the default "
+    "input_starvation burn-rate alert rule that goodput.start_run "
+    "appends to FLAGS_alert_rules.")
+
+DEFINE_string(
+    "goodput_alert_windows", "15s,60s",
+    "Multi-window spec of the default input_starvation burn-rate rule "
+    "(short,long — both must breach before the alert fires, the "
+    "monitor_alerts.py burn semantics). Only read when "
+    "goodput.install_starvation_alert builds the default rule.")
+
 DEFINE_string(
     "alert_rules", "",
     "Declarative SLO alert rules for paddle_tpu/monitor_alerts.py, "

@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import goodput as _goodput
 from . import trace as _trace
 from .core.dtypes import as_np_dtype
 from .core.lowering import LowerCtx, lower_block
@@ -99,6 +100,7 @@ class Executor:
         # spans of the slots in flight.
         self.last_step_timings: Optional[Dict[str, float]] = None
         self._last_feed_s = 0.0
+        self._last_build_s = 0.0
 
     # ------------------------------------------------------------------
     def run(self, program: Optional[Program] = None, feed=None,
@@ -128,6 +130,7 @@ class Executor:
 
         t_run0 = time.perf_counter()
         self._last_feed_s = 0.0
+        self._last_build_s = 0.0
         step_fn, state, feed_arrays = self._resolve_step(
             program, feed, fetch_list, scope, compiled, use_program_cache)
 
@@ -137,6 +140,14 @@ class Executor:
 
         first_run = step_fn.runs == 0
         step_fn.runs += 1
+
+        # Goodput ledger (FLAGS_enable_goodput): retry backoff inside the
+        # dispatch span is attributed directly by RetryPolicy, so snapshot
+        # the counter here and subtract the delta from dispatch time to
+        # keep the ledger's categories exclusive.
+        _gled = _goodput.active()
+        _bk0 = (_gled.category_seconds("retry_backoff")
+                if _gled is not None else 0.0)
 
         t_disp0 = time.perf_counter()
 
@@ -186,6 +197,15 @@ class Executor:
             "fetch_s": now - t_fetch0,
             "total_s": now - t_run0,
         }
+        if _gled is not None:
+            _gled.note_step(
+                feed_s=self._last_feed_s,
+                dispatch_s=t_fetch0 - t_disp0,
+                fetch_s=now - t_fetch0,
+                total_s=now - t_run0,
+                build_s=self._last_build_s,
+                first_run=first_run,
+                backoff_s=_gled.category_seconds("retry_backoff") - _bk0)
         if _monitor_on():
             tid = _trace.current_trace_id()
             # fetch/block time: device sync happens in np.asarray; with
@@ -322,8 +342,9 @@ class Executor:
                                     fetch_names, scope, compiled)
             # host-side lowering/closure build only — XLA compile itself
             # is lazy (first call; see executor.compile_first_step_seconds)
+            self._last_build_s = time.perf_counter() - t0
             STAT_OBSERVE("executor.compile_build_seconds",
-                         time.perf_counter() - t0)
+                         self._last_build_s)
             self._cache[key] = step_fn
             if compiled is not None:
                 self._compiled_refs[id(compiled)] = compiled

@@ -13,6 +13,7 @@ import queue
 import threading
 import time
 
+from . import goodput as _goodput
 from .monitor import STAT_ADD, STAT_OBSERVE, STAT_SET
 
 __all__ = ["DataLoader", "PyReader"]
@@ -96,8 +97,6 @@ class _GeneratorLoader:
             # sampled after the get shows remaining prefetch headroom.
             t0 = time.perf_counter()
             item = q.get()
-            STAT_OBSERVE("reader.batch_wait_seconds",
-                         time.perf_counter() - t0)
             STAT_SET("reader.queue_depth", q.qsize())
             if item is sentinel:
                 break
@@ -105,7 +104,16 @@ class _GeneratorLoader:
                 raise item.exc
             inj = _fault_injector()
             if inj is not None:
+                # an injected reader stall (slow_step:site=reader) models
+                # a slow data source — it must land in the batch-wait
+                # signal, so it sits inside the measured window
                 inj.pre_step("reader")
+            wait_s = time.perf_counter() - t0
+            STAT_OBSERVE("reader.batch_wait_seconds", wait_s)
+            # goodput input_wait attribution + starvation detector
+            # (goodput.input_wait_ms / goodput.input_starved_steps);
+            # no-op unless FLAGS_enable_goodput and a run is active
+            _goodput.note_input_wait(wait_s)
             STAT_ADD("reader.batches")
             yield item
 

@@ -133,6 +133,11 @@ class RetryPolicy:
             STAT_ADD("resilience.retries")
             STAT_OBSERVE("resilience.retry_backoff_ms", delay_ms,
                          buckets=_MS_BUCKETS)
+            # goodput ledger: backoff sleep is attributed here at the
+            # source; the executor subtracts the delta from its dispatch
+            # span so the categories stay exclusive
+            from .. import goodput as _goodput
+            _goodput.attribute("retry_backoff", delay_ms / 1000.0)
             self._sleep(delay_ms / 1000.0)
         STAT_ADD("resilience.retry_giveups")
         raise RetryExhausted(

@@ -50,6 +50,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from .. import goodput as _goodput
 from ..core.scope import Scope, global_scope
 from ..monitor import (STAT_ADD, dump_flight_recorder, flight_record)
 
@@ -102,6 +103,7 @@ class TrainerGuard:
         self._snapshot: Dict[str, np.ndarray] = {}
         self._snapshot_step = -1
         self._preempt_requested = False
+        self._preempt_draining = False
         self._closed = False
 
         self._prev_term = None
@@ -179,8 +181,10 @@ class TrainerGuard:
         STAT_ADD("resilience.snapshots")
 
     def _rollback(self):
+        t0 = time.perf_counter()
         for n, a in self._snapshot.items():
             self.scope.set(n, np.array(a, copy=True))
+        _goodput.attribute("nan_rollback", time.perf_counter() - t0)
         STAT_ADD("resilience.rollbacks")
         flight_record("rollback", step=self.global_step,
                       snapshot_step=self._snapshot_step)
@@ -194,6 +198,7 @@ class TrainerGuard:
         dirname = dirname or self.checkpoint_dir
         if not dirname:
             raise ValueError("no checkpoint_dir configured")
+        t0 = time.perf_counter()
         os.makedirs(dirname, exist_ok=True)
         names = _persistable_names(self.program, self.scope)
         for n in names:
@@ -206,6 +211,12 @@ class TrainerGuard:
             json.dumps({"global_step": self.global_step,
                         "nan_skips": self.nan_skips,
                         "vars": names}))
+        # on the preemption path the whole drain (this checkpoint) is
+        # preempt_drain, not a routine checkpoint_save
+        _goodput.attribute(
+            "preempt_drain" if self._preempt_draining
+            else "checkpoint_save",
+            time.perf_counter() - t0)
         STAT_ADD("resilience.checkpoints")
         flight_record("checkpoint", step=self.global_step, dir=dirname)
         return dirname
@@ -214,6 +225,7 @@ class TrainerGuard:
         """Restore a checkpoint written by checkpoint(); returns the
         consumed-batch count the data stream must skip."""
         dirname = dirname or self.checkpoint_dir
+        t0 = time.perf_counter()
         state_path = os.path.join(dirname, _GUARD_STATE)
         with open(state_path) as f:
             state = json.load(f)
@@ -225,6 +237,8 @@ class TrainerGuard:
         self.nan_skips = int(state.get("nan_skips", 0))
         self._snapshot = {}
         self._snapshot_step = -1
+        _goodput.attribute("checkpoint_restore",
+                           time.perf_counter() - t0)
         STAT_ADD("resilience.resumes")
         flight_record("resume", step=self.global_step, dir=dirname)
         return self.global_step
@@ -238,7 +252,11 @@ class TrainerGuard:
     def _checkpoint_and_raise(self):
         where = None
         if self.checkpoint_dir:
-            where = self.checkpoint(self.checkpoint_dir)
+            self._preempt_draining = True
+            try:
+                where = self.checkpoint(self.checkpoint_dir)
+            finally:
+                self._preempt_draining = False
         raise PreemptedError(
             f"preempted at step {self.global_step}"
             + (f"; checkpoint in {where}" if where else ""),

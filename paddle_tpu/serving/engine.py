@@ -19,6 +19,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from .. import goodput as _goodput
 from .. import trace
 from ..monitor import STAT_ADD, STAT_OBSERVE
 from ..resilience.breaker import CLOSED, HALF_OPEN, OPEN, CircuitBreaker
@@ -348,11 +349,18 @@ class ServingEngine:
 
     def _worker_loop(self):
         while True:
+            # serving goodput: time blocked in next_batch (empty queue or
+            # batching window) is idle; everything from batch receipt to
+            # scatter is busy. Pad waste = execute time x the ladder's
+            # padded-row fraction (the slack baked into the batch shape).
+            t_wait0 = time.perf_counter()
             batch = self._batcher.next_batch(timeout=0.1)
+            _goodput.serving_idle(time.perf_counter() - t_wait0)
             if batch is None:
                 if self._stopping and self._batcher.pending_rows() == 0:
                     return
                 continue
+            t_busy0 = time.perf_counter()
             try:
                 # One span per dispatched batch. It cannot PARENT the
                 # member request spans (they live in N different
@@ -368,7 +376,10 @@ class ServingEngine:
                     with trace.use_span(bspan):
                         feed, bucket, waste = batch.build_feed(
                             self._ladder)
+                        t_exec0 = time.perf_counter()
                         outputs = self._retry.call(self._execute, feed)
+                        _goodput.serving_pad_waste(
+                            waste * (time.perf_counter() - t_exec0))
                 except Exception as e:  # noqa: BLE001 — close the batch
                     # trace, then let the existing handler fail the batch
                     trace.finish_trace(bspan,
@@ -383,6 +394,7 @@ class ServingEngine:
                              buckets=FRACTION_BUCKETS)
                 batch.scatter(outputs)
                 self._breaker.record_success()
+                _goodput.serving_busy(time.perf_counter() - t_busy0)
             except Exception as e:  # noqa: BLE001 — a poison batch must
                 # fail ITS requests, not kill the worker thread
                 if is_transient(e):

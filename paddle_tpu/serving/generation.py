@@ -54,6 +54,7 @@ from typing import Callable, List, Optional, Sequence
 
 import numpy as np
 
+from .. import goodput as _goodput
 from .. import trace
 from ..monitor import STAT_ADD, STAT_OBSERVE, STAT_SET
 from ..monitor import enabled as _monitor_on
@@ -718,7 +719,10 @@ class GenerationEngine:
                     if self._closed and not self._queue:
                         exit_loop = True
                     elif not (self._closed and not self._draining):
+                        # generation goodput: no active slot = idle wait
+                        t_idle0 = time.perf_counter()
                         self._cond.wait(0.05)
+                        _goodput.gen_idle(time.perf_counter() - t_idle0)
             for q in expired:
                 STAT_ADD("serving.gen_timeouts")
                 trace.end_span(q.qspan, error="DeadlineExceededError")
@@ -743,11 +747,14 @@ class GenerationEngine:
             if not active_idx:
                 continue
             if self.paged:
+                t_busy0 = time.perf_counter()
                 self._paged_iteration()
+                _goodput.gen_busy(time.perf_counter() - t_busy0)
                 continue
 
             # ---- one decode step over the full fixed-shape batch ----
             now = time.perf_counter()
+            t_busy0 = now
             tokens = np.zeros((B, 1), np.int64)
             reset = np.zeros(B, np.float32)
             active = np.zeros(B, np.float32)
@@ -871,6 +878,7 @@ class GenerationEngine:
                     self._slots.release(i)
                 else:
                     st.cur = tok
+            _goodput.gen_busy(time.perf_counter() - t_busy0)
 
     # -- paged iteration -------------------------------------------------
     def _paged_iteration(self):

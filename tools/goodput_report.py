@@ -1,0 +1,279 @@
+#!/usr/bin/env python
+"""Goodput report: category table, goodput fraction, step waterfall.
+
+Renders the run-level wall-clock ledger (paddle_tpu/goodput.py): every
+second of a run attributed to one exclusive category, the goodput
+fraction (device_compute / wall), and a per-step waterfall for the
+worst-N steps.  Two modes:
+
+  # render the last goodput_snapshot record found in run logs
+  python tools/goodput_report.py RUN.jsonl [RUN2.jsonl ...] \
+      [--worst 5] [--out report.jsonl]
+
+  # self-contained CPU smoke: tiny SGD training loop, in-process
+  python tools/goodput_report.py --smoke --cpu --steps 40 \
+      [--starve] [--config goodput_smoke] [--out report.jsonl] [--check]
+
+``--starve`` arms ``slow_step:ms=<starve-ms>:site=reader`` so the run
+demonstrates input starvation (input_wait becomes the top category).
+``--out`` appends one ``kind="goodput_report"`` JSONL record that
+tools/perf_ledger.py ingests (metrics ``goodput_frac`` and
+``input_wait_s``) so tools/perf_gate.py flags goodput regressions like
+throughput regressions.  ``--check`` exits 1 when the category sum
+drifts more than 5% from wall-clock (the ledger's invariant).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# category render order matches paddle_tpu.goodput.CATEGORIES; the bar
+# glyph per category keys the waterfall
+_BAR_GLYPHS = {
+    "input_wait": "i",
+    "feed_s": "f",
+    "compile_s": "c",
+    "compute_s": "#",
+    "fetch_s": "s",
+    "other_s": ".",
+}
+
+
+def load_snapshot(paths):
+    """Last kind=goodput_snapshot record across the given JSONL logs."""
+    snap = None
+    for path in paths:
+        try:
+            with open(path, encoding="utf-8") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    if isinstance(rec, dict) and \
+                            rec.get("kind") == "goodput_snapshot":
+                        snap = rec
+        except OSError as e:
+            print(f"warning: cannot read {path}: {e}", file=sys.stderr)
+    return snap
+
+
+def run_smoke(steps=40, batch=8, starve=False, starve_ms=80.0,
+              label="smoke"):
+    """Self-contained tiny CPU training run under the goodput ledger."""
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu import goodput, layers
+    from paddle_tpu.core.flags import FLAGS
+    from paddle_tpu.resilience import faults
+
+    prev = {k: getattr(FLAGS, k)
+            for k in ("enable_monitor", "enable_goodput", "fault_spec")}
+    FLAGS.enable_monitor = True
+    FLAGS.enable_goodput = True
+    if starve:
+        FLAGS.fault_spec = "slow_step:ms=%g:site=reader" % starve_ms
+        faults.reset_injector()
+    try:
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup), \
+                fluid.unique_name.guard("gp_"):
+            x = layers.data("x", shape=[-1, 16], dtype="float32",
+                            append_batch_size=False)
+            y = layers.data("y", shape=[-1, 1], dtype="float32",
+                            append_batch_size=False)
+            h = layers.fc(x, size=32, act="relu")
+            pred = layers.fc(h, size=1)
+            loss = layers.mean(layers.square_error_cost(pred, y))
+            fluid.optimizer.SGD(learning_rate=0.01).minimize(loss)
+
+        rng = np.random.RandomState(0)
+
+        def gen():
+            for _ in range(steps):
+                yield {"x": rng.randn(batch, 16).astype(np.float32),
+                       "y": rng.randn(batch, 1).astype(np.float32)}
+
+        loader = fluid.io.DataLoader.from_generator(capacity=2)
+        loader.set_batch_generator(lambda: gen())
+
+        scope = fluid.Scope()
+        exe = fluid.Executor()
+        with fluid.scope_guard(scope):
+            # startup runs OUTSIDE the ledger window so its one-off
+            # build doesn't count against the training run's warmup
+            exe.run(startup)
+            goodput.start_run(label)
+            for feed in loader():
+                exe.run(main, feed=feed, fetch_list=[loss])
+            snap = goodput.end_run()
+    finally:
+        goodput.reset()
+        for k, v in prev.items():
+            setattr(FLAGS, k, v)
+        faults.reset_injector()
+    return snap
+
+
+def worst_steps(snap, n):
+    """Worst-N step records by wall time including the preceding wait."""
+    steps = list(snap.get("step_records") or [])
+    steps.sort(key=lambda s: float(s.get("total_s") or 0.0),
+               reverse=True)
+    return steps[:max(0, n)]
+
+
+def _bar(step, width=36):
+    total = float(step.get("total_s") or 0.0)
+    if total <= 0:
+        return " " * width
+    parts = [("input_wait", float(step.get("input_wait_s") or 0.0))]
+    for k in ("feed_s", "compile_s", "compute_s", "fetch_s", "other_s"):
+        parts.append((k, float(step.get(k) or 0.0)))
+    out = []
+    for key, sec in parts:
+        out.append(_BAR_GLYPHS[key] * int(round(width * sec / total)))
+    return ("".join(out))[:width].ljust(width)
+
+
+def render(snap, worst=5):
+    lines = []
+    wall = float(snap.get("wall_s") or 0.0)
+    cats = snap.get("categories") or {}
+    lines.append("== goodput report: %s ==" % (snap.get("label")
+                                               or snap.get("config")
+                                               or "run"))
+    lines.append("wall-clock            %10.3f s" % wall)
+    lines.append("goodput fraction      %10.3f   "
+                 "(device_compute / wall)" % float(
+                     snap.get("goodput_frac") or 0.0))
+    lines.append("sum-invariant error   %9.1f %%" % (
+        100.0 * float(snap.get("sum_frac_err") or 0.0)))
+    lines.append("steps %d  compile-steps %d  post-warmup compiles %d  "
+                 "starved steps %d" % (
+                     int(snap.get("steps") or 0),
+                     int(snap.get("compile_steps") or 0),
+                     int(snap.get("post_warmup_compiles") or 0),
+                     int(snap.get("starved_steps") or 0)))
+    lines.append("")
+    lines.append("%-20s %12s %8s" % ("category", "seconds", "% wall"))
+    order = sorted(cats.items(), key=lambda kv: -float(kv[1] or 0.0))
+    for name, sec in order:
+        sec = float(sec or 0.0)
+        pct = 100.0 * sec / wall if wall > 0 else 0.0
+        lines.append("%-20s %12.4f %7.1f%%" % (name, sec, pct))
+    top = worst_steps(snap, worst)
+    if top:
+        lines.append("")
+        lines.append("-- worst %d steps (i=input f=feed c=compile "
+                     "#=compute s=sync .=other) --" % len(top))
+        lines.append("%5s %10s %10s  %s" % ("step", "total_ms",
+                                            "input_ms", "waterfall"))
+        for s in top:
+            lines.append("%5d %10.2f %10.2f  |%s|" % (
+                int(s.get("step") or 0),
+                1e3 * float(s.get("total_s") or 0.0),
+                1e3 * float(s.get("input_wait_s") or 0.0),
+                _bar(s)))
+    return "\n".join(lines)
+
+
+def report_record(snap, config, worst=5):
+    """The kind="goodput_report" JSONL record perf_ledger ingests."""
+    return {
+        "kind": "goodput_report",
+        "ts": time.time(),
+        "config": config,
+        "wall_s": snap.get("wall_s"),
+        "goodput_frac": snap.get("goodput_frac"),
+        "sum_frac_err": snap.get("sum_frac_err"),
+        "categories": snap.get("categories") or {},
+        "steps": snap.get("steps"),
+        "compile_steps": snap.get("compile_steps"),
+        "post_warmup_compiles": snap.get("post_warmup_compiles"),
+        "input_batches": snap.get("input_batches"),
+        "starved_steps": snap.get("starved_steps"),
+        "worst_steps": worst_steps(snap, worst),
+    }
+
+
+def _emit(path, rec):
+    with open(path, "a", encoding="utf-8") as f:
+        f.write(json.dumps(rec, sort_keys=True) + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Goodput category table, fraction, and step-time "
+                    "waterfall")
+    ap.add_argument("logs", nargs="*",
+                    help="JSONL logs holding goodput_snapshot records")
+    ap.add_argument("--smoke", action="store_true",
+                    help="run a self-contained tiny CPU training loop")
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the jax CPU backend")
+    ap.add_argument("--steps", type=int, default=40)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--starve", action="store_true",
+                    help="arm slow_step:site=reader during --smoke")
+    ap.add_argument("--starve-ms", type=float, default=80.0)
+    ap.add_argument("--config", default=None,
+                    help="config label stamped into the --out record")
+    ap.add_argument("--worst", type=int, default=5)
+    ap.add_argument("--out", default=None,
+                    help="append a goodput_report JSONL record here")
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 when the category sum drifts >5%% "
+                         "from wall-clock")
+    args = ap.parse_args(argv)
+
+    if args.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    if args.smoke:
+        label = args.config or (
+            "smoke_starved" if args.starve else "smoke_clean")
+        snap = run_smoke(steps=args.steps, batch=args.batch,
+                         starve=args.starve, starve_ms=args.starve_ms,
+                         label=label)
+    else:
+        if not args.logs:
+            ap.error("give JSONL logs or --smoke")
+        snap = load_snapshot(args.logs)
+        if snap is None:
+            print("no goodput_snapshot record found", file=sys.stderr)
+            return 2
+
+    print(render(snap, worst=args.worst))
+    config = args.config or snap.get("label") or "goodput"
+    if args.out:
+        _emit(args.out, report_record(snap, config, worst=args.worst))
+        print("\nwrote goodput_report record -> %s" % args.out)
+    if args.check:
+        from paddle_tpu.goodput import check_invariant
+        if not check_invariant(snap, tol=0.05):
+            print("INVARIANT FAILED: category sum vs wall-clock "
+                  "err=%.1f%%" % (
+                      100.0 * float(snap.get("sum_frac_err") or 1.0)),
+                  file=sys.stderr)
+            return 1
+        print("invariant OK: category seconds sum to wall-clock "
+              "within 5%")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -50,7 +50,7 @@ def load(path):
     loadgens, lints, graph_opts = [], [], []
     gen_loadgens, chaos_loadgens, memory_plans = [], [], []
     sharded_benches, trace_reports, router_loadgens = [], [], []
-    perf_gates, incident_bundles = [], []
+    perf_gates, incident_bundles, goodput_reports = [], [], []
     with open(path) as f:
         for ln, line in enumerate(f, 1):
             line = line.strip()
@@ -95,10 +95,12 @@ def load(path):
                 memory_plans.append(rec)
             elif kind == "trace_report":
                 trace_reports.append(rec)
+            elif kind == "goodput_report":
+                goodput_reports.append(rec)
     return (snapshots, results, op_profiles, loadgens, lints,
             graph_opts, gen_loadgens, chaos_loadgens, memory_plans,
             sharded_benches, trace_reports, router_loadgens,
-            perf_gates, incident_bundles)
+            perf_gates, incident_bundles, goodput_reports)
 
 
 def _hist(snap, name):
@@ -109,7 +111,7 @@ def report(path, out=sys.stdout):
     (snapshots, results, op_profiles, loadgens, lints,
      graph_opts, gen_loadgens, chaos_loadgens, memory_plans,
      sharded_benches, trace_reports, router_loadgens,
-     perf_gates, incident_bundles) = load(path)
+     perf_gates, incident_bundles, goodput_reports) = load(path)
     w = out.write
     w(f"runtime stats report — {path}\n")
     if not snapshots and not results and not op_profiles \
@@ -117,7 +119,8 @@ def report(path, out=sys.stdout):
             and not gen_loadgens and not chaos_loadgens \
             and not memory_plans and not sharded_benches \
             and not trace_reports and not router_loadgens \
-            and not perf_gates and not incident_bundles:
+            and not perf_gates and not incident_bundles \
+            and not goodput_reports:
         w("no snapshots or bench results found\n")
         return 1
     w(f"snapshots: {len(snapshots)}   bench results: {len(results)}\n")
@@ -189,6 +192,67 @@ def report(path, out=sys.stdout):
         if rb:
             w(f"{'batches':26s} {int(rb)}   queue depth "
               f"{g.get('reader.queue_depth', 'n/a')}\n")
+
+    gp_wall = g.get("goodput.wall_seconds")
+    gwait = _hist(snap, "goodput.input_wait_ms")
+    gp_busy = c.get("goodput.serving_busy_seconds")
+    gen_busy = c.get("goodput.gen_busy_seconds")
+    if gp_wall is not None or goodput_reports or (gwait and
+                                                 gwait["count"]) \
+            or gp_busy or gen_busy:
+        w("\n-- goodput --\n")
+        if gp_wall is not None:
+            w(f"{'wall clock':26s} {_fmt_s(gp_wall)}   goodput "
+              f"fraction {float(g.get('goodput.fraction', 0.0)):.1%}\n")
+            for label, name in (
+                    ("device compute", "goodput.device_compute_seconds"),
+                    ("compile", "goodput.compile_seconds"),
+                    ("input wait", "goodput.input_wait_seconds"),
+                    ("feed stage", "goodput.feed_stage_seconds"),
+                    ("fetch sync", "goodput.fetch_sync_seconds"),
+                    ("checkpoint save", "goodput.checkpoint_save_seconds"),
+                    ("checkpoint restore",
+                     "goodput.checkpoint_restore_seconds"),
+                    ("retry backoff", "goodput.retry_backoff_seconds"),
+                    ("nan rollback", "goodput.nan_rollback_seconds"),
+                    ("preempt drain", "goodput.preempt_drain_seconds"),
+                    ("probe wait", "goodput.probe_wait_seconds"),
+                    ("other", "goodput.other_seconds")):
+                v = g.get(name)
+                if v:
+                    pct = (f" ({v / gp_wall:.1%})" if gp_wall else "")
+                    w(f"{label:26s} {_fmt_s(v)}{pct}\n")
+        if gwait and gwait["count"]:
+            w(f"{'input wait / batch':26s} count {gwait['count']:<6d} "
+              f"p50 {gwait['p50']:.2f} ms  p95 {gwait['p95']:.2f} ms  "
+              f"starved steps "
+              f"{int(c.get('goodput.input_starved_steps', 0))}\n")
+        if gp_busy:
+            idle = c.get("goodput.serving_idle_seconds", 0.0)
+            util = gp_busy / (gp_busy + idle) if gp_busy + idle else 0.0
+            w(f"{'serving busy/idle':26s} {_fmt_s(gp_busy)} / "
+              f"{_fmt_s(idle)} (util {util:.1%})  pad waste "
+              f"{_fmt_s(c.get('goodput.serving_pad_waste_seconds', 0.0))}"
+              f"\n")
+        if gen_busy:
+            idle = c.get("goodput.gen_idle_seconds", 0.0)
+            util = gen_busy / (gen_busy + idle) if gen_busy + idle \
+                else 0.0
+            w(f"{'generation busy/idle':26s} {_fmt_s(gen_busy)} / "
+              f"{_fmt_s(idle)} (util {util:.1%})\n")
+        for r in goodput_reports:
+            cats = r.get("categories") or {}
+            top = max(cats, key=lambda k: float(cats[k] or 0.0)) \
+                if cats else "n/a"
+            wall = float(r.get("wall_s") or 0.0)
+            top_pct = (float(cats.get(top) or 0.0) / wall
+                       if wall and top != "n/a" else 0.0)
+            w(f"report[{r.get('config', '?')}]  wall "
+              f"{_fmt_s(wall)}  frac "
+              f"{float(r.get('goodput_frac') or 0.0):.1%}  top "
+              f"{top} ({top_pct:.1%})  starved "
+              f"{int(r.get('starved_steps') or 0)}  post-warmup "
+              f"compiles {int(r.get('post_warmup_compiles') or 0)}\n")
 
     mem = g.get("memory.device_bytes_in_use")
     if mem is not None:

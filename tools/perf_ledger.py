@@ -217,6 +217,32 @@ def rows_from_record(rec) -> Tuple[List[dict], int]:
                    "est_peak_bytes", rec.get("est_peak_bytes"),
                    "bytes", ts=rec.get("ts"))
         return ([row] if row else []), (0 if row else 1)
+    if kind == "op_profile":
+        model = rec.get("model") or "?"
+        rows = []
+        for r in rec.get("rows") or []:
+            if not isinstance(r, dict) or not r.get("op"):
+                continue
+            row = _row("op_profile", f"{model}:{r['op']}", "avg_ms",
+                       r.get("avg_ms"), "ms", ts=rec.get("ts"))
+            if row:
+                rows.append(row)
+        return rows, (0 if rows else 1)
+    if kind == "goodput_report":
+        config = rec.get("config") or rec.get("label") or "goodput"
+        cats = rec.get("categories") if isinstance(
+            rec.get("categories"), dict) else {}
+        rows = []
+        # goodput_frac gates higher-is-better ("frac" unit hint in
+        # perf_gate.lower_is_better); input_wait_s gates lower-is-better
+        for metric, value, unit in (
+                ("goodput_frac", rec.get("goodput_frac"), "frac"),
+                ("input_wait_s", cats.get("input_wait"), "s")):
+            r = _row("goodput_report", config, metric, value, unit,
+                     ts=rec.get("ts"))
+            if r:
+                rows.append(r)
+        return rows, (0 if rows else 1)
     if kind is None and "metric" in rec and "value" in rec:
         rows = _bench_result_rows(rec)
         return rows, (0 if rows else 1)

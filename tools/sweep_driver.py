@@ -115,6 +115,15 @@ CONFIGS = [
     # run exits 0.
     ("gate_demo_base", None),  # special-cased below
     ("gate_demo_slow", None),  # special-cased below
+    # goodput A/B pair (tools/goodput_report.py, docs/observability.md
+    # "Goodput accounting"): the clean cell runs the self-contained CPU
+    # smoke three times to seed a goodput baseline (goodput_frac +
+    # input_wait_s rows); the starved cell runs the same smoke once
+    # under slow_step:site=reader and gates it against that baseline —
+    # PASS only when the gate flags the starved leg (input_wait_s blown
+    # and/or goodput_frac collapsed, rc=1 with regressions)
+    ("goodput_clean", None),  # special-cased below
+    ("goodput_starved", None),  # special-cased below
     ("gpt_b32", {"BENCH_MODEL": "gpt", "BENCH_BATCH": "32"}),
     # GSPMD dp x tp scaling (BENCH_MESH + FLAGS_sharded_exec layout,
     # docs/sharding.md): each sharded cell pairs with its single-chip
@@ -593,6 +602,86 @@ def run_special(key):
                 "baseline_median": row.get("baseline_median"),
                 "band": row.get("band"),
                 "fault_spec": "slow_step:ms=20:site=generation",
+                "gate_report": gate_out}, None
+    if key in ("goodput_clean", "goodput_starved"):
+        # both cells run the identical --smoke loop with the same
+        # --config label (= the ledger key), so the gate lines the
+        # starved run up against the clean baseline
+        starved = key == "goodput_starved"
+        demo_ledger = f"/tmp/goodput_demo_ledger_{ROUND}.jsonl"
+        gate_out = f"/tmp/goodput_gate_report_{ROUND}.jsonl"
+        prov = perf_ledger.provenance(platform="cpu")
+        if starved:
+            rows = perf_ledger.load_rows(demo_ledger)
+            if len([r for r in rows
+                    if r.get("metric") == "goodput_frac"]) < 3:
+                # retried on a later pass once goodput_clean has run
+                return None, "goodput baseline not seeded yet (needs " \
+                             "goodput_clean first)"
+        last_frac = None
+        for i in range(1 if starved else 3):
+            out_path = f"/tmp/{key}_{ROUND}_{i}.jsonl"
+            if os.path.exists(out_path):
+                os.unlink(out_path)
+            env = dict(os.environ)
+            env["JAX_PLATFORMS"] = "cpu"
+            cmd = [sys.executable, "tools/goodput_report.py",
+                   "--smoke", "--cpu", "--steps", "40",
+                   "--config", "goodput_smoke", "--check",
+                   "--out", out_path]
+            if starved:
+                # ~80ms deterministic stall on every reader batch:
+                # input_wait dominates and the sum≈wall invariant
+                # (--check) still has to hold
+                cmd += ["--starve", "--starve-ms", "80"]
+            p = subprocess.run(cmd, cwd=REPO, capture_output=True,
+                               text=True, timeout=1800, env=env)
+            if p.returncode != 0:
+                return None, (f"rc={p.returncode}: "
+                              + (p.stdout + p.stderr)[-300:])
+            rows, _ = perf_ledger.rows_from_file(out_path)
+            rows = [r for r in rows
+                    if r.get("record_kind") == "goodput_report"]
+            if not rows:
+                return None, f"no goodput rows in {out_path}"
+            last_frac = next((r["value"] for r in rows
+                              if r.get("metric") == "goodput_frac"),
+                             None)
+            if not starved:
+                perf_ledger.append_rows(demo_ledger, rows, prov)
+        if not starved:
+            return {"metric": "goodput_clean_frac", "value": last_frac,
+                    "unit": "frac", "runs": 3,
+                    "demo_ledger": demo_ledger}, None
+        # gate the starved run against the 3-run clean baseline; exit 1
+        # with regressions is the PASS condition for this cell
+        g = subprocess.run(
+            [sys.executable, "tools/perf_gate.py",
+             "--ledger", demo_ledger, "--out", gate_out,
+             f"/tmp/{key}_{ROUND}_0.jsonl"],
+            cwd=REPO, capture_output=True, text=True, timeout=600)
+        verdict = None
+        for ln in g.stdout.splitlines():
+            if ln.startswith("{"):
+                try:
+                    verdict = json.loads(ln)
+                except ValueError:
+                    pass
+        if g.returncode != 1 or not verdict \
+                or not verdict.get("regressions"):
+            return None, (f"gate did NOT flag the starved leg "
+                          f"(rc={g.returncode}): "
+                          + (g.stdout + g.stderr)[-300:])
+        row = next((r for r in verdict["results"]
+                    if r.get("status") == "regression"), {})
+        return {"metric": "goodput_starved_delta_frac",
+                "value": row.get("delta_frac"), "unit": "frac",
+                "gate_rc": g.returncode,
+                "regressed_metric": row.get("metric"),
+                "starved_goodput_frac": last_frac,
+                "baseline_median": row.get("baseline_median"),
+                "band": row.get("band"),
+                "fault_spec": "slow_step:ms=80:site=reader",
                 "gate_report": gate_out}, None
     if key == "profile":
         p = subprocess.run([sys.executable, "tools/profile_step.py"],

@@ -38,7 +38,12 @@ Checks, per file (type auto-detected from content):
   carry the ledger path, the per-(config, metric) verdict rows
   (status regression/improvement/ok/too_few_samples/new_config with
   the median +- k*MAD band that produced them) and regression /
-  improvement counts that must agree with the rows.
+  improvement counts that must agree with the rows; lines with
+  kind == "goodput_report" (tools/goodput_report.py --out) carry the
+  exclusive category ledger (every goodput category present,
+  non-negative, summing to wall_s within 5%), the goodput fraction in
+  [0,1], the step/compile/starvation counters and the worst-N step
+  waterfall rows.
 * incident_*.json (paddle_tpu/monitor_alerts.py bundles, also accepted
   as a JSONL line): kind == "incident_bundle" with the fired rule, the
   full stats snapshot, breaching-bucket exemplar trace ids, the kept
@@ -659,6 +664,82 @@ _GATE_STATUSES = ("ok", "regression", "improvement", "too_few_samples",
                   "new_config")
 
 
+_GOODPUT_CATEGORIES = (
+    "device_compute", "compile", "input_wait", "feed_stage",
+    "fetch_sync", "checkpoint_save", "checkpoint_restore",
+    "retry_backoff", "nan_rollback", "preempt_drain", "probe_wait",
+    "other")
+
+
+def validate_goodput_report(obj, where="goodput_report"):
+    """kind="goodput_report" (tools/goodput_report.py --out): the
+    exclusive category ledger of one run — every category present and
+    non-negative, the fraction in [0,1], and the sum≈wall invariant
+    the ledger promises (categories within 5% of wall-clock)."""
+    errs = []
+    if not isinstance(obj.get("config"), str):
+        errs.append(f"{where}: config must be a string "
+                    f"(got {obj.get('config')!r})")
+    for key in ("ts", "wall_s", "goodput_frac", "sum_frac_err"):
+        v = obj.get(key)
+        if not isinstance(v, (int, float)) or isinstance(v, bool):
+            errs.append(f"{where}: {key} must be numeric (got {v!r})")
+    frac = obj.get("goodput_frac")
+    if isinstance(frac, (int, float)) and not isinstance(frac, bool) \
+            and not 0.0 <= frac <= 1.0:
+        errs.append(f"{where}: goodput_frac must be in [0,1] "
+                    f"(got {frac})")
+    cats = obj.get("categories")
+    if not isinstance(cats, dict):
+        errs.append(f"{where}: categories must be an object")
+        cats = {}
+    for c in _GOODPUT_CATEGORIES:
+        v = cats.get(c)
+        if not isinstance(v, (int, float)) or isinstance(v, bool):
+            errs.append(f"{where}: categories.{c} must be numeric "
+                        f"(got {v!r})")
+        elif v < 0:
+            errs.append(f"{where}: categories.{c} must be >= 0 "
+                        f"(got {v})")
+    for c in cats:
+        if c not in _GOODPUT_CATEGORIES:
+            errs.append(f"{where}: unknown category {c!r}")
+    # the ledger's core contract: category seconds sum to wall-clock
+    wall = obj.get("wall_s")
+    if isinstance(wall, (int, float)) and not isinstance(wall, bool) \
+            and wall > 0 and not errs:
+        total = sum(float(cats[c]) for c in _GOODPUT_CATEGORIES)
+        if abs(total - wall) / wall > 0.05:
+            errs.append(f"{where}: categories sum {total:.4f}s drifts "
+                        f">5% from wall_s={wall:.4f}")
+    for key in ("steps", "compile_steps", "post_warmup_compiles",
+                "starved_steps"):
+        v = obj.get(key)
+        if not isinstance(v, int) or isinstance(v, bool):
+            errs.append(f"{where}: {key} must be an int (got {v!r})")
+        elif v < 0:
+            errs.append(f"{where}: {key} must be >= 0 (got {v})")
+    steps = obj.get("worst_steps")
+    if not isinstance(steps, list):
+        errs.append(f"{where}: worst_steps must be a list")
+        steps = []
+    for i, s in enumerate(steps):
+        if not isinstance(s, dict):
+            errs.append(f"{where}: worst_steps[{i}] is not an object")
+            continue
+        if not isinstance(s.get("step"), int) \
+                or isinstance(s.get("step"), bool):
+            errs.append(f"{where}: worst_steps[{i}].step must be an "
+                        f"int")
+        for key in ("input_wait_s", "feed_s", "compile_s", "compute_s",
+                    "fetch_s", "other_s", "total_s"):
+            v = s.get(key)
+            if not isinstance(v, (int, float)) or isinstance(v, bool):
+                errs.append(f"{where}: worst_steps[{i}].{key} must be "
+                            f"numeric (got {v!r})")
+    return errs
+
+
 def validate_perf_gate(obj, where="perf_gate"):
     """kind="perf_gate" (tools/perf_gate.py): the noise-aware verdict
     of one gated run against the ledger baseline."""
@@ -760,6 +841,9 @@ def validate_jsonl(path):
             elif rec.get("kind") == "perf_gate":
                 errs.extend(validate_perf_gate(
                     rec, where=f"{path}:{ln}"))
+            elif rec.get("kind") == "goodput_report":
+                errs.extend(validate_goodput_report(
+                    rec, where=f"{path}:{ln}"))
     return errs
 
 
@@ -786,6 +870,8 @@ def validate_file(path):
         return validate_incident_bundle(obj, where=path)
     if obj.get("kind") == "perf_gate":
         return validate_perf_gate(obj, where=path)
+    if obj.get("kind") == "goodput_report":
+        return validate_goodput_report(obj, where=path)
     if "parsed" in obj and "cmd" in obj:
         return validate_wrapper(obj, where=path)
     # a single-record JSONL (e.g. one snapshot) is also fine
