@@ -423,6 +423,34 @@ DEFINE_int64(
     "knob the gen_paged_vs_slab A/B holds fixed while comparing "
     "sustainable slot counts.")
 
+DEFINE_bool(
+    "gen_spec_decode", False,
+    "Generation engine default for speculative decoding "
+    "(serving/spec_decode.py): when True a paged engine builds the "
+    "third fixed-shape executable (the [max_slots, k+1] batched verify "
+    "step) at start() and drafts with the host-side n-gram / "
+    "prompt-lookup drafter every decode iteration. Per-request "
+    "GenerationRequest.spec_decode overrides (None = this default). "
+    "Host-side program choice only — never part of an executable cache "
+    "key; post_warmup_compiles() stays 0 either way.")
+
+DEFINE_int32(
+    "spec_decode_k", 4,
+    "Speculative decoding: maximum draft tokens proposed per slot per "
+    "iteration. The verify executable is compiled at [max_slots, k+1] "
+    "(k drafts + the committed token), so changing k changes the ONE "
+    "extra warmup compile, not the steady state. Larger k amortizes "
+    "more dispatch overhead on repetitive text but wastes verify "
+    "compute when acceptance is low.")
+
+DEFINE_int32(
+    "spec_decode_ngram", 3,
+    "Speculative decoding: longest context suffix the n-gram / "
+    "prompt-lookup drafter matches against the slot's prompt + "
+    "generated tokens. Matching tries n down to 1 and proposes the "
+    "tokens that followed the most recent earlier occurrence; 0 "
+    "disables drafting (the verify path then never dispatches).")
+
 DEFINE_double(
     "serving_default_timeout_ms", 1000.0,
     "Default EngineConfig.default_timeout_ms: per-request deadline "

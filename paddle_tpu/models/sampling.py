@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["sample_token"]
+__all__ = ["sample_token", "accept_draft"]
 
 
 def sample_token(step_logits, temperature=0.0, top_k=0, rng=None):
@@ -52,3 +52,44 @@ def sample_token(step_logits, temperature=0.0, top_k=0, rng=None):
         p /= p.sum()
         return int(rng.choice(len(p), p=p))
     return int(logits.argmax())
+
+
+def accept_draft(step_logits, draft, temperature=0.0, top_k=0,
+                 rng=None):
+    """Speculative-decoding accept/reject over one slot's verify logits.
+
+    `step_logits` is `[len(draft)+1, vocab]` — row j holds the target
+    model's next-token logits AFTER context position j (row 0 continues
+    the committed token, row j>0 continues draft token j). Walk the
+    rows in order, drawing each position's token through `sample_token`
+    (the SAME path, knobs and rng discipline as serial decode): while
+    the drawn token equals the draft token at that position the draft
+    is accepted and the walk continues; the first disagreement stops
+    the walk — the drawn token itself IS the correction (no extra
+    forward pass, no distribution shift: every emitted token is a draw
+    from the target model's distribution at its position, one rng draw
+    per emitted token in serial order). Accepting the whole draft emits
+    a bonus token from the final row for free.
+
+    Returns `(emitted, n_accepted)`: `emitted` is the 1..len(draft)+1
+    tokens to commit (order matters; a caller honoring eos truncates),
+    `n_accepted` how many draft tokens matched. With an empty draft
+    this degenerates to exactly the single-token sample — the bit-exact
+    fallback the serving engine and tests rely on.
+    """
+    rows = np.asarray(step_logits)
+    if rows.ndim != 2 or rows.shape[0] != len(draft) + 1:
+        raise ValueError(
+            f"accept_draft expects [len(draft)+1, vocab] logits, got "
+            f"shape {rows.shape} for {len(draft)} draft token(s)")
+    emitted = []
+    n_accepted = 0
+    for j in range(len(draft) + 1):
+        tok = sample_token(rows[j], temperature=temperature,
+                           top_k=top_k, rng=rng)
+        emitted.append(tok)
+        if j < len(draft) and tok == int(draft[j]):
+            n_accepted += 1
+            continue
+        break
+    return emitted, n_accepted

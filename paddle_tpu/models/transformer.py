@@ -41,17 +41,18 @@ class TransformerConfig:
         # attention WEIGHTS is a separate knob: the flash kernel does not
         # implement it, so attn_dropout > 0 forces the composed path
         # (keeping the trained model identical across kernel choices).
-        # "auto" = the seq-length heuristic: flash only beyond seq 1024,
-        # where the O(T^2) composed path starts losing outright. The r05
+        # "auto" = the measured-crossover heuristic: flash only from
+        # ops/attention.py:FLASH_AUTO_MIN_SEQ (4096) up. The r05
         # microbench has blk=512 flash ~2x faster than composed at seq
-        # 512 too (2.64 vs 5.47 ms fwd+bwd) — but the earlier always-on
-        # flip shipped with a hard-coded 128 tile that lost 2-4x
-        # end-to-end (55.5k vs 88.4k tok/s, ADVICE r5-high), so "auto"
-        # stays conservative until an end-to-end run with tuned tiles
-        # confirms the win (docs/attention_tuning.md has the full
-        # history).
+        # 512 in isolation (2.64 vs 5.47 ms fwd+bwd), but end-to-end
+        # flash LOST 37% tok/s at seq 512 (55.5k vs 88.4k) and the gap
+        # widened with batch; at 2048 the paths are within noise, so
+        # the flip sits where the tiled kernel's end-to-end win is
+        # unambiguous (docs/attention_tuning.md has the full history
+        # and the re-measurement recipe).
         if use_flash == "auto":
-            use_flash = max_seq_len > 1024
+            from ..ops.attention import FLASH_AUTO_MIN_SEQ
+            use_flash = max_seq_len >= FLASH_AUTO_MIN_SEQ
         self.use_flash = use_flash
         # Explicit Pallas tile override (op attrs). None = leave the
         # attrs unset so FLAGS_flash_attention_block_{q,k} and the

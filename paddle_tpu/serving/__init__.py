@@ -35,10 +35,12 @@ from .http import ServingHTTPServer, serve  # noqa: F401
 from .kv_blocks import (BlockPool, PrefixCache,  # noqa: F401
                         blocks_for_tokens)
 from .router import Replica, Router, RouterHTTP  # noqa: F401
+from .spec_decode import NgramDrafter  # noqa: F401
 
 __all__ = ["BucketLadder", "DynamicBatcher", "EngineConfig",
            "ServingEngine", "ServingHTTPServer", "serve", "ServingError",
            "QueueFullError", "DeadlineExceededError", "EngineClosedError",
            "OverloadedError", "GenerationEngine", "GenerationRequest",
            "SlotManager", "BlockPool", "PrefixCache",
-           "blocks_for_tokens", "Replica", "Router", "RouterHTTP"]
+           "blocks_for_tokens", "Replica", "Router", "RouterHTTP",
+           "NgramDrafter"]

@@ -45,6 +45,19 @@ iterations interleaved with — never stalling — the decode batch. The
 compile contract widens from one executable to exactly two (decode +
 chunk prefill), both compiled in `start()`: `post_warmup_compiles()`
 stays 0 for the engine's lifetime either way.
+
+Speculative decoding (FLAGS_gen_spec_decode / GenerationRequest
+.spec_decode, paged engines only): a host-side n-gram drafter
+(`serving/spec_decode.py`) proposes up to FLAGS_spec_decode_k tokens per
+slot between steps, and a THIRD fixed-shape executable — the
+`[max_slots, k+1]` batched verify step (`models/gpt.py:
+build_spec_verify_step`) — scores every draft position in one pass.
+`models/sampling.py:accept_draft` commits the longest agreeing prefix
+through the same sample_token path as serial decode, so outputs stay
+token-for-token identical at any temperature; each accepted token skips
+one whole decode iteration. The verify executable is compiled in
+`start()` alongside the other two, keeping `post_warmup_compiles()` at
+0.
 """
 from __future__ import annotations
 
@@ -70,6 +83,12 @@ from .kv_blocks import (SCRATCH_BLOCK, BlockPool, PrefixCache,
 
 __all__ = ["GenerationRequest", "SlotManager", "GenerationEngine"]
 
+# Effective tokens committed per verify step: 1 (full reject) through
+# spec_k + 1 (full accept + bonus token). Count-valued, so the ms/
+# fraction bucket ladders don't fit; upper rungs leave headroom for
+# larger FLAGS_spec_decode_k settings.
+SPEC_TOKEN_BUCKETS = (1.0, 2.0, 3.0, 4.0, 5.0, 6.0, 8.0, 12.0, 16.0)
+
 
 class GenerationRequest:
     """One generation job: prompt in, up to `max_new_tokens` out.
@@ -81,16 +100,24 @@ class GenerationRequest:
     decode; None falls back to the engine default. `stream_cb(token_id)`
     fires from the engine thread after every generated token — the
     streaming hook (and the loadgen's TTFT/inter-token probe).
+    `spec_decode` opts this request in/out of speculative decoding
+    (serving/spec_decode.py): None defers to the engine default
+    (FLAGS_gen_spec_decode), False forces plain one-token decode, True
+    speculates when the engine carries the verify executable (and
+    degrades silently to plain decode when it does not — outputs are
+    identical either way, only the step count changes).
     """
 
     __slots__ = ("prompt", "max_new_tokens", "temperature", "top_k",
-                 "eos_id", "timeout_ms", "seed", "stream_cb")
+                 "eos_id", "timeout_ms", "seed", "stream_cb",
+                 "spec_decode")
 
     def __init__(self, prompt: Sequence[int], max_new_tokens: int,
                  temperature: float = 0.0, top_k: int = 0,
                  eos_id: Optional[int] = None,
                  timeout_ms: Optional[float] = None, seed: int = 0,
-                 stream_cb: Optional[Callable[[int], None]] = None):
+                 stream_cb: Optional[Callable[[int], None]] = None,
+                 spec_decode: Optional[bool] = None):
         self.prompt = [int(t) for t in prompt]
         if not self.prompt:
             raise ValueError("GenerationRequest: prompt must be "
@@ -105,6 +132,8 @@ class GenerationRequest:
         self.timeout_ms = timeout_ms
         self.seed = int(seed)
         self.stream_cb = stream_cb
+        self.spec_decode = None if spec_decode is None \
+            else bool(spec_decode)
 
 
 class SlotManager:
@@ -208,7 +237,9 @@ class GenerationEngine:
                  state_prefix: str = "gen.",
                  paged: Optional[bool] = None,
                  block_size: Optional[int] = None,
-                 kv_pool_blocks: Optional[int] = None):
+                 kv_pool_blocks: Optional[int] = None,
+                 spec_decode: Optional[bool] = None,
+                 spec_k: Optional[int] = None):
         import paddle_tpu as fluid
         from ..core.flags import FLAGS
         from ..models import gpt
@@ -261,12 +292,40 @@ class GenerationEngine:
             self._pool = BlockPool(self.num_blocks, self.block_size)
             self._prefix = PrefixCache(self._pool)
         else:
+            spec_decode = False  # the slab graph has no verify substrate
             self.block_size = 0
             self.num_blocks = 0
             with fluid.program_guard(self._prog, self._startup):
                 self.step = gpt.build_decode_step(
                     cfg, batch=self.max_slots, max_seq=self.max_seq,
                     state_prefix=state_prefix)
+        # speculative decoding (serving/spec_decode.py): paged-only —
+        # the verify step is the THIRD and last fixed-shape executable,
+        # sharing the decode/prefill programs' K/V pools via
+        # state_prefix. Engines with spec off build nothing extra and
+        # keep the two-executable warmup unchanged.
+        self.spec_decode = bool(FLAGS.gen_spec_decode
+                                if spec_decode is None else spec_decode)
+        self.spec_k = int(spec_k if spec_k is not None
+                          else FLAGS.spec_decode_k)
+        self._spec_prog = None
+        self.spec_step = None
+        self._drafter = None
+        if self.spec_decode and self.spec_k >= 1:
+            from .spec_decode import NgramDrafter
+            self._spec_prog = fluid.Program()
+            self._spec_startup = fluid.Program()
+            with fluid.program_guard(self._spec_prog,
+                                     self._spec_startup):
+                self.spec_step = gpt.build_spec_verify_step(
+                    cfg, batch=self.max_slots, max_seq=self.max_seq,
+                    block_size=self.block_size,
+                    num_blocks=self.num_blocks, k=self.spec_k,
+                    state_prefix=state_prefix)
+            self._drafter = NgramDrafter(
+                max_ngram=int(FLAGS.spec_decode_ngram), k=self.spec_k)
+        else:
+            self.spec_decode = False
         self._slots = SlotManager(self.max_slots)
         self._state: List[Optional[_SlotState]] = \
             [None] * self.max_slots
@@ -360,6 +419,14 @@ class GenerationEngine:
                             np.zeros((B, mb), np.int64),
                             np.zeros(B, np.int64),
                             np.zeros(B, np.int64))
+            if self.spec_step is not None:
+                # the verify executable's one compile of the lifetime
+                self._run_paged(self._spec_prog, self.spec_step,
+                                np.zeros((B, self.spec_k + 1),
+                                         np.int64),
+                                np.zeros((B, mb), np.int64),
+                                np.zeros(B, np.int64),
+                                np.zeros(B, np.int64))
             STAT_SET("serving.gen_kv_blocks_total",
                      self._pool.capacity())
             STAT_SET("serving.gen_kv_blocks_free",
@@ -990,24 +1057,60 @@ class GenerationEngine:
                     st.phase_span.add_event("prefill_chunk",
                                             tokens=chunk_n[i])
 
-        # ---- phase 2: one decode step ---------------------------------
+        # ---- phase 2: one decode (or spec verify) step ----------------
         decode_idx = [
             i for i in range(B) if self._state[i] is not None
             and self._state[i].fed >=
             len(self._state[i].req.prompt) - 1]
         if not decode_idx:
             return
-        tokens = np.zeros((B, 1), np.int64)
+        # speculative drafts (serving/spec_decode.py): host-side n-gram
+        # lookup over each opted-in slot's prompt + generated tokens.
+        # Any non-empty draft routes the WHOLE batch through the verify
+        # executable — a draft-less row rides with n_valid=1, which is
+        # semantically the decode step — while an all-empty round takes
+        # the cheaper 1-token decode executable. Both were compiled in
+        # start(), so the per-iteration choice never costs a compile.
+        drafts = {}
+        if self._drafter is not None:
+            for i in decode_idx:
+                st = self._state[i]
+                if st.req.spec_decode is False:
+                    continue
+                # cap drafts to the blocks admission reserved (need-1
+                # is the slot's last writable position) and to the
+                # request's remaining token budget (the verify row
+                # already emits one token beyond the accepted drafts)
+                need = len(st.req.prompt) + st.req.max_new_tokens - 1
+                cap = min(self.spec_k, need - 1 - st.fed,
+                          st.req.max_new_tokens - len(st.generated) - 1)
+                if cap < 1:
+                    continue
+                d = self._drafter.draft(st.req.prompt + st.generated,
+                                        cap)
+                if d:
+                    drafts[i] = d
+        use_spec = bool(drafts)
+        prog = self._spec_prog if use_spec else self._prog
+        step = self.spec_step if use_spec else self.step
+        T = self.spec_k + 1 if use_spec else 1
+        tokens = np.zeros((B, T), np.int64)
         table = np.zeros((B, mb), np.int64)
         start = np.zeros(B, np.int64)
         nvalid = np.zeros(B, np.int64)
+        n_draft = {}
         for i in decode_idx:
             st = self._state[i]
+            d = drafts.get(i, ())
+            n_draft[i] = len(d)
             tokens[i, 0] = st.cur
+            if d:
+                tokens[i, 1:1 + len(d)] = d
             fill_row(table, start, i, st)
-            nvalid[i] = 1
-        logits = run_guarded(self._prog, self.step, tokens, table,
-                             start, nvalid, decode_idx, "decode")
+            nvalid[i] = 1 + len(d)
+        logits = run_guarded(prog, step, tokens, table, start, nvalid,
+                             decode_idx,
+                             "spec verify" if use_spec else "decode")
         if logits is None:
             return
         inj = _fault_injector()
@@ -1017,7 +1120,8 @@ class GenerationEngine:
                 logits = arrs[0]
         if FLAGS.serving_nan_guard:
             bad = [i for i in decode_idx
-                   if not np.all(np.isfinite(logits[i, 0]))]
+                   if not np.all(np.isfinite(
+                       logits[i, :1 + n_draft[i]]))]
             if bad:
                 self._breaker.record_failure()
                 STAT_ADD("resilience.gen_step_failures")
@@ -1031,6 +1135,8 @@ class GenerationEngine:
                 if not decode_idx:
                     return
         STAT_ADD("serving.gen_steps")
+        if use_spec:
+            STAT_ADD("serving.gen_spec_steps")
         if _monitor_on():
             STAT_OBSERVE("serving.gen_slot_occupancy",
                          len(decode_idx) / float(B),
@@ -1039,41 +1145,72 @@ class GenerationEngine:
         t_step = time.perf_counter()
         for i in decode_idx:
             st = self._state[i]
-            st.fed += 1
-            tok = sampling.sample_token(
-                logits[i, 0], temperature=st.req.temperature,
-                top_k=st.req.top_k, rng=st.rng)
-            st.generated.append(tok)
-            STAT_ADD("serving.gen_tokens")
-            if len(st.generated) == 1:
-                st.ttft_ms = (t_step - st.t_submit) * 1e3
+            nd = n_draft[i]
+            if nd:
+                STAT_ADD("serving.gen_spec_draft_proposed", nd)
+                # verify row j's logits condition on exactly the tokens
+                # a serial decode would have fed; accept_draft draws
+                # through the same sample_token path with the slot's
+                # rng, so emitted tokens are bit-identical to serial
+                # decode at any temperature (models/sampling.py)
+                emitted, n_acc = sampling.accept_draft(
+                    logits[i, :nd + 1], tokens[i, 1:1 + nd],
+                    temperature=st.req.temperature,
+                    top_k=st.req.top_k, rng=st.rng)
+                STAT_ADD("serving.gen_spec_draft_accepted", n_acc)
                 if _monitor_on():
-                    STAT_OBSERVE("serving.gen_ttft_ms", st.ttft_ms,
-                                 buckets=MS_BUCKETS)
-                if st.span is not None:
-                    # prefill -> decode phase flip at first token
-                    trace.end_span(st.phase_span)
-                    st.phase_span = trace.start_span(
-                        "decode", parent=st.span)
-                if not st.registered:
-                    # the whole prompt (every full block of it) is now
-                    # resident and immutable — shareable from here on
-                    self._register_prefix(st)
-                    st.registered = True
-            elif _monitor_on() and st.t_prev_token is not None:
-                STAT_OBSERVE("serving.gen_inter_token_ms",
-                             (t_step - st.t_prev_token) * 1e3,
-                             buckets=MS_BUCKETS)
-            st.t_prev_token = t_step
-            if st.req.stream_cb is not None:
-                st.req.stream_cb(tok)
-                if st.phase_span is not None:
-                    st.phase_span.add_event(
-                        "stream_flush", token_index=len(st.generated))
-            done_eos = (st.req.eos_id is not None
-                        and tok == st.req.eos_id)
-            if done_eos or len(st.generated) >= st.req.max_new_tokens:
-                self._finish(st, "eos" if done_eos else "length")
-                self._release_slot(i)
+                    STAT_OBSERVE("serving.gen_spec_acceptance_rate",
+                                 n_acc / nd, buckets=FRACTION_BUCKETS)
+                    STAT_OBSERVE("serving.gen_spec_tokens_per_step",
+                                 len(emitted),
+                                 buckets=SPEC_TOKEN_BUCKETS)
+                # the committed token + accepted drafts are now valid
+                # KV; writes past fed (rejected tail) sit beyond the
+                # cursor and are rewritten before any mask reads them
+                st.fed += 1 + n_acc
             else:
-                st.cur = tok
+                emitted = [sampling.sample_token(
+                    logits[i, 0], temperature=st.req.temperature,
+                    top_k=st.req.top_k, rng=st.rng)]
+                st.fed += 1
+            finished = False
+            for tok in emitted:
+                st.generated.append(tok)
+                STAT_ADD("serving.gen_tokens")
+                if len(st.generated) == 1:
+                    st.ttft_ms = (t_step - st.t_submit) * 1e3
+                    if _monitor_on():
+                        STAT_OBSERVE("serving.gen_ttft_ms", st.ttft_ms,
+                                     buckets=MS_BUCKETS)
+                    if st.span is not None:
+                        # prefill -> decode phase flip at first token
+                        trace.end_span(st.phase_span)
+                        st.phase_span = trace.start_span(
+                            "decode", parent=st.span)
+                    if not st.registered:
+                        # the whole prompt (every full block of it) is
+                        # now resident and immutable — shareable from
+                        # here on
+                        self._register_prefix(st)
+                        st.registered = True
+                elif _monitor_on() and st.t_prev_token is not None:
+                    STAT_OBSERVE("serving.gen_inter_token_ms",
+                                 (t_step - st.t_prev_token) * 1e3,
+                                 buckets=MS_BUCKETS)
+                st.t_prev_token = t_step
+                if st.req.stream_cb is not None:
+                    st.req.stream_cb(tok)
+                    if st.phase_span is not None:
+                        st.phase_span.add_event(
+                            "stream_flush",
+                            token_index=len(st.generated))
+                done_eos = (st.req.eos_id is not None
+                            and tok == st.req.eos_id)
+                if done_eos or len(st.generated) >= \
+                        st.req.max_new_tokens:
+                    self._finish(st, "eos" if done_eos else "length")
+                    self._release_slot(i)
+                    finished = True
+                    break
+            if not finished:
+                st.cur = emitted[-1]

@@ -10,8 +10,9 @@ its own handler thread, which blocks in `engine.predict` /
   backpressure status clients should retry with backoff), 504 deadline.
 - ``POST /v1/generate`` body ``{"prompt": [token ids],
   "max_new_tokens": n, "temperature"/"top_k"/"eos_id"/"seed"/
-  "timeout_ms": optional}`` -> ``{"tokens": [...], "finish_reason":
-  "length"|"eos", "ttft_ms", "e2e_ms"}`` from the continuous-batching
+  "timeout_ms"/"spec_decode": optional}`` -> ``{"tokens": [...],
+  "finish_reason": "length"|"eos", "ttft_ms", "e2e_ms"}`` from the
+  continuous-batching
   GenerationEngine; same 400/503/504 error mapping. 404 when the server
   was started without a generation engine.
 - ``GET /healthz``      -> aggregated engine health. 200 with
@@ -288,7 +289,8 @@ class ServingHTTPServer:
                         top_k=req.get("top_k", 0),
                         eos_id=req.get("eos_id"),
                         timeout_ms=req.get("timeout_ms"),
-                        seed=req.get("seed", 0))
+                        seed=req.get("seed", 0),
+                        spec_decode=req.get("spec_decode"))
                 except (KeyError, ValueError, TypeError,
                         json.JSONDecodeError) as e:
                     self._reply(400, {"error": f"bad request: {e}"})

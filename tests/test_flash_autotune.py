@@ -121,11 +121,18 @@ def test_model_configs_carry_no_pinned_tile():
     cfg = transformer.bert_base(use_flash=False)
     assert transformer._flash_block_attrs(cfg) == {"block_q": 0,
                                                    "block_k": 0}
-    # use_flash="auto" stays on the composed path at bench seq lengths
+    # use_flash="auto" stays on the composed path until the measured
+    # end-to-end crossover (ops/attention.py:FLASH_AUTO_MIN_SEQ): flash
+    # lost 37% tok/s at seq 512 and is within noise at 2048, so only
+    # 4096+ flips it
+    from paddle_tpu.ops.attention import FLASH_AUTO_MIN_SEQ
+    assert FLASH_AUTO_MIN_SEQ == 4096
     assert not transformer.bert_base(use_flash="auto",
                                      max_seq_len=512).use_flash
+    assert not transformer.bert_base(use_flash="auto",
+                                     max_seq_len=2048).use_flash
     assert transformer.bert_base(use_flash="auto",
-                                 max_seq_len=2048).use_flash
+                                 max_seq_len=4096).use_flash
 
 
 def test_autotune_cache_roundtrip_and_counters(tmp_path,

@@ -51,6 +51,7 @@ def load(path):
     gen_loadgens, chaos_loadgens, memory_plans = [], [], []
     sharded_benches, trace_reports, router_loadgens = [], [], []
     perf_gates, incident_bundles, goodput_reports = [], [], []
+    spec_loadgens = []
     with open(path) as f:
         for ln, line in enumerate(f, 1):
             line = line.strip()
@@ -85,6 +86,8 @@ def load(path):
                 gen_loadgens.append(rec)
             elif kind == "chaos_loadgen":
                 chaos_loadgens.append(rec)
+            elif kind == "spec_loadgen":
+                spec_loadgens.append(rec)
             elif kind == "router_loadgen":
                 router_loadgens.append(rec)
             elif kind == "program_lint":
@@ -100,7 +103,8 @@ def load(path):
     return (snapshots, results, op_profiles, loadgens, lints,
             graph_opts, gen_loadgens, chaos_loadgens, memory_plans,
             sharded_benches, trace_reports, router_loadgens,
-            perf_gates, incident_bundles, goodput_reports)
+            perf_gates, incident_bundles, goodput_reports,
+            spec_loadgens)
 
 
 def _hist(snap, name):
@@ -111,7 +115,8 @@ def report(path, out=sys.stdout):
     (snapshots, results, op_profiles, loadgens, lints,
      graph_opts, gen_loadgens, chaos_loadgens, memory_plans,
      sharded_benches, trace_reports, router_loadgens,
-     perf_gates, incident_bundles, goodput_reports) = load(path)
+     perf_gates, incident_bundles, goodput_reports,
+     spec_loadgens) = load(path)
     w = out.write
     w(f"runtime stats report — {path}\n")
     if not snapshots and not results and not op_profiles \
@@ -120,7 +125,7 @@ def report(path, out=sys.stdout):
             and not memory_plans and not sharded_benches \
             and not trace_reports and not router_loadgens \
             and not perf_gates and not incident_bundles \
-            and not goodput_reports:
+            and not goodput_reports and not spec_loadgens:
         w("no snapshots or bench results found\n")
         return 1
     w(f"snapshots: {len(snapshots)}   bench results: {len(results)}\n")
@@ -364,6 +369,46 @@ def report(path, out=sys.stdout):
                   f"ttft p50 hit {th} ms vs miss {tm} ms  "
                   f"({pre.get('hit_requests', 0)} hit / "
                   f"{pre.get('miss_requests', 0)} miss)\n")
+
+    sp_steps = c.get("serving.gen_spec_steps")
+    if sp_steps or spec_loadgens:
+        w("\n-- speculative (spec_decode, docs/serving.md) --\n")
+        if sp_steps:
+            prop = c.get("serving.gen_spec_draft_proposed", 0)
+            acc = c.get("serving.gen_spec_draft_accepted", 0)
+            rate = f"{acc / prop:.1%}" if prop else "n/a"
+            w(f"{'verify steps':26s} {int(sp_steps)} of "
+              f"{int(c.get('serving.gen_steps', 0))} decode steps   "
+              f"drafts {int(acc)}/{int(prop)} accepted "
+              f"({rate})\n")
+            tps = _hist(snap, "serving.gen_spec_tokens_per_step")
+            if tps and tps["count"]:
+                w(f"{'tokens per verify step':26s} mean "
+                  f"{tps['sum'] / tps['count']:.2f} "
+                  f"(1 = full reject, k+1 = full accept + bonus)\n")
+        for r in spec_loadgens:
+            s = r.get("spec") or {}
+            b = r.get("baseline") or {}
+            cfg_ = r.get("config") or {}
+            ar = s.get("acceptance_rate")
+            w(f"{'specload[closed]':26s} "
+              f"{r.get('requests', 0)} req  "
+              f"k={cfg_.get('spec_k')}  "
+              f"on {s.get('tokens_per_s', 0)} tok/s vs off "
+              f"{b.get('tokens_per_s', 0)} tok/s  "
+              f"speedup {r.get('speedup')}x  accept "
+              f"{'-' if ar is None else format(ar, '.1%')}  "
+              f"wrong {r.get('wrong_answers', 0)}  "
+              f"post-warmup compiles "
+              f"{s.get('post_warmup_compiles', 0)}+"
+              f"{b.get('post_warmup_compiles', 0)}\n")
+            st = s.get("gen_steps")
+            if st and b.get("gen_steps"):
+                w(f"{'  steps':26s} {st} spec vs "
+                  f"{b['gen_steps']} baseline "
+                  f"({b['gen_steps'] / st:.2f}x fewer dispatches; "
+                  f"{s.get('tokens_per_step')} vs "
+                  f"{b.get('tokens_per_step')} tok/step)\n")
 
     rreq = c.get("serving.router_requests")
     if rreq or router_loadgens:

@@ -169,6 +169,33 @@ def _loadgen_rows(rec) -> List[dict]:
     return rows
 
 
+def _spec_loadgen_rows(rec) -> List[dict]:
+    """Rows for one speculative-decoding A/B record: the speedup (the
+    headline the regression gate should watch), the acceptance rate
+    (the drafter-quality canary — a drafter regression shows here
+    before it shows in wall clock), and both sides' tokens/s."""
+    cfg = rec.get("config") if isinstance(rec.get("config"), dict) \
+        else {}
+    config = f"spec:{_config_digest(cfg)}"
+    rows = []
+    r = _row("spec_loadgen", config, "speedup", rec.get("speedup"),
+             "x", ts=rec.get("ts"))
+    if r:
+        rows.append(r)
+    spec = rec.get("spec") if isinstance(rec.get("spec"), dict) else {}
+    base = rec.get("baseline") \
+        if isinstance(rec.get("baseline"), dict) else {}
+    for metric, src, key, unit in (
+            ("acceptance_rate", spec, "acceptance_rate", "frac"),
+            ("spec_tokens_per_s", spec, "tokens_per_s", "tok/s"),
+            ("baseline_tokens_per_s", base, "tokens_per_s", "tok/s")):
+        r = _row("spec_loadgen", config, metric, src.get(key), unit,
+                 ts=rec.get("ts"))
+        if r:
+            rows.append(r)
+    return rows
+
+
 def rows_from_record(rec) -> Tuple[List[dict], int]:
     """(ledger rows, skipped count) for ONE parsed record/object."""
     if not isinstance(rec, dict):
@@ -201,6 +228,9 @@ def rows_from_record(rec) -> Tuple[List[dict], int]:
     if kind in ("serving_loadgen", "generation_loadgen",
                 "chaos_loadgen", "router_loadgen"):
         rows = _loadgen_rows(rec)
+        return rows, (0 if rows else 1)
+    if kind == "spec_loadgen":
+        rows = _spec_loadgen_rows(rec)
         return rows, (0 if rows else 1)
     if kind == "graph_opt":
         config = f"{rec.get('model', '?')}:O{rec.get('opt_level', 0)}"

@@ -15,7 +15,15 @@ Two workloads:
   mismatch). --shared-prefix-frac makes that fraction of requests open
   with one fixed whole-block prefix: the record gains a "prefix"
   object splitting TTFT hit-vs-miss and snapshotting the paged KV
-  pool; --block-size / --slab pick the KV layout for A/B runs.
+  pool; --block-size / --slab pick the KV layout for A/B runs;
+  --temperature applies one sampling temperature to every request
+  (engine, HTTP and serial paths alike — parity holds at any value).
+  --spec-decode switches to the speculative-decoding A/B
+  (`kind="spec_loadgen"`): a spec-on and a spec-off engine run the
+  same repetitive cyclic-successor traffic over briefly-trained
+  weights, the record carries acceptance rate, effective tokens/step
+  and the on/off tokens-per-second speedup, and every spec-on output
+  is verified against serial kv_generate (exit 4 on divergence).
 
 Two targets:
 
@@ -68,6 +76,10 @@ Usage:
         --rate 50 --duration 10
     python tools/serving_loadgen.py --generate --requests 24 \
         --slots 4 --max-new-tokens 8 --compare-serial --check-compiles
+    python tools/serving_loadgen.py --generate --spec-decode \
+        --spec-k 8 --requests 64 --slots 4 --vocab 8 --max-seq 128 \
+        --max-prompt 8 --max-new-tokens 96 --check-compiles \
+        --out spec.jsonl
     python tools/serving_loadgen.py --chaos --requests 100 \
         --fault-spec "transient_fail:p=0.05,step_nan:p=0.01"
 """
@@ -284,7 +296,8 @@ def summarize_generation(mode, latencies_s, ttfts_s, inter_s, tokens,
 
 
 def make_gen_requests(n, vocab, max_prompt, max_new_tokens, seed=0,
-                      shared_prefix_frac=0.0, shared_prefix_len=0):
+                      shared_prefix_frac=0.0, shared_prefix_len=0,
+                      temperature=0.0):
     """Mixed prompt lengths in [1, max_prompt] — with staggered
     admission this is exactly the traffic that would recompile a
     shape-naive decode path.
@@ -293,7 +306,13 @@ def make_gen_requests(n, vocab, max_prompt, max_new_tokens, seed=0,
     `shared_prefix_len`-token prefix (the shared-system-prompt shape of
     real LLM traffic): the prefix-cache workload. Each request carries
     `"shared": bool` so the report can split TTFT by cohort even when
-    the engine under test has no cache to report hits from."""
+    the engine under test has no cache to report hits from.
+
+    `temperature` rides on every request (engine, HTTP and serial-
+    reference paths all honor it): with the per-request seed, sampled
+    runs stay reproducible AND --compare-serial stays meaningful at
+    temperature > 0 — both paths draw through the same
+    models/sampling.py rng discipline."""
     rng = np.random.RandomState(seed)
     prefix = rng.randint(0, vocab, size=max(int(shared_prefix_len),
                                             0)).tolist()
@@ -310,7 +329,31 @@ def make_gen_requests(n, vocab, max_prompt, max_new_tokens, seed=0,
                 1, max_prompt + 1)).tolist()
         out.append({"prompt": prompt,
                     "max_new_tokens": int(max_new_tokens),
-                    "seed": int(seed + i), "idx": i, "shared": shared})
+                    "seed": int(seed + i), "idx": i, "shared": shared,
+                    "temperature": float(temperature)})
+    return out
+
+
+def make_spec_requests(n, vocab, max_prompt, max_new_tokens, seed=0,
+                       temperature=0.0):
+    """Repetitive generation traffic for the --spec-decode A/B: every
+    prompt is a run of the cyclic-successor sequence ((t+1) % vocab
+    follows t — the task the spec mode trains its tiny model on), so
+    greedy continuations are deterministic and, once the generation
+    wraps the vocab cycle, the n-gram drafter's suffix lookup starts
+    hitting — the repetition-heavy regime speculative decoding exists
+    for. Requests still vary in start token, length and seed so slots
+    join/leave the batch staggered."""
+    rng = np.random.RandomState(seed)
+    out = []
+    for i in range(n):
+        s = int(rng.randint(vocab))
+        plen = int(rng.randint(2, max_prompt + 1))
+        prompt = [(s + j) % vocab for j in range(plen)]
+        out.append({"prompt": prompt,
+                    "max_new_tokens": int(max_new_tokens),
+                    "seed": int(seed + i), "idx": i, "shared": False,
+                    "temperature": float(temperature)})
     return out
 
 
@@ -376,20 +419,19 @@ class _GenEngineTarget:
                                     attrs={"idx": req.get("idx")})
         t0 = time.perf_counter()
         try:
+            greq = GenerationRequest(
+                req["prompt"], req["max_new_tokens"],
+                temperature=req.get("temperature", 0.0),
+                seed=req["seed"], timeout_ms=timeout_ms,
+                spec_decode=req.get("spec_decode"),
+                stream_cb=lambda tok: times.append(
+                    time.perf_counter()))
             if root is not None:
                 from paddle_tpu import trace
                 with trace.use_span(root):
-                    resp = self.engine.submit(GenerationRequest(
-                        req["prompt"], req["max_new_tokens"],
-                        seed=req["seed"], timeout_ms=timeout_ms,
-                        stream_cb=lambda tok: times.append(
-                            time.perf_counter())))
+                    resp = self.engine.submit(greq)
             else:
-                resp = self.engine.submit(GenerationRequest(
-                    req["prompt"], req["max_new_tokens"],
-                    seed=req["seed"], timeout_ms=timeout_ms,
-                    stream_cb=lambda tok: times.append(
-                        time.perf_counter())))
+                resp = self.engine.submit(greq)
             out = resp.result(
                 timeout=(timeout_ms or 30000.0) / 1e3 + 30.0)
         except Exception as e:
@@ -421,7 +463,9 @@ class _GenHTTPTarget:
         import urllib.request
         body = json.dumps({"prompt": req["prompt"],
                            "max_new_tokens": req["max_new_tokens"],
+                           "temperature": req.get("temperature", 0.0),
                            "seed": req["seed"],
+                           "spec_decode": req.get("spec_decode"),
                            "timeout_ms": timeout_ms}).encode()
         r = urllib.request.Request(
             self.url + "/v1/generate", data=body,
@@ -449,7 +493,7 @@ def run_serial_generation(exe, scope, prog, step, reqs):
         out = gpt.kv_generate(
             exe, scope, prog, step.token_var, step.logits_var,
             step.cache_names, req["prompt"], req["max_new_tokens"],
-            seed=req["seed"],
+            temperature=req.get("temperature", 0.0), seed=req["seed"],
             stream_cb=lambda tok: times.append(time.perf_counter()))
         latencies.append(time.perf_counter() - t1)
         stats.record(t1, times, len(out))
@@ -556,14 +600,17 @@ def run_generation(args):
         prefix_len = (max(args.max_prompt - 1, 1)
                       // block_size) * block_size
         prefix_len = max(prefix_len, 0)
+    temperature = getattr(args, "temperature", 0.0) or 0.0
     reqs = make_gen_requests(args.requests, args.vocab, args.max_prompt,
                              args.max_new_tokens, args.seed,
                              shared_prefix_frac=prefix_frac,
-                             shared_prefix_len=prefix_len)
+                             shared_prefix_len=prefix_len,
+                             temperature=temperature)
     common = {"concurrency": args.concurrency, "rate": args.rate,
               "slots": args.slots, "max_prompt": args.max_prompt,
               "max_new_tokens": args.max_new_tokens,
               "max_seq": args.max_seq, "vocab": args.vocab,
+              "temperature": temperature,
               "shared_prefix_frac": prefix_frac,
               "shared_prefix_len": prefix_len}
     if args.trace and args.url:
@@ -689,6 +736,154 @@ def run_generation(args):
         return 3
     if trace_fail:
         return 6
+    return 0
+
+
+def run_spec_generation(args):
+    """--generate --spec-decode: the speculative-decoding A/B.
+
+    Trains the tiny GPT on the cyclic-successor task first (seconds on
+    CPU; greedy continuations become deterministic), then drives the
+    SAME repetitive closed-loop traffic (make_spec_requests) through a
+    spec-ON and a spec-OFF paged engine sharing the trained weights,
+    and finally replays every request through the serial kv_generate
+    reference for the exact-answer check. Emits one
+    kind="spec_loadgen" record (schema: tools/validate_bench_json.py)
+    carrying acceptance rate, effective tokens/step and the on/off
+    tokens-per-second speedup. Exit 4 when any spec-on output diverges
+    from the serial reference; 3 (--check-compiles) when either engine
+    compiled anything post-warmup."""
+    import paddle_tpu as fluid
+    from paddle_tpu import monitor
+    from paddle_tpu.models import gpt
+    from paddle_tpu.serving import GenerationEngine
+
+    if args.url or args.rate > 0 or args.trace:
+        print("--spec-decode is an in-process closed-loop A/B; "
+              "--url/--rate/--trace are not supported", file=sys.stderr)
+        return 2
+    temperature = getattr(args, "temperature", 0.0) or 0.0
+    vocab = args.vocab
+    spec_k = args.spec_k if args.spec_k > 0 \
+        else int(fluid.FLAGS.spec_decode_k)
+    spec_ngram = int(fluid.FLAGS.spec_decode_ngram)
+    cfg = gpt.gpt_small(vocab_size=vocab, d_model=32, n_heads=4,
+                        n_layers=2, d_ff=64, max_seq_len=args.max_seq,
+                        dropout=0.0, use_flash=False)
+    scope = fluid.Scope()
+    train_main, train_start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(train_main, train_start), \
+            fluid.scope_guard(scope):
+        # train at the FULL decode length: every positional-embedding
+        # row a generation can reach must learn the task, or greedy
+        # continuations drift off the cycle past the trained horizon
+        # (tanking draft acceptance for long requests)
+        t_seq = int(args.max_seq)
+        loss, _, _ = gpt.build_train(cfg, batch=8, seq_len=t_seq,
+                                     lr=5e-3)
+        exe = fluid.Executor()
+        exe.run(train_start)
+        base = np.arange(t_seq) % vocab
+        toks = np.stack([(base + i) % vocab
+                         for i in range(8)]).astype(np.int64)
+        for _ in range(40):
+            exe.run(train_main, feed={"tokens": toks},
+                    fetch_list=[loss])
+
+    reqs = make_spec_requests(args.requests, vocab, args.max_prompt,
+                              args.max_new_tokens, args.seed,
+                              temperature=temperature)
+    fluid.set_flags({"FLAGS_enable_monitor": True})
+
+    def one_run(spec_on):
+        monitor.STAT_RESET()
+        eng = GenerationEngine(
+            cfg, scope, exe=fluid.Executor(), max_slots=args.slots,
+            max_seq=args.max_seq, default_timeout_ms=args.timeout_ms,
+            block_size=(getattr(args, "block_size", 0) or None),
+            spec_decode=spec_on, spec_k=spec_k)
+        eng.start()
+        stats = _GenStats()
+        target = _GenEngineTarget(eng, stats)
+        lat, errs, dur = run_closed(target, reqs, args.concurrency,
+                                    args.timeout_ms)
+        c = monitor.get_stats_snapshot()["counters"]
+        post = eng.post_warmup_compiles()
+        eng.stop()
+        steps = int(c.get("serving.gen_steps", 0))
+        side = {
+            "duration_s": round(dur, 4),
+            "errors": errs,
+            "tokens": int(stats.tokens),
+            "tokens_per_s": round(stats.tokens / dur, 2) if dur
+            else 0.0,
+            "gen_steps": steps,
+            # batch-level: generated tokens per decode dispatch (> 1
+            # needs either multi-slot occupancy or accepted drafts)
+            "tokens_per_step": round(stats.tokens / steps, 3)
+            if steps else None,
+            "latency_ms": _lat_summary(lat),
+            "post_warmup_compiles": post,
+        }
+        if spec_on:
+            prop = int(c.get("serving.gen_spec_draft_proposed", 0))
+            acc = int(c.get("serving.gen_spec_draft_accepted", 0))
+            side.update({
+                "spec_steps": int(c.get("serving.gen_spec_steps", 0)),
+                "draft_proposed": prop,
+                "draft_accepted": acc,
+                "acceptance_rate": round(acc / prop, 4) if prop
+                else None,
+            })
+        return side, stats
+
+    base_side, _ = one_run(False)
+    spec_side, spec_stats = one_run(True)
+
+    # exact-answer reference: serial kv_generate over the same trained
+    # weights (unprefixed batch=1 graph, no collision with gen. state)
+    dec_main, dec_start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(dec_main, dec_start):
+        step1 = gpt.build_decode_step(cfg, batch=1,
+                                      max_seq=args.max_seq)
+    _, _, _, souts = run_serial_generation(
+        fluid.Executor(), scope, dec_main, step1, reqs)
+    wrong = sum(1 for i, toks in souts.items()
+                if i in spec_stats.outputs
+                and [int(t) for t in spec_stats.outputs[i]]
+                != [int(t) for t in toks])
+    compared = sum(1 for i in souts if i in spec_stats.outputs)
+
+    off_tps = base_side["tokens_per_s"]
+    rec = {
+        "kind": "spec_loadgen",
+        "mode": "closed",
+        "requests": len(reqs),
+        "wrong_answers": wrong,
+        "compared_requests": compared,
+        "speedup": round(spec_side["tokens_per_s"] / off_tps, 3)
+        if off_tps else None,
+        "spec": spec_side,
+        "baseline": base_side,
+        "config": {"concurrency": args.concurrency,
+                   "slots": args.slots,
+                   "max_prompt": args.max_prompt,
+                   "max_new_tokens": args.max_new_tokens,
+                   "max_seq": args.max_seq, "vocab": vocab,
+                   "temperature": temperature,
+                   "spec_k": spec_k, "spec_ngram": spec_ngram},
+    }
+    emit(rec, args.out)
+    if wrong:
+        print(f"FAIL: {wrong} spec-on outputs diverge from the serial "
+              f"reference", file=sys.stderr)
+        return 4
+    post = (spec_side["post_warmup_compiles"]
+            + base_side["post_warmup_compiles"])
+    if args.check_compiles and post > 0:
+        print(f"FAIL: {post} compiles after spec A/B warmup",
+              file=sys.stderr)
+        return 3
     return 0
 
 
@@ -1193,6 +1388,20 @@ def main(argv=None):
     ap.add_argument("--max-seq", type=int, default=32,
                     help="generation KV-cache length")
     ap.add_argument("--vocab", type=int, default=64)
+    ap.add_argument("--temperature", type=float, default=0.0,
+                    help="generation sampling temperature, honored by "
+                         "the engine, HTTP and serial-reference paths "
+                         "alike (0 = greedy); with per-request seeds "
+                         "--compare-serial stays exact at any value")
+    ap.add_argument("--spec-decode", action="store_true",
+                    help="generation only: speculative-decoding A/B — "
+                         "spec-on vs spec-off engines over the same "
+                         "repetitive traffic plus the serial exact-"
+                         "answer reference (kind=spec_loadgen; exit 4 "
+                         "on divergence)")
+    ap.add_argument("--spec-k", type=int, default=0,
+                    help="draft tokens per slot per verify step for "
+                         "--spec-decode (0 = FLAGS_spec_decode_k)")
     ap.add_argument("--shared-prefix-frac", type=float, default=0.0,
                     help="fraction of generation requests opening with "
                          "one fixed shared prefix (the prefix-cache "
@@ -1256,6 +1465,8 @@ def main(argv=None):
     if args.chaos:
         return run_chaos(args)
     if args.generate:
+        if args.spec_decode:
+            return run_spec_generation(args)
         return run_generation(args)
 
     seq_buckets = tuple(int(s) for s in args.seq_buckets.split(","))

@@ -99,6 +99,17 @@ CONFIGS = [
     # instrumented request path is a measured number, not a claim
     ("gen_trace_on", None),  # special-cased below
     ("gen_trace_off", None),  # special-cased below
+    # speculative-decoding A/B (FLAGS_gen_spec_decode, docs/serving.md
+    # "Speculative decoding"): identical generation loadgen traffic —
+    # the standard MIXED-RANDOM prompts, where the n-gram drafter
+    # rarely fires — with the engine default on vs off, both
+    # serial-verified (rc 4 on divergence). The pair bounds the
+    # worst-case cost of shipping spec-on as a default: random traffic
+    # must stay bit-exact and lose at most noise, while the dedicated
+    # --spec-decode repetitive-workload speedup is measured by
+    # tools/serving_loadgen.py itself (kind=spec_loadgen)
+    ("gen_spec_on", None),  # special-cased below
+    ("gen_spec_off", None),  # special-cased below
     # chaos acceptance (serving_loadgen --chaos): serving traffic under
     # FLAGS_fault_spec; the ledger entry records the p99 inflation and
     # the zero-wrong-answers / zero-worker-deaths verdict (rc 4/5 when
@@ -454,6 +465,49 @@ def run_special(key):
                 "trace_sample": 0.05 if traced else None,
                 "inter_token_p99_ms":
                     (cont.get("inter_token_ms") or {}).get("p99"),
+                "post_warmup_compiles":
+                    (cont.get("cache") or {}).get("post_warmup_compiles"),
+                }, None
+    if key in ("gen_spec_on", "gen_spec_off"):
+        # speculative-decoding default A/B: same mixed-random loadgen
+        # traffic, only FLAGS_gen_spec_decode flips. --compare-serial
+        # keeps both cells bit-exact-verified (rc 4 on divergence) —
+        # the cell pair records what spec-on costs traffic the drafter
+        # can't help with, not the repetitive-workload win (that is
+        # the --spec-decode run's kind=spec_loadgen record)
+        spec_on = key == "gen_spec_on"
+        out_path = f"/tmp/gen_{key}_{ROUND}.jsonl"
+        env = dict(os.environ,
+                   FLAGS_gen_spec_decode=str(int(spec_on)),
+                   FLAGS_enable_monitor="1")
+        p = subprocess.run(
+            [sys.executable, "tools/serving_loadgen.py", "--generate",
+             "--slots", "4", "--requests", "24", "--compare-serial",
+             "--check-compiles", "--out", out_path],
+            cwd=REPO, capture_output=True, text=True, timeout=1800,
+            env=env)
+        if p.returncode != 0:
+            # rc 4 = engine/serial divergence, rc 3 = post-warmup
+            # recompile: both are spec-decode regressions, not flakes
+            return None, (f"rc={p.returncode}: "
+                          + (p.stdout + p.stderr)[-300:])
+        recs = []
+        try:
+            with open(out_path) as f:
+                recs = [json.loads(ln) for ln in f if ln.strip()]
+        except (OSError, ValueError) as e:
+            return None, f"unreadable {out_path}: {e}"
+        cont = next((r for r in recs
+                     if r.get("kind") == "generation_loadgen"
+                     and r.get("mode") != "serial_baseline"), None)
+        if cont is None or not cont.get("tokens_per_s"):
+            return None, "no generation_loadgen record with tokens_per_s"
+        serial = next((r for r in recs
+                       if r.get("mode") == "serial_baseline"), {})
+        return {"metric": "gen_tokens_per_s",
+                "value": cont["tokens_per_s"], "unit": "tok/s",
+                "spec_decode": "on" if spec_on else "off",
+                "wrong_answers": serial.get("wrong_answers"),
                 "post_warmup_compiles":
                     (cont.get("cache") or {}).get("post_warmup_compiles"),
                 }, None

@@ -22,6 +22,11 @@ Checks, per file (type auto-detected from content):
   loadgen contract plus fault_spec and the chaos verdict
   (wrong_answers/worker_deaths, both required to be ZERO, and the
   baseline/chaos p99 pair with its inflation bound); lines with
+  kind == "spec_loadgen" (tools/serving_loadgen.py --generate
+  --spec-decode) carry the speculative-decoding A/B contract: spec and
+  baseline side objects with tokens/tokens_per_s/gen_steps, the spec
+  side's draft accounting (acceptance_rate in [0,1]), the on/off
+  speedup, and wrong_answers required to be ZERO; lines with
   kind == "router_loadgen" (tools/serving_loadgen.py --router N) carry
   the loadgen contract plus replicas/redispatches/shed, the 1->N
   scaling block, and zero-gated preempt / hot_swap / chaos drill
@@ -199,6 +204,63 @@ def validate_generation_loadgen(obj, where="generation_loadgen"):
                                           or isinstance(v, bool)):
                         errs.append(f"{where}: prefix.{field}.{q} must "
                                     f"be numeric (got {v!r})")
+    return errs
+
+
+def validate_spec_loadgen(obj, where="spec_loadgen"):
+    """Schema of one tools/serving_loadgen.py --generate --spec-decode
+    record: the speculative-decoding A/B. Both sides ("spec" and
+    "baseline") carry tokens / tokens_per_s / gen_steps; the spec side
+    adds the drafter accounting (draft_proposed / draft_accepted /
+    acceptance_rate in [0,1]); wrong_answers must be ZERO — the record
+    documents bit-exact parity with the serial reference, not a
+    best-effort tally."""
+    errs = []
+    if not isinstance(obj.get("mode"), str):
+        errs.append(f"{where}: mode must be a string "
+                    f"(got {obj.get('mode')!r})")
+    for key in ("requests", "compared_requests"):
+        v = obj.get(key)
+        if not isinstance(v, int) or isinstance(v, bool):
+            errs.append(f"{where}: {key} must be an int (got {v!r})")
+    wrong = obj.get("wrong_answers")
+    if not isinstance(wrong, int) or isinstance(wrong, bool):
+        errs.append(f"{where}: wrong_answers must be an int "
+                    f"(got {wrong!r})")
+    elif wrong != 0:
+        errs.append(f"{where}: wrong_answers={wrong} violates the "
+                    f"bit-exact speculative-decoding contract")
+    sp = obj.get("speedup")
+    if sp is not None and (not isinstance(sp, (int, float))
+                           or isinstance(sp, bool)):
+        errs.append(f"{where}: speedup must be numeric or null "
+                    f"(got {sp!r})")
+    for side in ("spec", "baseline"):
+        s = obj.get(side)
+        if not isinstance(s, dict):
+            errs.append(f"{where}: {side} must be an object")
+            continue
+        for key in ("duration_s", "errors", "tokens", "tokens_per_s",
+                    "gen_steps", "post_warmup_compiles"):
+            v = s.get(key)
+            if not isinstance(v, (int, float)) or isinstance(v, bool):
+                errs.append(f"{where}: {side}.{key} must be numeric "
+                            f"(got {v!r})")
+    s = obj.get("spec")
+    if isinstance(s, dict):
+        for key in ("spec_steps", "draft_proposed", "draft_accepted"):
+            v = s.get(key)
+            if not isinstance(v, int) or isinstance(v, bool):
+                errs.append(f"{where}: spec.{key} must be an int "
+                            f"(got {v!r})")
+        ar = s.get("acceptance_rate")
+        if ar is not None and (not isinstance(ar, (int, float))
+                               or isinstance(ar, bool)
+                               or not 0.0 <= ar <= 1.0):
+            errs.append(f"{where}: spec.acceptance_rate must be in "
+                        f"[0, 1] or null (got {ar!r})")
+    if not isinstance(obj.get("config"), dict):
+        errs.append(f"{where}: config must be an object")
     return errs
 
 
@@ -816,6 +878,9 @@ def validate_jsonl(path):
                     rec, where=f"{path}:{ln}"))
             elif rec.get("kind") == "chaos_loadgen":
                 errs.extend(validate_chaos_loadgen(
+                    rec, where=f"{path}:{ln}"))
+            elif rec.get("kind") == "spec_loadgen":
+                errs.extend(validate_spec_loadgen(
                     rec, where=f"{path}:{ln}"))
             elif rec.get("kind") == "router_loadgen":
                 errs.extend(validate_router_loadgen(
