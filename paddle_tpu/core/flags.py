@@ -451,6 +451,29 @@ DEFINE_int32(
     "tokens that followed the most recent earlier occurrence; 0 "
     "disables drafting (the verify path then never dispatches).")
 
+DEFINE_bool(
+    "spec_decode_adaptive", True,
+    "Acceptance-aware adaptive draft length (serving/spec_decode.py "
+    "update_spec_k): each slot tracks an EWMA of its measured draft "
+    "acceptance rate and shrinks its per-iteration draft budget toward "
+    "1 when acceptance stops paying for the verify premium (EWMA < "
+    "FLAGS_spec_adapt_low), growing it back toward FLAGS_spec_decode_k "
+    "when acceptance recovers (EWMA > FLAGS_spec_adapt_high). Host-side "
+    "only: the verify executable stays compiled at [max_slots, k+1] and "
+    "accepted outputs are unchanged — only the proposed draft length "
+    "moves.")
+
+DEFINE_double(
+    "spec_adapt_low", 0.3,
+    "Adaptive spec_k shrink threshold: when a slot's acceptance-rate "
+    "EWMA drops below this, its draft budget shrinks by 1 (floor 1).")
+
+DEFINE_double(
+    "spec_adapt_high", 0.8,
+    "Adaptive spec_k grow threshold: when a slot's acceptance-rate "
+    "EWMA rises above this, its draft budget grows by 1 (cap "
+    "FLAGS_spec_decode_k).")
+
 DEFINE_double(
     "serving_default_timeout_ms", 1000.0,
     "Default EngineConfig.default_timeout_ms: per-request deadline "
@@ -546,6 +569,24 @@ DEFINE_double(
     "Hot-swap / deregister drain deadline: how long the router waits "
     "for a retired replica's in-flight requests to finish before "
     "stopping it anyway.")
+
+DEFINE_bool(
+    "router_disagg", False,
+    "Disaggregated prefill/decode dispatch (paddle_tpu/serving/"
+    "disagg.py): Router.generate() runs two-phase scheduling — pick a "
+    "decode replica, and when the fleet prefix store says it does not "
+    "already own the prompt's full-block chain, have a prefill-capable "
+    "replica export the KV blocks over the wire and the decode replica "
+    "adopt them before the decode dispatch. Off (default) = classic "
+    "single-phase routing; transfer failures always fall back to the "
+    "decode worker re-prefilling locally, so answers never change.")
+
+DEFINE_int32(
+    "disagg_fleet_prefix_max", 4096,
+    "FleetPrefixStore capacity: at most this many chain-hash entries "
+    "(hash -> owning replica names) are kept on the router, LRU-evicted "
+    "past the cap. Eviction only forgets WHERE a prefix lives — the "
+    "worst case is a redundant re-prefill, never a wrong answer.")
 
 DEFINE_bool(
     "serving_nan_guard", True,

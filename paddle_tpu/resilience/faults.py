@@ -52,7 +52,7 @@ __all__ = ["TransientFault", "FaultSpecError", "FaultInjector",
            "injector", "parse_fault_spec", "reset_injector"]
 
 _KINDS = ("step_nan", "slow_step", "transient_fail", "preempt_at")
-_SITES = ("executor", "reader", "serving", "generation")
+_SITES = ("executor", "reader", "serving", "generation", "gen_prefill")
 
 
 class TransientFault(RuntimeError):

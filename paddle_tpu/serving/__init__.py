@@ -34,8 +34,12 @@ from .generation import (GenerationEngine, GenerationRequest,  # noqa: F401
 from .http import ServingHTTPServer, serve  # noqa: F401
 from .kv_blocks import (BlockPool, PrefixCache,  # noqa: F401
                         blocks_for_tokens)
+from .disagg import (FleetPrefixStore, adopt_prefix,  # noqa: F401
+                     export_prefix)
+from .kv_wire import (KVShipment, pack_blocks,  # noqa: F401
+                      unpack_blocks)
 from .router import Replica, Router, RouterHTTP  # noqa: F401
-from .spec_decode import NgramDrafter  # noqa: F401
+from .spec_decode import NgramDrafter, update_spec_k  # noqa: F401
 
 __all__ = ["BucketLadder", "DynamicBatcher", "EngineConfig",
            "ServingEngine", "ServingHTTPServer", "serve", "ServingError",
@@ -43,4 +47,6 @@ __all__ = ["BucketLadder", "DynamicBatcher", "EngineConfig",
            "OverloadedError", "GenerationEngine", "GenerationRequest",
            "SlotManager", "BlockPool", "PrefixCache",
            "blocks_for_tokens", "Replica", "Router", "RouterHTTP",
-           "NgramDrafter"]
+           "NgramDrafter", "update_spec_k", "FleetPrefixStore",
+           "export_prefix", "adopt_prefix", "KVShipment",
+           "pack_blocks", "unpack_blocks"]

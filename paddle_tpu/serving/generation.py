@@ -172,7 +172,8 @@ class _SlotState:
     __slots__ = ("req", "response", "fed", "cur", "generated", "rng",
                  "needs_reset", "deadline", "t_submit", "t_prev_token",
                  "ttft_ms", "blocks", "n_cached", "registered",
-                 "span", "phase_span", "fetch_s")
+                 "span", "phase_span", "fetch_s",
+                 "spec_k_cur", "spec_acc_ewma")
 
     def __init__(self, req: GenerationRequest, response: _Response,
                  deadline: Optional[float], t_submit: float):
@@ -201,6 +202,10 @@ class _SlotState:
         self.span = None
         self.phase_span = None
         self.fetch_s = 0.0
+        # adaptive speculative decoding: per-slot draft budget and
+        # acceptance-rate EWMA (None until the first measured ratio)
+        self.spec_k_cur: Optional[int] = None
+        self.spec_acc_ewma: Optional[float] = None
 
 
 class _Queued:
@@ -239,7 +244,8 @@ class GenerationEngine:
                  block_size: Optional[int] = None,
                  kv_pool_blocks: Optional[int] = None,
                  spec_decode: Optional[bool] = None,
-                 spec_k: Optional[int] = None):
+                 spec_k: Optional[int] = None,
+                 spec_adaptive: Optional[bool] = None):
         import paddle_tpu as fluid
         from ..core.flags import FLAGS
         from ..models import gpt
@@ -326,9 +332,20 @@ class GenerationEngine:
                 max_ngram=int(FLAGS.spec_decode_ngram), k=self.spec_k)
         else:
             self.spec_decode = False
+        # acceptance-aware adaptive draft length: host-side only (the
+        # verify executable is still [max_slots, spec_k+1]); a slot
+        # whose measured acceptance stops paying for the verify premium
+        # shrinks its own proposal budget toward 1
+        self.spec_adaptive = bool(
+            FLAGS.spec_decode_adaptive if spec_adaptive is None
+            else spec_adaptive) and self.spec_decode
         self._slots = SlotManager(self.max_slots)
         self._state: List[Optional[_SlotState]] = \
             [None] * self.max_slots
+        # serializes paged KV structures (BlockPool / PrefixCache / the
+        # pool arrays themselves) between the worker's iteration and
+        # cross-process export/adopt (serving/disagg.py)
+        self._kv_mutex = threading.Lock()
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._queue: List[_Queued] = []
@@ -624,6 +641,22 @@ class GenerationEngine:
     def _set_block_gauges(self):
         STAT_SET("serving.gen_kv_blocks_free", self._pool.free_count())
 
+    def _adapt_spec_k(self, st: _SlotState, rate: float):
+        """Fold one measured acceptance ratio into the slot's draft
+        budget (spec_decode.update_spec_k). Gauge reflects the most
+        recently adapted slot's budget."""
+        from .spec_decode import update_spec_k
+        from ..core.flags import FLAGS
+        st.spec_k_cur, st.spec_acc_ewma, moved = update_spec_k(
+            st.spec_k_cur, st.spec_acc_ewma, rate,
+            k_max=self.spec_k, low=float(FLAGS.spec_adapt_low),
+            high=float(FLAGS.spec_adapt_high))
+        if moved < 0:
+            STAT_ADD("serving.gen_spec_k_shrinks")
+        elif moved > 0:
+            STAT_ADD("serving.gen_spec_k_grows")
+        STAT_SET("serving.gen_spec_k_effective", st.spec_k_cur)
+
     def _admit_trace(self, st: _SlotState, q: "_Queued"):
         """Queue -> prefill phase transition on the request's span tree
         (admission happens on the worker thread — the span rode the
@@ -815,7 +848,10 @@ class GenerationEngine:
                 continue
             if self.paged:
                 t_busy0 = time.perf_counter()
-                self._paged_iteration()
+                # _kv_mutex: disagg export/adopt (serving/disagg.py)
+                # mutates the same pools/PrefixCache between iterations
+                with self._kv_mutex:
+                    self._paged_iteration()
                 _goodput.gen_busy(time.perf_counter() - t_busy0)
                 continue
 
@@ -978,15 +1014,19 @@ class GenerationEngine:
             arr_start[i] = st.fed
 
         def run_guarded(prog, step, tokens, table, start, nvalid,
-                        idx, what):
+                        idx, what, site="generation"):
             """Shared failure envelope: injector pre-step faults retry
             (RetryPolicy), anything after the real dispatch fails the
             involved slots — KV already advanced, a replay would
-            double-write. Returns the fetch or None."""
+            double-write. Returns the fetch or None. `site` names the
+            fault-injection hook (prefill chunks get their own,
+            "gen_prefill", so drills can slow prefill without touching
+            decode — the disagg loadgen's machine-independent
+            service-time knob)."""
             def _attempt():
                 inj = _fault_injector()
                 if inj is not None:
-                    inj.pre_step("generation")
+                    inj.pre_step(site)
                 return self._run_paged(prog, step, tokens, table,
                                        start, nvalid)
             try:
@@ -1031,7 +1071,8 @@ class GenerationEngine:
                 chunk_n[i] = n
             probe = run_guarded(self._prefill_prog, self.prefill_step,
                                 tokens, table, start, nvalid,
-                                prefill_idx, "prefill")
+                                prefill_idx, "prefill",
+                                site="gen_prefill")
             if probe is None:
                 return
             if FLAGS.serving_nan_guard:
@@ -1082,7 +1123,11 @@ class GenerationEngine:
                 # request's remaining token budget (the verify row
                 # already emits one token beyond the accepted drafts)
                 need = len(st.req.prompt) + st.req.max_new_tokens - 1
-                cap = min(self.spec_k, need - 1 - st.fed,
+                if st.spec_k_cur is None:
+                    st.spec_k_cur = self.spec_k
+                k_slot = st.spec_k_cur if self.spec_adaptive \
+                    else self.spec_k
+                cap = min(k_slot, need - 1 - st.fed,
                           st.req.max_new_tokens - len(st.generated) - 1)
                 if cap < 1:
                     continue
@@ -1168,6 +1213,8 @@ class GenerationEngine:
                 # KV; writes past fed (rejected tail) sit beyond the
                 # cursor and are rewritten before any mask reads them
                 st.fed += 1 + n_acc
+                if self.spec_adaptive:
+                    self._adapt_spec_k(st, n_acc / nd)
             else:
                 emitted = [sampling.sample_token(
                     logits[i, 0], temperature=st.req.temperature,

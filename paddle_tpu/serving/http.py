@@ -15,6 +15,12 @@ its own handler thread, which blocks in `engine.predict` /
   continuous-batching
   GenerationEngine; same 400/503/504 error mapping. 404 when the server
   was started without a generation engine.
+- ``POST /v1/kv/export`` body ``{"prompt": [token ids],
+  "run_prefill": optional}`` -> a ``kv_wire`` shipment (the prompt's
+  full-block KV prefix, prefilled locally if needed), and
+  ``POST /v1/kv/adopt`` body = a shipment -> adoption summary; the
+  disaggregated-fleet transfer hop (serving/disagg.py,
+  docs/serving.md). 404 unless a *paged* generation engine is attached.
 - ``GET /healthz``      -> aggregated engine health. 200 with
   ``{"state": "ok"|"degraded", ...}`` while every attached engine is
   ready (degraded = some circuit breaker is half-open and probing);
@@ -130,6 +136,12 @@ class ServingHTTPServer:
                     else:
                         h = {"state": "ready" if e.ready
                              else "warming"}
+                    if hasattr(e, "post_warmup_compiles"):
+                        h = dict(h)
+                        h["post_warmup_compiles"] = \
+                            e.post_warmup_compiles()
+                    if hasattr(e, "kv_block_stats"):
+                        h["kv"] = e.kv_block_stats()
                     detail[name] = h
                     if _STATE_RANK.get(h["state"], 4) > \
                             _STATE_RANK.get(worst, 4):
@@ -226,6 +238,9 @@ class ServingHTTPServer:
                 if self.path.startswith("/v1/generate"):
                     self._generate()
                     return
+                if self.path.startswith("/v1/kv/"):
+                    self._kv()
+                    return
                 if not self.path.startswith("/v1/predict") \
                         or eng is None:
                     self._reply(404, {"error": f"no route {self.path}"})
@@ -315,6 +330,56 @@ class ServingHTTPServer:
                     return
                 except ValueError as e:
                     self._reply(400, {"error": f"bad request: {e}"})
+                    return
+                self._reply(200, out)
+
+            def _kv(self):
+                """Disaggregated KV transfer (serving/disagg.py):
+                /v1/kv/export packs a prompt's full-block prefix into a
+                kv_wire shipment; /v1/kv/adopt unpacks one into the
+                local pool. 404 unless a paged generation engine is
+                attached."""
+                from . import disagg
+                if gen is None or not getattr(gen, "paged", False):
+                    self._reply(404, {"error": "no paged generation "
+                                               "engine attached"})
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(length) or b"{}")
+                except json.JSONDecodeError as e:
+                    self._reply(400, {"error": f"bad request: {e}"})
+                    return
+                try:
+                    if self.path.startswith("/v1/kv/export"):
+                        out = disagg.export_prefix(
+                            gen, req["prompt"],
+                            run_prefill=bool(
+                                req.get("run_prefill", True)))
+                    elif self.path.startswith("/v1/kv/adopt"):
+                        out = disagg.adopt_prefix(gen, req)
+                    else:
+                        self._reply(404, {"error":
+                                          f"no route {self.path}"})
+                        return
+                except (KeyError, ValueError, TypeError) as e:
+                    self._reply(400, {"error": f"bad request: {e}"})
+                    return
+                except OverloadedError as e:
+                    self._reply(503, {"error": str(e),
+                                      "retryable": True},
+                                headers=_retry_after_hdr(e))
+                    return
+                except QueueFullError as e:
+                    self._reply(503, {"error": str(e),
+                                      "retryable": True})
+                    return
+                except DeadlineExceededError as e:
+                    self._reply(504, {"error": str(e)})
+                    return
+                except EngineClosedError as e:
+                    self._reply(503, {"error": str(e),
+                                      "retryable": False})
                     return
                 self._reply(200, out)
 

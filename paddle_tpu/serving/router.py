@@ -85,7 +85,8 @@ class Replica:
 
     def __init__(self, name: str, engine=None, gen_engine=None,
                  url: Optional[str] = None, version: str = "v1",
-                 failure_threshold: Optional[int] = None):
+                 failure_threshold: Optional[int] = None,
+                 role: str = "unified"):
         if url is None and engine is None and gen_engine is None:
             raise ValueError(f"replica {name!r} needs engine, "
                              "gen_engine, or url")
@@ -93,11 +94,16 @@ class Replica:
                                 or gen_engine is not None):
             raise ValueError(f"replica {name!r}: url= and in-process "
                              "engines are mutually exclusive")
+        if role not in ("unified", "prefill", "decode"):
+            raise ValueError(
+                f"replica {name!r}: role must be unified, prefill, or "
+                f"decode, got {role!r}")
         self.name = name
         self.engine = engine
         self.gen_engine = gen_engine
         self.url = url.rstrip("/") if url else None
         self.version = version
+        self.role = role
         self.registered = True
         self.healthy = True          # last probe verdict
         self.backoff_until = 0.0     # monotonic; Retry-After honor
@@ -247,6 +253,35 @@ class Replica:
                 seed=payload.get("seed", 0))
             return self.gen_engine.submit(greq).result()
 
+    def kv_export(self, prompt, run_prefill: bool = True) -> dict:
+        """Disaggregated prefill: pack the prompt's full-block KV
+        prefix into a kv_wire shipment (running chunked prefill through
+        the replica's existing executable if not already resident)."""
+        with self._track():
+            if self.url is not None:
+                return self._post(
+                    "/v1/kv/export",
+                    {"prompt": [int(t) for t in prompt],
+                     "run_prefill": bool(run_prefill)}, None)
+            if self.gen_engine is None:
+                raise ValueError(
+                    f"replica {self.name!r} has no generation engine")
+            from . import disagg
+            return disagg.export_prefix(self.gen_engine, prompt,
+                                        run_prefill=run_prefill)
+
+    def kv_adopt(self, payload: dict) -> dict:
+        """Disaggregated decode: adopt a kv_wire shipment into the
+        replica's local BlockPool/PrefixCache."""
+        with self._track():
+            if self.url is not None:
+                return self._post("/v1/kv/adopt", payload, None)
+            if self.gen_engine is None:
+                raise ValueError(
+                    f"replica {self.name!r} has no generation engine")
+            from . import disagg
+            return disagg.adopt_prefix(self.gen_engine, payload)
+
     def _post(self, path: str, payload: dict,
               timeout_ms: Optional[float]) -> dict:
         """POST to the replica server, translating its status codes
@@ -331,10 +366,18 @@ class Router:
 
     def __init__(self, replicas=(), probe_interval_s=None,
                  redispatch_budget=None, drain_timeout_s=None,
-                 affinity_max=None, start_probe: bool = True):
+                 affinity_max=None, start_probe: bool = True,
+                 disagg: Optional[bool] = None):
+        from .disagg import FleetPrefixStore
         self.probe_interval_s = float(
             probe_interval_s if probe_interval_s is not None
             else FLAGS.router_probe_interval_s)
+        self.disagg = bool(FLAGS.router_disagg if disagg is None
+                           else disagg)
+        # fleet-level content-addressed prefix registry (chain hash ->
+        # owning replica names); maintained even with disagg off so a
+        # flag flip needs no restart
+        self.prefix_store = FleetPrefixStore()
         self.redispatch_budget = int(
             redispatch_budget if redispatch_budget is not None
             else FLAGS.router_redispatch_budget)
@@ -387,6 +430,9 @@ class Router:
         if rep is None:
             return
         rep.registered = False
+        # forget its fleet-store blocks: a chain entry pointing at a
+        # gone replica would only buy failed transfers
+        self.prefix_store.drop_owner(name)
         if drain:
             rep.drain(self.drain_timeout_s)
         if stop and rep.url is None:
@@ -455,18 +501,34 @@ class Router:
 
     # -- dispatch --------------------------------------------------------
 
-    def _pick(self, kind: str, exclude, session: Optional[str]
-              ) -> Optional[Replica]:
+    # which replica roles may serve each dispatch kind: a prefill-only
+    # worker must never absorb decode traffic (or skew least-loaded
+    # picks), and vice versa; predict stays on unified replicas
+    _KIND_ROLES = {"generate": ("unified", "decode"),
+                   "prefill": ("unified", "prefill"),
+                   "predict": ("unified",)}
+
+    def _pick(self, kind: str, exclude, session: Optional[str],
+              prefer: Optional[str] = None) -> Optional[Replica]:
+        roles = self._KIND_ROLES.get(kind, ("unified",))
         now = time.monotonic()
         with self._lock:
             reps = [r for r in self._replicas.values()
                     if r.name not in exclude
+                    and r.role in roles
                     and self._routable(r, now)
                     and (r.url is not None
                          or (r.engine if kind == "predict"
                              else r.gen_engine) is not None)]
             if not reps:
                 return None
+            if prefer is not None:
+                for r in reps:
+                    if r.name == prefer:
+                        if session is not None:
+                            self._affinity[session] = r.name
+                            self._affinity.move_to_end(session)
+                        return r
             if session is not None:
                 pinned = self._affinity.get(session)
                 if pinned is not None:
@@ -505,7 +567,8 @@ class Router:
             "backing off, or deregistered)",
             retry_after_s=self._fleet_retry_after())
 
-    def _dispatch(self, kind: str, call, session: Optional[str] = None):
+    def _dispatch(self, kind: str, call, session: Optional[str] = None,
+                  prefer: Optional[str] = None):
         STAT_ADD("serving.router_requests")
         with self._lock:
             self.requests += 1
@@ -513,7 +576,11 @@ class Router:
         tried = set()
         attempt = 0
         while True:
-            rep = self._pick(kind, tried, session)
+            # `prefer` only steers the FIRST pick (disagg phase 2:
+            # decode must land where the KV was just adopted); failover
+            # reverts to least-loaded
+            rep = self._pick(kind, tried, session,
+                             prefer=prefer if attempt == 0 else None)
             if rep is None:
                 # every replica is out (or the budget exhausted the
                 # healthy set): shed with Retry-After rather than
@@ -578,10 +645,117 @@ class Router:
                  session: Optional[str] = None) -> dict:
         """Route one generation request (a /v1/generate-shaped dict).
         `session` pins subsequent calls with the same key to the same
-        replica while it stays healthy (KV prefix-cache affinity)."""
+        replica while it stays healthy (KV prefix-cache affinity).
+        With disagg on this becomes two-phase prefill->decode
+        scheduling (see _generate_disagg)."""
+        if self.disagg:
+            return self._generate_disagg(payload, session)
         return self._dispatch(
             "generate", lambda rep: rep.generate(payload),
             session=session)
+
+    # -- disaggregated prefill/decode dispatch --------------------------
+
+    def _generate_disagg(self, payload: dict,
+                         session: Optional[str] = None) -> dict:
+        """Two-phase dispatch: pick the decode replica first (session
+        affinity pins to it), consult the fleet prefix store, and only
+        when the decode replica does not already own the prompt's
+        full-block chain run the prefill hop (export on a
+        prefill-capable peer, adopt on the decode replica). Any
+        transfer failure — prefill worker death mid-transfer included
+        — falls back to plain dispatch: the decode worker re-prefills
+        locally, so answers never change, only latency."""
+        from .kv_blocks import PrefixCache
+        STAT_ADD("serving.disagg_requests")
+        rep_d = self._pick("generate", set(), session)
+        if rep_d is None:
+            raise self._shed_error()
+        prompt = [int(t) for t in payload.get("prompt", ())]
+        store = self.prefix_store
+        bs = store.block_size
+        hashes: List[str] = []
+        if bs and len(prompt) >= bs:
+            hashes = PrefixCache.chunk_hashes(
+                prompt[:(len(prompt) // bs) * bs], bs)
+        need_xfer = bs is None or bool(
+            hashes and store.owned_depth(hashes, rep_d.name)
+            < len(hashes))
+        if hashes and not need_xfer:
+            STAT_ADD("serving.disagg_prefix_reuse")
+        if need_xfer and (bs is None or hashes):
+            try:
+                self._disagg_transfer(prompt, rep_d, hashes, store)
+            except Exception as e:
+                STAT_ADD("serving.disagg_fallbacks")
+                flight_record("disagg_fallback", replica=rep_d.name,
+                              error=type(e).__name__)
+        sp = trace.start_span("decode", attrs={"replica": rep_d.name})
+        try:
+            with trace.use_span(sp):
+                out = self._dispatch(
+                    "generate", lambda rep: rep.generate(payload),
+                    session=session, prefer=rep_d.name)
+        except Exception as e:
+            trace.end_span(sp, error=type(e).__name__)
+            raise
+        trace.end_span(sp)
+        return out
+
+    def _disagg_transfer(self, prompt, rep_d: Replica,
+                         hashes: List[str], store):
+        """The prefill hop: export the prompt's KV prefix from a
+        prefill-capable source and adopt it on the decode replica.
+        Raises on any failure — the caller falls back."""
+        from . import kv_wire
+        src = None
+        if hashes:
+            owner = store.chain_owner(hashes, exclude=(rep_d.name,))
+            if owner is not None:
+                with self._lock:
+                    cand = self._replicas.get(owner)
+                if cand is not None and \
+                        self._routable(cand, time.monotonic()):
+                    src = cand
+        if src is None:
+            src = self._pick("prefill", {rep_d.name}, None)
+        if src is None:
+            raise OverloadedError(
+                "no prefill-capable replica for KV transfer")
+        t0 = time.perf_counter()
+        sp = trace.start_span(
+            "prefill", attrs={"replica": src.name,
+                              "prompt_tokens": len(prompt)})
+        try:
+            with trace.use_span(sp):
+                shipment = src.kv_export(prompt)
+        except Exception as e:
+            trace.end_span(sp, error=type(e).__name__)
+            raise
+        trace.end_span(sp)
+        store.learn_block_size(int(shipment.get("block_size") or 0))
+        hs = [str(h) for h in shipment.get("chain_hashes", ())]
+        if not hs:
+            return
+        store.register(hs, src.name)
+        nbytes = kv_wire.payload_bytes(shipment)
+        sp = trace.start_span(
+            "fetch", attrs={"src": src.name, "dst": rep_d.name,
+                            "blocks": len(hs), "bytes": nbytes})
+        try:
+            with trace.use_span(sp):
+                res = rep_d.kv_adopt(shipment)
+        except Exception as e:
+            trace.end_span(sp, error=type(e).__name__)
+            raise
+        trace.end_span(sp)
+        resident = int(res.get("resident") or 0)
+        if resident:
+            store.register(hs[:resident], rep_d.name)
+        STAT_ADD("serving.kv_xfer_blocks", len(hs))
+        STAT_ADD("serving.kv_xfer_bytes", nbytes)
+        STAT_OBSERVE("serving.kv_xfer_ms",
+                     (time.perf_counter() - t0) * 1e3)
 
     # -- elasticity: hot swap -------------------------------------------
 
@@ -707,6 +881,7 @@ class Router:
         detail = {r.name: {"registered": r.registered,
                            "healthy": r.healthy,
                            "version": r.version,
+                           "role": r.role,
                            "load": r.load()} for r in reps}
         # informational only — a firing SLO alert never makes the
         # router stop routing (monitor_alerts.py)

@@ -35,9 +35,36 @@ reference at any temperature (tests/test_spec_decode.py).
 """
 from __future__ import annotations
 
-from typing import List, Sequence
+from typing import List, Optional, Sequence, Tuple
 
-__all__ = ["NgramDrafter"]
+__all__ = ["NgramDrafter", "update_spec_k"]
+
+
+def update_spec_k(cur: int, ewma: Optional[float], rate: float,
+                  k_max: int, low: float = 0.3, high: float = 0.8,
+                  alpha: float = 0.5) -> Tuple[int, float, int]:
+    """Acceptance-aware draft-length controller (pure, per slot).
+
+    Folds this iteration's measured acceptance `rate` (accepted /
+    proposed, in [0, 1]) into an EWMA and moves the slot's draft budget
+    one step: below `low` the budget shrinks (drafting is not paying
+    for the verify premium), above `high` it grows back toward `k_max`.
+    Returns `(new_k, new_ewma, moved)` with moved in {-1, 0, +1}.
+
+    Only the number of PROPOSED tokens changes — verification and
+    acceptance stay sampling-path identical, so adapting k can never
+    change emitted tokens, only how much verify compute is wasted.
+    """
+    rate = min(1.0, max(0.0, float(rate)))
+    ewma = rate if ewma is None else alpha * rate + (1 - alpha) * ewma
+    moved = 0
+    if ewma < low and cur > 1:
+        cur -= 1
+        moved = -1
+    elif ewma > high and cur < k_max:
+        cur += 1
+        moved = 1
+    return cur, ewma, moved
 
 
 class NgramDrafter:

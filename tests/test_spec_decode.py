@@ -279,3 +279,103 @@ def test_spec_requires_paged_engine(trained):
                            max_slots=2, max_seq=SEQ,
                            spec_decode=True, paged=False)
     assert not eng.spec_decode
+
+
+# ---------------------------------------------------------------------------
+# acceptance-aware adaptive spec_k (serving/spec_decode.update_spec_k)
+# ---------------------------------------------------------------------------
+
+def test_update_spec_k_pure_function():
+    from paddle_tpu.serving import update_spec_k
+    # first sample seeds the EWMA directly; low acceptance shrinks
+    k, ewma, moved = update_spec_k(4, None, 0.0, k_max=4)
+    assert (k, moved) == (3, -1) and ewma == 0.0
+    # floor at 1 draft — never moves below
+    k2, _, moved2 = update_spec_k(1, 0.0, 0.0, k_max=4)
+    assert (k2, moved2) == (1, 0)
+    # high acceptance grows back, capped at k_max
+    k3, ewma3, moved3 = update_spec_k(3, 0.9, 1.0, k_max=4)
+    assert (k3, moved3) == (4, 1) and ewma3 > 0.8
+    k4, _, moved4 = update_spec_k(4, 0.95, 1.0, k_max=4)
+    assert (k4, moved4) == (4, 0)
+    # mid-band holds steady; EWMA blends alpha*rate + (1-alpha)*prev
+    k5, ewma5, moved5 = update_spec_k(3, 0.5, 0.6, k_max=4, alpha=0.5)
+    assert (k5, moved5) == (3, 0) and abs(ewma5 - 0.55) < 1e-9
+    # out-of-range rates are clamped, not propagated
+    _, ewma6, _ = update_spec_k(2, None, 7.5, k_max=4)
+    assert ewma6 == 1.0
+
+
+class _BadDrafter:
+    """Adversarial drafter: always proposes the wrong successor, so
+    every draft is rejected and the adaptive budget must collapse."""
+
+    def draft(self, ctx, k=None):
+        k = int(k or 1)
+        return [(int(ctx[-1]) + 3) % VOCAB] * k
+
+
+def test_adaptive_spec_k_shrinks_under_bad_drafter(trained):
+    """With a drafter that is always wrong, the per-slot budget must
+    walk down to 1 (gen_spec_k_shrinks counter moves, effective-k gauge
+    ends at 1) while verify keeps the output EXACTLY serial."""
+    cfg, scope, exe = trained
+    dec_main, step = _serial_decode(cfg)
+    prompt, n = [0, 1, 2], 24
+    want = _kv(exe, scope, dec_main, step, prompt, n)
+
+    prev_mon = fluid.FLAGS.enable_monitor
+    fluid.set_flags({"FLAGS_enable_monitor": True})
+    monitor.reset_stats()
+    try:
+        eng = GenerationEngine(cfg, scope, exe=fluid.Executor(),
+                               max_slots=2, max_seq=SEQ, block_size=4,
+                               spec_decode=True, spec_k=4,
+                               spec_adaptive=True)
+        assert eng.spec_adaptive
+        eng._drafter = _BadDrafter()
+        eng.start()
+        try:
+            got = eng.generate(prompt, n)["tokens"]
+            assert got == want, (got, want)
+            assert eng.post_warmup_compiles() == 0
+        finally:
+            eng.stop()
+        snap = monitor.get_stats_snapshot()
+        c = snap["counters"]
+        assert c.get("serving.gen_spec_k_shrinks", 0) >= 3  # 4 -> 1
+        assert not c.get("serving.gen_spec_k_grows")
+        assert snap["gauges"].get("serving.gen_spec_k_effective") == 1
+    finally:
+        monitor.reset_stats()
+        fluid.set_flags({"FLAGS_enable_monitor": prev_mon})
+
+
+def test_adaptive_spec_k_off_keeps_static_budget(trained):
+    """spec_adaptive=False: the same bad drafter never moves the
+    budget (no shrink counters), and parity still holds."""
+    cfg, scope, exe = trained
+    dec_main, step = _serial_decode(cfg)
+    prompt, n = [5, 6], 20
+    want = _kv(exe, scope, dec_main, step, prompt, n)
+
+    prev_mon = fluid.FLAGS.enable_monitor
+    fluid.set_flags({"FLAGS_enable_monitor": True})
+    monitor.reset_stats()
+    try:
+        eng = GenerationEngine(cfg, scope, exe=fluid.Executor(),
+                               max_slots=2, max_seq=SEQ, block_size=4,
+                               spec_decode=True, spec_k=4,
+                               spec_adaptive=False)
+        assert not eng.spec_adaptive
+        eng._drafter = _BadDrafter()
+        eng.start()
+        try:
+            assert eng.generate(prompt, n)["tokens"] == want
+        finally:
+            eng.stop()
+        c = monitor.get_stats_snapshot()["counters"]
+        assert not c.get("serving.gen_spec_k_shrinks")
+    finally:
+        monitor.reset_stats()
+        fluid.set_flags({"FLAGS_enable_monitor": prev_mon})

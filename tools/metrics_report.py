@@ -51,7 +51,7 @@ def load(path):
     gen_loadgens, chaos_loadgens, memory_plans = [], [], []
     sharded_benches, trace_reports, router_loadgens = [], [], []
     perf_gates, incident_bundles, goodput_reports = [], [], []
-    spec_loadgens = []
+    spec_loadgens, disagg_loadgens = [], []
     with open(path) as f:
         for ln, line in enumerate(f, 1):
             line = line.strip()
@@ -90,6 +90,8 @@ def load(path):
                 spec_loadgens.append(rec)
             elif kind == "router_loadgen":
                 router_loadgens.append(rec)
+            elif kind == "disagg_loadgen":
+                disagg_loadgens.append(rec)
             elif kind == "program_lint":
                 lints.append(rec)
             elif kind == "graph_opt":
@@ -104,7 +106,7 @@ def load(path):
             graph_opts, gen_loadgens, chaos_loadgens, memory_plans,
             sharded_benches, trace_reports, router_loadgens,
             perf_gates, incident_bundles, goodput_reports,
-            spec_loadgens)
+            spec_loadgens, disagg_loadgens)
 
 
 def _hist(snap, name):
@@ -116,7 +118,7 @@ def report(path, out=sys.stdout):
      graph_opts, gen_loadgens, chaos_loadgens, memory_plans,
      sharded_benches, trace_reports, router_loadgens,
      perf_gates, incident_bundles, goodput_reports,
-     spec_loadgens) = load(path)
+     spec_loadgens, disagg_loadgens) = load(path)
     w = out.write
     w(f"runtime stats report — {path}\n")
     if not snapshots and not results and not op_profiles \
@@ -125,7 +127,8 @@ def report(path, out=sys.stdout):
             and not memory_plans and not sharded_benches \
             and not trace_reports and not router_loadgens \
             and not perf_gates and not incident_bundles \
-            and not goodput_reports and not spec_loadgens:
+            and not goodput_reports and not spec_loadgens \
+            and not disagg_loadgens:
         w("no snapshots or bench results found\n")
         return 1
     w(f"snapshots: {len(snapshots)}   bench results: {len(results)}\n")
@@ -467,6 +470,51 @@ def report(path, out=sys.stdout):
                   f"{ch.get('worker_deaths', 0)}  p99 "
                   f"{ch.get('p99_inflation')}x fault-free (bound "
                   f"{ch.get('p99_bound')}x)\n")
+
+    dreq = c.get("serving.disagg_requests")
+    if dreq or disagg_loadgens:
+        w("\n-- disaggregation (serving/disagg.py, docs/serving.md) "
+          "--\n")
+        if dreq:
+            w(f"{'disagg requests':26s} {int(dreq)}   prefix reuse "
+              f"{int(c.get('serving.disagg_prefix_reuse', 0))}   "
+              f"fallbacks "
+              f"{int(c.get('serving.disagg_fallbacks', 0))}\n")
+            w(f"{'kv transfer':26s} blocks "
+              f"{int(c.get('serving.kv_xfer_blocks', 0))}   "
+              f"{_fmt_bytes(c.get('serving.kv_xfer_bytes', 0))}   "
+              f"exports {int(c.get('serving.kv_xfer_exports', 0))}   "
+              f"adopted "
+              f"{int(c.get('serving.kv_xfer_adopted_blocks', 0))}   "
+              f"dup {int(c.get('serving.kv_xfer_dup_blocks', 0))}\n")
+            xh = _hist(snap, "serving.kv_xfer_ms")
+            if xh and xh["count"]:
+                w(f"{'transfer latency':26s} count {xh['count']:<6d} "
+                  f"p50 {xh['p50']:.2f} ms  p95 {xh['p95']:.2f} ms\n")
+        for r in disagg_loadgens:
+            reps = r.get("replicas") or {}
+            lat = r.get("latency_ms") or {}
+            w(f"{'disagg loadgen':26s} "
+              f"{reps.get('prefill', 0)}p+{reps.get('decode', 0)}d  "
+              f"{r.get('requests', 0)} req  "
+              f"{r.get('throughput_rps', 0)} rps  p99 "
+              f"{lat.get('p99')} ms  errors {r.get('errors', 0)}  "
+              f"wrong {r.get('wrong_answers', 0)}  compiles "
+              f"{r.get('post_warmup_compiles', 0)}\n")
+            d99 = (r.get("ttft_shared_ms") or {}).get("p99")
+            b99 = ((r.get("baseline") or {}).get("ttft_shared_ms")
+                   or {}).get("p99")
+            if d99 is not None or b99 is not None:
+                w(f"{'  ttft shared p99':26s} {d99} ms vs baseline "
+                  f"{b99} ms  ratio "
+                  f"{r.get('ttft_shared_p99_ratio')}\n")
+            xfer = r.get("transfer")
+            if xfer:
+                w(f"{'  kv transfer':26s} "
+                  f"{xfer.get('blocks', 0)} block(s)  "
+                  f"{_fmt_bytes(xfer.get('bytes', 0))}  reuse "
+                  f"{xfer.get('prefix_reuse', 0)}  fallbacks "
+                  f"{xfer.get('fallbacks', 0)}\n")
 
     faults = c.get("resilience.faults_injected")
     retries = c.get("resilience.retries")

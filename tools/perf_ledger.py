@@ -166,6 +166,20 @@ def _loadgen_rows(rec) -> List[dict]:
                  ts=rec.get("ts"))
         if r:
             rows.append(r)
+    if kind == "disagg_loadgen":
+        # the disagg headline: shared-cohort TTFT p99 and its ratio vs
+        # the same-run symmetric baseline (< 1.0 = disagg winning)
+        shared = rec.get("ttft_shared_ms")
+        if isinstance(shared, dict):
+            r = _row(kind, config, "ttft_shared_ms_p99",
+                     shared.get("p99"), "ms", ts=rec.get("ts"))
+            if r:
+                rows.append(r)
+        r = _row(kind, config, "ttft_shared_p99_ratio",
+                 rec.get("ttft_shared_p99_ratio"), "x",
+                 ts=rec.get("ts"))
+        if r:
+            rows.append(r)
     return rows
 
 
@@ -226,7 +240,7 @@ def rows_from_record(rec) -> Tuple[List[dict], int]:
                    ts=rec.get("ts"))
         return ([row] if row else []), (0 if row else 1)
     if kind in ("serving_loadgen", "generation_loadgen",
-                "chaos_loadgen", "router_loadgen"):
+                "chaos_loadgen", "router_loadgen", "disagg_loadgen"):
         rows = _loadgen_rows(rec)
         return rows, (0 if rows else 1)
     if kind == "spec_loadgen":

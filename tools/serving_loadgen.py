@@ -1337,6 +1337,418 @@ def run_router(args):
     return 0
 
 
+def run_disagg(args):
+    """--router N --disagg: the disaggregated prefill/decode fleet
+    acceptance run (`kind="disagg_loadgen"` records).
+
+    Two passes over IDENTICAL shared-prefix generation traffic, each
+    against a FRESH fleet of N real subprocess replicas
+    (tools/serving_replica.py — separate processes, HTTP wire,
+    loaded-from-npz identical weights):
+
+    * baseline: N symmetric (role=unified) workers behind a plain
+      Router — every worker re-prefills every prefix it meets.
+    * disagg: --disagg-prefill prefill workers + the rest decode
+      workers behind Router(disagg=True) — prefixes are prefilled
+      once, shipped over /v1/kv/export -> /v1/kv/adopt, and reused via
+      the fleet prefix store.
+
+    --service-ms injects a deterministic per-prefill-chunk delay
+    (slow_step at the gen_prefill fault site, armed via FLAGS env in
+    the worker processes) so the TTFT comparison is
+    machine-independent, exactly like the router scaling run. Gates:
+    any wrong answer vs the in-process serial reference exits 4; any
+    worker post-warmup compile exits 3 (--check-compiles); disagg
+    shared-cohort TTFT p99 not beating baseline exits 5 (active at
+    shared-prefix-frac >= 0.6); the one-tree trace audit
+    (request -> prefill/fetch/decode spans, trace_report consistency)
+    exits 6."""
+    import shutil
+    import signal as _signal
+    import subprocess
+    import urllib.request
+
+    import paddle_tpu as fluid
+    from paddle_tpu import monitor as _mon
+    from paddle_tpu import trace as _tr
+    from paddle_tpu.models import gpt
+    from paddle_tpu.serving import GenerationEngine, Replica, Router
+
+    if args.url or args.chaos:
+        print("--disagg races local subprocess replicas; --url and "
+              "--chaos are not supported", file=sys.stderr)
+        return 2
+    n_rep = args.router
+    n_p = max(1, args.disagg_prefill)
+    n_d = n_rep - n_p
+    if n_d < 1:
+        print(f"--disagg needs >= 1 decode worker (--router {n_rep} "
+              f"--disagg-prefill {n_p})", file=sys.stderr)
+        return 2
+
+    block_size = args.block_size or 8
+    prefix_frac = args.shared_prefix_frac \
+        if args.shared_prefix_frac > 0 else 0.75
+    prefix_len = args.shared_prefix_len or (
+        (max(args.max_prompt - 1, 1) // block_size) * block_size)
+    if prefix_len < block_size:
+        print(f"--disagg needs at least one full shared block "
+              f"(prefix_len {prefix_len} < block_size {block_size}; "
+              f"raise --max-prompt)", file=sys.stderr)
+        return 2
+    reqs = make_gen_requests(args.requests, args.vocab,
+                             args.max_prompt, args.max_new_tokens,
+                             args.seed, shared_prefix_frac=prefix_frac,
+                             shared_prefix_len=prefix_len,
+                             temperature=args.temperature)
+
+    tmpdir = tempfile.mkdtemp(prefix="serving_disagg_")
+
+    # -- the weights every process shares (npz under training-graph
+    # names; each replica loads them, so all fleets decode identically)
+    cfg = gpt.gpt_small(vocab_size=args.vocab, d_model=32, n_heads=4,
+                        n_layers=2, d_ff=64, max_seq_len=args.max_seq,
+                        dropout=0.0, use_flash=False)
+    scope = fluid.Scope()
+    seed_engine = GenerationEngine(cfg, scope, max_slots=args.slots,
+                                   max_seq=args.max_seq, paged=True,
+                                   block_size=block_size)
+    seed_engine.init_scope()  # scratch weights; never start()ed
+    weights = {}
+    for name in scope.names():
+        if name.startswith("gen."):
+            continue  # decode state is per-process, not a weight
+        v = scope.get(name)
+        if v is not None:
+            weights[name] = np.asarray(v)
+    npz = os.path.join(tmpdir, "weights.npz")
+    np.savez(npz, **weights)
+
+    # -- serial exact-answer reference (in-process, batch=1 graph on
+    # the same scope — the wrong-answers oracle for BOTH passes)
+    dec_main, dec_start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(dec_main, dec_start):
+        step1 = gpt.build_decode_step(cfg, batch=1,
+                                      max_seq=args.max_seq)
+    _, _, _, souts = run_serial_generation(
+        seed_engine.exe, scope, dec_main, step1, reqs)
+
+    replica_py = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "serving_replica.py")
+    worker_env = dict(os.environ)
+    worker_env.setdefault("JAX_PLATFORMS", "cpu")
+    if args.service_ms > 0:
+        # deterministic per-prefill-chunk service time in EVERY worker
+        # of BOTH fleets: prefill cost dominates and is identical
+        # across machines, so where prefill *runs* (the thing disagg
+        # changes) decides the TTFT comparison
+        worker_env["FLAGS_fault_spec"] = \
+            f"slow_step:ms={args.service_ms}:site=gen_prefill"
+
+    def spawn_fleet(tag, n):
+        procs = []
+        for i in range(n):
+            name = f"{tag}{i}"
+            pf = os.path.join(tmpdir, f"{name}.port")
+            log = open(os.path.join(tmpdir, f"{name}.log"), "w")
+            cmd = [sys.executable, replica_py, "--weights", npz,
+                   "--vocab", str(args.vocab),
+                   "--max-seq", str(args.max_seq),
+                   "--slots", str(args.slots),
+                   "--block-size", str(block_size),
+                   "--timeout-ms", str(args.timeout_ms),
+                   "--port-file", pf]
+            p = subprocess.Popen(cmd, stdout=log,
+                                 stderr=subprocess.STDOUT,
+                                 env=worker_env)
+            procs.append({"proc": p, "port_file": pf, "log": log,
+                          "name": name})
+        deadline = time.monotonic() + 300.0
+        for w in procs:
+            while not os.path.exists(w["port_file"]):
+                if w["proc"].poll() is not None:
+                    w["log"].flush()
+                    with open(w["log"].name) as lf:
+                        tail = "".join(lf.readlines()[-15:])
+                    raise RuntimeError(
+                        f"replica {w['name']} died during warmup "
+                        f"(rc={w['proc'].returncode}):\n{tail}")
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"replica {w['name']} not ready in 300s")
+                time.sleep(0.1)
+            with open(w["port_file"]) as f:
+                w["url"] = f"http://127.0.0.1:{int(f.read().strip())}"
+        return procs
+
+    def worker_compiles(url):
+        with urllib.request.urlopen(url + "/healthz",
+                                    timeout=5.0) as r:
+            body = json.loads(r.read() or b"{}")
+        return int(body.get("engines", {}).get("generate", {})
+                   .get("post_warmup_compiles") or 0)
+
+    def stop_fleet(procs):
+        clean = 0
+        for w in procs:
+            if w["proc"].poll() is None:
+                w["proc"].send_signal(_signal.SIGTERM)
+        for w in procs:
+            try:
+                rc = w["proc"].wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                w["proc"].kill()
+                rc = w["proc"].wait()
+            if rc == 0:
+                clean += 1
+            w["log"].close()
+        return clean
+
+    def drive(router, traced):
+        """Closed loop: --concurrency threads, each one request in
+        flight, straight into Router.generate. Client-side TTFT proxy:
+        measured e2e minus the engine-reported decode tail, so router
+        + transfer overhead lands in TTFT (where it belongs)."""
+        pending = list(reqs)
+        results = {}
+        errors = [0]
+        lock = threading.Lock()
+
+        def worker():
+            while True:
+                with lock:
+                    if not pending:
+                        return
+                    req = pending.pop(0)
+                payload = {"prompt": req["prompt"],
+                           "max_new_tokens": req["max_new_tokens"],
+                           "temperature": req.get("temperature", 0.0),
+                           "seed": req["seed"],
+                           "timeout_ms": args.timeout_ms}
+                root = None
+                t0 = time.perf_counter()
+                try:
+                    if traced:
+                        root = _tr.start_span(
+                            "request", attrs={"idx": req["idx"]})
+                        with _tr.use_span(root):
+                            out = router.generate(payload)
+                    else:
+                        out = router.generate(payload)
+                except Exception as e:  # noqa: BLE001
+                    if root is not None:
+                        _tr.finish_trace(
+                            root, error=f"{type(e).__name__}: {e}",
+                            e2e_ms=(time.perf_counter() - t0) * 1e3)
+                    with lock:
+                        errors[0] += 1
+                    continue
+                e2e = time.perf_counter() - t0
+                if root is not None:
+                    _tr.finish_trace(root, e2e_ms=e2e * 1e3)
+                eng_e2e = (out.get("e2e_ms") or 0.0) / 1e3
+                eng_ttft = (out.get("ttft_ms") or 0.0) / 1e3
+                ttft = max(0.0, e2e - max(0.0, eng_e2e - eng_ttft))
+                with lock:
+                    results[req["idx"]] = {
+                        "e2e": e2e, "ttft": ttft,
+                        "tokens": list(out.get("tokens", ())),
+                        "shared": bool(req["shared"])}
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=worker)
+                   for _ in range(args.concurrency)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return results, errors[0], time.perf_counter() - t0
+
+    def wrong_count(results):
+        return sum(1 for i, r in results.items()
+                   if i in souts and [int(t) for t in r["tokens"]]
+                   != [int(t) for t in souts[i]])
+
+    def pass_summary(results, errors, dur):
+        vals = list(results.values())
+        lat = [r["e2e"] for r in vals]
+        tokens = sum(len(r["tokens"]) for r in vals)
+        return {
+            "requests": len(vals), "errors": errors,
+            "duration_s": round(dur, 4),
+            "throughput_rps": round(len(vals) / dur, 2) if dur else 0.0,
+            "tokens": tokens,
+            "tokens_per_s": round(tokens / dur, 2) if dur else 0.0,
+            "latency_ms": _lat_summary(lat),
+            "ttft_ms": _lat_summary([r["ttft"] for r in vals]),
+            "ttft_shared_ms": _lat_summary(
+                [r["ttft"] for r in vals if r["shared"]]),
+            "ttft_miss_ms": _lat_summary(
+                [r["ttft"] for r in vals if not r["shared"]]),
+        }
+
+    fleet = []
+    try:
+        # ---- pass A: symmetric baseline (N unified workers) ----------
+        fleet = spawn_fleet("u", n_rep)
+        router_a = Router(
+            [Replica(w["name"], url=w["url"], role="unified")
+             for w in fleet],
+            probe_interval_s=0.2, disagg=False)
+        res_a, err_a, dur_a = drive(router_a, traced=False)
+        compiles_a = sum(worker_compiles(w["url"]) for w in fleet)
+        router_a.close()
+        clean_a = stop_fleet(fleet)
+        wrong_a = wrong_count(res_a)
+        base = pass_summary(res_a, err_a, dur_a)
+        base["post_warmup_compiles"] = compiles_a
+        base["clean_exits"] = clean_a
+
+        # ---- pass B: disaggregated fleet (fresh processes) -----------
+        fluid.set_flags({"FLAGS_enable_trace": True,
+                         "FLAGS_trace_sample": 1.0,
+                         "FLAGS_enable_monitor": True})
+        _mon.STAT_RESET()
+        _tr.reset()
+        fleet = spawn_fleet("p", n_p) + spawn_fleet("d", n_d)
+        reps_b = [Replica(w["name"], url=w["url"],
+                          role=("prefill" if w["name"].startswith("p")
+                                else "decode"))
+                  for w in fleet]
+        router_b = Router(reps_b, probe_interval_s=0.2, disagg=True)
+        res_b, err_b, dur_b = drive(router_b, traced=True)
+        counters = _mon.get_stats_snapshot().get("counters", {})
+        store_stats = router_b.prefix_store.stats()
+        compiles_b = sum(worker_compiles(w["url"]) for w in fleet)
+        router_b.close()
+        clean_b = stop_fleet(fleet)
+        fleet = []
+        wrong_b = wrong_count(res_b)
+    finally:
+        for w in fleet:
+            if w["proc"].poll() is None:
+                w["proc"].kill()
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+    # ---- trace audit: one tree per request, router->prefill->fetch->
+    # decode spans, trace_report consistency clean --------------------
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import trace_report as trp
+    spans = _tr.drain_spans()
+    trace_out = args.trace_out
+    if not trace_out:
+        base_p = args.out or os.path.join(tempfile.gettempdir(),
+                                          "disagg_loadgen.jsonl")
+        trace_out = os.path.splitext(os.path.abspath(base_p))[0] \
+            + ".spans.jsonl"
+    try:
+        os.remove(trace_out)
+    except OSError:
+        pass
+    _tr.export_jsonl(trace_out, spans)
+    by_id, children = trp.build_index(spans)
+    roots = [r for r in trp.trace_roots(spans, by_id)
+             if r["name"] in trp.REQUEST_ROOTS]
+    n_no_decode = 0
+    n_with_transfer = 0
+    for root in roots:
+        if root.get("status") != "ok":
+            continue
+        names = {s["name"] for s in trp._walk(root, children)}
+        if "decode" not in names:
+            n_no_decode += 1
+        if "prefill" in names and "fetch" in names:
+            n_with_transfer += 1
+    _, violations = trp.check_consistency(spans, children)
+    trace_fail = (not roots) or n_no_decode or violations \
+        or n_with_transfer == 0
+    if not roots:
+        print("FAIL: disagg pass kept no request traces",
+              file=sys.stderr)
+    if n_no_decode:
+        print(f"FAIL: {n_no_decode} request trace(s) missing the "
+              f"decode span", file=sys.stderr)
+    if n_with_transfer == 0 and roots:
+        print("FAIL: no request trace carries the prefill+fetch "
+              "transfer spans", file=sys.stderr)
+    for v in violations[:10]:
+        print(f"FAIL: trace consistency: {v}", file=sys.stderr)
+
+    dis = pass_summary(res_b, err_b, dur_b)
+    dis["post_warmup_compiles"] = compiles_b
+    dis["clean_exits"] = clean_b
+    b99 = base["ttft_shared_ms"]["p99"] \
+        if base["ttft_shared_ms"] else None
+    d99 = dis["ttft_shared_ms"]["p99"] \
+        if dis["ttft_shared_ms"] else None
+    ratio = round(d99 / b99, 3) if b99 and d99 is not None else None
+
+    rec = {
+        "kind": "disagg_loadgen",
+        "mode": "closed",
+        "replicas": {"prefill": n_p, "decode": n_d,
+                     "baseline_unified": n_rep},
+        "requests": dis["requests"],
+        "errors": err_a + err_b,
+        "wrong_answers": wrong_a + wrong_b,
+        "duration_s": dis["duration_s"],
+        "throughput_rps": dis["throughput_rps"],
+        "tokens": dis["tokens"],
+        "tokens_per_s": dis["tokens_per_s"],
+        "latency_ms": dis["latency_ms"],
+        "ttft_ms": dis["ttft_ms"],
+        "ttft_shared_ms": dis["ttft_shared_ms"],
+        "ttft_miss_ms": dis["ttft_miss_ms"],
+        "ttft_shared_p99_ratio": ratio,
+        "post_warmup_compiles": compiles_a + compiles_b,
+        "baseline": base,
+        "transfer": {
+            "requests": int(counters.get(
+                "serving.disagg_requests", 0)),
+            "prefix_reuse": int(counters.get(
+                "serving.disagg_prefix_reuse", 0)),
+            "fallbacks": int(counters.get(
+                "serving.disagg_fallbacks", 0)),
+            "blocks": int(counters.get("serving.kv_xfer_blocks", 0)),
+            "bytes": int(counters.get("serving.kv_xfer_bytes", 0)),
+            "fleet_store": store_stats,
+        },
+        "trace": {"out": trace_out, "spans": len(spans),
+                  "requests": len(roots),
+                  "with_transfer": n_with_transfer,
+                  "missing_decode": n_no_decode,
+                  "consistency_violations": len(violations)},
+        "config": {"concurrency": args.concurrency,
+                   "slots": args.slots,
+                   "max_prompt": args.max_prompt,
+                   "max_new_tokens": args.max_new_tokens,
+                   "max_seq": args.max_seq, "vocab": args.vocab,
+                   "block_size": block_size,
+                   "shared_prefix_frac": prefix_frac,
+                   "shared_prefix_len": prefix_len,
+                   "service_ms": args.service_ms,
+                   "seed": args.seed},
+    }
+    emit(rec, args.out)
+
+    if rec["wrong_answers"]:
+        print(f"FAIL: {rec['wrong_answers']} outputs diverge from the "
+              f"serial reference", file=sys.stderr)
+        return 4
+    if args.check_compiles and rec["post_warmup_compiles"]:
+        print(f"FAIL: {rec['post_warmup_compiles']} post-warmup "
+              f"compiles across the fleets", file=sys.stderr)
+        return 3
+    if prefix_frac >= 0.6 and b99 and d99 is not None and d99 > b99:
+        print(f"FAIL: disagg shared-cohort TTFT p99 {d99}ms does not "
+              f"beat the symmetric baseline {b99}ms", file=sys.stderr)
+        return 5
+    if trace_fail:
+        return 6
+    return 0
+
+
 def emit(rec, out_path):
     print(json.dumps(rec))
     if out_path:
@@ -1458,9 +1870,21 @@ def main(argv=None):
                     help="router mode: preempt+resume one replica "
                          "under load; exit 4 on any client-visible "
                          "error")
+    ap.add_argument("--disagg", action="store_true",
+                    help="router mode: disaggregated prefill/decode "
+                         "fleet acceptance run across real subprocess "
+                         "replicas — --disagg-prefill prefill workers "
+                         "+ rest decode, KV blocks shipped over "
+                         "/v1/kv/export->adopt, vs a symmetric "
+                         "baseline (kind=disagg_loadgen)")
+    ap.add_argument("--disagg-prefill", type=int, default=1,
+                    help="disagg mode: prefill workers out of "
+                         "--router N (rest are decode workers)")
     args = ap.parse_args(argv)
 
     if args.router:
+        if args.disagg:
+            return run_disagg(args)
         return run_router(args)
     if args.chaos:
         return run_chaos(args)

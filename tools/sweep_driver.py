@@ -116,6 +116,15 @@ CONFIGS = [
     # violated — a hard failure, not a flake)
     ("chaos_s4", None),  # special-cased below
     ("router_chaos_s4", None),  # special-cased below
+    # disaggregated prefill/decode fleet (serving_loadgen --router N
+    # --disagg, kind=disagg_loadgen): real subprocess replicas at three
+    # prefill:decode ratios; each ledger row records the shared-cohort
+    # TTFT p99 ratio vs a symmetric-replica baseline plus the zero-
+    # gated wrong-answers / post-warmup-compile verdict (rc 3/4/5/6 =
+    # real regressions, not flakes)
+    ("disagg_1to1", None),  # special-cased below
+    ("disagg_1to2", None),  # special-cased below
+    ("disagg_2to1", None),  # special-cased below
     # perf-gate demo pair (tools/perf_gate.py, docs/observability.md
     # "Perf ledger & regression gate"): the base cell runs the same
     # generation loadgen three times to seed a demo ledger; the slow
@@ -577,6 +586,53 @@ def run_special(key):
                 "chaos_wrong_answers": chaos.get("wrong_answers"),
                 "chaos_worker_deaths": chaos.get("worker_deaths"),
                 "chaos_p99_inflation": chaos.get("p99_inflation")}, None
+    if key in ("disagg_1to1", "disagg_1to2", "disagg_2to1"):
+        n_p, n_d = {"disagg_1to1": (1, 1), "disagg_1to2": (1, 2),
+                    "disagg_2to1": (2, 1)}[key]
+        out_path = f"/tmp/{key}_{ROUND}.jsonl"
+        p = subprocess.run(
+            [sys.executable, "tools/serving_loadgen.py",
+             "--router", str(n_p + n_d), "--disagg",
+             "--disagg-prefill", str(n_p),
+             "--requests", "120", "--concurrency", "4",
+             "--max-prompt", "64", "--max-seq", "96",
+             "--max-new-tokens", "8", "--block-size", "8",
+             "--slots", "4", "--service-ms", "20",
+             "--check-compiles", "--out", out_path],
+            cwd=REPO, capture_output=True, text=True, timeout=1800)
+        if p.returncode != 0:
+            # rc 3 = post-warmup compile, rc 4 = wrong answers, rc 5 =
+            # TTFT p99 not beating the symmetric baseline, rc 6 =
+            # broken trace tree: all real regressions, not flakes
+            return None, (f"rc={p.returncode}: "
+                          + (p.stdout + p.stderr)[-300:])
+        recs = []
+        try:
+            with open(out_path) as f:
+                recs = [json.loads(ln) for ln in f if ln.strip()]
+        except (OSError, ValueError) as e:
+            return None, f"unreadable {out_path}: {e}"
+        rec = next((r for r in recs
+                    if r.get("kind") == "disagg_loadgen"), None)
+        if rec is None:
+            return None, "no disagg_loadgen record"
+        xfer = rec.get("transfer") or {}
+        return {"metric": "disagg_ttft_shared_p99_ratio",
+                "value": rec.get("ttft_shared_p99_ratio"),
+                "unit": "x",
+                "replicas": rec.get("replicas"),
+                "throughput_rps": rec.get("throughput_rps"),
+                "ttft_shared_p99_ms":
+                    (rec.get("ttft_shared_ms") or {}).get("p99"),
+                "baseline_ttft_shared_p99_ms":
+                    ((rec.get("baseline") or {}).get("ttft_shared_ms")
+                     or {}).get("p99"),
+                "wrong_answers": rec.get("wrong_answers"),
+                "post_warmup_compiles":
+                    rec.get("post_warmup_compiles"),
+                "kv_xfer_blocks": xfer.get("blocks"),
+                "prefix_reuse": xfer.get("prefix_reuse"),
+                "fallbacks": xfer.get("fallbacks")}, None
     if key in ("gate_demo_base", "gate_demo_slow"):
         # identical --generate loadgen traffic in both cells; the CLI
         # flags (and so the record's config digest = the ledger key)

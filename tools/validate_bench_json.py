@@ -31,6 +31,11 @@ Checks, per file (type auto-detected from content):
   the loadgen contract plus replicas/redispatches/shed, the 1->N
   scaling block, and zero-gated preempt / hot_swap / chaos drill
   verdicts; lines with
+  kind == "disagg_loadgen" (tools/serving_loadgen.py --router N
+  --disagg) carry the disaggregated prefill/decode fleet contract:
+  replicas.prefill/decode split, zero-gated wrong_answers and
+  post_warmup_compiles, disagg vs baseline TTFT distributions, and
+  the KV-transfer accounting; lines with
   kind == "program_lint" (tools/program_lint.py) carry the
   model/ok/counts/findings contract the lint report section reads;
   lines with kind == "graph_opt" (tools/program_lint.py --optimize)
@@ -396,6 +401,72 @@ def validate_router_loadgen(obj, where="router_loadgen"):
                 errs.append(f"{where}: chaos.p99_inflation="
                             f"{chaos['p99_inflation']} exceeds "
                             f"p99_bound={chaos['p99_bound']}")
+    return errs
+
+
+def validate_disagg_loadgen(obj, where="disagg_loadgen"):
+    """Schema of one tools/serving_loadgen.py --router --disagg record:
+    the base loadgen contract plus the prefill/decode fleet split, the
+    zero-gated correctness fields (wrong_answers and post-warmup
+    compiles must BOTH be zero — the record documents the
+    disaggregation guarantee), the disagg TTFT distributions with their
+    symmetric-baseline counterparts, and the KV-transfer accounting."""
+    errs = validate_loadgen(obj, where=where)
+    reps = obj.get("replicas")
+    if not isinstance(reps, dict):
+        errs.append(f"{where}: replicas must be an object "
+                    f"(got {reps!r})")
+    else:
+        for key, floor in (("prefill", 1), ("decode", 1)):
+            v = reps.get(key)
+            if not isinstance(v, int) or isinstance(v, bool) \
+                    or v < floor:
+                errs.append(f"{where}: replicas.{key} must be an int "
+                            f">= {floor} (got {v!r})")
+    for key in ("wrong_answers", "post_warmup_compiles"):
+        v = obj.get(key)
+        if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+            errs.append(f"{where}: {key} must be a non-negative int "
+                        f"(got {v!r})")
+        elif v != 0:
+            errs.append(f"{where}: {key}={v} violates the "
+                        f"zero-wrong-answers / zero-recompile "
+                        f"disaggregation contract")
+    for side, label in ((obj, where),
+                        (obj.get("baseline"), f"{where}.baseline")):
+        if not isinstance(side, dict):
+            errs.append(f"{where}: baseline must be an object "
+                        f"(got {side!r})")
+            continue
+        for key in ("ttft_ms", "ttft_shared_ms"):
+            d = side.get(key)
+            if not isinstance(d, dict):
+                errs.append(f"{label}: {key} must be an object "
+                            f"(got {d!r})")
+                continue
+            for q in _LOADGEN_PCTS:
+                v = d.get(q)
+                if v is not None and (not isinstance(v, (int, float))
+                                      or isinstance(v, bool)):
+                    errs.append(f"{label}: {key}.{q} must be numeric "
+                                f"or null (got {v!r})")
+    ratio = obj.get("ttft_shared_p99_ratio")
+    if ratio is not None and (not isinstance(ratio, (int, float))
+                              or isinstance(ratio, bool)):
+        errs.append(f"{where}: ttft_shared_p99_ratio must be numeric "
+                    f"or null (got {ratio!r})")
+    xfer = obj.get("transfer")
+    if xfer is not None:
+        if not isinstance(xfer, dict):
+            errs.append(f"{where}: transfer must be an object")
+        else:
+            for key in ("requests", "blocks", "bytes", "fallbacks",
+                        "prefix_reuse"):
+                v = xfer.get(key)
+                if v is not None and (not isinstance(v, int)
+                                      or isinstance(v, bool) or v < 0):
+                    errs.append(f"{where}: transfer.{key} must be a "
+                                f"non-negative int (got {v!r})")
     return errs
 
 
@@ -884,6 +955,9 @@ def validate_jsonl(path):
                     rec, where=f"{path}:{ln}"))
             elif rec.get("kind") == "router_loadgen":
                 errs.extend(validate_router_loadgen(
+                    rec, where=f"{path}:{ln}"))
+            elif rec.get("kind") == "disagg_loadgen":
+                errs.extend(validate_disagg_loadgen(
                     rec, where=f"{path}:{ln}"))
             elif rec.get("kind") == "program_lint":
                 errs.extend(validate_program_lint(
