@@ -310,6 +310,11 @@ def _timed_steps(exe, prog, feed, loss, steps):
         stats["mesh_devices"] = int(mesh.size)
         stats["collective_bytes_per_step"] = \
             int(layout.collective_bytes_estimate(prog))
+        # closed-form gradient-sync reference (arxiv 2004.13336): the
+        # perf ledger flags drift between this and the per-op model's
+        # prediction above
+        stats["grad_sync_bytes_per_step"] = \
+            int(layout.gradient_sync_bytes(prog))
     if est_peak is not None:
         stats["est_peak_bytes"] = est_peak
         stats["est_peak_dynamic"] = est_dynamic

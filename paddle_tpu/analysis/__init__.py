@@ -23,6 +23,10 @@ This package checks a Program with ZERO device work:
   global block, a per-op resident-bytes timeline, a peak-HBM estimate,
   and the FLAGS_memory_gate pre-compile OOM gate (PTV050/051/052) that
   rejects over-budget programs before a single XLA compile.
+- `sharding`: the static sharding analyzer — propagates SpecLayout
+  annotations op-by-op, prices the implied collectives into a predicted
+  collective_bytes_per_step, and drives the FLAGS_sharding_verify
+  pre-compile gate (PTV060-063) plus program_lint --sharding.
 
 Every diagnostic carries a stable rule ID (PTVnnn), a severity, and
 provenance in the same "{op_type}:{block}/{op_idx}" format the op trace
@@ -53,6 +57,23 @@ def memory_gate(program, feed_shapes=None, fetch_names=None,
                  fetch_names=fetch_names, where=where)
 
 
+def sharding_gate(program, layout=None, feed_shapes=None,
+                  fetch_names=None, where="executor"):
+    """Memoized FLAGS_sharding_verify static-sharding gate
+    (analysis/sharding) — lazy import, same reason as optimize_gate."""
+    from .sharding import sharding_gate as _gate
+    return _gate(program, layout=layout, feed_shapes=feed_shapes,
+                 fetch_names=fetch_names, where=where)
+
+
+def analyze_program_sharding(program, layout, feed_names=(),
+                             fetch_names=(), feed_shapes=None):
+    """Unmemoized sharding analysis -> ShardingReport (CLI, tests)."""
+    from .sharding import analyze_program_sharding as _analyze
+    return _analyze(program, layout, feed_names=feed_names,
+                    fetch_names=fetch_names, feed_shapes=feed_shapes)
+
+
 def analyze_program_memory(program, feed_names=(), fetch_names=(),
                            feed_shapes=None, budget_bytes=0):
     """Unmemoized memory analysis -> MemoryPlan (CLI, bench, tests)."""
@@ -64,4 +85,5 @@ def analyze_program_memory(program, feed_names=(), fetch_names=(),
 
 __all__ = ["Diagnostic", "VerifyResult", "ProgramVerificationError",
            "RULES", "verify_program", "verify_gate", "optimize_gate",
-           "memory_gate", "analyze_program_memory"]
+           "memory_gate", "analyze_program_memory", "sharding_gate",
+           "analyze_program_sharding"]

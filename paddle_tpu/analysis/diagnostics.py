@@ -41,6 +41,15 @@ RULES = {
     "PTV050": (ERROR, "estimated peak HBM exceeds the memory budget"),
     "PTV051": (ERROR, "a single tensor alone exceeds the memory budget"),
     "PTV052": (WARN, "large dead buffers are eligible for reuse"),
+    # sharding band (06x) — the static sharding analyzer
+    # (analysis/sharding.py)
+    "PTV060": (ERROR, "operands disagree on a mesh axis (layout-"
+                      "inconsistent op)"),
+    "PTV061": (WARN, "implicit reshard on a hot path (per-op resharded "
+                     "bytes over threshold)"),
+    "PTV062": (WARN, "non-divisible shard dim silently replicated"),
+    "PTV063": (WARN, "op has no sharding propagation rule (conservative "
+                     "replicate + reshard)"),
 }
 
 

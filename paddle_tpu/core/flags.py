@@ -309,6 +309,23 @@ DEFINE_string(
     "dims are documented lower bounds and the finding says so "
     "(Spec.nbytes). Docs: docs/memory_planning.md.")
 
+DEFINE_string(
+    "sharding_verify", "warn",
+    "The pre-compile sharding gate (analysis/sharding.py — the PTV06x "
+    "sibling of FLAGS_program_verify / FLAGS_memory_gate): 'off' = "
+    "skip; 'warn' (default) = propagate the SpecLayout through the "
+    "program graph once per (fingerprint, mesh, feed shapes, fetches) "
+    "and surface PTV060-063 findings as one summarized warning; "
+    "'error' = raise ProgramVerificationError on PTV060 layout-"
+    "inconsistent ops — in Executor._resolve_step BEFORE the "
+    "executable cache records a miss, and in ServingEngine.warmup "
+    "before any ladder cell compiles. The gate only engages when a "
+    "layout is in scope (the sharded-exec SpecLayout, or "
+    "FLAGS_sharded_mesh is set); with no mesh it is a no-op. The same "
+    "pass prices the implied collectives into a predicted "
+    "collective_bytes_per_step (docs/sharding.md, "
+    "docs/static_analysis.md).")
+
 DEFINE_bool(
     "buffer_reuse", True,
     "Enable the buffer-reuse rewrite (analysis/passes/reuse.py) when "

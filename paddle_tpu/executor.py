@@ -327,6 +327,21 @@ class Executor:
                                  for n, a in feed_arrays.items()},
                     fetch_names=fetch_names, where="executor")
 
+        # Static sharding gate (FLAGS_sharding_verify, default warn):
+        # propagates the SpecLayout through the OPTIMIZED program and
+        # prices the implied collectives; engages only when a layout is
+        # in scope (sharded-exec state_spec_fn, or FLAGS_sharded_mesh).
+        # A layout-inconsistent program raises PTV060 HERE — before the
+        # cache key, so cache_stats() shows zero compiles attempted
+        # (paddle_tpu/analysis/sharding.py).
+        from .analysis import sharding_gate
+        sharding_gate(program,
+                      layout=getattr(compiled, "_state_spec_fn", None)
+                      if compiled is not None else None,
+                      feed_shapes={n: (tuple(a.shape), str(a.dtype))
+                                   for n, a in feed_arrays.items()},
+                      fetch_names=fetch_names, where="executor")
+
         key = self._cache_key(program, feed_arrays, fetch_names, compiled)
         step_fn = self._cache.get(key) if use_program_cache else None
         self._last_cache_hit = step_fn is not None

@@ -27,10 +27,15 @@ from ..monitor import enabled as _monitor_on
 from .mesh import make_mesh
 
 __all__ = ["SpecLayout", "MeshDims", "mesh_from_spec", "DATA_AXIS",
-           "MODEL_AXIS"]
+           "MODEL_AXIS", "FSDP_AXIS"]
 
 DATA_AXIS = "dp"
 MODEL_AXIS = "tp"
+# Weight-sharding (FSDP) axis, SNIPPETS.md [1]: parameters shard their
+# leading dim here (ZeRO-3 — weights, not just optimizer state), and
+# GSPMD inserts the per-layer all-gather before each use. Third
+# positional axis of mesh_from_spec ("dp,tp,fsdp").
+FSDP_AXIS = "fsdp"
 
 # Optimizer accumulator name markers. optimizer._add_accumulator names
 # accumulators unique_name.generate(f"{param.name}_{acc}") -> e.g.
@@ -46,21 +51,35 @@ _ZERO_ACC_MARKERS = (
 _SCALAR_MARKERS = ("learning_rate", "_beta1_pow_", "_beta2_pow_")
 
 
+_POSITIONAL_AXES = (DATA_AXIS, MODEL_AXIS, FSDP_AXIS)
+
+
+def mesh_axes_for(ndims: int):
+    """Positional axis names for an n-dim mesh shape: (dp), (dp, tp),
+    (dp, tp, fsdp). Shared by mesh_from_spec and MeshDims so the
+    device-backed and device-free spellings can never disagree."""
+    if not 1 <= ndims <= len(_POSITIONAL_AXES):
+        raise ValueError(
+            f"mesh rank {ndims}: expected 'dp', 'dp,tp' or "
+            f"'dp,tp,fsdp' (1-{len(_POSITIONAL_AXES)} axes)")
+    return _POSITIONAL_AXES[:ndims]
+
+
 def mesh_from_spec(spec: str, devices=None) -> Mesh:
-    """Build a Mesh from a 'dp' / 'dp,tp' shape string ("8", "4,2").
+    """Build a Mesh from a 'dp' / 'dp,tp' / 'dp,tp,fsdp' shape string
+    ("8", "4,2", "2,2,2").
 
     Axis names follow position: first axis is the data axis, second the
-    model axis — the Mesh(data, model) convention of docs/sharding.md.
+    model axis — the Mesh(data, model) convention of docs/sharding.md —
+    and third the weight-sharding (FSDP) axis from SNIPPETS.md [1].
     """
     dims = tuple(int(d) for d in str(spec).replace("x", ",").split(",")
                  if str(d).strip())
     if not dims or any(d < 1 for d in dims):
         raise ValueError(
-            f"mesh spec {spec!r}: expected 'dp' or 'dp,tp' positive ints")
-    if len(dims) > 2:
-        raise ValueError(
-            f"mesh spec {spec!r}: at most 2 axes (data, model) supported")
-    names = (DATA_AXIS,) if len(dims) == 1 else (DATA_AXIS, MODEL_AXIS)
+            f"mesh spec {spec!r}: expected 'dp'[,'tp'[,'fsdp']] "
+            f"positive ints")
+    names = mesh_axes_for(len(dims))
     return make_mesh(shape=dims, axis_names=names, devices=devices)
 
 
@@ -74,9 +93,11 @@ class MeshDims:
     def __init__(self, shape, axis_names=None):
         shape = tuple(int(d) for d in shape)
         if axis_names is None:
-            axis_names = (DATA_AXIS, MODEL_AXIS)[:len(shape)]
+            axis_names = mesh_axes_for(len(shape)) if shape else ()
         if len(axis_names) != len(shape):
             raise ValueError(f"axis_names {axis_names} vs shape {shape}")
+        if any(d < 1 for d in shape):
+            raise ValueError(f"mesh shape {shape}: axes must be >= 1")
         self.axis_names = tuple(axis_names)
         self.shape = dict(zip(self.axis_names, shape))
         self.size = int(np.prod(shape)) if shape else 1
@@ -93,16 +114,40 @@ class SpecLayout:
     """
 
     def __init__(self, mesh: Mesh, data_axis: str = DATA_AXIS,
-                 model_axis: str = MODEL_AXIS, shard_params: bool = True):
+                 model_axis: str = MODEL_AXIS, shard_params: bool = True,
+                 fsdp_axis: str = FSDP_AXIS):
         self.mesh = mesh
         self.data_axis = data_axis if data_axis in mesh.axis_names else None
         self.model_axis = model_axis if model_axis in mesh.axis_names \
             else None
+        # fsdp resolution hook (SNIPPETS.md [1], ROADMAP item 1): when
+        # the mesh carries this axis, parameters shard their leading
+        # dim over it — full weight sharding, not just optimizer state.
+        self.fsdp_axis = fsdp_axis if fsdp_axis in mesh.axis_names \
+            else None
         self.dp = int(mesh.shape[self.data_axis]) if self.data_axis else 1
         self.tp = int(mesh.shape[self.model_axis]) if self.model_axis \
             else 1
+        self.fsdp = int(mesh.shape[self.fsdp_axis]) if self.fsdp_axis \
+            else 1
         self.shard_params = shard_params
         self._table: Dict[str, PartitionSpec] = {}
+        # Non-divisibility fallbacks: every time a rule WANTED to shard
+        # (name, dim) over axis but the dim did not divide, the decline
+        # is recorded here — analysis/sharding.py turns these into
+        # PTV062 "silently replicated" findings instead of losing them.
+        self.fallbacks: list = []
+        self._fallback_seen: set = set()
+
+    def _note_fallback(self, name: str, dim: int, axis: str,
+                       dim_size, axis_size: int):
+        key = (name, dim, axis)
+        if key in self._fallback_seen:
+            return
+        self._fallback_seen.add(key)
+        self.fallbacks.append(
+            {"name": str(name), "dim": int(dim), "axis": str(axis),
+             "dim_size": int(dim_size), "axis_size": int(axis_size)})
 
     # -- classification --------------------------------------------------
     @staticmethod
@@ -116,14 +161,32 @@ class SpecLayout:
                    for m in _ZERO_ACC_MARKERS)
 
     # -- spec rules ------------------------------------------------------
-    def _model_parts(self, shape) -> list:
+    def _model_parts(self, name, shape) -> list:
         """Per-dim axis assignment for the model (tp) axis: last dim of
         a >=2-D tensor, when divisible. [] when tp doesn't apply."""
         parts = [None] * len(shape)
         if (self.shard_params and self.tp > 1 and len(shape) >= 2
-                and shape[-1] is not None and shape[-1] > 0
-                and shape[-1] % self.tp == 0):
-            parts[-1] = self.model_axis
+                and shape[-1] is not None and shape[-1] > 0):
+            if shape[-1] % self.tp == 0:
+                parts[-1] = self.model_axis
+            else:
+                self._note_fallback(name, len(shape) - 1,
+                                    self.model_axis, shape[-1], self.tp)
+        return parts
+
+    def _fsdp_dim0(self, name, shape, parts) -> list:
+        """The fsdp resolution hook: leading dim over the fsdp axis
+        when divisible and not already assigned. Applies to any >=1-D
+        parameter — embeddings, qkv/ffn weights, 1-D layer_norm scales
+        alike (SNIPPETS.md [1] per-family specs all lead with fsdp)."""
+        if (self.shard_params and self.fsdp_axis and self.fsdp > 1
+                and shape and shape[0] is not None and shape[0] > 0
+                and parts[0] is None):
+            if shape[0] % self.fsdp == 0:
+                parts[0] = self.fsdp_axis
+            else:
+                self._note_fallback(name, 0, self.fsdp_axis, shape[0],
+                                    self.fsdp)
         return parts
 
     def param_spec(self, name: str, shape: Tuple[int, ...]) -> \
@@ -131,24 +194,34 @@ class SpecLayout:
         """Parameters: replicated over data (ZeRO keeps weights whole
         for the forward pass), last dim over the model axis when it
         divides — the Megatron-style column split GSPMD propagates
-        through matmuls."""
+        through matmuls — and, when the mesh has an fsdp axis, leading
+        dim over fsdp (full weight sharding; GSPMD all-gathers before
+        each use)."""
         shape = tuple(s for s in (shape or ()))
-        parts = self._model_parts(shape)
+        parts = self._fsdp_dim0(name, shape,
+                                self._model_parts(name, shape))
         return PartitionSpec(*parts) if any(parts) else PartitionSpec()
 
     def zero_spec(self, name: str, shape: Tuple[int, ...]) -> \
             PartitionSpec:
         """Optimizer accumulators (arxiv 2004.13336): leading dim over
         the data axis when divisible (plus the same model split as the
-        owning param), else fall back toward replication per-dim."""
+        owning param), else fall back toward replication per-dim. With
+        an fsdp axis the accumulators co-shard with the weights (fsdp
+        on dim 0) instead — the update math stays local either way."""
         shape = tuple(s for s in (shape or ()))
         if not shape:
             return PartitionSpec()
-        parts = self._model_parts(shape)
-        if (self.data_axis and self.dp > 1 and shape[0] is not None
-                and shape[0] > 0 and shape[0] % self.dp == 0
-                and parts[0] is None):
-            parts[0] = self.data_axis
+        parts = self._model_parts(name, shape)
+        if self.fsdp_axis and self.fsdp > 1:
+            parts = self._fsdp_dim0(name, shape, parts)
+        elif (self.data_axis and self.dp > 1 and shape[0] is not None
+                and shape[0] > 0 and parts[0] is None):
+            if shape[0] % self.dp == 0:
+                parts[0] = self.data_axis
+            else:
+                self._note_fallback(name, 0, self.data_axis, shape[0],
+                                    self.dp)
         return PartitionSpec(*parts) if any(parts) else PartitionSpec()
 
     def feed_spec(self, name: str, shape: Tuple[int, ...]) -> \
@@ -157,9 +230,11 @@ class SpecLayout:
         divides; otherwise replicate (small/odd batches still run)."""
         shape = tuple(s for s in (shape or ()))
         if (self.data_axis and self.dp > 1 and shape
-                and shape[0] is not None and shape[0] > 0
-                and shape[0] % self.dp == 0):
-            return PartitionSpec(self.data_axis)
+                and shape[0] is not None and shape[0] > 0):
+            if shape[0] % self.dp == 0:
+                return PartitionSpec(self.data_axis)
+            self._note_fallback(name, 0, self.data_axis, shape[0],
+                                self.dp)
         return PartitionSpec()
 
     def spec_for(self, name: str, shape=None,
@@ -230,13 +305,18 @@ class SpecLayout:
                 n *= int(self.mesh.shape[a])
         return n
 
-    def collective_bytes_estimate(self, program) -> int:
-        """Static per-step gradient-synchronisation volume: every
+    def gradient_sync_bytes(self, program) -> int:
+        """Closed-form per-step gradient-synchronisation volume: every
         dp-replicated parameter's gradient is all-reduced (2(n-1)/n ~ 2x
         payload in a ring), counted once per step. Sharded-update params
         reduce-scatter + all-gather the same payload, so the estimate
-        holds for both layouts (arxiv 2004.13336 §3)."""
-        if not self.data_axis or self.dp <= 1:
+        holds for both layouts (arxiv 2004.13336 §3). Kept as the
+        reconciliation reference the per-op cost model must agree with
+        (tools/perf_ledger.py's predicted-vs-measured drift rows)."""
+        sync_over = self.dp * (self.fsdp
+                               if self.fsdp_axis and self.fsdp > 1
+                               else 1)
+        if sync_over <= 1:
             return 0
         total = 0
         for v in program.list_vars():
@@ -254,6 +334,18 @@ class SpecLayout:
             nbytes = int(np.prod(shape)) * itemsize
             total += nbytes // self.shard_count(v.name, shape)
         return 2 * total
+
+    def collective_bytes_estimate(self, program) -> int:
+        """Static per-step collective-traffic volume — ONE oracle: the
+        per-op communication-cost model of analysis/sharding.py (layout
+        propagation + priced collectives: gradient all-reduce /
+        reduce-scatter+all-gather, explicit c_* ops, implicit
+        reshards). The bench sharded path reports this number, and the
+        regression tests hold it within 10% of the closed-form
+        gradient_sync_bytes above on the bench builders."""
+        from ..analysis.sharding import analyze_program_sharding
+        return int(analyze_program_sharding(
+            program, layout=self).collective_bytes_per_step)
 
     def to_dict(self) -> Dict[str, str]:
         return {n: str(s) for n, s in sorted(self._table.items())}

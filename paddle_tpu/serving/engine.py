@@ -200,7 +200,7 @@ class ServingEngine:
         # Analyzes the optimized program (level-2 buffer reuse counts);
         # the per-cell plans are memoized, so the executor's own gate
         # hits the same entries during the warm loop below.
-        from ..analysis import memory_gate
+        from ..analysis import memory_gate, sharding_gate
         for bb, sb in shapes:
             cell = {}
             for name, (per_example, dtype) in spec.items():
@@ -214,6 +214,13 @@ class ServingEngine:
             memory_gate(opt_prog, feed_shapes=cell,
                         fetch_names=self.predictor.get_output_names(),
                         where="serving.warmup")
+            # Static sharding gate per cell (FLAGS_sharding_verify):
+            # engages only when FLAGS_sharded_mesh puts a layout in
+            # scope; a layout-inconsistent model raises PTV060 here,
+            # before the ladder spends its first compile.
+            sharding_gate(opt_prog, feed_shapes=cell,
+                          fetch_names=self.predictor.get_output_names(),
+                          where="serving.warmup")
         for bb, sb in shapes:
             feed = {}
             for name, (per_example, dtype) in spec.items():

@@ -1,0 +1,375 @@
+"""Static sharding analyzer tests (analysis/sharding.py,
+docs/static_analysis.md): propagation-rule units, the PTV06x findings,
+the FLAGS_sharding_verify pre-compile gate in Executor._resolve_step
+and ServingEngine.warmup (rejection BEFORE any compile), and the
+one-oracle reconciliation between the per-op communication-cost model
+and SpecLayout's closed-form gradient_sync_bytes."""
+import contextlib
+import io as pyio
+import json
+import os
+import sys
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from jax.sharding import PartitionSpec as P
+from paddle_tpu import layers
+from paddle_tpu.analysis import (ProgramVerificationError,
+                                 analyze_program_sharding)
+from paddle_tpu.analysis.sharding import (_remap_reshape, reset_memo,
+                                          sharding_gate)
+from paddle_tpu.parallel.layout import MeshDims, SpecLayout
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+F32 = 4  # itemsize used by the byte assertions below
+
+
+def _tools(module):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        return __import__(module)
+    finally:
+        sys.path.pop(0)
+
+
+@contextlib.contextmanager
+def _gate_flags(mode, mesh):
+    """Flip the gate flags and clear the memo, restoring on exit."""
+    prev = (fluid.FLAGS.sharding_verify, fluid.FLAGS.sharded_mesh)
+    fluid.set_flags({"FLAGS_sharding_verify": mode,
+                     "FLAGS_sharded_mesh": mesh})
+    reset_memo()
+    try:
+        yield
+    finally:
+        fluid.set_flags({"FLAGS_sharding_verify": prev[0],
+                         "FLAGS_sharded_mesh": prev[1]})
+        reset_memo()
+
+
+def _conflict_program():
+    """Two shard_hints place the dp axis on different (batch-free)
+    dims of the same tensor; the elementwise_add merge is the PTV060
+    layout inconsistency."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[-1, 8, 8], dtype="float32",
+                        append_batch_size=False)
+        a = layers.shard_hint(x, [None, "dp", None])
+        b = layers.shard_hint(x, [None, None, "dp"])
+        out = layers.elementwise_add(a, b)
+    return main, startup, out
+
+
+def _nondivisible_program():
+    """shard_hint over a dim the mesh axis does not divide: PTV062."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[6, 16], dtype="float32",
+                        append_batch_size=False)
+        out = layers.shard_hint(x, ["dp", None])
+    return main, startup, out
+
+
+# ---------------------------------------------------------------------------
+# propagation-rule units
+# ---------------------------------------------------------------------------
+
+def _axis_size(p, sizes={"dp": 4, "tp": 2}):
+    if isinstance(p, (tuple, list)):
+        n = 1
+        for a in p:
+            n *= sizes.get(str(a), 1)
+        return n
+    return sizes.get(str(p), 1)
+
+
+def test_remap_reshape_rules():
+    # 1:1 dims carry their axis through
+    parts, lost = _remap_reshape((8, 16), ("dp", None), (8, 16),
+                                 _axis_size)
+    assert parts == ("dp", None) and lost == []
+    # merge: the leading in-dim's axis rides onto the merged out dim
+    parts, lost = _remap_reshape((8, 16), ("dp", None), (128,),
+                                 _axis_size)
+    assert parts == ("dp",) and lost == []
+    # merge: a non-leading sharded in-dim is lost (-> reshard)
+    parts, lost = _remap_reshape((8, 16), (None, "dp"), (128,),
+                                 _axis_size)
+    assert parts == (None,) and lost == [1]
+    # split: the axis lands on the leading out dim when it divides
+    parts, lost = _remap_reshape((128,), ("dp",), (8, 16), _axis_size)
+    assert parts == ("dp", None) and lost == []
+    # split where the leading out dim does not divide: lost
+    parts, lost = _remap_reshape((6,), ("dp",), (2, 3), _axis_size)
+    assert parts == (None, None) and lost == [0]
+
+
+def test_elementwise_conflict_is_ptv060():
+    main, _, _ = _conflict_program()
+    layout = SpecLayout(MeshDims((8,)))
+    rep = analyze_program_sharding(main, layout)
+    errs = rep.result.errors()
+    assert errs and all(d.rule == "PTV060" for d in errs)
+    assert rep.to_record()["counts"]["error"] == len(errs)
+
+
+def test_shard_hint_nondivisible_is_ptv062():
+    main, _, _ = _nondivisible_program()
+    rep = analyze_program_sharding(main, SpecLayout(MeshDims((8,))))
+    assert not rep.result.errors()
+    assert any(d.rule == "PTV062" for d in rep.result.findings)
+    # the hint was declined, so nothing is sharded and nothing moves
+    assert rep.collective_bytes_per_step == 0
+
+
+def test_matmul_contraction_costs():
+    """Both-sides-sharded contraction prices a 2x partial-sum
+    all-reduce; one-sided prices a gather of that operand. Mesh
+    (1, 2) also covers the 1-sized-dp-axis edge case: feeds replicate
+    (dp=1), only tp is live."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[8, 16], dtype="float32",
+                        append_batch_size=False)
+        y = layers.data("y", shape=[16, 4], dtype="float32",
+                        append_batch_size=False)
+        a = layers.shard_hint(x, [None, "tp"])
+        b = layers.shard_hint(y, ["tp", None])
+        both = layers.matmul(a, b)     # contraction sharded both sides
+        one = layers.matmul(a, y)      # ... and on one side only
+    layout = SpecLayout(MeshDims((1, 2)))
+    rep = analyze_program_sharding(main, layout)
+    assert not rep.result.errors()
+    kinds = {}
+    for c in rep.costs:
+        kinds.setdefault(c.kind, 0)
+        kinds[c.kind] += c.bytes
+    # partial sum: 2 x full out bytes (out [8,4] is replicated)
+    assert kinds.get("all_reduce") == 2 * 8 * 4 * F32
+    # one-sided: gather a's [8,16] out of its 2-way tp split
+    a_bytes = 8 * 16 * F32
+    assert kinds.get("reshard") == a_bytes - a_bytes // 2
+    assert rep.reshard_bytes_per_step == kinds["reshard"]
+    assert rep.collective_bytes_per_step == sum(kinds.values())
+    assert both is not None and one is not None
+
+
+def test_reduce_over_sharded_dim_prices_allreduce():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[8, 16], dtype="float32",
+                        append_batch_size=False)
+        a = layers.shard_hint(x, [None, "tp"])
+        s = layers.reduce_sum(a, dim=1)
+    rep = analyze_program_sharding(main, SpecLayout(MeshDims((1, 2))))
+    costs = [c for c in rep.costs if c.kind == "all_reduce"]
+    assert len(costs) == 1
+    # out [8] replicated: 2 x its full payload
+    assert costs[0].bytes == 2 * 8 * F32
+    assert s is not None
+
+
+# ---------------------------------------------------------------------------
+# mesh / layout edge cases
+# ---------------------------------------------------------------------------
+
+def test_mesh_dims_edge_cases():
+    for bad in ((0,), (4, -1), (2, 2, 2, 2)):
+        with pytest.raises(ValueError):
+            MeshDims(bad)
+    lay = SpecLayout(MeshDims((8, 1)))  # 1-sized model axis
+    assert (lay.dp, lay.tp, lay.fsdp) == (8, 1, 1)
+    assert lay.param_spec("w", (16, 16)) == P()  # no tp split at tp=1
+    assert lay.feed_spec("x", (16, 4)) == P("dp")
+    lay3 = SpecLayout(MeshDims((2, 2, 2)))
+    assert lay3.fsdp_axis == "fsdp" and lay3.fsdp == 2
+    # fsdp leading-dim weight shard composes with the tp column split
+    assert lay3.param_spec("w", (8, 4)) == P("fsdp", "tp")
+    assert lay3.shard_count("w", (8, 4)) == 4
+
+
+def test_layout_fallbacks_become_ptv062():
+    """A declined shard (non-divisible dim) recorded by the layout
+    surfaces as a PTV062 finding on the report, not a silent drop."""
+    main, _, _ = _nondivisible_program()
+    layout = SpecLayout(MeshDims((8,)))
+    rep = analyze_program_sharding(main, layout)
+    assert layout.fallbacks  # feed_spec declined x's batch dim (6 % 8)
+    wants = {d.var for d in rep.result.findings if d.rule == "PTV062"}
+    assert "x" in wants
+
+
+# ---------------------------------------------------------------------------
+# the FLAGS_sharding_verify gate
+# ---------------------------------------------------------------------------
+
+def test_gate_modes_off_and_invalid():
+    main, _, out = _nondivisible_program()
+    with _gate_flags("off", "8"):
+        assert sharding_gate(main) is None
+    with _gate_flags("warn", ""):  # no layout in scope -> no-op
+        assert sharding_gate(main) is None
+    with _gate_flags("bogus", "8"):
+        with pytest.raises(ValueError):
+            sharding_gate(main)
+    assert out is not None
+
+
+def test_gate_warns_once_then_memoizes():
+    main, _, out = _nondivisible_program()
+    shapes = {"x": ((6, 16), "float32")}
+    with _gate_flags("warn", "8"):
+        with pytest.warns(UserWarning, match="sharding analysis"):
+            rep1 = sharding_gate(main, feed_shapes=shapes,
+                                 fetch_names=[out.name], where="t")
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            rep2 = sharding_gate(main, feed_shapes=shapes,
+                                 fetch_names=[out.name], where="t")
+        assert rep2 is rep1  # memo hit, no re-analysis
+        assert not [w for w in caught
+                    if "sharding analysis" in str(w.message)]
+
+
+def test_executor_gate_rejects_with_zero_compiles():
+    """error mode: a layout-inconsistent program raises from
+    _resolve_step BEFORE the executable-cache key — cache_stats()
+    still shows zero compiles attempted — and keeps raising on every
+    call (memoized analysis)."""
+    main, _, out = _conflict_program()
+    feed = {"x": np.zeros((2, 8, 8), np.float32)}
+    with _gate_flags("error", "8"):
+        exe = fluid.Executor()
+        with fluid.scope_guard(fluid.Scope()):
+            for _ in range(2):
+                with pytest.raises(ProgramVerificationError,
+                                   match="PTV060"):
+                    exe.run(main, feed=feed, fetch_list=[out])
+        stats = exe.cache_stats()
+        assert stats["misses"] == 0 and stats["hits"] == 0, stats
+
+
+def test_warmup_gate_rejects_before_ladder(tmp_path):
+    """ServingEngine.warmup: the per-cell sharding gate rejects the
+    saved layout-inconsistent model before the first ladder compile."""
+    from paddle_tpu.serving import EngineConfig, ServingEngine
+
+    main, startup, out = _conflict_program()
+    d = str(tmp_path / "model")
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor()
+        exe.run(startup)
+        fluid.io.save_inference_model(d, ["x"], [out], exe,
+                                      main_program=main)
+    with _gate_flags("error", "8"):
+        eng = ServingEngine(EngineConfig(d, max_batch_size=2,
+                                         warmup=False))
+        with pytest.raises(ProgramVerificationError, match="PTV060"):
+            eng.warmup()
+        assert eng.cache_stats()["misses"] == 0
+
+
+# ---------------------------------------------------------------------------
+# reconciliation: per-op cost model vs the closed form, one oracle
+# ---------------------------------------------------------------------------
+
+def test_cost_model_reconciles_with_closed_form(monkeypatch):
+    """Over every bench builder x {dp8, dp4xtp2}: the per-op grad_sync
+    component must agree with SpecLayout.gradient_sync_bytes within
+    10%, and collective_bytes_estimate must BE the analyzer total (the
+    delegation makes them one oracle). Startup compiles are stubbed —
+    the analysis only reads the Program."""
+    sys.path.insert(0, REPO)
+    try:
+        import bench
+    finally:
+        sys.path.pop(0)
+    monkeypatch.setattr(fluid.Executor, "run",
+                        lambda self, *a, **kw: [])
+    for dims in ((8,), (4, 2)):
+        for name, build in sorted(bench._CPU_TINY_BUILDS.items()):
+            _, prog, _, _, _, _ = build()
+            layout = SpecLayout(MeshDims(dims)).add_program(prog)
+            rep = analyze_program_sharding(prog, layout)
+            closed = layout.gradient_sync_bytes(prog)
+            assert closed > 0, (name, dims)  # train programs sync grads
+            drift = abs(rep.grad_sync_bytes - closed) / closed
+            assert drift <= 0.10, (name, dims, rep.grad_sync_bytes,
+                                   closed)
+            assert rep.collective_bytes_per_step >= rep.grad_sync_bytes
+        # one-oracle check once per mesh (it re-runs the analysis)
+        assert layout.collective_bytes_estimate(prog) == \
+            rep.collective_bytes_per_step, (name, dims)
+
+
+def test_layout_total_under_fsdp_mesh(monkeypatch):
+    """Resolution stays total on a 3-axis dp x tp x fsdp mesh: every
+    persistable of every bench builder gets a spec whose shard count
+    divides the mesh."""
+    sys.path.insert(0, REPO)
+    try:
+        import bench
+    finally:
+        sys.path.pop(0)
+    monkeypatch.setattr(fluid.Executor, "run",
+                        lambda self, *a, **kw: [])
+    mesh = MeshDims((2, 2, 2))
+    for name, build in sorted(bench._CPU_TINY_BUILDS.items()):
+        _, prog, _, _, _, _ = build()
+        layout = SpecLayout(mesh).add_program(prog)
+        persist = [v for v in prog.list_vars()
+                   if getattr(v, "persistable", False)]
+        assert len(layout) == len(persist), name
+        for v in persist:
+            n = layout.shard_count(v.name, v.shape)
+            assert n >= 1 and mesh.size % n == 0, (name, v.name, n)
+
+
+# ---------------------------------------------------------------------------
+# artifact schema, report section, ledger rows
+# ---------------------------------------------------------------------------
+
+def test_sharding_report_schema_and_render(tmp_path):
+    main, _, _ = _conflict_program()
+    rep = analyze_program_sharding(main, SpecLayout(MeshDims((8,))))
+    rec = rep.to_record(model="conflict")
+    v = _tools("validate_bench_json")
+    assert v.validate_sharding_report(rec, "r0") == []
+    assert any("mesh_shape" in e for e in v.validate_sharding_report(
+        dict(rec, mesh_shape=[]), "r0"))
+    assert any("collective" in e for e in v.validate_sharding_report(
+        dict(rec, collective_bytes_per_step=-1), "r0"))
+    log = tmp_path / "shard.jsonl"
+    log.write_text(json.dumps(rec) + "\n")
+    assert v.validate_file(str(log)) == []
+    buf = pyio.StringIO()
+    rc = _tools("metrics_report").report(str(log), out=buf)
+    text = buf.getvalue()
+    assert rc == 0
+    assert "-- sharding analysis" in text
+    assert "conflict" in text and "PTV060" in text
+
+
+def test_perf_ledger_sharding_rows():
+    pl = _tools("perf_ledger")
+    rows, skipped = pl.rows_from_record(
+        {"kind": "sharded_bench", "metric": "gpt_tok_s", "ts": 0.0,
+         "mesh_shape": [8], "per_chip_throughput": 10.0,
+         "collective_bytes_per_step": 4096,
+         "grad_sync_bytes_per_step": 2048})
+    assert skipped == 0
+    by_metric = {r["metric"]: r for r in rows}
+    assert by_metric["collective_bytes_per_step"]["value"] == 4096.0
+    assert by_metric["collective_vs_grad_sync_ratio"]["value"] == 2.0
+    # sharding_report records land as predicted-bytes rows too
+    main, _, _ = _conflict_program()
+    rep = analyze_program_sharding(main, SpecLayout(MeshDims((8,))))
+    rows2, _ = pl.rows_from_record(rep.to_record(model="m"))
+    metrics = {r["metric"] for r in rows2}
+    assert {"collective_bytes_per_step", "reshard_bytes_per_step",
+            "grad_sync_bytes"} <= metrics

@@ -14,8 +14,9 @@ import pytest
 
 import paddle_tpu as fluid
 from jax.sharding import PartitionSpec as P
-from paddle_tpu.parallel.layout import (DATA_AXIS, MODEL_AXIS, MeshDims,
-                                        SpecLayout, mesh_from_spec)
+from paddle_tpu.parallel.layout import (DATA_AXIS, FSDP_AXIS, MODEL_AXIS,
+                                        MeshDims, SpecLayout,
+                                        mesh_from_spec)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -173,7 +174,11 @@ def test_mesh_from_spec_parsing():
     assert (m2.shape[DATA_AXIS], m2.shape[MODEL_AXIS]) == (4, 2)
     m3 = mesh_from_spec("4x2")  # sweep-config spelling
     assert dict(m3.shape) == dict(m2.shape)
-    for bad in ("2,2,2", "0", "", "-4,2"):
+    m4 = mesh_from_spec("2,2,2")  # third positional axis: fsdp
+    assert m4.axis_names == (DATA_AXIS, MODEL_AXIS, FSDP_AXIS)
+    assert (m4.shape[DATA_AXIS], m4.shape[MODEL_AXIS],
+            m4.shape[FSDP_AXIS]) == (2, 2, 2)
+    for bad in ("0", "", "-4,2", "2,2,2,2"):
         with pytest.raises(ValueError):
             mesh_from_spec(bad)
 

@@ -16,9 +16,12 @@ summarizes it; earlier snapshots only add the time axis.
 
 Also renders interleaved `kind="perf_gate"` records (tools/
 perf_gate.py verdicts), `kind="incident_bundle"` lines
-(paddle_tpu/monitor_alerts.py), and an `-- alerts --` section from the
-`alerts.*` stats when the SLO engine ran; `kind="ledger_row"` history
-lines are skipped (they are inputs to the gate, not results).
+(paddle_tpu/monitor_alerts.py), `kind="sharding_report"` lines
+(tools/program_lint.py --sharding — static predicted collective
+traffic, rendered next to the measured sharded-bench rows), and an
+`-- alerts --` section from the `alerts.*` stats when the SLO engine
+ran; `kind="ledger_row"` history lines are skipped (they are inputs
+to the gate, not results).
 """
 from __future__ import annotations
 
@@ -51,7 +54,7 @@ def load(path):
     gen_loadgens, chaos_loadgens, memory_plans = [], [], []
     sharded_benches, trace_reports, router_loadgens = [], [], []
     perf_gates, incident_bundles, goodput_reports = [], [], []
-    spec_loadgens, disagg_loadgens = [], []
+    spec_loadgens, disagg_loadgens, sharding_reports = [], [], []
     with open(path) as f:
         for ln, line in enumerate(f, 1):
             line = line.strip()
@@ -102,11 +105,13 @@ def load(path):
                 trace_reports.append(rec)
             elif kind == "goodput_report":
                 goodput_reports.append(rec)
+            elif kind == "sharding_report":
+                sharding_reports.append(rec)
     return (snapshots, results, op_profiles, loadgens, lints,
             graph_opts, gen_loadgens, chaos_loadgens, memory_plans,
             sharded_benches, trace_reports, router_loadgens,
             perf_gates, incident_bundles, goodput_reports,
-            spec_loadgens, disagg_loadgens)
+            spec_loadgens, disagg_loadgens, sharding_reports)
 
 
 def _hist(snap, name):
@@ -118,7 +123,7 @@ def report(path, out=sys.stdout):
      graph_opts, gen_loadgens, chaos_loadgens, memory_plans,
      sharded_benches, trace_reports, router_loadgens,
      perf_gates, incident_bundles, goodput_reports,
-     spec_loadgens, disagg_loadgens) = load(path)
+     spec_loadgens, disagg_loadgens, sharding_reports) = load(path)
     w = out.write
     w(f"runtime stats report — {path}\n")
     if not snapshots and not results and not op_profiles \
@@ -128,7 +133,7 @@ def report(path, out=sys.stdout):
             and not trace_reports and not router_loadgens \
             and not perf_gates and not incident_bundles \
             and not goodput_reports and not spec_loadgens \
-            and not disagg_loadgens:
+            and not disagg_loadgens and not sharding_reports:
         w("no snapshots or bench results found\n")
         return 1
     w(f"snapshots: {len(snapshots)}   bench results: {len(results)}\n")
@@ -749,6 +754,36 @@ def report(path, out=sys.stdout):
               f"{r.get('per_chip_throughput', 0):>10} "
               f"{r.get('unit', '') or '':8s}/chip  collective/step="
               f"{_fmt_bytes(r.get('collective_bytes_per_step', 0))}\n")
+
+    if sharding_reports:
+        # one record per analyzed model (tools/program_lint.py
+        # --sharding --mesh ... --out): the static analyzer's predicted
+        # collective traffic — compare against the measured
+        # collective_bytes_per_step in -- sharding -- above
+        w("\n-- sharding analysis (analysis/sharding, "
+          "docs/static_analysis.md) --\n")
+        for r in sharding_reports:
+            shape = "x".join(str(d) for d in r.get("mesh_shape", []))
+            axes = ",".join(r.get("mesh_axes") or [])
+            dyn = " (lower bound)" if r.get("dynamic") else ""
+            cnt = r.get("counts", {})
+            status = "FAIL" if cnt.get("error") else "ok  "
+            w(f"{status} {r.get('model', '?'):32s} mesh {shape:>7s} "
+              f"({axes:9s}) collective/step="
+              f"{_fmt_bytes(r.get('collective_bytes_per_step', 0))}"
+              f"{dyn}  reshard="
+              f"{_fmt_bytes(r.get('reshard_bytes_per_step', 0))}  "
+              f"grad_sync={_fmt_bytes(r.get('grad_sync_bytes', 0))}\n")
+            unc = r.get("uncovered_op_types") or []
+            if unc:
+                w(f"  uncovered op types: {', '.join(unc)}\n")
+            for cc in (r.get("collectives") or [])[:5]:
+                w(f"  {cc.get('kind', '?'):<12s} "
+                  f"{_fmt_bytes(cc.get('bytes', 0)):>10s}  "
+                  f"{cc.get('where', '')}\n")
+            for f in (r.get("findings") or [])[:5]:
+                w(f"  {f.get('rule', '?')} {f.get('severity', '?'):5s} "
+                  f"{f.get('where', '?')}: {f.get('message', '')}\n")
 
     if results:
         w("\n-- bench results --\n")

@@ -16,7 +16,13 @@ tools/validate_bench_json.py knows:
 * bench_summary files / bench-log result lines (metric/value/unit)
 * driver BENCH_rNN.json wrappers ({"parsed": ...} — a null or errored
   parsed payload is SKIPPED and counted, the r03/r05 failure mode)
-* kind="sharded_bench" (per-chip throughput keyed by mesh shape)
+* kind="sharded_bench" (per-chip throughput keyed by mesh shape, plus
+  the per-op predicted collective bytes/step and — when the record
+  carries the closed-form grad_sync_bytes_per_step — the predicted/
+  closed-form drift ratio, so perf_gate flags a cost-model drift the
+  same way it flags a tok/s loss)
+* kind="sharding_report" (program_lint --sharding: predicted
+  collective/reshard bytes per step keyed by model + mesh)
 * serving/generation/chaos/router loadgen records (throughput, p99
   latency, tokens/s — config keyed by mode + a stable digest of the
   run's config object)
@@ -234,11 +240,42 @@ def rows_from_record(rec) -> Tuple[List[dict], int]:
     if kind == "sharded_bench":
         shape = rec.get("mesh_shape") or []
         config = "mesh" + "x".join(str(d) for d in shape)
+        rows = []
         row = _row("sharded_bench", config,
                    f"{rec.get('metric', 'throughput')}_per_chip",
                    rec.get("per_chip_throughput"), "per-chip",
                    ts=rec.get("ts"))
-        return ([row] if row else []), (0 if row else 1)
+        if row:
+            rows.append(row)
+        coll = rec.get("collective_bytes_per_step")
+        r = _row("sharded_bench", config, "collective_bytes_per_step",
+                 coll, "bytes", ts=rec.get("ts"))
+        if r:
+            rows.append(r)
+        # drift canary: per-op analyzer prediction over the closed-form
+        # gradient-sync bytes — a rule change that silently re-prices
+        # the model moves this ratio before anything moves tok/s
+        gs = rec.get("grad_sync_bytes_per_step")
+        if isinstance(coll, (int, float)) \
+                and isinstance(gs, (int, float)) and gs > 0:
+            r = _row("sharded_bench", config,
+                     "collective_vs_grad_sync_ratio", coll / gs, "x",
+                     ts=rec.get("ts"))
+            if r:
+                rows.append(r)
+        return rows, (0 if rows else 1)
+    if kind == "sharding_report":
+        shape = rec.get("mesh_shape") or []
+        config = (f"{rec.get('model') or rec.get('fingerprint', '?')}"
+                  f":mesh" + "x".join(str(d) for d in shape))
+        rows = []
+        for metric in ("collective_bytes_per_step",
+                       "reshard_bytes_per_step", "grad_sync_bytes"):
+            r = _row("sharding_report", config, metric, rec.get(metric),
+                     "bytes", ts=rec.get("ts"))
+            if r:
+                rows.append(r)
+        return rows, (0 if rows else 1)
     if kind in ("serving_loadgen", "generation_loadgen",
                 "chaos_loadgen", "router_loadgen", "disagg_loadgen"):
         rows = _loadgen_rows(rec)

@@ -35,18 +35,31 @@ Options:
                   (default: FLAGS_memory_budget_bytes semantics — 0
                   auto-detects from the device, which on CPU means no
                   budget)
-  --mesh DP[,TP]  report --memory's peak PER CHIP under a dp(,tp) mesh
-                  ('8', '4,2'): each var's bytes divide by its shard
-                  count under the SpecLayout rules (parallel/layout.py
-                  — ZeRO moments over dp, params over tp, batch-major
+  --mesh DP[,TP[,FSDP]]
+                  report --memory's peak PER CHIP under a dp(,tp(,fsdp))
+                  mesh ('8', '4,2', '2,2,2'): each var's bytes divide by
+                  its shard count under the SpecLayout rules
+                  (parallel/layout.py — ZeRO moments over dp, params
+                  over tp, leading dims over fsdp, batch-major
                   feeds/transients over dp) instead of over-reporting
-                  the replicated footprint; needs no actual devices
+                  the replicated footprint; needs no actual devices.
+                  Also selects the mesh for --sharding.
+  --sharding      additionally run the static sharding analyzer
+                  (paddle_tpu/analysis/sharding) on each model under the
+                  --mesh layout (required) and print the per-op
+                  layout/reshard/cost table — predicted collective bytes
+                  per step, the top collectives, and any PTV060-063
+                  findings; emits one extra kind="sharding_report" JSONL
+                  record per model
   --self-check    lint two bundled in-process example programs (one
                   known-good, one with seeded defects), then run the
                   memory planner over a fixed sample of OP_TEST_MATRIX
                   pass ops (must not crash, must not raise PTV050 at
-                  the default budget) — the repo's CI self-lint,
-                  seconds-scale
+                  the default budget), then run the PTV verifier + the
+                  sharding analyzer over the MULTICHIP dryrun programs
+                  (moe_ffn, ring/ulysses attention, recompute segments,
+                  plus a SectionPipeline smoke) — the repo's CI
+                  self-lint, seconds-scale
   --self-check-memory
                   the same, but the planner sweeps EVERY tiny bench
                   builder and ALL matrix pass ops — minutes of work
@@ -64,6 +77,12 @@ and with --optimize additionally:
     {"kind": "graph_opt", "model": ..., "opt_level": L,
      "ops_before": N, "ops_after": M, "vars_eliminated": V,
      "passes": [{"name", "ops_before", "ops_after", "seconds", ...}]}
+and with --sharding additionally:
+    {"kind": "sharding_report", "model": ..., "mesh_shape": [...],
+     "collective_bytes_per_step": N, "reshard_bytes_per_step": R,
+     "grad_sync_bytes": G, "uncovered_op_types": [...],
+     "collectives": [{"kind", "bytes", "where", "axis"?, "note"?}],
+     "counts": {...}, "findings": [...]}
 """
 from __future__ import annotations
 
@@ -169,17 +188,67 @@ def memory_path(path, budget=None, mesh=None):
     return rec
 
 
+def _mesh_dims(mesh):
+    dims = [int(d) for d in str(mesh).replace("x", ",").split(",")
+            if str(d).strip()]
+    if not dims or any(d < 1 for d in dims) or len(dims) > 3:
+        raise ValueError(f"--mesh {mesh!r}: expected 'dp', 'dp,tp' or "
+                         f"'dp,tp,fsdp' positive ints")
+    return dims
+
+
+def sharding_path(path, mesh):
+    """Run the static sharding analyzer on one model path under a
+    device-free dp[,tp[,fsdp]] mesh -> kind="sharding_report" record
+    (ShardingReport.to_record plus model)."""
+    from paddle_tpu.analysis import analyze_program_sharding
+    from paddle_tpu.framework import Program
+    from paddle_tpu.parallel.layout import MeshDims, SpecLayout
+
+    prog_dict, feeds, fetches, label = _load_program_dict(path)
+    prog_dict = dict(prog_dict)
+    prog_dict.pop("op_versions", None)
+    program = Program.from_dict(dict(prog_dict, op_versions={}))
+    layout = SpecLayout(MeshDims(_mesh_dims(mesh)))
+    report = analyze_program_sharding(program, layout,
+                                      feed_names=feeds,
+                                      fetch_names=fetches)
+    return report.to_record(model=label)
+
+
+def _print_sharding_text(rec, out=sys.stdout):
+    from paddle_tpu.analysis.memory import _fmt_bytes
+    mesh = "x".join(str(d) for d in rec["mesh_shape"]) or "1"
+    axes = ",".join(rec["mesh_axes"])
+    dyn = " (lower bound: dynamic dims)" if rec["dynamic"] else ""
+    out.write(f"shard {rec['model']}  mesh={mesh} ({axes})  "
+              f"collective_bytes_per_step="
+              f"{_fmt_bytes(rec['collective_bytes_per_step'])}{dyn}  "
+              f"reshard={_fmt_bytes(rec['reshard_bytes_per_step'])}  "
+              f"grad_sync={_fmt_bytes(rec['grad_sync_bytes'])}\n")
+    if rec["uncovered_op_types"]:
+        out.write(f"  uncovered op types (PTV063): "
+                  f"{', '.join(rec['uncovered_op_types'])}\n")
+    if rec["collectives"]:
+        out.write(f"  {'collective':<14s} {'axis':<8s} {'bytes':>12s}"
+                  f"  where\n")
+        for c in rec["collectives"]:
+            note = f"  ({c['note']})" if c.get("note") else ""
+            out.write(f"  {c['kind']:<14s} {c.get('axis') or '-':<8s} "
+                      f"{c['bytes']:>12d}  {c['where']}{note}\n")
+    for f in rec["findings"]:
+        var = f" [{f['var']}]" if f.get("var") else ""
+        out.write(f"  {f['rule']} {f['severity']:5s} {f['where']}"
+                  f"{var}: {f['message']}\n")
+
+
 def _apply_mesh_to_plan(plan, program, mesh):
     """Divide every interval's bytes by its shard count under the
     layout table, then rebuild the timeline/peak in place."""
     from paddle_tpu.analysis.memory import _timeline
     from paddle_tpu.parallel.layout import MeshDims, SpecLayout
 
-    dims = [int(d) for d in str(mesh).replace("x", ",").split(",")
-            if str(d).strip()]
-    if not dims or any(d < 1 for d in dims) or len(dims) > 2:
-        raise ValueError(f"--mesh {mesh!r}: expected 'dp' or 'dp,tp' "
-                         f"positive ints")
+    dims = _mesh_dims(mesh)
     layout = SpecLayout(MeshDims(dims)).add_program(program)
     block = program.global_block()
     dp = layout.dp
@@ -324,10 +393,14 @@ def self_check(full_memory: bool = False) -> int:
     rc = _self_check_memory(full=full_memory)
     if rc:
         return rc
+    rc = _self_check_parallel()
+    if rc:
+        return rc
     print(f"self-check ok: clean program clean, seeded defects "
           f"{sorted(want)} all detected, memory planner clean on "
           + ("all bench builders and matrix ops" if full_memory
-             else "the matrix-op sample"))
+             else "the matrix-op sample")
+          + ", parallel dryrun programs verified + sharding-analyzed")
     return 0
 
 
@@ -412,6 +485,111 @@ def _self_check_memory(full: bool = False) -> int:
     return 0
 
 
+def _self_check_parallel() -> int:
+    """Verifier + sharding analyzer over the MULTICHIP dryrun programs
+    (parallel/moe.py, ring_attention.py, ulysses.py, recompute.py via
+    their Program-IR front-ends) so those modules stop bit-rotting
+    unverified, plus a single-chip SectionPipeline smoke for
+    parallel/pipeline.py (pure JAX — no Program IR to lint)."""
+    from paddle_tpu import Program, layers, program_guard
+    from paddle_tpu.analysis import (analyze_program_sharding,
+                                     verify_program)
+    from paddle_tpu.parallel.layout import MeshDims, SpecLayout
+    from paddle_tpu.parallel.recompute import \
+        rewrite_program_for_recompute
+
+    def build_moe():
+        main, startup = Program(), Program()
+        with program_guard(main, startup):
+            x = layers.data(name="x", shape=[4, 8], dtype="float32")
+            out, load = layers.moe_ffn(x, num_experts=2, d_ff=16)
+        return main, ["x"], [out.name, load.name], ("dp", "ep")
+
+    def build_attention(kind):
+        main, startup = Program(), Program()
+        with program_guard(main, startup):
+            q = layers.data(name="q", shape=[2, 8, 4],
+                            dtype="float32")
+            k = layers.data(name="k", shape=[2, 8, 4],
+                            dtype="float32")
+            v = layers.data(name="v", shape=[2, 8, 4],
+                            dtype="float32")
+            fn = layers.ring_attention if kind == "ring_attention" \
+                else layers.ulysses_attention
+            out = fn(q, k, v, causal=True)
+        return main, ["q", "k", "v"], [out.name], ("dp", "sp")
+
+    def build_recompute():
+        main, startup = Program(), Program()
+        with program_guard(main, startup):
+            x = layers.data(name="x", shape=[6], dtype="float32")
+            h1 = layers.fc(x, size=8, act="relu")
+            h2 = layers.fc(h1, size=8, act="relu")
+            out = layers.fc(h2, size=4)
+        rewrite_program_for_recompute(main, [h1.name, h2.name],
+                                      keep_names=[out.name])
+        return main, ["x"], [out.name], ("dp", "tp")
+
+    builds = {
+        "moe_ffn": build_moe,
+        "ring_attention": lambda: build_attention("ring_attention"),
+        "ulysses_attention":
+            lambda: build_attention("ulysses_attention"),
+        "recompute": build_recompute,
+    }
+    analyzed = 0
+    for name, build in builds.items():
+        try:
+            prog, feeds, fetches, axes = build()
+            res = verify_program(prog, feed_names=feeds,
+                                 fetch_names=fetches)
+        except Exception as e:  # noqa: BLE001 — classify
+            print(f"self-check FAILED: verifier crashed on parallel "
+                  f"program {name!r}: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+            return 1
+        if res.errors():
+            print(f"self-check FAILED: parallel program {name!r} has "
+                  f"verifier errors:", *res.errors(), sep="\n  ",
+                  file=sys.stderr)
+            return 1
+        layout = SpecLayout(MeshDims((2, 2), axes))
+        try:
+            rep = analyze_program_sharding(prog, layout,
+                                           feed_names=feeds,
+                                           fetch_names=fetches)
+        except Exception as e:  # noqa: BLE001
+            print(f"self-check FAILED: sharding analyzer crashed on "
+                  f"parallel program {name!r}: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
+            return 1
+        if rep.result.errors():
+            print(f"self-check FAILED: parallel program {name!r} has "
+                  f"sharding errors:", *rep.result.errors(),
+                  sep="\n  ", file=sys.stderr)
+            return 1
+        analyzed += 1
+
+    # pipeline.py is pure JAX (no Program IR): single-chip numerics
+    # smoke so the module at least imports and runs under this gate
+    try:
+        import jax.numpy as jnp
+        from paddle_tpu.parallel.pipeline import SectionPipeline
+        pipe = SectionPipeline(
+            [lambda p, h: jnp.tanh(h @ p["w"])] * 2, n_microbatches=2)
+        params = [{"w": jnp.full((4, 4), 0.1, jnp.float32)}] * 2
+        y = pipe.forward(params, jnp.ones((4, 4), jnp.float32))
+        if y.shape != (4, 4):
+            raise ValueError(f"forward shape {y.shape}")
+    except Exception as e:  # noqa: BLE001
+        print(f"self-check FAILED: SectionPipeline smoke: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+        return 1
+    print(f"parallel dryrun: {analyzed} programs verified + "
+          f"sharding-analyzed (2x2 mesh), SectionPipeline smoke ok")
+    return 0
+
+
 def main(argv=None):
     argv = list(argv if argv is not None else sys.argv[1:])
     if not argv or argv[0] in ("-h", "--help"):
@@ -427,6 +605,7 @@ def main(argv=None):
     check_shapes = "--no-shapes" not in argv
     optimize = "--optimize" in argv
     memory = "--memory" in argv
+    sharding = "--sharding" in argv
     opt_level = 2
     budget = None
     mesh = None
@@ -461,12 +640,16 @@ def main(argv=None):
                       "8 or 4,2)", file=sys.stderr)
                 return 2
         elif a in ("--jsonl", "--strict", "--no-shapes", "--optimize",
-                   "--memory"):
+                   "--memory", "--sharding"):
             continue
         else:
             paths.append(a)
     if not paths:
         print("no models given", file=sys.stderr)
+        return 2
+    if sharding and not mesh:
+        print("--sharding needs --mesh (e.g. --mesh 8 or --mesh 4,2)",
+              file=sys.stderr)
         return 2
 
     records = []
@@ -512,6 +695,21 @@ def main(argv=None):
                 print(json.dumps(mem_rec))
             else:
                 _print_memory_text(mem_rec)
+        if sharding:
+            try:
+                shard_rec = sharding_path(path, mesh)
+            except (ValueError, OSError, KeyError,
+                    json.JSONDecodeError) as e:
+                print(f"INVALID: {path}: {e}", file=sys.stderr)
+                return 2
+            records.append(shard_rec)
+            sevs = {f["severity"] for f in shard_rec["findings"]}
+            if "error" in sevs or (strict and "warn" in sevs):
+                failed = True
+            if as_jsonl:
+                print(json.dumps(shard_rec))
+            else:
+                _print_sharding_text(shard_rec)
     if out_path:
         with open(out_path, "a") as f:
             for rec in records:

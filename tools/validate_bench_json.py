@@ -41,6 +41,11 @@ Checks, per file (type auto-detected from content):
   lines with kind == "graph_opt" (tools/program_lint.py --optimize)
   carry the model/opt_level/ops_before/ops_after/vars_eliminated/
   passes contract the graph-optimization report section reads; lines
+  with kind == "sharding_report" (tools/program_lint.py --sharding,
+  also emitted by the FLAGS_sharding_verify gate's to_record) carry
+  the mesh shape/axes, the predicted collective/reshard/grad-sync
+  bytes per step, the priced-collective rows and the PTV06x findings
+  the sharding analysis report section reads; lines
   with kind == "trace_report" (tools/trace_report.py --out) carry the
   span/trace/request counts, the per-component breakdown_ms, the
   slowest-N rows and the consistency-audit verdict the tracing report
@@ -619,6 +624,81 @@ def validate_memory_plan(obj, where="memory_plan"):
     return errs
 
 
+def validate_sharding_report(obj, where="sharding_report"):
+    """kind="sharding_report" (tools/program_lint.py --sharding /
+    analysis/sharding.ShardingReport.to_record): the static layout-
+    propagation verdict — mesh, predicted collective/reshard/grad-sync
+    bytes per step, the priced-collective rows, and PTV06x findings."""
+    errs = []
+    if not isinstance(obj.get("fingerprint"), str):
+        errs.append(f"{where}: fingerprint must be a string")
+    shape = obj.get("mesh_shape")
+    if not isinstance(shape, list) or not shape or not all(
+            isinstance(d, int) and not isinstance(d, bool) and d >= 1
+            for d in shape):
+        errs.append(f"{where}: mesh_shape must be a non-empty list of "
+                    f"positive ints (got {shape!r})")
+    axes = obj.get("mesh_axes")
+    if not isinstance(axes, list) or not all(
+            isinstance(a, str) for a in axes):
+        errs.append(f"{where}: mesh_axes must be a list of strings")
+    elif isinstance(shape, list) and len(axes) != len(shape):
+        errs.append(f"{where}: mesh_axes {axes} and mesh_shape "
+                    f"{shape} disagree on rank")
+    for key in ("mesh_devices", "ops", "collective_bytes_per_step",
+                "reshard_bytes_per_step", "grad_sync_bytes"):
+        v = obj.get(key)
+        if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+            errs.append(f"{where}: {key} must be a non-negative int "
+                        f"(got {v!r})")
+    if not isinstance(obj.get("dynamic"), bool):
+        errs.append(f"{where}: dynamic must be a bool")
+    if not isinstance(obj.get("uncovered_op_types"), list):
+        errs.append(f"{where}: uncovered_op_types must be a list")
+    colls = obj.get("collectives")
+    if not isinstance(colls, list):
+        errs.append(f"{where}: collectives must be a list")
+        colls = []
+    total = 0
+    for i, c in enumerate(colls):
+        if not isinstance(c, dict):
+            errs.append(f"{where}: collectives[{i}] is not an object")
+            continue
+        for key in ("kind", "where"):
+            if not isinstance(c.get(key), str):
+                errs.append(f"{where}: collectives[{i}].{key} must be "
+                            f"a string")
+        v = c.get("bytes")
+        if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+            errs.append(f"{where}: collectives[{i}].bytes must be a "
+                        f"non-negative int (got {v!r})")
+        else:
+            total += v
+    # the rows are the TOP collectives of the total, never more than it
+    cb = obj.get("collective_bytes_per_step")
+    if isinstance(cb, int) and not isinstance(cb, bool) and total > cb:
+        errs.append(f"{where}: collectives rows sum {total} exceeds "
+                    f"collective_bytes_per_step={cb}")
+    # grad-sync and reshard components can never exceed the total
+    for key in ("reshard_bytes_per_step", "grad_sync_bytes"):
+        v = obj.get(key)
+        if isinstance(cb, int) and isinstance(v, int) \
+                and not isinstance(v, bool) and v > cb:
+            errs.append(f"{where}: {key}={v} exceeds "
+                        f"collective_bytes_per_step={cb}")
+    findings = obj.get("findings")
+    if not isinstance(findings, list):
+        errs.append(f"{where}: findings must be a list")
+        findings = []
+    for i, f in enumerate(findings):
+        if not isinstance(f, dict) or not isinstance(
+                f.get("rule"), str) or not f.get("rule", "").startswith(
+                "PTV06"):
+            errs.append(f"{where}: findings[{i}] must be an object "
+                        f"with a PTV06x rule")
+    return errs
+
+
 def validate_sharded_bench(obj, where):
     """kind="sharded_bench" (bench.py BENCH_MESH runs): the scaling
     facts a dp x tp ledger row must carry — mesh shape, per-chip
@@ -661,6 +741,17 @@ def validate_sharded_bench(obj, where):
     if not isinstance(cb, int) or isinstance(cb, bool) or cb < 0:
         errs.append(f"{where}: collective_bytes_per_step must be a "
                     f"non-negative int (got {cb!r})")
+    # optional closed-form gradient-sync reference (bench.py): when
+    # present it is a component of the per-op total above
+    gs = obj.get("grad_sync_bytes_per_step")
+    if gs is not None:
+        if not isinstance(gs, int) or isinstance(gs, bool) or gs < 0:
+            errs.append(f"{where}: grad_sync_bytes_per_step must be a "
+                        f"non-negative int (got {gs!r})")
+        elif isinstance(cb, int) and not isinstance(cb, bool) \
+                and gs > cb:
+            errs.append(f"{where}: grad_sync_bytes_per_step={gs} "
+                        f"exceeds collective_bytes_per_step={cb}")
     return errs
 
 
@@ -967,6 +1058,9 @@ def validate_jsonl(path):
                     rec, where=f"{path}:{ln}"))
             elif rec.get("kind") == "memory_plan":
                 errs.extend(validate_memory_plan(
+                    rec, where=f"{path}:{ln}"))
+            elif rec.get("kind") == "sharding_report":
+                errs.extend(validate_sharding_report(
                     rec, where=f"{path}:{ln}"))
             elif rec.get("kind") == "sharded_bench":
                 errs.extend(validate_sharded_bench(
